@@ -1,0 +1,92 @@
+"""Tensorization tests: packed tensors agree with the scalar predicates."""
+
+import numpy as np
+
+from tpu_scheduler import ClusterSnapshot
+from tpu_scheduler.core.predicates import node_selector_matches, pod_fits_resources
+from tpu_scheduler.ops.pack import CPU, MEM, build_selector_vocab, pack_snapshot, round_up
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+def test_round_up():
+    assert round_up(0, 128) == 128
+    assert round_up(1, 128) == 128
+    assert round_up(128, 128) == 128
+    assert round_up(129, 128) == 256
+    assert round_up(5, 1) == 5
+
+
+def test_pack_shapes_and_padding():
+    snap = synth_cluster(n_nodes=10, n_pending=20, n_bound=15, seed=1)
+    packed = pack_snapshot(snap, pod_block=16, node_block=8, label_block=4)
+    assert packed.num_nodes == 10 and packed.padded_nodes == 16
+    assert packed.num_pods == 20 and packed.padded_pods == 32
+    assert packed.node_valid.sum() == 10 and packed.pod_valid.sum() == 20
+    # Padding rows are inert: zero capacity, zero request.
+    assert (packed.node_avail[10:] == 0).all()
+    assert (packed.pod_req[20:] == 0).all()
+    assert packed.pod_req.dtype == np.int32 and packed.node_avail.dtype == np.int32
+
+
+def test_pack_units_and_bound_usage():
+    node = make_node("n0", cpu="4", memory="16Gi", labels={"zone": "a"})
+    bound = make_pod("b0", cpu="1500m", memory="2Gi", node_name="n0", phase="Running")
+    pend = make_pod("p0", cpu="250m", memory="512Mi")
+    snap = ClusterSnapshot.build([node], [bound, pend])
+    packed = pack_snapshot(snap, pod_block=1, node_block=1)
+    assert packed.node_alloc[0, CPU] == 4000
+    assert packed.node_alloc[0, MEM] == 16 * 2**20  # KiB
+    assert packed.node_avail[0, CPU] == 4000 - 1500
+    assert packed.node_avail[0, MEM] == (16 - 2) * 2**20
+    assert packed.pod_req[0, CPU] == 250
+    assert packed.pod_req[0, MEM] == 512 * 2**10
+
+
+def test_conservative_rounding():
+    # Allocatable 10000 bytes (9.76 KiB → floor 9), request 1025 bytes (→ ceil 2 KiB).
+    node = make_node("n", cpu="1", memory=10000)
+    pend = make_pod("p", cpu="100m", memory=1025)
+    snap = ClusterSnapshot.build([node], [pend])
+    packed = pack_snapshot(snap)
+    assert packed.node_avail[0, MEM] == 9
+    assert packed.pod_req[0, MEM] == 2
+
+
+def test_selector_bitmap_matches_scalar():
+    snap = synth_cluster(n_nodes=30, n_pending=50, seed=2, selector_fraction=0.6)
+    packed = pack_snapshot(snap)
+    pending = snap.pending_pods()
+    counts = packed.pod_sel @ packed.node_labels.T  # [P, N]
+    for i, pod in enumerate(pending):
+        for j, node in enumerate(snap.nodes):
+            batched = counts[i, j] == packed.pod_sel_count[i]
+            assert batched == node_selector_matches(pod, node), (pod.name, node.name)
+
+
+def test_feasibility_conservative_vs_scalar():
+    # Whole-KiB quantities → packed fit decision equals the scalar oracle.
+    snap = synth_cluster(n_nodes=20, n_pending=40, n_bound=30, seed=3)
+    packed = pack_snapshot(snap)
+    pending = snap.pending_pods()
+    for i, pod in enumerate(pending):
+        for j, node in enumerate(snap.nodes):
+            fits = bool((packed.pod_req[i] <= packed.node_avail[j]).all())
+            assert fits == pod_fits_resources(pod, node, snap), (pod.name, node.name)
+
+
+def test_vocab_only_covers_selectors():
+    snap = synth_cluster(n_nodes=50, n_pending=10, seed=4, selector_fraction=0.0)
+    vocab = build_selector_vocab(snap.pending_pods())
+    assert vocab == {}
+    packed = pack_snapshot(snap)
+    assert packed.pod_sel.shape[1] >= 1  # padded to at least one column
+    assert (packed.pod_sel_count == 0).all()
+
+
+def test_overcommitted_node_negative_avail():
+    node = make_node("n", cpu="1", memory="1Gi")
+    b1 = make_pod("b1", cpu="2", memory="2Gi", node_name="n", phase="Running")
+    snap = ClusterSnapshot.build([node], [b1, make_pod("p", cpu="100m", memory="1Mi")])
+    packed = pack_snapshot(snap)
+    assert packed.node_avail[0, CPU] == -1000
+    assert not (packed.pod_req[0] <= packed.node_avail[0]).all()

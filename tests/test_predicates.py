@@ -1,0 +1,135 @@
+"""Scalar predicate tests.
+
+Ports the reference's three rstest cases for the nodeSelector predicate
+(reference src/predicates/test.rs:42-58) and adds the coverage the reference
+skipped (resource fit, chain ordering, util helpers) — SURVEY.md §4.
+"""
+
+import pytest
+
+from tpu_scheduler import ClusterSnapshot, InvalidNodeReason, check_node_validity, full_name, is_pod_bound
+from tpu_scheduler.api.objects import Node, ObjectMeta, Pod, total_pod_resources
+from tpu_scheduler.core.predicates import node_selector_matches, pod_fits_resources
+from tpu_scheduler.testing import make_node, make_pod
+
+NODE_NAME = "node1"
+
+
+@pytest.fixture
+def test_node():
+    # Mirrors the reference fixture: node labelled name=node1 (test.rs:30-40).
+    return make_node(NODE_NAME, cpu="4", memory="16Gi", labels={"name": NODE_NAME})
+
+
+def snap(nodes, pods=()):
+    return ClusterSnapshot.build(nodes, pods)
+
+
+# --- the three reference cases (test.rs:42-58) ---
+
+
+def test_does_node_selector_match_no_selector(test_node):
+    pod = make_pod("pod1", namespace="test", node_selector=None)
+    assert node_selector_matches(pod, test_node) is True
+
+
+def test_does_node_selector_match_false(test_node):
+    pod = make_pod("pod1", namespace="test", node_selector={"foo": "bar"})
+    assert node_selector_matches(pod, test_node) is False
+
+
+def test_does_node_selector_match_true(test_node):
+    pod = make_pod("pod1", namespace="test", node_selector={"name": NODE_NAME})
+    assert node_selector_matches(pod, test_node) is True
+
+
+# --- coverage the reference skipped ---
+
+
+def test_selector_fails_on_unlabelled_node():
+    # Reference: node with no labels fails any selector (predicates.rs:55-58).
+    node = make_node("bare", labels=None)
+    pod = make_pod("p", node_selector={"a": "b"})
+    assert node_selector_matches(pod, node) is False
+
+
+def test_selector_requires_all_keys(test_node):
+    pod = make_pod("p", node_selector={"name": NODE_NAME, "zone": "z1"})
+    assert node_selector_matches(pod, test_node) is False
+
+
+def test_pod_fits_empty_node(test_node):
+    pod = make_pod("p", cpu="2", memory="8Gi")
+    assert pod_fits_resources(pod, test_node, snap([test_node])) is True
+
+
+def test_pod_too_big(test_node):
+    pod = make_pod("p", cpu="8", memory="1Gi")
+    assert pod_fits_resources(pod, test_node, snap([test_node])) is False
+    pod2 = make_pod("p2", cpu="1", memory="32Gi")
+    assert pod_fits_resources(pod2, test_node, snap([test_node])) is False
+
+
+def test_fit_accounts_for_bound_pods(test_node):
+    # 4 cores total; 3 cores bound → a 2-core pod no longer fits.
+    bound = make_pod("b", cpu="3", memory="1Gi", node_name=NODE_NAME, phase="Running")
+    s = snap([test_node], [bound])
+    assert pod_fits_resources(make_pod("p", cpu="2", memory="1Gi"), test_node, s) is False
+    assert pod_fits_resources(make_pod("p", cpu="1", memory="1Gi"), test_node, s) is True
+
+
+def test_fit_exact_boundary(test_node):
+    # Reference uses <= (predicates.rs:42): an exactly-fitting pod fits.
+    pod = make_pod("p", cpu="4", memory="16Gi")
+    assert pod_fits_resources(pod, test_node, snap([test_node])) is True
+
+
+def test_node_without_allocatable_fits_only_zero_request():
+    node = Node(metadata=ObjectMeta(name="empty"))
+    zero = Pod(metadata=ObjectMeta(name="z"))
+    assert pod_fits_resources(zero, node, snap([node])) is True
+    assert pod_fits_resources(make_pod("p", cpu="100m", memory="1Mi"), node, snap([node])) is False
+
+
+def test_check_node_validity_order(test_node):
+    # Resource failure is reported before selector failure (predicates.rs:68,72).
+    pod = make_pod("p", cpu="100", memory="1Ti", node_selector={"foo": "bar"})
+    assert check_node_validity(pod, test_node, snap([test_node])) is InvalidNodeReason.NOT_ENOUGH_RESOURCES
+    pod2 = make_pod("p", cpu="1", memory="1Gi", node_selector={"foo": "bar"})
+    assert check_node_validity(pod2, test_node, snap([test_node])) is InvalidNodeReason.NODE_SELECTOR_MISMATCH
+    pod3 = make_pod("p", cpu="1", memory="1Gi", node_selector={"name": NODE_NAME})
+    assert check_node_validity(pod3, test_node, snap([test_node])) is None
+
+
+# --- util.rs helpers (reference left them untested) ---
+
+
+def test_total_pod_resources_sums_containers():
+    pod = make_pod("p", cpu="250m", memory="256Mi")
+    from tpu_scheduler.api.objects import Container, ResourceRequirements
+
+    pod.spec.containers.append(
+        Container(name="c2", resources=ResourceRequirements(requests={"cpu": "750m", "memory": "768Mi"}))
+    )
+    pod.spec.containers.append(Container(name="no-req"))
+    res = total_pod_resources(pod)
+    assert res.cpu == 1000
+    assert res.memory == 1024 * 2**20
+
+
+def test_is_pod_bound_and_full_name():
+    assert is_pod_bound(make_pod("p", node_name="n1")) is True
+    assert is_pod_bound(make_pod("p")) is False
+    assert is_pod_bound(Pod(metadata=ObjectMeta(name="specless"))) is False
+    assert full_name(make_pod("p", namespace="ns")) == "ns/p"
+    assert full_name(make_node("n")) == "n"
+
+
+def test_pending_pods_filter():
+    bound = make_pod("b", node_name="n1", phase="Running")
+    pending = make_pod("q")
+    # Bound-but-still-Pending pod must be skipped (main.rs:74-76 skips bound).
+    bound_pending = make_pod("bp", node_name="n1", phase="Pending")
+    s = ClusterSnapshot.build([make_node("n1")], [bound, pending, bound_pending])
+    assert s.pending_pods() == [pending]
+    assert {p.name for p in s.pods_on_node("n1")} == {"b", "bp"}

@@ -1,0 +1,77 @@
+"""Quantity parsing — kube_quantity-parity semantics (reference util.rs:17-36)."""
+
+from fractions import Fraction
+
+import pytest
+
+from tpu_scheduler.api.quantity import (
+    QuantityError,
+    bytes_to_memory_str,
+    cpu_to_millis,
+    memory_to_bytes,
+    millis_to_cpu_str,
+    parse_quantity,
+)
+
+
+@pytest.mark.parametrize(
+    "s,expected",
+    [
+        ("0", 0),
+        ("1", 1000),
+        ("2", 2000),
+        ("500m", 500),
+        ("0.5", 500),
+        ("1.5", 1500),
+        ("100u", 1),  # ceil of 0.1 millicores
+        ("1n", 1),  # ceil
+        ("2k", 2_000_000),
+        (2, 2000),
+        (0.25, 250),
+    ],
+)
+def test_cpu_to_millis(s, expected):
+    assert cpu_to_millis(s) == expected
+
+
+@pytest.mark.parametrize(
+    "s,expected",
+    [
+        ("0", 0),
+        ("128974848", 128974848),
+        ("129e6", 129_000_000),
+        ("1G", 1_000_000_000),
+        ("1Gi", 2**30),
+        ("2Gi", 2 * 2**30),
+        ("1.5Gi", 3 * 2**29),
+        ("64Mi", 64 * 2**20),
+        ("1Ki", 1024),
+        ("100m", 1),  # 0.1 bytes ceils to 1
+        ("1Ti", 2**40),
+        (4096, 4096),
+    ],
+)
+def test_memory_to_bytes(s, expected):
+    assert memory_to_bytes(s) == expected
+
+
+def test_parse_exact_fraction():
+    assert parse_quantity("0.1") == Fraction(1, 10)
+    assert parse_quantity("-2Ki") == -2048
+    assert parse_quantity("+3M") == 3_000_000
+    assert parse_quantity("1E") == 10**18
+    assert parse_quantity("1Ei") == 2**60
+    assert parse_quantity("12e-3") == Fraction(12, 1000)
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1Qi", "1.2.3", "e5", "--1", "1 Gi", "Gi"])
+def test_invalid_quantities(bad):
+    with pytest.raises(QuantityError):
+        parse_quantity(bad)
+
+
+def test_roundtrip_strings():
+    assert millis_to_cpu_str(2000) == "2"
+    assert millis_to_cpu_str(500) == "500m"
+    assert bytes_to_memory_str(2**30) == "1Gi"
+    assert bytes_to_memory_str(1_000_000_000) == "1000000000"

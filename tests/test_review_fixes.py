@@ -1,0 +1,89 @@
+"""Regression tests for code-review findings: int32 saturation, remainder
+blocks, repack_avail validation + incremental semantics, jax-free native path.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_scheduler import ClusterSnapshot
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+from tpu_scheduler.ops.pack import INT32_MAX, pack_snapshot, repack_avail
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+def test_huge_node_memory_saturates_not_wraps():
+    # 4 TiB = 2^32 KiB would wrap int32 to 0; must clamp to INT32_MAX instead.
+    node = make_node("big", cpu="64", memory="4Ti")
+    pod = make_pod("p", cpu="1", memory="1Ti")
+    packed = pack_snapshot(ClusterSnapshot.build([node], [pod]))
+    assert packed.node_avail[0, 1] == INT32_MAX
+    result = NativeBackend().schedule(packed)
+    assert result.bindings == [("default/p", "big")]  # node usable, not "full"
+
+
+def test_huge_pod_request_unschedulable_not_wrapped():
+    node = make_node("n", cpu="64", memory="1Ti")
+    pod = make_pod("p", cpu="1", memory="8Ti")  # > int32 KiB → clamp, never fits
+    packed = pack_snapshot(ClusterSnapshot.build([node], [pod]))
+    assert packed.pod_req[0, 1] == INT32_MAX
+    result = NativeBackend().schedule(packed)
+    assert result.unschedulable == ["default/p"]
+
+
+def test_assign_remainder_block_stays_blockwise():
+    # padded_pods=384 not divisible by block=256: jax path must pad, and the
+    # result must match native (which chunks with a remainder) exactly.
+    from tpu_scheduler.backends.tpu import TpuBackend
+
+    snap = synth_cluster(n_nodes=16, n_pending=300, seed=21)
+    packed = pack_snapshot(snap, pod_block=128)
+    assert packed.padded_pods % 256 != 0
+    profile = DEFAULT_PROFILE.with_(pod_block=256)
+    native = NativeBackend().schedule(packed, profile)
+    tpu = TpuBackend().schedule(packed, profile)
+    assert (native.assigned == tpu.assigned).all()
+
+
+def test_repack_avail_incremental():
+    snap = synth_cluster(n_nodes=8, n_pending=10, n_bound=4, seed=5)
+    packed = pack_snapshot(snap)
+    # Bind one more pod to node-0 and refresh.
+    extra = make_pod("extra", cpu="1", memory="1Gi", node_name="node-0", phase="Running")
+    snap2 = ClusterSnapshot.build(snap.nodes, list(snap.pods) + [extra])
+    packed2 = repack_avail(packed, snap2)
+    assert packed2.node_avail[0, 0] == packed.node_avail[0, 0] - 1000
+    assert (packed2.pod_req == packed.pod_req).all()  # pod tensors untouched
+    assert packed2.node_labels is packed.node_labels
+
+
+def test_repack_avail_rejects_node_set_change():
+    snap = synth_cluster(n_nodes=4, n_pending=5, seed=6)
+    packed = pack_snapshot(snap)
+    snap2 = ClusterSnapshot.build(list(snap.nodes)[:-1], snap.pods)
+    with pytest.raises(ValueError, match="identical node set"):
+        repack_avail(packed, snap2)
+    # Reordered nodes are also rejected (rows would misalign).
+    snap3 = ClusterSnapshot.build(list(snap.nodes)[::-1], snap.pods)
+    with pytest.raises(ValueError, match="identical node set"):
+        repack_avail(packed, snap3)
+
+
+def test_native_backend_is_jax_free():
+    # The recovery path must not import jax (BackendUnavailable fallback).
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"  # any import attempt raises ImportError
+        "sys.path.insert(0, '.')\n"
+        "from tpu_scheduler.backends.native import NativeBackend\n"
+        "from tpu_scheduler.ops.pack import pack_snapshot\n"
+        "from tpu_scheduler.testing import synth_cluster\n"
+        "r = NativeBackend().schedule(pack_snapshot(synth_cluster(4, 10, seed=0)))\n"
+        "print(len(r.bindings))\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "10"

@@ -1,0 +1,33 @@
+"""tpu_scheduler — a TPU-native scheduling framework.
+
+Capability parity with acrlabs/kube-scheduler-rs-reference (a Rust Kubernetes
+pod scheduler; see SURVEY.md), rebuilt TPU-first: the entire predicate filter
+plus priority scoring for all pending pods × all nodes runs as batched tensor
+ops (JAX/XLA, Pallas kernels, pjit/shard_map over device meshes) instead of a
+per-pod random-sample loop with per-candidate API round-trips.
+
+Layout:
+  api/       Kubernetes-shaped object model + quantity arithmetic  (ref L1)
+  core/      ClusterSnapshot + pure scalar predicates              (ref L2)
+  ops/       tensorization, masks, scoring, commit kernels         (the TPU hot path)
+  backends/  native (NumPy) and tpu (JAX) batched scheduling backends
+  parallel/  mesh / shard_map / ring-blockwise distribution
+  models/    scheduling policy profiles (score weights, chains)
+  runtime/   fake API server, reflector, controller loop           (ref L4)
+  utils/     tracing spans, metrics, checkpointing
+"""
+
+__version__ = "0.1.0"
+
+from .api.objects import (  # noqa: F401
+    Binding,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodResources,
+    full_name,
+    is_pod_bound,
+    total_pod_resources,
+)
+from .core.predicates import InvalidNodeReason, check_node_validity  # noqa: F401
+from .core.snapshot import ClusterSnapshot  # noqa: F401

@@ -1,0 +1,225 @@
+"""Kubernetes-shaped object model for the scheduler.
+
+Capability-parity with the slices of ``k8s-openapi`` the reference consumes
+(reference: ``src/util.rs``, ``src/predicates.rs``): Pod (metadata, spec
+containers/resources/nodeSelector/nodeName, status.phase), Node (metadata
+labels, status.allocatable), Binding (metadata + target ObjectReference).
+
+Objects are plain dataclasses; the tensor path never touches them per-pod —
+they exist for the control plane, the fake API server, and parity tests.
+Construction from k8s-style dict manifests is supported via ``from_dict`` so
+synthetic cluster generators and tests can speak YAML-shaped data.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from .quantity import cpu_to_millis, memory_to_bytes
+
+__all__ = [
+    "ObjectMeta",
+    "ResourceRequirements",
+    "Container",
+    "PodSpec",
+    "PodStatus",
+    "Pod",
+    "NodeStatus",
+    "Node",
+    "ObjectReference",
+    "Binding",
+    "PodResources",
+    "total_pod_resources",
+    "is_pod_bound",
+    "full_name",
+]
+
+_uid_counter = itertools.count(1)
+
+
+def _next_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str | None = None
+    labels: dict[str, str] | None = None
+    uid: str = field(default_factory=_next_uid)
+    resource_version: int = 0
+
+
+@dataclass
+class ResourceRequirements:
+    # Quantity strings ("500m", "2Gi") or numbers, keyed by resource name.
+    requests: dict[str, Any] | None = None
+    limits: dict[str, Any] | None = None
+
+
+@dataclass
+class Container:
+    name: str = ""
+    resources: ResourceRequirements | None = None
+
+
+@dataclass
+class PodSpec:
+    containers: list[Container] = field(default_factory=list)
+    node_selector: dict[str, str] | None = None
+    node_name: str | None = None
+    priority: int = 0
+    # Topology-spread / anti-affinity surface (BASELINE.json config 5):
+    # topology key -> max skew; anti-affinity label selector terms.
+    topology_spread: dict[str, int] | None = None
+    anti_affinity_labels: dict[str, str] | None = None
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec | None = None
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "Pod":
+        meta = d.get("metadata", {})
+        spec_d = d.get("spec")
+        spec = None
+        if spec_d is not None:
+            containers = [
+                Container(
+                    name=c.get("name", ""),
+                    resources=ResourceRequirements(
+                        requests=(c.get("resources") or {}).get("requests"),
+                        limits=(c.get("resources") or {}).get("limits"),
+                    )
+                    if c.get("resources") is not None
+                    else None,
+                )
+                for c in spec_d.get("containers", [])
+            ]
+            spec = PodSpec(
+                containers=containers,
+                node_selector=spec_d.get("nodeSelector"),
+                node_name=spec_d.get("nodeName"),
+                priority=spec_d.get("priority", 0),
+                topology_spread=spec_d.get("topologySpread"),
+                anti_affinity_labels=spec_d.get("antiAffinityLabels"),
+            )
+        status = PodStatus(phase=d.get("status", {}).get("phase", "Pending"))
+        return Pod(
+            metadata=ObjectMeta(
+                name=meta.get("name", ""),
+                namespace=meta.get("namespace"),
+                labels=meta.get("labels"),
+            ),
+            spec=spec,
+            status=status,
+        )
+
+
+@dataclass
+class NodeStatus:
+    # Quantity strings/numbers keyed by resource name ("cpu", "memory").
+    allocatable: dict[str, Any] | None = None
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus | None = None
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "Node":
+        meta = d.get("metadata", {})
+        status_d = d.get("status")
+        return Node(
+            metadata=ObjectMeta(
+                name=meta.get("name", ""),
+                namespace=meta.get("namespace"),
+                labels=meta.get("labels"),
+            ),
+            status=NodeStatus(allocatable=status_d.get("allocatable")) if status_d else None,
+        )
+
+
+@dataclass
+class ObjectReference:
+    name: str | None = None
+    kind: str = "Node"
+
+
+@dataclass
+class Binding:
+    """Pod→node binding, mirroring the Binding subresource the reference
+    POSTs at ``src/main.rs:83-115``."""
+
+    metadata: ObjectMeta
+    target: ObjectReference
+
+
+@dataclass
+class PodResources:
+    """(cpu millicores, memory bytes) pair with the arithmetic the reference
+    defines on ``PodResources`` (``src/util.rs:17-36``)."""
+
+    cpu: int = 0  # millicores
+    memory: int = 0  # bytes
+
+    def __isub__(self, other: "PodResources") -> "PodResources":
+        self.cpu -= other.cpu
+        self.memory -= other.memory
+        return self
+
+    def __iadd__(self, other: "PodResources") -> "PodResources":
+        self.cpu += other.cpu
+        self.memory += other.memory
+        return self
+
+
+def total_pod_resources(pod: Pod) -> PodResources:
+    """Sum container *requests* (cpu, memory) — reference ``src/util.rs:54-75``.
+
+    Containers without a resources/requests block contribute zero; resource
+    names other than cpu/memory are ignored, matching the reference.
+    """
+    out = PodResources()
+    if pod.spec is None:
+        return out
+    for c in pod.spec.containers:
+        if c.resources is None or c.resources.requests is None:
+            continue
+        req = c.resources.requests
+        if "cpu" in req:
+            out.cpu += cpu_to_millis(req["cpu"])
+        if "memory" in req:
+            out.memory += memory_to_bytes(req["memory"])
+    return out
+
+
+def is_pod_bound(pod: Pod) -> bool:
+    """True iff ``spec.nodeName`` is set — reference ``src/util.rs:38-45``."""
+    return pod.spec is not None and pod.spec.node_name is not None
+
+
+def full_name(obj: Pod | Node) -> str:
+    """"namespace/name" or bare name — reference ``src/util.rs:47-52``."""
+    if obj.metadata.namespace:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+    return obj.metadata.name

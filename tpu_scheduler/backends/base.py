@@ -1,0 +1,58 @@
+"""Scheduling-backend interface — the trait boundary of the north star.
+
+The reference gates everything behind ``check_node_validity``
+(``src/predicates.rs:63``); here the boundary is one cycle-level call: packed
+tensors in, per-pod node assignments out.  Two implementations with identical
+semantics: ``native`` (NumPy, the recovery/parity path) and ``tpu``
+(JAX/XLA).  Selected by the ``--backend={native,tpu}`` flag (runtime/cli).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
+from ..ops.pack import PackedCluster
+
+__all__ = ["CycleResult", "SchedulingBackend"]
+
+
+@dataclass
+class CycleResult:
+    """Outcome of one scheduling cycle."""
+
+    assigned: np.ndarray  # [num_pods] int32 — node index into packed.node_names, or −1
+    bindings: list[tuple[str, str]]  # (pod full name, node name) for assigned pods
+    unschedulable: list[str]  # pod full names with no feasible node this cycle
+    rounds: int
+    stats: dict = field(default_factory=dict)
+
+
+class SchedulingBackend(abc.ABC):
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
+        """Run the cycle over padded tensors; return (assigned [padded_pods], rounds)."""
+
+    def schedule(self, packed: PackedCluster, profile: SchedulingProfile = DEFAULT_PROFILE) -> CycleResult:
+        assigned_padded, rounds = self.assign(packed, profile)
+        assigned = np.asarray(assigned_padded)[: packed.num_pods]
+        bindings = []
+        unschedulable = []
+        for i, pod_name in enumerate(packed.pod_names):
+            j = int(assigned[i])
+            if j >= 0:
+                bindings.append((pod_name, packed.node_names[j]))
+            else:
+                unschedulable.append(pod_name)
+        return CycleResult(
+            assigned=assigned,
+            bindings=bindings,
+            unschedulable=unschedulable,
+            rounds=int(rounds),
+            stats={"backend": self.name},
+        )

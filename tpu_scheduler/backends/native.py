@@ -1,0 +1,87 @@
+"""Native (NumPy) batched backend — the ``--backend=native`` path.
+
+Same auction-round algorithm as ops/assign.py, expressed in NumPy.  It shares
+the mask/score expression trees (ops/masks.py, ops/score.py, xp-generic) so
+float behaviour is identical; the segmented prefix-sum is exact int64 clamped
+to INT32_MAX, which equals the TPU path's saturating scan (see
+ops/assign.py overflow note).  Serves three roles from SURVEY.md:
+  • parity oracle for the TPU backend (binding-for-binding equality),
+  • recovery path when the TPU backend is unavailable (§5 failure handling),
+  • the "native" side of the north star's --backend flag.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.profiles import SchedulingProfile
+from ..ops.masks import feasibility_block
+from ..ops.pack import INT32_MAX, PackedCluster
+from ..ops.score import score_block
+from .base import SchedulingBackend
+
+__all__ = ["NativeBackend"]
+
+
+class NativeBackend(SchedulingBackend):
+    name = "native"
+
+    def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
+        node_alloc, node_avail = packed.node_alloc, packed.node_avail
+        node_labels, node_valid = packed.node_labels, packed.node_valid
+        weights = profile.weights()
+        p = packed.padded_pods
+        n = packed.padded_nodes
+        block = profile.pod_block
+
+        perm = np.argsort(-packed.pod_prio, kind="stable")
+        req = packed.pod_req[perm]
+        sel = packed.pod_sel[perm]
+        selc = packed.pod_sel_count[perm]
+        valid = packed.pod_valid[perm]
+
+        avail = node_avail.copy()
+        assigned = np.full((p,), -1, dtype=np.int32)
+        active = valid.copy()
+        rounds = 0
+
+        while rounds < profile.max_rounds and active.any():
+            choice = np.zeros((p,), dtype=np.int32)
+            has = np.zeros((p,), dtype=bool)
+            for lo in range(0, p, block):
+                hi = min(lo + block, p)
+                m = feasibility_block(np, req[lo:hi], sel[lo:hi], selc[lo:hi], active[lo:hi], avail, node_labels, node_valid)
+                sc = score_block(np, req[lo:hi], node_alloc, avail, weights)
+                sc = np.where(m, sc, -np.inf)
+                choice[lo:hi] = sc.argmax(axis=1).astype(np.int32)
+                has[lo:hi] = m.any(axis=1)
+
+            cand = active & has
+            ch = np.where(cand, choice, n).astype(np.int32)
+            claim = np.where(cand[:, None], req, 0)
+
+            order = np.argsort(ch, kind="stable")
+            ch_s = ch[order]
+            claim_s = claim[order].astype(np.int64)
+            cum = claim_s.cumsum(axis=0)
+            is_start = np.concatenate([[True], ch_s[1:] != ch_s[:-1]])
+            start_idx = np.maximum.accumulate(np.where(is_start, np.arange(p), 0))
+            base = (cum - claim_s)[start_idx]
+            within = np.minimum(cum - base, INT32_MAX)
+
+            avail_ext = np.concatenate([avail, np.zeros((1, 2), avail.dtype)], axis=0)
+            fits_prefix = (within <= avail_ext[ch_s]).all(-1)
+            acc_s = fits_prefix & (ch_s < n)
+            accepted = np.zeros((p,), dtype=bool)
+            accepted[order] = acc_s
+
+            assigned = np.where(accepted, choice, assigned)
+            dec = np.zeros((n + 1, 2), dtype=np.int64)
+            np.add.at(dec, ch, np.where(accepted[:, None], req, 0).astype(np.int64))
+            avail = (avail.astype(np.int64) - dec[:n]).astype(np.int32)
+            active = cand & ~accepted
+            rounds += 1
+
+        out = np.full((p,), -1, dtype=np.int32)
+        out[perm] = assigned
+        return out, rounds

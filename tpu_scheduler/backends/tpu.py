@@ -1,0 +1,68 @@
+"""TPU (JAX/XLA) batched backend — the ``--backend=tpu`` path.
+
+Ships the packed tensors to device once per cycle and runs the whole
+filter+score+commit auction under one jit (ops/assign.py).  Works on any JAX
+platform (tests run it on CPU; the benchmark on a real v5e chip); the class
+is named for its design target.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import BackendUnavailable
+from ..models.profiles import SchedulingProfile
+from ..ops.assign import assign_cycle
+from ..ops.pack import PackedCluster
+from .base import SchedulingBackend
+
+__all__ = ["TpuBackend"]
+
+
+class TpuBackend(SchedulingBackend):
+    name = "tpu"
+
+    def __init__(self, device=None):
+        try:
+            import jax
+        except Exception as e:  # pragma: no cover - jax is baked into the image
+            raise BackendUnavailable(f"jax unavailable: {e}") from e
+        self._jax = jax
+        if device is None:
+            devices = jax.devices()
+            if not devices:
+                raise BackendUnavailable("no jax devices")
+            device = devices[0]
+        self.device = device
+
+    def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
+        jax = self._jax
+        a = packed.device_arrays()
+        put = {k: jax.device_put(v, self.device) for k, v in a.items()}
+        weights = jax.device_put(profile.weights(), self.device)
+        assigned, rounds, _avail = assign_cycle(
+            put["node_alloc"],
+            put["node_avail"],
+            put["node_labels"],
+            put["node_valid"],
+            put["pod_req"],
+            put["pod_sel"],
+            put["pod_sel_count"],
+            put["pod_prio"],
+            put["pod_valid"],
+            weights,
+            max_rounds=profile.max_rounds,
+            block=profile.pod_block,
+        )
+        return np.asarray(jax.device_get(assigned)), int(rounds)
+
+
+def make_backend(name: str, **kw) -> SchedulingBackend:
+    """Factory for the --backend flag."""
+    from .native import NativeBackend
+
+    if name == "native":
+        return NativeBackend()
+    if name == "tpu":
+        return TpuBackend(**kw)
+    raise ValueError(f"unknown backend {name!r} (expected 'native' or 'tpu')")

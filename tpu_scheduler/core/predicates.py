@@ -1,0 +1,83 @@
+"""Scalar (per pod, per node) predicates — pure reference semantics.
+
+Mirrors ``src/predicates.rs:20-77`` exactly, minus the I/O: where the
+reference lists pods live from the API server inside ``can_pod_fit``
+(``predicates.rs:21-34``), these functions take a ``ClusterSnapshot``.  The
+scalar path is the semantic oracle: the batched native and TPU backends must
+agree with it pod-by-pod (tests/test_backends_parity.py).
+
+Predicate registry: predicates are named, ordered, and composable, so the
+chain can grow (the reference hard-codes two, ``predicates.rs:63-77``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+from ..api.objects import Node, Pod, total_pod_resources
+from .snapshot import ClusterSnapshot, node_allocatable, node_used_resources
+
+__all__ = [
+    "InvalidNodeReason",
+    "pod_fits_resources",
+    "node_selector_matches",
+    "check_node_validity",
+    "PREDICATE_CHAIN",
+]
+
+
+class InvalidNodeReason(enum.Enum):
+    """Typed failure reason — reference ``predicates.rs:14-18``."""
+
+    NOT_ENOUGH_RESOURCES = "NotEnoughResources"
+    NODE_SELECTOR_MISMATCH = "NodeSelectorMismatch"
+    ANTI_AFFINITY_VIOLATION = "AntiAffinityViolation"  # beyond reference (config 5)
+
+
+def pod_fits_resources(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> bool:
+    """Resource-fit predicate — reference ``can_pod_fit``
+    (``predicates.rs:20-43``).
+
+    available = node.status.allocatable − Σ requests of pods on the node;
+    fits iff request.cpu ≤ available.cpu AND request.memory ≤ available.memory.
+    A node with no allocatable has zero available (only zero-request pods fit).
+    """
+    available = node_allocatable(node)
+    available -= node_used_resources(snapshot, node.name)
+    req = total_pod_resources(pod)
+    return req.cpu <= available.cpu and req.memory <= available.memory
+
+
+def node_selector_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
+    """nodeSelector predicate — reference ``does_node_selector_match``
+    (``predicates.rs:45-61``).
+
+    Every selector key must equal the node label exactly; a pod with no
+    selector matches vacuously; a node with no labels fails any selector.
+    """
+    if pod.spec is None or not pod.spec.node_selector:
+        return True
+    labels = node.metadata.labels
+    if not labels:
+        return False
+    return all(labels.get(k) == v for k, v in pod.spec.node_selector.items())
+
+
+# Ordered chain: fixed resource-then-selector order, as in the reference
+# (``predicates.rs:68,72``).  Each entry: (reason-on-failure, predicate fn).
+PREDICATE_CHAIN: list[tuple[InvalidNodeReason, Callable[[Pod, Node, ClusterSnapshot], bool]]] = [
+    (InvalidNodeReason.NOT_ENOUGH_RESOURCES, pod_fits_resources),
+    (InvalidNodeReason.NODE_SELECTOR_MISMATCH, node_selector_matches),
+]
+
+
+def check_node_validity(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> InvalidNodeReason | None:
+    """Run the predicate chain; return the first failure reason or None if
+    the node is valid — reference ``check_node_validity``
+    (``predicates.rs:63-77``, which returns ``Result<(), InvalidNodeReason>``).
+    """
+    for reason, pred in PREDICATE_CHAIN:
+        if not pred(pod, node, snapshot):
+            return reason
+    return None

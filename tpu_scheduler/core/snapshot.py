@@ -1,0 +1,77 @@
+"""ClusterSnapshot — an immutable, I/O-free view of cluster state.
+
+The reference's resource predicate reaches straight to the API server from
+inside the filter (``src/predicates.rs:21-34`` lists pods live per candidate
+node — its single most expensive operation, and the source of its TOCTOU
+race).  This framework instead evaluates every predicate against an explicit
+snapshot taken once per scheduling cycle: predicates become pure functions,
+fully unit-testable (fixing the untestability called out in SURVEY.md §4), and
+the snapshot is exactly what gets packed into device tensors (ops/pack.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from ..api.objects import Node, Pod, PodResources, is_pod_bound, total_pod_resources
+from ..api.quantity import cpu_to_millis, memory_to_bytes
+
+__all__ = ["ClusterSnapshot", "node_allocatable", "node_used_resources"]
+
+
+def node_allocatable(node: Node) -> PodResources:
+    """Allocatable (cpu millicores, memory bytes) of a node.
+
+    Matches reference semantics (``src/predicates.rs:28-32``): a node without
+    ``status.allocatable`` has zero allocatable of both resources.
+    """
+    out = PodResources()
+    if node.status is not None and node.status.allocatable is not None:
+        alloc = node.status.allocatable
+        if "cpu" in alloc:
+            out.cpu = cpu_to_millis(alloc["cpu"])
+        if "memory" in alloc:
+            out.memory = memory_to_bytes(alloc["memory"])
+    return out
+
+
+@dataclass(frozen=True)
+class ClusterSnapshot:
+    """Point-in-time cluster state: all nodes + all pods.
+
+    ``pods`` includes both bound pods (they consume node capacity) and
+    pending pods (the scheduling workload).
+    """
+
+    nodes: tuple[Node, ...]
+    pods: tuple[Pod, ...]
+    _pods_by_node: dict[str, list[Pod]] = field(default_factory=dict, compare=False, repr=False)
+
+    @staticmethod
+    def build(nodes: Iterable[Node], pods: Iterable[Pod]) -> "ClusterSnapshot":
+        snap = ClusterSnapshot(nodes=tuple(nodes), pods=tuple(pods))
+        for p in snap.pods:
+            if p.spec is not None and p.spec.node_name is not None:
+                snap._pods_by_node.setdefault(p.spec.node_name, []).append(p)
+        return snap
+
+    def pods_on_node(self, node_name: str) -> list[Pod]:
+        """Snapshot equivalent of the reference's live field-selector list
+        ``spec.nodeName=<node>`` (``src/predicates.rs:22-26``)."""
+        return self._pods_by_node.get(node_name, [])
+
+    def pending_pods(self) -> list[Pod]:
+        """Pods the controller schedules: phase Pending and not yet bound
+        (reference filters the watch to ``status.phase=Pending`` at
+        ``src/main.rs:141-142`` and skips bound pods at ``src/main.rs:74-76``).
+        """
+        return [p for p in self.pods if p.status.phase == "Pending" and not is_pod_bound(p)]
+
+
+def node_used_resources(snapshot: ClusterSnapshot, node_name: str) -> PodResources:
+    """Sum of resource requests of pods bound to ``node_name``."""
+    used = PodResources()
+    for p in snapshot.pods_on_node(node_name):
+        used += total_pod_resources(p)
+    return used

@@ -1,0 +1,45 @@
+"""Error model — capability parity with the reference's ``src/error.rs:3-15``
+(``ReconcileError { CreateBindingFailed, CreateBindingObjectFailed,
+NoNodeFound }``) plus the new failure modes a batched TPU backend introduces.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "SchedulerError",
+    "ReconcileError",
+    "CreateBindingFailed",
+    "CreateBindingObjectFailed",
+    "NoNodeFound",
+    "BackendUnavailable",
+    "PackingError",
+]
+
+
+class SchedulerError(Exception):
+    """Base class for all framework errors."""
+
+
+class ReconcileError(SchedulerError):
+    """A reconcile-cycle failure; the controller's error policy requeues it."""
+
+
+class CreateBindingFailed(ReconcileError):
+    """The API server rejected the Binding POST."""
+
+
+class CreateBindingObjectFailed(ReconcileError):
+    """The Binding object could not be constructed/serialised."""
+
+
+class NoNodeFound(ReconcileError):
+    """No feasible node for the pod this cycle."""
+
+
+class BackendUnavailable(SchedulerError):
+    """The requested scheduling backend (e.g. TPU) cannot run; the controller
+    falls back to the native path (see runtime.controller)."""
+
+
+class PackingError(SchedulerError):
+    """Snapshot → tensor packing failed (e.g. invalid quantity)."""

@@ -1,0 +1,46 @@
+"""Scheduling profiles — named policy configurations.
+
+The reference has a single hard-coded policy (random candidate, first-fit,
+``src/main.rs:49-71``).  Here policy is data: score weights, commit-round
+budget, block sizes.  Profiles are the "models" of this framework — the
+flagship profile drives the benchmark cycle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+__all__ = ["SchedulingProfile", "DEFAULT_PROFILE", "PROFILES"]
+
+
+@dataclass(frozen=True)
+class SchedulingProfile:
+    name: str = "default"
+    # Score weights (kube-scheduler defaults both at 1).
+    least_requested_weight: float = 1.0
+    balanced_allocation_weight: float = 1.0
+    # Auction-round safety cap (rounds needed ≈ max per-node contention).
+    max_rounds: int = 32
+    # Pods per choose-block (caps peak [block, N] tile memory on device).
+    pod_block: int = 4096
+    # Topology-spread / anti-affinity (BASELINE.json config 5); weight 0 = off.
+    topology_weight: float = 0.0
+
+    def weights(self) -> np.ndarray:
+        return np.array([self.least_requested_weight, self.balanced_allocation_weight], dtype=np.float32)
+
+    def with_(self, **kw) -> "SchedulingProfile":
+        return replace(self, **kw)
+
+
+DEFAULT_PROFILE = SchedulingProfile()
+
+PROFILES: dict[str, SchedulingProfile] = {
+    "default": DEFAULT_PROFILE,
+    # Bin-packing flavour: prefer fuller nodes (negative least-requested).
+    "most-requested": SchedulingProfile(name="most-requested", least_requested_weight=-1.0),
+    # Pure spread on balanced allocation.
+    "balanced-only": SchedulingProfile(name="balanced-only", least_requested_weight=0.0),
+}

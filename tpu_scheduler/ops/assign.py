@@ -1,0 +1,172 @@
+"""Conflict-free batched assignment — the jitted scheduling cycle.
+
+This is the TPU replacement for the reference's sequential reconcile loop
+(``src/main.rs:51-71`` + the controller dispatch at ``main.rs:141-149``):
+instead of one pod at a time × ≤5 random candidates × one RPC each, all
+pending pods are assigned in a small number of *auction rounds*, entirely
+on-device, with capacity commits that make oversubscription impossible —
+closing the reference's by-design TOCTOU race (SURVEY.md §5: two concurrent
+reconciles can both fit the same gap).
+
+Round structure (all under ``lax.while_loop``; shapes static):
+  1. choose:  blockwise over pods — feasibility mask + scores vs the
+     *current* remaining capacity; per-pod masked argmax → choice[P].
+  2. accept:  pods are pre-permuted into (priority desc, FIFO) order; a
+     stable sort by chosen node groups each node's claimants in priority
+     order; a segmented saturating prefix-sum of their requests accepts the
+     longest prefix that fits remaining capacity.
+  3. commit:  accepted requests scatter-subtract from remaining capacity;
+     accepted pods leave the pool; pods with no feasible node drop out
+     (capacity only shrinks within a cycle, so they can never become
+     feasible again this cycle → they requeue, reference ``main.rs:122-125``).
+
+Every round with any claimant accepts at least the highest-priority claimant
+of each contended node, so the loop strictly progresses; ``max_rounds`` is a
+safety cap only.
+
+Overflow note: within-segment demand prefix-sums can exceed int32 (100k pods
+× multi-GiB requests in KiB), so the scan uses *saturating* int32 addition —
+associative for non-negatives, yielding exactly ``min(true_sum, INT32_MAX)``,
+which the native NumPy backend mirrors with exact int64 + clamp.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .masks import feasibility_block
+from .pack import INT32_MAX
+from .score import score_block
+
+__all__ = ["assign_cycle", "INT32_MAX"]
+
+
+def _sat_add(a, b):
+    """Saturating int32 add for non-negative operands: min(a+b, INT32_MAX)."""
+    s = a + b
+    return jnp.where(s < 0, INT32_MAX, s)
+
+
+def _seg_scan_op(x, y):
+    """Segmented saturating-sum operator for lax.associative_scan.
+
+    Elements are (segment_start_flag [.,1] bool, value [.,2] int32).
+    """
+    fx, vx = x
+    fy, vy = y
+    return fx | fy, jnp.where(fy, vy, _sat_add(vx, vy))
+
+
+def _choose(avail, active, req, sel, selc, node_alloc, node_labels, node_valid, weights, block):
+    """Per-pod best feasible node vs current capacity, blockwise over pods.
+
+    Never materialises the full [P,N] score matrix: peak live memory is one
+    [block, N] tile (HBM-bandwidth friendly; the pipeline analogue of
+    SURVEY.md §2b PP).
+    """
+    p = req.shape[0]
+
+    def one(args):
+        breq, bsel, bselc, bact = args
+        m = feasibility_block(jnp, breq, bsel, bselc, bact, avail, node_labels, node_valid)
+        sc = score_block(jnp, breq, node_alloc, avail, weights)
+        sc = jnp.where(m, sc, -jnp.inf)
+        return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
+
+    if block >= p:
+        return one((req, sel, selc, active))
+    nb = p // block  # caller guarantees p % block == 0 (assign_cycle pads)
+    choice, has = lax.map(
+        one,
+        (
+            req.reshape(nb, block, 2),
+            sel.reshape(nb, block, -1),
+            selc.reshape(nb, block),
+            active.reshape(nb, block),
+        ),
+    )
+    return choice.reshape(p), has.reshape(p)
+
+
+@partial(jax.jit, static_argnames=("max_rounds", "block"))
+def assign_cycle(
+    node_alloc,
+    node_avail,
+    node_labels,
+    node_valid,
+    pod_req,
+    pod_sel,
+    pod_sel_count,
+    pod_prio,
+    pod_valid,
+    weights,
+    max_rounds: int = 32,
+    block: int = 4096,
+):
+    """Assign all pending pods to nodes in one on-device cycle.
+
+    Returns (assigned [P] int32 — node index or −1, rounds int32,
+    remaining node_avail [N,2] int32).
+    """
+    p = pod_req.shape[0]
+    n = node_avail.shape[0]
+
+    # Pad the pod axis to a block multiple so the blockwise choose path is
+    # always exact — otherwise a remainder would silently materialise the
+    # full [P,N] score matrix and blow HBM at target scale (100k × 10k).
+    p_out = p
+    if block < p and p % block != 0:
+        extra = block - p % block
+        pod_req = jnp.pad(pod_req, ((0, extra), (0, 0)))
+        pod_sel = jnp.pad(pod_sel, ((0, extra), (0, 0)))
+        pod_sel_count = jnp.pad(pod_sel_count, ((0, extra),))
+        pod_prio = jnp.pad(pod_prio, ((0, extra),))
+        pod_valid = jnp.pad(pod_valid, ((0, extra),))
+        p = p + extra
+
+    # Priority order (priority desc, FIFO index asc); stable sort keeps FIFO.
+    perm = jnp.argsort(-pod_prio, stable=True)
+    req = pod_req[perm]
+    sel = pod_sel[perm]
+    selc = pod_sel_count[perm]
+    valid = pod_valid[perm]
+
+    def cond(state):
+        _, _, active, rounds = state
+        return (rounds < max_rounds) & active.any()
+
+    def body(state):
+        avail, assigned, active, rounds = state
+        choice, has = _choose(avail, active, req, sel, selc, node_alloc, node_labels, node_valid, weights, block)
+        cand = active & has
+        ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
+        claim = jnp.where(cand[:, None], req, 0)
+
+        # Group claimants per node, priority order preserved by stable sort.
+        order = jnp.argsort(ch, stable=True)
+        ch_s = ch[order]
+        claim_s = claim[order]
+        is_start = jnp.concatenate([jnp.ones((1,), bool), ch_s[1:] != ch_s[:-1]])[:, None]
+        _, within = lax.associative_scan(_seg_scan_op, (is_start, claim_s))
+
+        avail_ext = jnp.concatenate([avail, jnp.zeros((1, 2), avail.dtype)], axis=0)
+        fits_prefix = (within <= avail_ext[ch_s]).all(-1)
+        acc_s = fits_prefix & (ch_s < n)
+        accepted = jnp.zeros((p,), bool).at[order].set(acc_s)
+
+        assigned = jnp.where(accepted, choice, assigned)
+        dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], req, 0))
+        avail = avail - dec[:n]
+        active = cand & ~accepted
+        return avail, assigned, active, rounds + 1
+
+    state0 = (node_avail, jnp.full((p,), -1, jnp.int32), valid, jnp.int32(0))
+    avail, assigned, _, rounds = lax.while_loop(cond, body, state0)
+
+    # Back to original pod order (dropping block padding).
+    out = jnp.full((p,), -1, jnp.int32).at[perm].set(assigned)[:p_out]
+    return out, rounds, avail

@@ -1,0 +1,32 @@
+"""Batched feasibility mask — the tensor form of the predicate chain.
+
+Replaces the reference's per-(pod, node) checks (``src/predicates.rs:20-61``)
+with one [pods × nodes] boolean mask:
+
+  fit[p,n]  = all_r( pod_req[p,r] <= node_avail[n,r] )          (PodFitsResources)
+  sel[p,n]  = (pod_sel[p] · node_labels[n]) == pod_sel_count[p] (nodeSelector)
+  mask      = fit & sel & pod_active & node_valid
+
+Written against an ``xp`` array namespace (numpy or jax.numpy) so the native
+and TPU backends share one expression tree — bit-identical semantics by
+construction (tests/test_backends_parity.py).
+"""
+
+from __future__ import annotations
+
+__all__ = ["feasibility_block"]
+
+
+def feasibility_block(xp, pod_req, pod_sel, pod_sel_count, pod_active, node_avail, node_labels, node_valid):
+    """[B, N] feasibility of a block of pods against all nodes.
+
+    pod_req [B,2] int32, pod_sel [B,L] f32, pod_sel_count [B] f32,
+    pod_active [B] bool, node_avail [N,2] int32, node_labels [N,L] f32,
+    node_valid [N] bool.
+    """
+    fit = (pod_req[:, None, :] <= node_avail[None, :, :]).all(-1)
+    # Selector-pair counting: matches iff the node carries every selector pair.
+    # Counts are tiny integers — exact even through a bf16 MXU pass.
+    counts = pod_sel @ node_labels.T
+    sel = counts == pod_sel_count[:, None]
+    return fit & sel & node_valid[None, :] & pod_active[:, None]

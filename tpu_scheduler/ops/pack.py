@@ -1,0 +1,246 @@
+"""Tensorization: ClusterSnapshot → packed device tensors.
+
+This is the boundary between the object world (api/, core/) and the tensor
+world (ops/, backends/).  It replaces the reference's per-candidate live
+API-server list + quantity subtraction loop (``src/predicates.rs:21-38``)
+with a one-shot pack of the whole cluster:
+
+  node_alloc[N,2]  int32   total allocatable  (cpu millicores, memory KiB)
+  node_avail[N,2]  int32   remaining = allocatable − Σ bound-pod requests
+  node_labels[N,L] float32 bitmap over the selector-pair vocabulary
+  pod_req[P,2]     int32   pending-pod requests (millicores, KiB ceil)
+  pod_sel[P,L]     float32 selector bitmap; pod_sel_count[P] = #selector keys
+  pod_prio[P]      int32   pod priority (commit order tie-break)
+
+Unit choice: memory is KiB (not bytes) so everything fits int32 without
+enabling jax_enable_x64 (int64 on TPU is emulated and slow).  Rounding is
+conservative — allocatable floors, requests ceil, and values clamp to
+[INT32_MIN, INT32_MAX] (a >2 TiB node appears as 2 TiB; a >2 TiB request is
+effectively unschedulable) — so a fit decision made on packed tensors is
+always valid under the exact scalar predicates (core/predicates.py); see
+tests/test_pack.py.
+
+Label vocabulary: only (key, value) pairs that appear in some pending pod's
+nodeSelector can affect a decision, so the vocab is built from selectors, not
+from the (unbounded) node label space.  A selector matches a node iff the
+node carries every one of its pairs:  (pod_sel @ node_labels^T) == count.
+Vocabularies are dynamic per cycle; shapes are padded to static buckets so
+XLA recompiles only when a bucket grows (SURVEY.md §7 hard part (b)).
+
+Shapes are padded to multiples of (pod_block, node_block) with validity
+masks; padding rows have zero requests / zero capacity and are masked out of
+every decision.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from ..api.objects import Pod, total_pod_resources
+from ..api.quantity import cpu_to_millis, memory_to_bytes
+from ..core.snapshot import ClusterSnapshot
+
+__all__ = ["PackedCluster", "pack_snapshot", "repack_avail", "build_selector_vocab", "round_up", "INT32_MAX"]
+
+CPU, MEM = 0, 1  # resource axis indices
+INT32_MAX = 2**31 - 1
+INT32_MIN = -(2**31)
+
+
+def round_up(x: int, multiple: int) -> int:
+    if multiple <= 1:
+        return max(x, 1)
+    return max(((x + multiple - 1) // multiple) * multiple, multiple)
+
+
+def _clamp_i32(x64: np.ndarray) -> np.ndarray:
+    """int64 → int32 with saturation (never silent wraparound)."""
+    return np.clip(x64, INT32_MIN, INT32_MAX).astype(np.int32)
+
+
+@dataclass(frozen=True)
+class PackedCluster:
+    """Static-shape tensor view of one scheduling cycle's input."""
+
+    # Nodes (padded to N)
+    node_alloc: np.ndarray  # [N,2] int32 — total allocatable (millis, KiB)
+    node_avail: np.ndarray  # [N,2] int32 — remaining after bound pods
+    node_labels: np.ndarray  # [N,L] float32 — selector-pair bitmap
+    node_valid: np.ndarray  # [N]  bool
+    node_names: tuple[str, ...]  # real nodes only (len = num_nodes)
+
+    # Pending pods (padded to P)
+    pod_req: np.ndarray  # [P,2] int32 — (millis, KiB ceil)
+    pod_sel: np.ndarray  # [P,L] float32
+    pod_sel_count: np.ndarray  # [P] float32
+    pod_prio: np.ndarray  # [P] int32
+    pod_valid: np.ndarray  # [P]  bool
+    pod_names: tuple[str, ...]  # full names of real pending pods
+
+    vocab: dict[tuple[str, str], int]
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_names)
+
+    @property
+    def num_pods(self) -> int:
+        return len(self.pod_names)
+
+    @property
+    def padded_nodes(self) -> int:
+        return self.node_alloc.shape[0]
+
+    @property
+    def padded_pods(self) -> int:
+        return self.pod_req.shape[0]
+
+    def device_arrays(self) -> dict[str, np.ndarray]:
+        """The tensors that ship to the device (names → arrays)."""
+        return {
+            "node_alloc": self.node_alloc,
+            "node_avail": self.node_avail,
+            "node_labels": self.node_labels,
+            "node_valid": self.node_valid,
+            "pod_req": self.pod_req,
+            "pod_sel": self.pod_sel,
+            "pod_sel_count": self.pod_sel_count,
+            "pod_prio": self.pod_prio,
+            "pod_valid": self.pod_valid,
+        }
+
+
+def build_selector_vocab(pods: list[Pod]) -> dict[tuple[str, str], int]:
+    """Vocabulary of selector (key, value) pairs over the pending pods."""
+    vocab: dict[tuple[str, str], int] = {}
+    for p in pods:
+        if p.spec is not None and p.spec.node_selector:
+            for kv in p.spec.node_selector.items():
+                if kv not in vocab:
+                    vocab[kv] = len(vocab)
+    return vocab
+
+
+def _alloc_and_used64(snapshot: ClusterSnapshot, n_pad: int) -> tuple[np.ndarray, np.ndarray, dict[str, int]]:
+    """Exact int64 (allocatable, bound-usage) per node — shared by pack and
+    the incremental avail refresh."""
+    alloc64 = np.zeros((n_pad, 2), dtype=np.int64)
+    used64 = np.zeros((n_pad, 2), dtype=np.int64)
+    node_index: dict[str, int] = {}
+    for i, node in enumerate(snapshot.nodes):
+        node_index[node.name] = i
+        if node.status is not None and node.status.allocatable is not None:
+            alloc = node.status.allocatable
+            if "cpu" in alloc:
+                alloc64[i, CPU] = cpu_to_millis(alloc["cpu"])
+            if "memory" in alloc:
+                alloc64[i, MEM] = memory_to_bytes(alloc["memory"])
+    # Bound-pod usage, summed exactly in int64 bytes before the KiB floor.
+    for pod in snapshot.pods:
+        if pod.spec is not None and pod.spec.node_name is not None:
+            i = node_index.get(pod.spec.node_name)
+            if i is None:
+                continue  # bound to an unknown node; consumes nothing we track
+            res = total_pod_resources(pod)
+            used64[i, CPU] += res.cpu
+            used64[i, MEM] += res.memory
+    return alloc64, used64, node_index
+
+
+def _avail_i32(alloc64: np.ndarray, used64: np.ndarray) -> np.ndarray:
+    avail64 = alloc64 - used64
+    # Floor the available memory to KiB (conservative); cpu millis are exact.
+    return _clamp_i32(np.stack([avail64[:, CPU], np.floor_divide(avail64[:, MEM], 1024)], axis=1))
+
+
+def pack_snapshot(
+    snapshot: ClusterSnapshot,
+    pod_block: int = 128,
+    node_block: int = 128,
+    label_block: int = 8,
+    vocab: dict[tuple[str, str], int] | None = None,
+) -> PackedCluster:
+    """Pack a snapshot into static-shape tensors.
+
+    ``vocab`` may be supplied (e.g. reused across cycles by the reflector) as
+    long as it covers every selector pair among the pending pods; otherwise
+    it is built fresh.
+    """
+    pending = snapshot.pending_pods()
+    nodes = list(snapshot.nodes)
+    if vocab is None:
+        vocab = build_selector_vocab(pending)
+
+    n_real, p_real = len(nodes), len(pending)
+    n_pad = round_up(n_real, node_block)
+    p_pad = round_up(p_real, pod_block)
+    l_pad = round_up(len(vocab), label_block)
+
+    alloc64, used64, _ = _alloc_and_used64(snapshot, n_pad)
+    node_labels = np.zeros((n_pad, l_pad), dtype=np.float32)
+    node_valid = np.zeros((n_pad,), dtype=bool)
+    for i, node in enumerate(nodes):
+        node_valid[i] = True
+        labels = node.metadata.labels
+        if labels:
+            for kv in labels.items():
+                j = vocab.get(kv)
+                if j is not None:
+                    node_labels[i, j] = 1.0
+
+    node_alloc = _clamp_i32(np.stack([alloc64[:, CPU], alloc64[:, MEM] // 1024], axis=1))
+    node_avail = _avail_i32(alloc64, used64)
+
+    pod_req64 = np.zeros((p_pad, 2), dtype=np.int64)
+    pod_sel = np.zeros((p_pad, l_pad), dtype=np.float32)
+    pod_sel_count = np.zeros((p_pad,), dtype=np.float32)
+    pod_prio = np.zeros((p_pad,), dtype=np.int32)
+    pod_valid = np.zeros((p_pad,), dtype=bool)
+    pod_names = []
+    from ..api.objects import full_name
+
+    for i, pod in enumerate(pending):
+        res = total_pod_resources(pod)
+        pod_req64[i, CPU] = res.cpu
+        pod_req64[i, MEM] = -(-res.memory // 1024)  # ceil KiB (conservative)
+        pod_valid[i] = True
+        pod_names.append(full_name(pod))
+        if pod.spec is not None:
+            pod_prio[i] = pod.spec.priority
+            if pod.spec.node_selector:
+                for kv in pod.spec.node_selector.items():
+                    j = vocab.get(kv)
+                    if j is None:
+                        raise KeyError(f"selector pair {kv} missing from supplied vocab")
+                    pod_sel[i, j] = 1.0
+                pod_sel_count[i] = len(pod.spec.node_selector)
+
+    return PackedCluster(
+        node_alloc=node_alloc,
+        node_avail=node_avail,
+        node_labels=node_labels,
+        node_valid=node_valid,
+        node_names=tuple(n.name for n in nodes),
+        pod_req=_clamp_i32(pod_req64),
+        pod_sel=pod_sel,
+        pod_sel_count=pod_sel_count,
+        pod_prio=pod_prio,
+        pod_valid=pod_valid,
+        pod_names=tuple(pod_names),
+        vocab=dict(vocab),
+    )
+
+
+def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedCluster:
+    """Cheap refresh of ``node_avail`` from a new snapshot over the *same*
+    node set — the incremental-update path the reflector uses between full
+    packs (device-resident node tensor, SURVEY.md §3.3).  Only capacity
+    bookkeeping is recomputed; pod tensors and label bitmaps are untouched.
+    """
+    fresh_names = tuple(n.name for n in snapshot.nodes)
+    if fresh_names != packed.node_names:
+        raise ValueError("repack_avail requires an identical node set/order; run a full pack_snapshot instead")
+    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes)
+    return replace(packed, node_avail=_avail_i32(alloc64, used64))

@@ -1,0 +1,36 @@
+"""Priority scoring — LeastRequested + BalancedAllocation as dense tensor ops
+(BASELINE.json config 4).
+
+The reference has *no* scoring (first feasible random candidate wins,
+``src/main.rs:51-71``); this implements the standard kube-scheduler pair the
+north star mandates, over the packed tensors:
+
+  used_after[p,n,r] = (alloc[n,r] − avail[n,r]) + req[p,r]
+  frac              = used_after / alloc              (1.0 where alloc == 0)
+  least_requested   = mean_r(1 − frac) · 100
+  balanced          = (1 − |frac_cpu − frac_mem|) · 100
+  score             = w_lr · least_requested + w_ba · balanced
+
+xp-generic (numpy / jax.numpy): one expression tree for both backends, all
+float32 elementwise, so native and TPU scores agree bitwise.
+"""
+
+from __future__ import annotations
+
+__all__ = ["score_block"]
+
+
+def score_block(xp, pod_req, node_alloc, node_avail, weights):
+    """[B, N] combined priority score of a block of pods against all nodes.
+
+    pod_req [B,2] int32; node_alloc, node_avail [N,2] int32;
+    weights [2] f32 — (least_requested_weight, balanced_allocation_weight).
+    """
+    f32 = xp.float32
+    used_after = (node_alloc - node_avail)[None, :, :] + pod_req[:, None, :]  # [B,N,2] int32
+    safe = (node_alloc > 0)[None, :, :]
+    denom = xp.where(safe, node_alloc.astype(f32)[None, :, :], f32(1.0))
+    frac = xp.where(safe, used_after.astype(f32) / denom, f32(1.0))
+    least_requested = ((f32(1.0) - frac[..., 0]) + (f32(1.0) - frac[..., 1])) * f32(50.0)
+    balanced = (f32(1.0) - xp.abs(frac[..., 0] - frac[..., 1])) * f32(100.0)
+    return (weights[0] * least_requested + weights[1] * balanced).astype(f32)

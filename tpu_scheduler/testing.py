@@ -1,0 +1,123 @@
+"""Synthetic cluster generation — the "kind-style synthetic cluster" of
+BASELINE.json config 3, used by tests, the fake API server fixtures, and
+bench.py.  Deterministic via an explicit seed.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .api.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodSpec, PodStatus, ResourceRequirements
+from .core.snapshot import ClusterSnapshot
+
+__all__ = ["make_node", "make_pod", "synth_cluster"]
+
+# Node shapes roughly covering a heterogeneous fleet (cpu cores, memory GiB).
+_NODE_SHAPES = [(4, 16), (8, 32), (16, 64), (32, 128), (64, 256)]
+# Zone labels for selector / topology-spread exercises.
+_ZONES = ["zone-a", "zone-b", "zone-c", "zone-d"]
+_POOLS = ["default", "compute", "memory-optimized"]
+
+
+def make_node(
+    name: str,
+    cpu: str | int = "8",
+    memory: str | int = "32Gi",
+    labels: dict[str, str] | None = None,
+) -> Node:
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels),
+        status=NodeStatus(allocatable={"cpu": cpu, "memory": memory}),
+    )
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    cpu: str | int = "500m",
+    memory: str | int = "1Gi",
+    node_selector: dict[str, str] | None = None,
+    node_name: str | None = None,
+    phase: str = "Pending",
+    priority: int = 0,
+    labels: dict[str, str] | None = None,
+    topology_spread: dict[str, int] | None = None,
+    anti_affinity_labels: dict[str, str] | None = None,
+) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace, labels=labels),
+        spec=PodSpec(
+            containers=[
+                Container(name="main", resources=ResourceRequirements(requests={"cpu": cpu, "memory": memory}))
+            ],
+            node_selector=node_selector,
+            node_name=node_name,
+            priority=priority,
+            topology_spread=topology_spread,
+            anti_affinity_labels=anti_affinity_labels,
+        ),
+        status=PodStatus(phase=phase),
+    )
+
+
+def synth_cluster(
+    n_nodes: int,
+    n_pending: int,
+    n_bound: int = 0,
+    seed: int = 0,
+    selector_fraction: float = 0.2,
+    multi_container_fraction: float = 0.1,
+) -> ClusterSnapshot:
+    """Generate a synthetic cluster snapshot.
+
+    ``selector_fraction`` of pending pods carry a nodeSelector on the zone or
+    pool labels; ``multi_container_fraction`` get a second container so the
+    request-summation path (reference ``util.rs:54-75``) is exercised.
+    Bound pods are spread round-robin over nodes so resource-fit sees
+    realistic partially-full nodes.
+    """
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n_nodes):
+        cores, gib = _NODE_SHAPES[i % len(_NODE_SHAPES)]
+        labels = {
+            "zone": _ZONES[i % len(_ZONES)],
+            "pool": _POOLS[i % len(_POOLS)],
+            "name": f"node-{i}",
+        }
+        nodes.append(make_node(f"node-{i}", cpu=cores, memory=f"{gib}Gi", labels=labels))
+
+    pods: list[Pod] = []
+    for i in range(n_bound):
+        node = f"node-{i % n_nodes}"
+        pods.append(
+            make_pod(
+                f"bound-{i}",
+                cpu=f"{rng.choice([100, 250, 500, 1000])}m",
+                memory=f"{rng.choice([256, 512, 1024, 2048])}Mi",
+                node_name=node,
+                phase="Running",
+            )
+        )
+    for i in range(n_pending):
+        selector = None
+        if rng.random() < selector_fraction:
+            if rng.random() < 0.5:
+                selector = {"zone": rng.choice(_ZONES)}
+            else:
+                selector = {"pool": rng.choice(_POOLS)}
+        pod = make_pod(
+            f"pending-{i}",
+            cpu=f"{rng.choice([100, 250, 500, 1000, 2000])}m",
+            memory=f"{rng.choice([128, 256, 512, 1024, 4096])}Mi",
+            node_selector=selector,
+            priority=rng.randrange(0, 10),
+            labels={"app": f"app-{rng.randrange(0, 50)}"},
+        )
+        if rng.random() < multi_container_fraction:
+            pod.spec.containers.append(
+                Container(name="sidecar", resources=ResourceRequirements(requests={"cpu": "50m", "memory": "64Mi"}))
+            )
+        pods.append(pod)
+
+    return ClusterSnapshot.build(nodes, pods)
