@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""North-star benchmark: one scheduling cycle over P pending pods × N nodes
+on the real TPU chip (BASELINE.md: 100k × 10k in < 1 s on v5e-1).
+
+Prints ONE JSON line to stdout:
+  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": target/value}
+(vs_baseline > 1 means faster than the 1 s north-star target; the reference
+publishes no numbers of its own — BASELINE.md.)
+
+The timed cycle is the honest end-to-end device path: host→device transfer of
+the packed tensors, the full filter+score+commit auction, and fetching the
+per-pod assignments back.  Packing (host-side, amortisable/incremental in the
+controller) is reported separately on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=100_000)
+    ap.add_argument("--nodes", type=int, default=10_000)
+    ap.add_argument("--bound", type=int, default=None, help="pre-bound pods (default: 2x nodes)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--block", type=int, default=8192)
+    ap.add_argument("--max-rounds", type=int, default=64)
+    ap.add_argument("--target-seconds", type=float, default=1.0)
+    args = ap.parse_args()
+
+    import jax
+
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    n_bound = args.bound if args.bound is not None else 2 * args.nodes
+    log(f"devices: {jax.devices()}")
+
+    t0 = time.perf_counter()
+    snap = synth_cluster(n_nodes=args.nodes, n_pending=args.pods, n_bound=n_bound, seed=args.seed)
+    log(f"synth cluster ({args.nodes} nodes, {args.pods} pending, {n_bound} bound): {time.perf_counter()-t0:.2f}s")
+
+    t0 = time.perf_counter()
+    packed = pack_snapshot(snap, pod_block=args.block, node_block=128)
+    pack_s = time.perf_counter() - t0
+    log(f"pack: {pack_s:.2f}s (padded {packed.padded_pods}x{packed.padded_nodes}, vocab={len(packed.vocab)})")
+
+    backend = TpuBackend()
+    profile = DEFAULT_PROFILE.with_(pod_block=args.block, max_rounds=args.max_rounds)
+
+    # Warmup: compile + first execution.
+    t0 = time.perf_counter()
+    result = backend.schedule(packed, profile)
+    log(
+        f"warmup (incl. compile): {time.perf_counter()-t0:.2f}s — bound {len(result.bindings)}/{packed.num_pods} "
+        f"in {result.rounds} rounds"
+    )
+
+    times = []
+    for i in range(args.repeats):
+        t0 = time.perf_counter()
+        r = backend.schedule(packed, profile)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        log(f"cycle {i}: {dt:.4f}s ({len(r.bindings)} bound, {r.rounds} rounds, {len(r.bindings)/dt:,.0f} pods/s)")
+
+    value = statistics.median(times)
+    print(
+        json.dumps(
+            {
+                "metric": f"sched_cycle_seconds_{args.pods}x{args.nodes}",
+                "value": round(value, 4),
+                "unit": "s",
+                "vs_baseline": round(args.target_seconds / value, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

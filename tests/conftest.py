@@ -1,6 +1,9 @@
 """Test harness config: run all tests on a virtual 8-device CPU mesh so the
 multi-chip sharding paths (parallel/) are exercised without TPU hardware.
-Must set env before jax is imported anywhere.
+
+The axon TPU plugin's sitecustomize overrides JAX_PLATFORMS at interpreter
+start, so setting the env var alone is not enough — we must also flip
+jax.config after import (before any devices are used).
 """
 
 import os
@@ -9,3 +12,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"

@@ -1,0 +1,77 @@
+"""Fake API server: watch/list/bind semantics the control loop depends on."""
+
+import pytest
+
+from tpu_scheduler.api.objects import ObjectReference
+from tpu_scheduler.errors import CreateBindingFailed
+from tpu_scheduler.runtime.fake_api import ApiError, FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+
+def test_node_crud_and_watch():
+    api = FakeApiServer()
+    w = api.watch_nodes()
+    api.create_node(make_node("n1"))
+    api.create_node(make_node("n2"))
+    events = w.poll()
+    assert [(e.type, e.object.name) for e in events] == [("ADDED", "n1"), ("ADDED", "n2")]
+    api.delete_node("n1")
+    assert [(e.type, e.object.name) for e in w.poll()] == [("DELETED", "n1")]
+    assert [n.name for n in api.list_nodes()] == ["n2"]
+    with pytest.raises(ApiError, match="409"):
+        api.create_node(make_node("n2"))
+    with pytest.raises(ApiError, match="404"):
+        api.delete_node("ghost")
+
+
+def test_watch_initial_state_and_field_selector():
+    api = FakeApiServer()
+    api.create_pod(make_pod("pending1"))
+    api.create_pod(make_pod("running1", node_name="n", phase="Running"))
+    w = api.watch_pods(field_selector="status.phase=Pending")
+    assert [e.object.name for e in w.poll()] == ["pending1"]
+
+
+def test_list_pods_by_node_name():
+    # The reference's spec.nodeName=<node> list (predicates.rs:22-26).
+    api = FakeApiServer()
+    api.create_pod(make_pod("a", node_name="n1", phase="Running"))
+    api.create_pod(make_pod("b", node_name="n2", phase="Running"))
+    api.create_pod(make_pod("c"))
+    assert [p.name for p in api.list_pods("spec.nodeName=n1")] == ["a"]
+    with pytest.raises(ApiError, match="unsupported field selector"):
+        api.list_pods("spec.hostIP=1.2.3.4")
+
+
+def test_binding_subresource():
+    api = FakeApiServer()
+    api.create_node(make_node("n1"))
+    api.create_pod(make_pod("p1"))
+    w = api.watch_pods()
+    w.poll()
+    api.create_binding("default", "p1", ObjectReference(name="n1"))
+    (ev,) = w.poll()
+    assert ev.type == "MODIFIED"
+    assert ev.object.spec.node_name == "n1"
+    assert ev.object.status.phase == "Running"
+    # Double-bind is a 409 conflict.
+    with pytest.raises(ApiError, match="409"):
+        api.create_binding("default", "p1", ObjectReference(name="n1"))
+    # Unknown pod/node are 404s.
+    with pytest.raises(ApiError, match="404"):
+        api.create_binding("default", "ghost", ObjectReference(name="n1"))
+    api.create_pod(make_pod("p2"))
+    with pytest.raises(ApiError, match="404"):
+        api.create_binding("default", "p2", ObjectReference(name="ghost"))
+
+
+def test_binding_fault_injection():
+    api = FakeApiServer()
+    api.create_node(make_node("n1"))
+    api.create_pod(make_pod("p1"))
+    api.fail_next_bindings = 1
+    with pytest.raises(CreateBindingFailed):
+        api.create_binding("default", "p1", ObjectReference(name="n1"))
+    # Next attempt succeeds.
+    api.create_binding("default", "p1", ObjectReference(name="n1"))
+    assert api.binding_count == 2

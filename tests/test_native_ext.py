@@ -1,0 +1,95 @@
+"""Native (C++) packing shim: exact agreement with the Python quantity
+oracle, fuzzed over the grammar; builds via make if missing."""
+
+import math
+import random
+import subprocess
+
+import numpy as np
+import pytest
+
+from tpu_scheduler.api.quantity import QuantityError, cpu_to_millis, memory_to_bytes
+from tpu_scheduler.ops import native_ext
+
+NATIVE_DIR = "/root/repo/native"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    if not native_ext.available():
+        subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
+        native_ext._lib.cache_clear()
+    assert native_ext.available(), "libtpusched.so failed to build"
+
+
+CASES = [
+    "0", "1", "2", "500m", "0.5", "1.5", "100u", "1n", "2k", "3M", "1G",
+    "1Gi", "2Gi", "1.5Gi", "64Mi", "1Ki", "100m", "1Ti", "129e6", "12e-3",
+    "+3M", "-2Ki", "1E", "1Ei", "0.1", "128974848", "1e3", "2E2", "-0.5",
+    "999999999999", "3.14159", ".5", "5.",
+]
+
+
+I64_MAX = np.iinfo(np.int64).max
+
+
+def clamp64(v: int) -> int:
+    return max(min(v, I64_MAX), -I64_MAX)
+
+
+@pytest.mark.parametrize("s", CASES)
+def test_cpu_agreement(s):
+    assert native_ext.batch_parse([s], native_ext.MODE_CPU_MILLIS)[0] == clamp64(cpu_to_millis(s))
+
+
+@pytest.mark.parametrize("s", CASES)
+def test_mem_agreement(s):
+    assert native_ext.batch_parse([s], native_ext.MODE_MEM_BYTES)[0] == clamp64(memory_to_bytes(s))
+
+
+def test_fuzz_against_python_oracle():
+    rng = random.Random(7)
+    suffixes = ["", "n", "u", "m", "k", "M", "G", "T", "Ki", "Mi", "Gi", "Ti", "e3", "e-2", "E2"]
+    strs = []
+    for _ in range(3000):
+        whole = rng.randrange(0, 10**rng.randrange(1, 10))
+        if rng.random() < 0.4:
+            frac = rng.randrange(0, 1000)
+            base = f"{whole}.{frac}"
+        else:
+            base = str(whole)
+        sign = rng.choice(["", "+", "-"]) if rng.random() < 0.2 else ""
+        strs.append(sign + base + rng.choice(suffixes))
+    got_cpu = native_ext.batch_parse(strs, native_ext.MODE_CPU_MILLIS)
+    got_mem = native_ext.batch_parse(strs, native_ext.MODE_MEM_BYTES)
+    for s, gc, gm in zip(strs, got_cpu, got_mem):
+        assert gc == clamp64(cpu_to_millis(s)), s
+        assert gm == clamp64(memory_to_bytes(s)), s
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1Qi", "1.2.3", "e5", "--1", "Gi", "1 Gi", "1e"])
+def test_invalid_rejected_like_python(bad):
+    with pytest.raises(QuantityError):
+        cpu_to_millis(bad)
+    with pytest.raises(ValueError, match="invalid quantity"):
+        native_ext.batch_parse([bad], native_ext.MODE_CPU_MILLIS)
+
+
+def test_pack_requests_rows():
+    out = native_ext.pack_requests(["500m", "2", None], ["1Gi", "1025", "64Mi"])
+    assert out.dtype == np.int32
+    assert out[0].tolist() == [500, 2**20]
+    assert out[1].tolist() == [2000, 2]  # ceil(1025/1024)
+    assert out[2].tolist() == [0, 64 * 2**10]  # None cpu -> 0
+
+
+def test_pack_requests_clamps_to_int32():
+    out = native_ext.pack_requests(["4000000000"], ["8Ti"])
+    assert out[0, 0] == 2**31 - 1
+    assert out[0, 1] == 2**31 - 1
+
+
+def test_huge_exponent_saturates():
+    v = native_ext.batch_parse(["9e30"], native_ext.MODE_MEM_BYTES)[0]
+    assert v == np.iinfo(np.int64).max  # clamped, not wrapped
+    assert memory_to_bytes("9e30") == 9 * 10**30  # python stays exact

@@ -1,0 +1,115 @@
+"""Regression tests for the second code-review round: bound-but-Pending
+capacity accounting, negative-priority jitter-rank parity, requeue cleanup,
+shim whitespace, synth/CLI guards."""
+
+import numpy as np
+import pytest
+
+from tpu_scheduler import ClusterSnapshot
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.backends.tpu import TpuBackend
+from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+from tpu_scheduler.ops.pack import pack_snapshot
+from tpu_scheduler.parallel.mesh import make_mesh
+from tpu_scheduler.parallel.sharded import ShardedBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+def test_bound_but_pending_pod_counts_capacity():
+    # Pod bound to the node but phase still Pending (kubelet lag) must consume
+    # capacity in the cycle snapshot — previously it was dropped and the node
+    # oversubscribed (3 + 2 > 4 cores).
+    api = FakeApiServer()
+    api.create_node(make_node("n1", cpu="4", memory="32Gi"))
+    api.create_pod(make_pod("bp", cpu="3", memory="1Gi", node_name="n1", phase="Pending"))
+    api.create_pod(make_pod("p", cpu="2", memory="1Gi"))
+    sched = Scheduler(api, NativeBackend())
+    m = sched.run_cycle()
+    assert m.bound == 0 and m.unschedulable == 1  # p cannot fit next to bp
+
+
+def make_negative_priority_packed():
+    # padded_pods=384 with block 256 forces jax-side block padding; a
+    # negative-priority pod must land at the same rank in every backend.
+    snap = synth_cluster(n_nodes=16, n_pending=299, seed=13)
+    pods = list(snap.pods) + [make_pod("negprio", cpu="500m", memory="1Gi", priority=-5)]
+    snap = ClusterSnapshot.build(snap.nodes, pods)
+    return snap, pack_snapshot(snap, pod_block=128)
+
+
+def test_negative_priority_parity_tpu():
+    snap, packed = make_negative_priority_packed()
+    profile = DEFAULT_PROFILE.with_(pod_block=256)
+    native = NativeBackend().schedule(packed, profile)
+    tpu = TpuBackend().schedule(packed, profile)
+    assert (native.assigned == tpu.assigned).all(), np.flatnonzero(native.assigned != tpu.assigned)[:10]
+
+
+def test_negative_priority_parity_sharded():
+    snap, packed = make_negative_priority_packed()
+    native = NativeBackend().schedule(packed)
+    sharded = ShardedBackend(make_mesh(tp=2)).schedule(packed)
+    assert (native.assigned == sharded.assigned).all()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_requeue_cleared_when_pod_deleted():
+    clock = FakeClock()
+    api = FakeApiServer()
+    api.create_node(make_node("tiny", cpu="1", memory="1Gi"))
+    api.create_pod(make_pod("huge", cpu="64", memory="256Gi"))
+    sched = Scheduler(api, NativeBackend(), clock=clock)
+    sched.run_cycle()
+    assert "default/huge" in sched.requeue_at
+    # Delete and recreate with a feasible spec under the same name: the new
+    # pod must NOT inherit the old backoff.
+    api.delete_pod("default", "huge")
+    sched.run_cycle()  # prunes the stale entry
+    assert "default/huge" not in sched.requeue_at
+    api.create_pod(make_pod("huge", cpu="500m", memory="512Mi"))
+    clock.t = 10.0  # well inside the old 300 s window
+    m = sched.run_cycle()
+    assert m.bound == 1
+
+
+def test_requeue_cleared_on_successful_bind():
+    clock = FakeClock()
+    api = FakeApiServer()
+    api.create_node(make_node("n1", cpu="8", memory="32Gi"))
+    api.create_pod(make_pod("p1", cpu="1", memory="1Gi"))
+    api.fail_next_bindings = 1
+    sched = Scheduler(api, NativeBackend(), clock=clock)
+    sched.run_cycle()
+    assert "default/p1" in sched.requeue_at
+    clock.t = 301.0
+    m = sched.run_cycle()
+    assert m.bound == 1
+    assert sched.requeue_at == {}
+
+
+def test_shim_accepts_whitespace_like_python():
+    from tpu_scheduler.api.quantity import memory_to_bytes
+    from tpu_scheduler.ops import native_ext
+
+    if not native_ext.available():
+        import subprocess
+
+        subprocess.run(["make", "-C", "/root/repo/native"], check=True, capture_output=True)
+        native_ext._lib.cache_clear()
+    for s in ["1Gi ", " 1Gi", "\t2Ki\n", " 500 "]:
+        assert native_ext.batch_parse([s], native_ext.MODE_MEM_BYTES)[0] == memory_to_bytes(s)
+
+
+def test_synth_cluster_zero_nodes_with_bound():
+    snap = synth_cluster(n_nodes=0, n_pending=3, n_bound=5)
+    assert len(snap.nodes) == 0
+    assert len(snap.pending_pods()) == 3

@@ -48,10 +48,12 @@ class NativeBackend(SchedulingBackend):
         while rounds < profile.max_rounds and active.any():
             choice = np.zeros((p,), dtype=np.int32)
             has = np.zeros((p,), dtype=bool)
+            node_idx = np.arange(n, dtype=np.uint32)
             for lo in range(0, p, block):
                 hi = min(lo + block, p)
                 m = feasibility_block(np, req[lo:hi], sel[lo:hi], selc[lo:hi], active[lo:hi], avail, node_labels, node_valid)
-                sc = score_block(np, req[lo:hi], node_alloc, avail, weights)
+                pod_idx = np.arange(lo, hi, dtype=np.uint32)
+                sc = score_block(np, req[lo:hi], node_alloc, avail, weights, pod_idx, node_idx)
                 sc = np.where(m, sc, -np.inf)
                 choice[lo:hi] = sc.argmax(axis=1).astype(np.int32)
                 has[lo:hi] = m.any(axis=1)

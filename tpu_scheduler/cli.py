@@ -1,0 +1,95 @@
+"""CLI entry point — the ``main()`` of the framework (reference
+``src/main.rs:127-152``), with the north-star ``--backend={native,tpu}`` flag.
+
+The reference connects to a real cluster via kubeconfig; this framework's
+first-class cluster is the in-process fake API server loaded with a synthetic
+workload (BASELINE.json config 3) — a real-cluster adapter is an edge module
+by design (SURVEY.md §7 step 5).  Run:
+
+    python -m tpu_scheduler.cli --backend=tpu --nodes 1000 --pods 10000
+
+Prints one JSON metrics line per cycle and a final summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .backends.native import NativeBackend
+from .models.profiles import PROFILES
+from .runtime.controller import ATTEMPTS, REQUEUE_SECONDS, Scheduler
+from .runtime.fake_api import FakeApiServer
+from .testing import synth_cluster
+from .utils.tracing import configure_logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-scheduler", description=__doc__)
+    p.add_argument("--backend", choices=["native", "tpu"], default="tpu", help="scheduling backend (north-star flag)")
+    p.add_argument("--policy", choices=["batch", "sample"], default="batch", help="batched cycle vs reference-style per-pod random sampling")
+    p.add_argument("--profile", choices=sorted(PROFILES), default="default", help="scoring profile")
+    p.add_argument("--nodes", type=int, default=100, help="synthetic cluster: node count")
+    p.add_argument("--pods", type=int, default=1000, help="synthetic cluster: pending pods")
+    p.add_argument("--bound-pods", type=int, default=0, help="synthetic cluster: pre-bound pods")
+    p.add_argument("--seed", type=int, default=0, help="synthetic cluster seed")
+    p.add_argument("--cycles", type=int, default=None, help="max scheduling cycles (default: run until settled)")
+    p.add_argument("--attempts", type=int, default=ATTEMPTS, help="sample policy: candidates per pod (reference ATTEMPTS)")
+    p.add_argument("--requeue-seconds", type=float, default=REQUEUE_SECONDS, help="failed-pod requeue delay")
+    p.add_argument("--no-fallback", action="store_true", help="disable tpu->native failure fallback")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace of the cycles here")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    configure_logging(args.log_level)
+
+    api = FakeApiServer()
+    snap = synth_cluster(n_nodes=args.nodes, n_pending=args.pods, n_bound=args.bound_pods, seed=args.seed)
+    api.load(snap.nodes, snap.pods)
+
+    if args.backend == "native":
+        backend = NativeBackend()
+        fallback = None
+    else:
+        from .backends.tpu import TpuBackend
+
+        backend = TpuBackend()
+        fallback = None if args.no_fallback else NativeBackend()
+
+    sched = Scheduler(
+        api,
+        backend,
+        profile=PROFILES[args.profile],
+        policy=args.policy,
+        attempts=args.attempts,
+        requeue_seconds=args.requeue_seconds,
+        fallback_backend=fallback,
+    )
+
+    from .utils.tracing import device_profile
+
+    with device_profile(args.profile_dir):
+        metrics = sched.run(max_cycles=args.cycles, until_settled=args.cycles is None)
+
+    for m in metrics:
+        print(m.to_json())
+    total_bound = sum(m.bound for m in metrics)
+    summary = {
+        "summary": True,
+        "backend": args.backend,
+        "policy": args.policy,
+        "cycles": len(metrics),
+        "bound_total": total_bound,
+        "unschedulable_last": metrics[-1].unschedulable if metrics else 0,
+        "counters": sched.metrics.snapshot(),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,9 @@ class SchedulingProfile:
     # Score weights (kube-scheduler defaults both at 1).
     least_requested_weight: float = 1.0
     balanced_allocation_weight: float = 1.0
+    # Deterministic tie-spreading jitter (score points); spreads identical-
+    # request pods across near-tied nodes so auction rounds don't herd.
+    spread_jitter: float = 0.5
     # Auction-round safety cap (rounds needed ≈ max per-node contention).
     max_rounds: int = 32
     # Pods per choose-block (caps peak [block, N] tile memory on device).
@@ -29,7 +32,9 @@ class SchedulingProfile:
     topology_weight: float = 0.0
 
     def weights(self) -> np.ndarray:
-        return np.array([self.least_requested_weight, self.balanced_allocation_weight], dtype=np.float32)
+        return np.array(
+            [self.least_requested_weight, self.balanced_allocation_weight, self.spread_jitter], dtype=np.float32
+        )
 
     def with_(self, **kw) -> "SchedulingProfile":
         return replace(self, **kw)

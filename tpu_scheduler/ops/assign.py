@@ -69,16 +69,19 @@ def _choose(avail, active, req, sel, selc, node_alloc, node_labels, node_valid, 
     SURVEY.md §2b PP).
     """
     p = req.shape[0]
+    n = avail.shape[0]
+    pod_idx = jnp.arange(p, dtype=jnp.uint32)
+    node_idx = jnp.arange(n, dtype=jnp.uint32)
 
     def one(args):
-        breq, bsel, bselc, bact = args
+        breq, bsel, bselc, bact, bidx = args
         m = feasibility_block(jnp, breq, bsel, bselc, bact, avail, node_labels, node_valid)
-        sc = score_block(jnp, breq, node_alloc, avail, weights)
+        sc = score_block(jnp, breq, node_alloc, avail, weights, bidx, node_idx)
         sc = jnp.where(m, sc, -jnp.inf)
         return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
     if block >= p:
-        return one((req, sel, selc, active))
+        return one((req, sel, selc, active, pod_idx))
     nb = p // block  # caller guarantees p % block == 0 (assign_cycle pads)
     choice, has = lax.map(
         one,
@@ -87,6 +90,7 @@ def _choose(avail, active, req, sel, selc, node_alloc, node_labels, node_valid, 
             sel.reshape(nb, block, -1),
             selc.reshape(nb, block),
             active.reshape(nb, block),
+            pod_idx.reshape(nb, block),
         ),
     )
     return choice.reshape(p), has.reshape(p)
@@ -112,28 +116,32 @@ def assign_cycle(
     Returns (assigned [P] int32 — node index or −1, rounds int32,
     remaining node_avail [N,2] int32).
     """
-    p = pod_req.shape[0]
+    p_out = pod_req.shape[0]
     n = node_avail.shape[0]
 
-    # Pad the pod axis to a block multiple so the blockwise choose path is
-    # always exact — otherwise a remainder would silently materialise the
-    # full [P,N] score matrix and blow HBM at target scale (100k × 10k).
-    p_out = p
-    if block < p and p % block != 0:
-        extra = block - p % block
-        pod_req = jnp.pad(pod_req, ((0, extra), (0, 0)))
-        pod_sel = jnp.pad(pod_sel, ((0, extra), (0, 0)))
-        pod_sel_count = jnp.pad(pod_sel_count, ((0, extra),))
-        pod_prio = jnp.pad(pod_prio, ((0, extra),))
-        pod_valid = jnp.pad(pod_valid, ((0, extra),))
-        p = p + extra
-
     # Priority order (priority desc, FIFO index asc); stable sort keeps FIFO.
+    # The permutation happens BEFORE any block padding: rank positions feed
+    # the score-jitter hash and must equal the native backend's (which never
+    # pads) for binding parity — padding first would shift ranks whenever a
+    # pod has negative priority.
     perm = jnp.argsort(-pod_prio, stable=True)
     req = pod_req[perm]
     sel = pod_sel[perm]
     selc = pod_sel_count[perm]
     valid = pod_valid[perm]
+
+    # Pad the pod axis to a block multiple so the blockwise choose path is
+    # always exact — otherwise a remainder would silently materialise the
+    # full [P,N] score matrix and blow HBM at target scale (100k × 10k).
+    # Padding rows sit at ranks ≥ p_out (inactive), leaving real ranks intact.
+    p = p_out
+    if block < p and p % block != 0:
+        extra = block - p % block
+        req = jnp.pad(req, ((0, extra), (0, 0)))
+        sel = jnp.pad(sel, ((0, extra), (0, 0)))
+        selc = jnp.pad(selc, ((0, extra),))
+        valid = jnp.pad(valid, ((0, extra),))
+        p = p + extra
 
     def cond(state):
         _, _, active, rounds = state
@@ -168,5 +176,5 @@ def assign_cycle(
     avail, assigned, _, rounds = lax.while_loop(cond, body, state0)
 
     # Back to original pod order (dropping block padding).
-    out = jnp.full((p,), -1, jnp.int32).at[perm].set(assigned)[:p_out]
+    out = jnp.full((p_out,), -1, jnp.int32).at[perm].set(assigned[:p_out])
     return out, rounds, avail

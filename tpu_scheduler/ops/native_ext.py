@@ -1,0 +1,103 @@
+"""ctypes loader for the native packing shim (native/pack.cpp →
+libtpusched.so).
+
+The shim is the C++ equivalent of the reference's native kube_quantity
+arithmetic (``src/util.rs:17-36``): batch quantity parsing and request-row
+packing.  Python (api/quantity.py) remains the semantic oracle — the shim is
+an accelerator, optional at runtime: every caller falls back to the Python
+path when the library isn't built (``make -C native``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from functools import lru_cache
+
+import numpy as np
+
+__all__ = ["available", "batch_parse", "pack_requests", "MODE_CPU_MILLIS", "MODE_MEM_BYTES"]
+
+MODE_CPU_MILLIS = 0
+MODE_MEM_BYTES = 1
+
+_DEFAULT_LIB = os.path.join(os.path.dirname(__file__), "..", "..", "native", "build", "libtpusched.so")
+
+
+@lru_cache(maxsize=1)
+def _lib():
+    # Env override wins over the default build path; read lazily so setting
+    # it before first use works.  (Changing it after first use requires
+    # _lib.cache_clear() — the handle is cached.)
+    for path in (os.environ.get("TPUSCHED_NATIVE_LIB", ""), _DEFAULT_LIB):
+        if path and os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(os.path.abspath(path))
+            except OSError:
+                continue
+            lib.tpusched_parse.restype = ctypes.c_int
+            lib.tpusched_parse.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+            lib.tpusched_batch_parse.restype = ctypes.c_int64
+            lib.tpusched_batch_parse.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.tpusched_pack_requests.restype = ctypes.c_int64
+            lib.tpusched_pack_requests.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            return lib
+    return None
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def _to_char_pp(strs: list[str | None]):
+    arr = (ctypes.c_char_p * len(strs))()
+    for i, s in enumerate(strs):
+        arr[i] = None if s is None else str(s).encode()
+    return arr
+
+
+def batch_parse(strs: list[str], mode: int) -> np.ndarray:
+    """Parse quantities to int64 base units (millicores / bytes).
+
+    Raises ValueError naming the first invalid quantity, matching the Python
+    parser's behaviour.
+    """
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native shim not built (make -C native)")
+    out = np.zeros(len(strs), dtype=np.int64)
+    bad = lib.tpusched_batch_parse(
+        _to_char_pp(strs), len(strs), mode, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    )
+    if bad >= 0:
+        raise ValueError(f"invalid quantity: {strs[bad]!r}")
+    return out
+
+
+def pack_requests(cpu_strs: list[str | None], mem_strs: list[str | None]) -> np.ndarray:
+    """[n,2] int32 (millicores, KiB-ceil) request rows — the ops/pack.py
+    unit/rounding convention, computed natively."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native shim not built (make -C native)")
+    assert len(cpu_strs) == len(mem_strs)
+    out = np.zeros((len(cpu_strs), 2), dtype=np.int32)
+    bad = lib.tpusched_pack_requests(
+        _to_char_pp(cpu_strs),
+        _to_char_pp(mem_strs),
+        len(cpu_strs),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if bad >= 0:
+        raise ValueError(f"invalid quantity in row {bad}: cpu={cpu_strs[bad]!r} mem={mem_strs[bad]!r}")
+    return out

@@ -42,7 +42,15 @@ from ..api.objects import Pod, total_pod_resources
 from ..api.quantity import cpu_to_millis, memory_to_bytes
 from ..core.snapshot import ClusterSnapshot
 
-__all__ = ["PackedCluster", "pack_snapshot", "repack_avail", "build_selector_vocab", "round_up", "INT32_MAX"]
+__all__ = [
+    "PackedCluster",
+    "pack_snapshot",
+    "repack_avail",
+    "repack_incremental",
+    "build_selector_vocab",
+    "round_up",
+    "INT32_MAX",
+]
 
 CPU, MEM = 0, 1  # resource axis indices
 INT32_MAX = 2**31 - 1
@@ -193,13 +201,29 @@ def pack_snapshot(
     node_alloc = _clamp_i32(np.stack([alloc64[:, CPU], alloc64[:, MEM] // 1024], axis=1))
     node_avail = _avail_i32(alloc64, used64)
 
+    pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad)
+
+    return PackedCluster(
+        node_alloc=node_alloc,
+        node_avail=node_avail,
+        node_labels=node_labels,
+        node_valid=node_valid,
+        node_names=tuple(n.name for n in nodes),
+        vocab=dict(vocab),
+        **pod_tensors,
+    )
+
+
+def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int) -> dict:
+    """Pod-side tensors (the part that changes every cycle as pods bind)."""
+    from ..api.objects import full_name
+
     pod_req64 = np.zeros((p_pad, 2), dtype=np.int64)
     pod_sel = np.zeros((p_pad, l_pad), dtype=np.float32)
     pod_sel_count = np.zeros((p_pad,), dtype=np.float32)
     pod_prio = np.zeros((p_pad,), dtype=np.int32)
     pod_valid = np.zeros((p_pad,), dtype=bool)
     pod_names = []
-    from ..api.objects import full_name
 
     for i, pod in enumerate(pending):
         res = total_pod_resources(pod)
@@ -217,19 +241,13 @@ def pack_snapshot(
                     pod_sel[i, j] = 1.0
                 pod_sel_count[i] = len(pod.spec.node_selector)
 
-    return PackedCluster(
-        node_alloc=node_alloc,
-        node_avail=node_avail,
-        node_labels=node_labels,
-        node_valid=node_valid,
-        node_names=tuple(n.name for n in nodes),
+    return dict(
         pod_req=_clamp_i32(pod_req64),
         pod_sel=pod_sel,
         pod_sel_count=pod_sel_count,
         pod_prio=pod_prio,
         pod_valid=pod_valid,
         pod_names=tuple(pod_names),
-        vocab=dict(vocab),
     )
 
 
@@ -244,3 +262,21 @@ def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedClus
         raise ValueError("repack_avail requires an identical node set/order; run a full pack_snapshot instead")
     alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes)
     return replace(packed, node_avail=_avail_i32(alloc64, used64))
+
+
+def repack_incremental(packed: PackedCluster, snapshot: ClusterSnapshot, pod_block: int = 128) -> PackedCluster:
+    """Between-cycles repack: reuse the node-side tensors (labels, alloc,
+    vocab — stable while the node set is stable) and rebuild only what a
+    cycle changes — the pending-pod tensors and remaining capacity.
+
+    Caller guarantees: identical node set/order (validated) and that
+    ``packed.vocab`` covers every pending selector pair (KeyError otherwise).
+    """
+    fresh_names = tuple(n.name for n in snapshot.nodes)
+    if fresh_names != packed.node_names:
+        raise ValueError("repack_incremental requires an identical node set/order; run a full pack_snapshot instead")
+    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes)
+    pending = snapshot.pending_pods()
+    p_pad = max(packed.padded_pods, round_up(len(pending), pod_block))
+    pod_tensors = _pack_pods(pending, packed.vocab, p_pad, packed.pod_sel.shape[1])
+    return replace(packed, node_avail=_avail_i32(alloc64, used64), **pod_tensors)

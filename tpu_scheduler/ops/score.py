@@ -11,6 +11,15 @@ north star mandates, over the packed tensors:
   balanced          = (1 − |frac_cpu − frac_mem|) · 100
   score             = w_lr · least_requested + w_ba · balanced
 
+A third term breaks score ties deterministically: pods with identical
+requests see identical LeastRequested/Balanced rows, so a whole batch would
+herd onto one argmax node per auction round (the reference never hits this
+because it samples randomly, ``main.rs:56``).  A hash-based per-(pod, node)
+jitter — uint32 Knuth-multiplicative, identical wraparound semantics in
+NumPy and XLA — spreads near-ties across near-tied nodes while leaving
+materially different scores ordered.  Deterministic, so native/TPU/sharded
+parity is preserved bitwise.
+
 xp-generic (numpy / jax.numpy): one expression tree for both backends, all
 float32 elementwise, so native and TPU scores agree bitwise.
 """
@@ -20,11 +29,13 @@ from __future__ import annotations
 __all__ = ["score_block"]
 
 
-def score_block(xp, pod_req, node_alloc, node_avail, weights):
+def score_block(xp, pod_req, node_alloc, node_avail, weights, pod_idx=None, node_idx=None):
     """[B, N] combined priority score of a block of pods against all nodes.
 
     pod_req [B,2] int32; node_alloc, node_avail [N,2] int32;
-    weights [2] f32 — (least_requested_weight, balanced_allocation_weight).
+    weights [3] f32 — (least_requested_w, balanced_allocation_w, jitter);
+    pod_idx [B] / node_idx [N] uint32 — global indices for the jitter hash
+    (optional; jitter term is skipped when either is None).
     """
     f32 = xp.float32
     used_after = (node_alloc - node_avail)[None, :, :] + pod_req[:, None, :]  # [B,N,2] int32
@@ -33,4 +44,10 @@ def score_block(xp, pod_req, node_alloc, node_avail, weights):
     frac = xp.where(safe, used_after.astype(f32) / denom, f32(1.0))
     least_requested = ((f32(1.0) - frac[..., 0]) + (f32(1.0) - frac[..., 1])) * f32(50.0)
     balanced = (f32(1.0) - xp.abs(frac[..., 0] - frac[..., 1])) * f32(100.0)
-    return (weights[0] * least_requested + weights[1] * balanced).astype(f32)
+    score = weights[0] * least_requested + weights[1] * balanced
+    if pod_idx is not None and node_idx is not None:
+        u32 = xp.uint32
+        h = pod_idx.astype(u32)[:, None] * u32(2654435761) + node_idx.astype(u32)[None, :] * u32(2246822519)
+        h = (h ^ (h >> u32(15))) & u32(0xFFFF)
+        score = score + weights[2] * (h.astype(f32) / f32(65536.0))
+    return score.astype(f32)

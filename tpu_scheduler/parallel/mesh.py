@@ -1,0 +1,40 @@
+"""Device meshes for the scheduler — the ICI/DCN scaling surface.
+
+The reference's only "distribution" is HTTPS to the API server (SURVEY.md
+§2b); here the scaling axes are a ``jax.sharding.Mesh``:
+
+  dp — data parallelism over the *pods* axis (each device scores a pod shard)
+  tp — tensor parallelism over the *nodes* axis (for node counts × label
+       widths beyond one device's HBM)
+
+Multi-host extends the same mesh over DCN via ``jax.distributed`` — the mesh
+abstraction is identical, so everything in parallel/sharded.py carries over.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["make_mesh", "mesh_shape_for"]
+
+
+def mesh_shape_for(n_devices: int, tp: int | None = None) -> tuple[int, int]:
+    """(dp, tp) factorisation: biggest power-of-two tp requested (default 2
+    when it divides evenly, else 1) — pods are the long axis, so dp gets the
+    bulk of the devices."""
+    if tp is None:
+        tp = 2 if n_devices % 2 == 0 and n_devices > 1 else 1
+    if n_devices % tp != 0:
+        raise ValueError(f"tp={tp} does not divide device count {n_devices}")
+    return n_devices // tp, tp
+
+
+def make_mesh(devices=None, tp: int | None = None):
+    """Build a (dp, tp) Mesh over the given (default: all) devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    dp, tp_ = mesh_shape_for(len(devices), tp)
+    return Mesh(np.array(devices).reshape(dp, tp_), ("dp", "tp"))

@@ -1,0 +1,221 @@
+"""Multi-chip scheduling cycle: the auction of ops/assign.py distributed over
+a (dp, tp) mesh with jax.shard_map — pods sharded over ``dp``, nodes over
+``tp``, XLA collectives over ICI (SURVEY.md §2b).
+
+Identical results to the single-device path, by construction:
+
+  choose   — each device scores its pod shard against its node shard; the
+             per-pod best node is reduced across ``tp`` with all_gather +
+             (score desc, node-index asc) tie-break, which equals the global
+             first-max argmax.
+  accept   — pod claims (choice, request) are all_gathered over ``dp`` in
+             global priority order (pods are pre-permuted before sharding,
+             so the tiled gather *is* rank order); each tp column runs the
+             segmented saturating prefix acceptance for the nodes it owns;
+             per-pod accepted flags come back via a tp psum (node shards are
+             disjoint).
+  commit   — each column scatter-subtracts its own nodes; every dp row in a
+             column computes identically, keeping replicated state in sync
+             without extra traffic.
+
+Per-round traffic: O(P) int32s over dp + O(P) over tp — a few MB at 100k
+pods, ICI-trivial next to the [P/dp × N/tp] compute tiles.
+
+The same code scales to multi-host (DCN) by building the mesh over
+``jax.distributed`` processes; nothing below is aware of the difference.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..models.profiles import SchedulingProfile
+from ..ops.assign import _seg_scan_op
+from ..ops.masks import feasibility_block
+from ..ops.pack import PackedCluster, round_up
+from ..ops.score import score_block
+from ..backends.base import SchedulingBackend
+from .mesh import make_mesh
+
+__all__ = ["sharded_assign_cycle", "ShardedBackend"]
+
+
+def _local_choose(avail, active, req, sel, selc, node_alloc, node_labels, node_valid, weights, pod_idx, node_idx):
+    """Best local node per pod of this shard: (best_score, local idx, has).
+
+    ``pod_idx``/``node_idx`` are *global* (rank-space) indices so the score
+    jitter hash matches the single-device path exactly."""
+    m = feasibility_block(jnp, req, sel, selc, active, avail, node_labels, node_valid)
+    sc = score_block(jnp, req, node_alloc, avail, weights, pod_idx, node_idx)
+    sc = jnp.where(m, sc, -jnp.inf)
+    return jnp.max(sc, axis=1), jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
+
+
+@lru_cache(maxsize=64)
+def _build_sharded_fn(mesh, max_rounds: int):
+    """Jitted (mesh, max_rounds)-specialised cycle fn — cached so repeated
+    cycles reuse the compiled executable (jit re-specialises per shape)."""
+    dp = mesh.shape["dp"]
+    tp = mesh.shape["tp"]
+
+    def local_fn(node_alloc, node_avail, node_labels, node_valid, req, sel, selc, valid, w):
+        p_local = req.shape[0]
+        n_local = node_avail.shape[0]
+        p_tot = p_local * dp
+        n_tot = n_local * tp
+        dp_idx = lax.axis_index("dp")
+        tp_idx = lax.axis_index("tp")
+        node_base = tp_idx * n_local
+        g_pod_idx = (dp_idx * p_local + jnp.arange(p_local)).astype(jnp.uint32)
+        g_node_idx = (node_base + jnp.arange(n_local)).astype(jnp.uint32)
+
+        def cond(state):
+            _, _, _, go, rounds = state
+            return (rounds < max_rounds) & go
+
+        def body(state):
+            avail, assigned, active, _, rounds = state
+
+            # 1. choose: local tile, then argmax across the tp axis.
+            best_l, idx_l, _ = _local_choose(
+                avail, active, req, sel, selc, node_alloc, node_labels, node_valid, w, g_pod_idx, g_node_idx
+            )
+            bests = lax.all_gather(best_l, "tp")  # [tp, p_local]
+            idxs = lax.all_gather(idx_l + node_base, "tp")
+            best, choice = bests[0], idxs[0]
+            for k in range(1, tp):
+                take = (bests[k] > best) | ((bests[k] == best) & (idxs[k] < choice))
+                best = jnp.where(take, bests[k], best)
+                choice = jnp.where(take, idxs[k], choice)
+            has = jnp.isfinite(best)
+            cand = active & has
+
+            # 2. accept: gather all claims (already in global priority order).
+            g_choice = lax.all_gather(jnp.where(cand, choice, n_tot), "dp", tiled=True)  # [P]
+            g_req = lax.all_gather(jnp.where(cand[:, None], req, 0), "dp", tiled=True)  # [P,2]
+            in_range = (g_choice >= node_base) & (g_choice < node_base + n_local)
+            ch_local = jnp.where(in_range, g_choice - node_base, n_local).astype(jnp.int32)
+            claim = jnp.where(in_range[:, None], g_req, 0)
+
+            order = jnp.argsort(ch_local, stable=True)
+            ch_s = ch_local[order]
+            claim_s = claim[order]
+            is_start = jnp.concatenate([jnp.ones((1,), bool), ch_s[1:] != ch_s[:-1]])[:, None]
+            _, within = lax.associative_scan(_seg_scan_op, (is_start, claim_s))
+            avail_ext = jnp.concatenate([avail, jnp.zeros((1, 2), avail.dtype)], axis=0)
+            acc_s = (within <= avail_ext[ch_s]).all(-1) & (ch_s < n_local)
+            accepted_rng = jnp.zeros((p_tot,), bool).at[order].set(acc_s)
+
+            # 3. commit locally; flags across node shards are disjoint → psum.
+            dec = jnp.zeros((n_local + 1, 2), jnp.int32).at[ch_local].add(jnp.where(accepted_rng[:, None], claim, 0))
+            avail = avail - dec[:n_local]
+            accepted = lax.psum(accepted_rng.astype(jnp.int32), "tp") > 0
+            acc_local = lax.dynamic_slice(accepted, (dp_idx * p_local,), (p_local,))
+
+            assigned = jnp.where(acc_local, choice, assigned)
+            active = cand & ~acc_local
+            n_active = lax.psum(active.sum(), "dp")
+            return avail, assigned, active, n_active > 0, rounds + 1
+
+        state0 = (
+            node_avail,
+            jnp.full((p_local,), -1, jnp.int32),
+            valid,
+            lax.psum(valid.sum(), "dp") > 0,
+            jnp.int32(0),
+        )
+        avail, assigned, _, _, rounds = lax.while_loop(cond, body, state0)
+        return assigned, rounds, avail
+
+    sharded = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P("tp", None),  # node_alloc
+            P("tp", None),  # node_avail
+            P("tp", None),  # node_labels
+            P("tp"),  # node_valid
+            P("dp", None),  # pod_req
+            P("dp", None),  # pod_sel
+            P("dp"),  # pod_sel_count
+            P("dp"),  # pod_valid (already priority-permuted)
+            P(),  # weights
+        ),
+        out_specs=(P("dp"), P(), P("tp", None)),
+        # The while-carry mixes tp-varying (avail) and dp-varying (assigned)
+        # state that converges by construction; VMA inference can't see that.
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(a, w):
+        p_tot = a["pod_req"].shape[0]
+        # Permute BEFORE dp padding: ranks feed the score-jitter hash and
+        # must equal the unpadded native backend's (see ops/assign.py).
+        perm = jnp.argsort(-a["pod_prio"], stable=True)
+        req = a["pod_req"][perm]
+        sel = a["pod_sel"][perm]
+        selc = a["pod_sel_count"][perm]
+        valid = a["pod_valid"][perm]
+        extra = (-p_tot) % dp
+        if extra:
+            req = jnp.pad(req, ((0, extra), (0, 0)))
+            sel = jnp.pad(sel, ((0, extra), (0, 0)))
+            selc = jnp.pad(selc, ((0, extra),))
+            valid = jnp.pad(valid, ((0, extra),))
+        assigned_p, rounds, avail = sharded(
+            a["node_alloc"],
+            a["node_avail"],
+            a["node_labels"],
+            a["node_valid"],
+            req,
+            sel,
+            selc,
+            valid,
+            w,
+        )
+        assigned = jnp.full((p_tot,), -1, jnp.int32).at[perm].set(assigned_p[:p_tot])
+        return assigned, rounds, avail
+
+    return run
+
+
+def sharded_assign_cycle(mesh, arrays: dict, weights, max_rounds: int = 32):
+    """Run one cycle over the mesh. ``arrays`` are the PackedCluster device
+    arrays with N pre-padded to a tp multiple (pods pad internally, post-
+    permute).  Returns (assigned [P], rounds, avail [N_padded,2])."""
+    assert arrays["node_avail"].shape[0] % mesh.shape["tp"] == 0
+    return _build_sharded_fn(mesh, max_rounds)(arrays, weights)
+
+
+class ShardedBackend(SchedulingBackend):
+    """SchedulingBackend over a device mesh — DP×TP distribution of the
+    cycle.  Drop-in for TpuBackend; used by dryrun_multichip and the
+    multi-chip benches."""
+
+    name = "tpu-sharded"
+
+    def __init__(self, mesh=None, tp: int | None = None):
+        self.mesh = mesh if mesh is not None else make_mesh(tp=tp)
+
+    def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
+        tp = self.mesh.shape["tp"]
+        a = dict(packed.device_arrays())
+        # Node padding to the tp multiple happens here; pod padding to the dp
+        # multiple happens inside the jitted run, after the priority permute.
+        n_pad = round_up(packed.padded_nodes, tp)
+        for k in ("node_alloc", "node_avail", "node_labels"):
+            a[k] = np.pad(a[k], ((0, n_pad - packed.padded_nodes), (0, 0)))
+        a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - packed.padded_nodes),))
+        assigned, rounds, _avail = sharded_assign_cycle(self.mesh, a, packed_weights(profile), profile.max_rounds)
+        return np.asarray(jax.device_get(assigned)), int(rounds)
+
+
+def packed_weights(profile: SchedulingProfile):
+    return jnp.asarray(profile.weights())

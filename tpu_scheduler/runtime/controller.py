@@ -1,0 +1,282 @@
+"""The scheduler control loop — capability parity with ``src/main.rs``.
+
+Two scheduling policies behind one loop:
+
+  • ``batch`` (the TPU-native default): every eligible pending pod is packed
+    and assigned in one backend cycle (ops/assign.py), then bindings POST to
+    the API server.  This replaces the reference's per-pod reconcile
+    (``main.rs:73-120``) with the batched north-star path.
+  • ``sample``: a faithful re-expression of the reference's policy —
+    ≤ ``attempts`` random candidates with replacement from the node cache,
+    first to pass the predicate chain wins (``main.rs:49-71``) — useful as a
+    behavioral oracle and as the zero-dependency degraded mode.  Unlike the
+    reference it commits against an assumed-resources ledger, closing the
+    TOCTOU oversubscription race SURVEY.md §5 documents.
+
+Shared semantics with the reference:
+  • watches pending pods / all nodes through reflectors (main.rs:133-144)
+  • skips already-bound pods (main.rs:74-76)
+  • failed pods (no node, binding error) requeue after ``requeue_seconds``
+    (error_policy, main.rs:122-125; default 300 s)
+  • TPU-backend failure falls back to the native backend (SURVEY.md §5
+    failure handling; the --backend flag makes native the recovery path).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+
+from ..api.objects import Node, ObjectReference, Pod, PodResources, full_name, is_pod_bound, total_pod_resources
+from ..backends.base import SchedulingBackend
+from ..core.predicates import InvalidNodeReason, node_selector_matches
+from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
+from ..errors import CreateBindingFailed, NoNodeFound
+from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
+from ..ops.pack import pack_snapshot, repack_incremental
+from ..utils.metrics import CycleMetrics, MetricsRegistry
+from ..utils.tracing import Trace, span
+from .fake_api import ApiError, FakeApiServer
+from .reflector import ClusterReflector
+
+logger = logging.getLogger("tpu_scheduler.controller")
+
+__all__ = ["Scheduler", "ATTEMPTS", "REQUEUE_SECONDS"]
+
+ATTEMPTS = 5  # reference main.rs:49
+REQUEUE_SECONDS = 300.0  # reference main.rs:124
+
+
+class Scheduler:
+    def __init__(
+        self,
+        api: FakeApiServer,
+        backend: SchedulingBackend,
+        profile: SchedulingProfile = DEFAULT_PROFILE,
+        policy: str = "batch",
+        attempts: int = ATTEMPTS,
+        requeue_seconds: float = REQUEUE_SECONDS,
+        fallback_backend: SchedulingBackend | None = None,
+        clock=time.monotonic,
+        rng: random.Random | None = None,
+        pod_block: int = 128,
+        node_block: int = 128,
+    ):
+        if policy not in ("batch", "sample"):
+            raise ValueError(f"unknown policy {policy!r} (expected 'batch' or 'sample')")
+        self.api = api
+        self.backend = backend
+        self.profile = profile
+        self.policy = policy
+        self.attempts = attempts
+        self.requeue_seconds = requeue_seconds
+        self.fallback_backend = fallback_backend
+        self.clock = clock
+        self.rng = rng or random.Random()
+        self.pod_block = pod_block
+        self.node_block = node_block
+        self.reflector = ClusterReflector(api)
+        self.metrics = MetricsRegistry()
+        self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
+        self._cycle_count = 0
+        self._packed = None
+        self._node_sig = None
+
+    # -- eligibility -------------------------------------------------------
+
+    def _eligible(self, pending: list[Pod]) -> list[Pod]:
+        now = self.clock()
+        out = []
+        for p in pending:
+            retry_at = self.requeue_at.get(full_name(p))
+            if retry_at is None or retry_at <= now:
+                out.append(p)
+        return out
+
+    def _requeue(self, pod_name: str, reason: str) -> None:
+        self.requeue_at[pod_name] = self.clock() + self.requeue_seconds
+        self.metrics.inc("scheduler_requeues_total")
+        logger.warning("reconcile failed on pod %s: %s; requeue in %.0fs", pod_name, reason, self.requeue_seconds)
+
+    # -- binding (main.rs:83-115) -----------------------------------------
+
+    def _bind(self, namespace: str, name: str, node_name: str) -> bool:
+        pod_full = f"{namespace}/{name}"
+        try:
+            self.api.create_binding(namespace, name, ObjectReference(name=node_name))
+            logger.info("Binding pod %s to %s", pod_full, node_name)
+            self.metrics.inc("scheduler_bindings_total")
+            self.requeue_at.pop(pod_full, None)
+            return True
+        except CreateBindingFailed as e:
+            self._requeue(pod_full, f"create-binding-failed: {e}")
+            return False
+        except ApiError as e:
+            if e.code == 409:
+                # Already bound elsewhere (await_change, main.rs:74-76).
+                logger.info("pod %s already bound; skipping", pod_full)
+                return False
+            self._requeue(pod_full, f"api-error: {e}")
+            return False
+
+    # -- batch policy ------------------------------------------------------
+
+    def _pack(self, snapshot: ClusterSnapshot):
+        """Full pack, or incremental avail-refresh when the node set and the
+        selector vocabulary are stable (the device-resident tensor path)."""
+        sig = self.reflector.node_set_signature()
+        pending = snapshot.pending_pods()
+        if (
+            self._packed is not None
+            and sig == self._node_sig
+            and all(
+                kv in self._packed.vocab
+                for p in pending
+                if p.spec is not None and p.spec.node_selector
+                for kv in p.spec.node_selector.items()
+            )
+        ):
+            packed = repack_incremental(self._packed, snapshot, pod_block=self.pod_block)
+            self.metrics.inc("scheduler_incremental_packs_total")
+        else:
+            packed = pack_snapshot(snapshot, pod_block=self.pod_block, node_block=self.node_block)
+            self._node_sig = sig
+            self.metrics.inc("scheduler_full_packs_total")
+        self._packed = packed
+        return packed
+
+    def _run_batch_cycle(self, snapshot: ClusterSnapshot, trace: Trace) -> tuple[int, int, int]:
+        with span("pack"):
+            packed = self._pack(snapshot)
+        with span("solve"):
+            try:
+                result = self.backend.schedule(packed, self.profile)
+            except Exception as e:
+                if self.fallback_backend is None:
+                    raise
+                logger.error("backend %s failed (%s); falling back to %s", self.backend.name, e, self.fallback_backend.name)
+                self.metrics.inc("scheduler_backend_fallbacks_total")
+                result = self.fallback_backend.schedule(packed, self.profile)
+        bound = 0
+        with span("bind"):
+            for pod_full, node_name in result.bindings:
+                namespace, _, name = pod_full.rpartition("/")
+                if self._bind(namespace or "default", name, node_name):
+                    bound += 1
+            for pod_full in result.unschedulable:
+                self._requeue(pod_full, "no-node-found")
+        return bound, len(result.unschedulable), result.rounds
+
+    # -- sample policy (reference main.rs:49-71) ---------------------------
+
+    def _select_node_sample(self, pod: Pod, snapshot: ClusterSnapshot, ledger: dict[str, PodResources]) -> Node | None:
+        nodes = self.reflector.nodes.state()
+        if not nodes:
+            return None
+        for _ in range(self.attempts):
+            candidate = self.rng.choice(nodes)  # with replacement, main.rs:56
+            reason = self._check_with_ledger(pod, candidate, snapshot, ledger)
+            if reason is None:
+                return candidate
+            logger.debug("Node %s failed validity check for pod %s: %s", candidate.name, full_name(pod), reason)
+        return None
+
+    @staticmethod
+    def _check_with_ledger(
+        pod: Pod, node: Node, snapshot: ClusterSnapshot, ledger: dict[str, PodResources]
+    ) -> InvalidNodeReason | None:
+        """Predicate chain vs snapshot + this-loop commitments (the assumed-
+        resources ledger that closes the reference's TOCTOU race)."""
+        available = node_allocatable(node)
+        available -= node_used_resources(snapshot, node.name)
+        assumed = ledger.get(node.name)
+        if assumed is not None:
+            available -= assumed
+        req = total_pod_resources(pod)
+        if not (req.cpu <= available.cpu and req.memory <= available.memory):
+            return InvalidNodeReason.NOT_ENOUGH_RESOURCES
+        if not node_selector_matches(pod, node):
+            return InvalidNodeReason.NODE_SELECTOR_MISMATCH
+        return None
+
+    def _run_sample_cycle(self, snapshot: ClusterSnapshot, pending: list[Pod]) -> tuple[int, int]:
+        ledger: dict[str, PodResources] = {}
+        bound = 0
+        unschedulable = 0
+        for pod in pending:
+            node = self._select_node_sample(pod, snapshot, ledger)
+            if node is None:
+                self._requeue(full_name(pod), "no-node-found")
+                unschedulable += 1
+                continue
+            if self._bind(pod.metadata.namespace or "default", pod.metadata.name, node.name):
+                bound += 1
+                committed = ledger.setdefault(node.name, PodResources())
+                committed += total_pod_resources(pod)
+        return bound, unschedulable
+
+    # -- the loop ----------------------------------------------------------
+
+    def run_cycle(self) -> CycleMetrics:
+        t0 = time.perf_counter()
+        trace = Trace()
+        with trace:
+            with span("sync"):
+                self.reflector.sync()
+                snapshot = self.reflector.snapshot()
+            pending_all = snapshot.pending_pods()
+            pending = self._eligible(pending_all)
+            # Prune requeue backoffs for pods that no longer exist / are no
+            # longer pending (deleted, or bound out-of-band).
+            pending_names = {full_name(p) for p in pending_all}
+            for gone in [k for k in self.requeue_at if k not in pending_names]:
+                del self.requeue_at[gone]
+            if pending:
+                # Schedule only eligible pods; bound pods — including
+                # bound-but-still-Pending ones (kubelet lag) — count capacity.
+                eligible_names = {full_name(p) for p in pending}
+                cycle_snapshot = ClusterSnapshot.build(
+                    snapshot.nodes,
+                    [
+                        p
+                        for p in snapshot.pods
+                        if p.status.phase != "Pending" or is_pod_bound(p) or full_name(p) in eligible_names
+                    ],
+                )
+                if self.policy == "batch":
+                    bound, unsched, rounds = self._run_batch_cycle(cycle_snapshot, trace)
+                else:
+                    bound, unsched = self._run_sample_cycle(cycle_snapshot, pending)
+                    rounds = self.attempts
+            else:
+                bound, unsched, rounds = 0, 0, 0
+
+        self._cycle_count += 1
+        wall = time.perf_counter() - t0
+        durations = trace.summary()
+        m = CycleMetrics(
+            cycle=self._cycle_count,
+            backend=self.backend.name if self.policy == "batch" else f"sample×{self.attempts}",
+            pending=len(pending),
+            bound=bound,
+            unschedulable=unsched,
+            rounds=rounds,
+            wall_seconds=wall,
+            pack_seconds=durations.get("pack", 0.0),
+            solve_seconds=durations.get("solve", 0.0),
+            bind_seconds=durations.get("bind", 0.0),
+        )
+        self.metrics.observe_cycle(m)
+        return m
+
+    def run(self, max_cycles: int | None = None, until_settled: bool = False) -> list[CycleMetrics]:
+        """Run cycles; with ``until_settled`` stop once a cycle binds nothing
+        and nothing new is pending (the steady state a test/bench wants)."""
+        out = []
+        while max_cycles is None or len(out) < max_cycles:
+            m = self.run_cycle()
+            out.append(m)
+            if until_settled and m.bound == 0:
+                break
+        return out

@@ -1,0 +1,218 @@
+"""In-memory Kubernetes-style API server.
+
+The reference can only run against a real cluster (``src/main.rs:130``,
+``README.md:27-28``) and its API-dependent predicate was therefore untestable
+(SURVEY.md §4 — the unused mockall deps).  This fake server delivers what the
+reference merely *intended*: full watch/list/bind semantics in-process, so the
+whole control loop is exercised by unit tests and synthetic benchmarks.
+
+Capabilities (matching what the reference consumes from kube):
+  • typed stores of Nodes and Pods with resourceVersion bookkeeping
+  • watch streams with ADDED/MODIFIED/DELETED events and field selectors
+    (``status.phase=Pending`` — main.rs:141-142; ``spec.nodeName=X`` —
+    predicates.rs:22-26)
+  • list with the same field selectors
+  • the Binding subresource (main.rs:94-109): sets ``spec.nodeName``, flips
+    phase to Running (standing in for the kubelet), 409s on conflicts
+  • fault injection for the error paths (CreateBindingFailed → requeue)
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, replace
+from typing import Callable, Iterable
+
+from ..api.objects import Node, ObjectReference, Pod, is_pod_bound
+from ..errors import CreateBindingFailed
+
+__all__ = ["ApiError", "WatchEvent", "Watch", "FakeApiServer"]
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: Pod | Node
+
+
+def _field_selector_fn(selector: str | None) -> Callable[[Pod | Node], bool]:
+    """Supports the two k8s field-selector shapes the reference uses."""
+    if not selector:
+        return lambda obj: True
+
+    clauses = []
+    for part in selector.split(","):
+        path, _, want = part.partition("=")
+        path = path.strip()
+        want = want.strip()
+        if path == "status.phase":
+            clauses.append(lambda o, w=want: getattr(o.status, "phase", None) == w)
+        elif path == "spec.nodeName":
+            clauses.append(lambda o, w=want: o.spec is not None and o.spec.node_name == w)
+        elif path == "metadata.name":
+            clauses.append(lambda o, w=want: o.metadata.name == w)
+        else:
+            raise ApiError(400, f"unsupported field selector {path!r}")
+    return lambda obj: all(c(obj) for c in clauses)
+
+
+class Watch:
+    """A subscription to a kind's event stream (the reflector's feed)."""
+
+    def __init__(self, server: "FakeApiServer", kind: str, selector: str | None):
+        self._server = server
+        self._kind = kind
+        self._match = _field_selector_fn(selector)
+        self._queue: deque[WatchEvent] = deque()
+
+    def _offer(self, event: WatchEvent) -> None:
+        if self._match(event.object):
+            self._queue.append(event)
+
+    def poll(self) -> list[WatchEvent]:
+        """Drain currently-queued events (non-blocking)."""
+        with self._server._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        return out
+
+    def close(self) -> None:
+        with self._server._lock:
+            self._server._watches[self._kind].discard(self)
+
+
+class FakeApiServer:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes: dict[str, Node] = {}
+        self._pods: dict[tuple[str, str], Pod] = {}  # (namespace, name)
+        self._rv = 0
+        self._watches: dict[str, set[Watch]] = {"Node": set(), "Pod": set()}
+        # Fault injection: number of upcoming binding calls to fail with 500.
+        self.fail_next_bindings = 0
+        self.binding_count = 0
+
+    # -- internals ---------------------------------------------------------
+
+    def _emit(self, kind: str, event: WatchEvent) -> None:
+        for w in self._watches[kind]:
+            w._offer(event)
+
+    def _bump(self, obj: Pod | Node) -> None:
+        self._rv += 1
+        obj.metadata.resource_version = self._rv
+
+    @staticmethod
+    def _pod_key(pod: Pod) -> tuple[str, str]:
+        return (pod.metadata.namespace or "default", pod.metadata.name)
+
+    # -- nodes -------------------------------------------------------------
+
+    def create_node(self, node: Node) -> None:
+        with self._lock:
+            if node.name in self._nodes:
+                raise ApiError(409, f"node {node.name} exists")
+            self._bump(node)
+            self._nodes[node.name] = node
+            self._emit("Node", WatchEvent("ADDED", node))
+
+    def update_node(self, node: Node) -> None:
+        with self._lock:
+            if node.name not in self._nodes:
+                raise ApiError(404, f"node {node.name} not found")
+            self._bump(node)
+            self._nodes[node.name] = node
+            self._emit("Node", WatchEvent("MODIFIED", node))
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is None:
+                raise ApiError(404, f"node {name} not found")
+            self._emit("Node", WatchEvent("DELETED", node))
+
+    def list_nodes(self) -> list[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def watch_nodes(self, field_selector: str | None = None, send_initial: bool = True) -> Watch:
+        with self._lock:
+            w = Watch(self, "Node", field_selector)
+            self._watches["Node"].add(w)
+            if send_initial:
+                for node in self._nodes.values():
+                    w._offer(WatchEvent("ADDED", node))
+            return w
+
+    # -- pods --------------------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> None:
+        with self._lock:
+            key = self._pod_key(pod)
+            if key in self._pods:
+                raise ApiError(409, f"pod {key} exists")
+            self._bump(pod)
+            self._pods[key] = pod
+            self._emit("Pod", WatchEvent("ADDED", pod))
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+            if pod is None:
+                raise ApiError(404, f"pod {namespace}/{name} not found")
+            self._emit("Pod", WatchEvent("DELETED", pod))
+
+    def list_pods(self, field_selector: str | None = None) -> list[Pod]:
+        match = _field_selector_fn(field_selector)
+        with self._lock:
+            return [p for p in self._pods.values() if match(p)]
+
+    def watch_pods(self, field_selector: str | None = None, send_initial: bool = True) -> Watch:
+        with self._lock:
+            w = Watch(self, "Pod", field_selector)
+            self._watches["Pod"].add(w)
+            if send_initial:
+                for pod in self._pods.values():
+                    w._offer(WatchEvent("ADDED", pod))
+            return w
+
+    # -- binding subresource (main.rs:94-109) ------------------------------
+
+    def create_binding(self, namespace: str, pod_name: str, target: ObjectReference) -> None:
+        """POST /api/v1/namespaces/{ns}/pods/{name}/binding."""
+        with self._lock:
+            self.binding_count += 1
+            if self.fail_next_bindings > 0:
+                self.fail_next_bindings -= 1
+                raise CreateBindingFailed(f"injected API failure binding {namespace}/{pod_name}")
+            pod = self._pods.get((namespace, pod_name))
+            if pod is None:
+                raise ApiError(404, f"pod {namespace}/{pod_name} not found")
+            if is_pod_bound(pod):
+                raise ApiError(409, f"pod {namespace}/{pod_name} already bound")
+            if target.name not in self._nodes:
+                raise ApiError(404, f"node {target.name} not found")
+            new_spec = replace(pod.spec, node_name=target.name) if pod.spec is not None else None
+            if new_spec is None:
+                from ..api.objects import PodSpec
+
+                new_spec = PodSpec(node_name=target.name)
+            bound = replace(pod, spec=new_spec, status=replace(pod.status, phase="Running"))
+            self._bump(bound)
+            self._pods[(namespace, pod_name)] = bound
+            self._emit("Pod", WatchEvent("MODIFIED", bound))
+
+    # -- bulk helpers for synthetic clusters -------------------------------
+
+    def load(self, nodes: Iterable[Node] = (), pods: Iterable[Pod] = ()) -> None:
+        for n in nodes:
+            self.create_node(n)
+        for p in pods:
+            self.create_pod(p)

@@ -1,0 +1,65 @@
+"""Reflectors — watch-fed in-memory caches of cluster state.
+
+Equivalent of the reference's node reflector (``src/main.rs:133-139``:
+``reflector::store`` + ``watcher`` + backoff) and its Pending-pod controller
+feed (``main.rs:141-144``), generalised to both kinds.  The node cache is
+what becomes the device-resident node tensor (SURVEY.md §3.3); the pod cache
+replaces the reference's per-candidate live list (``predicates.rs:21-34``)
+so predicates never do I/O.
+"""
+
+from __future__ import annotations
+
+from ..api.objects import Node, Pod
+from ..core.snapshot import ClusterSnapshot
+from .fake_api import Watch, WatchEvent
+
+__all__ = ["Reflector", "ClusterReflector"]
+
+
+class Reflector:
+    """Applies watch events to a keyed store (kube-runtime reflector::store)."""
+
+    def __init__(self, watch: Watch, key_fn):
+        self._watch = watch
+        self._key = key_fn
+        self.store: dict = {}
+        self.events_seen = 0
+
+    def sync(self) -> list[WatchEvent]:
+        """Drain the watch and fold events into the store; returns the events
+        (the ``touched_objects`` stream, main.rs:137)."""
+        events = self._watch.poll()
+        for ev in events:
+            key = self._key(ev.object)
+            if ev.type == "DELETED":
+                self.store.pop(key, None)
+            else:
+                self.store[key] = ev.object
+            self.events_seen += 1
+        return events
+
+    def state(self) -> list:
+        """Snapshot of cached objects (reflector Store::state, main.rs:56)."""
+        return list(self.store.values())
+
+
+class ClusterReflector:
+    """Node + pod reflectors combined into cycle snapshots."""
+
+    def __init__(self, api):
+        self.api = api
+        self.nodes = Reflector(api.watch_nodes(), key_fn=lambda n: n.name)
+        self.pods = Reflector(api.watch_pods(), key_fn=lambda p: (p.metadata.namespace, p.metadata.name))
+
+    def sync(self) -> tuple[int, int]:
+        """Drain both watches; returns (node_events, pod_events)."""
+        return len(self.nodes.sync()), len(self.pods.sync())
+
+    def snapshot(self) -> ClusterSnapshot:
+        return ClusterSnapshot.build(self.nodes.state(), self.pods.state())
+
+    def node_set_signature(self) -> tuple[tuple[str, int], ...]:
+        """(name, resourceVersion) per node — cheap change detection for
+        deciding between full repack and incremental avail refresh."""
+        return tuple(sorted((n.name, n.metadata.resource_version) for n in self.nodes.state()))

@@ -77,6 +77,8 @@ def synth_cluster(
     realistic partially-full nodes.
     """
     rng = random.Random(seed)
+    if n_nodes == 0:
+        n_bound = 0  # bound pods need a node to be bound to
     nodes = []
     for i in range(n_nodes):
         cores, gib = _NODE_SHAPES[i % len(_NODE_SHAPES)]

@@ -1,0 +1,60 @@
+"""Cycle metrics — pods-bound/sec and cycle wall-clock are the north-star
+numbers (BASELINE.md); the reference exposes no metrics at all (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["CycleMetrics", "MetricsRegistry"]
+
+
+@dataclass
+class CycleMetrics:
+    cycle: int
+    backend: str
+    pending: int
+    bound: int
+    unschedulable: int
+    rounds: int
+    wall_seconds: float
+    pack_seconds: float = 0.0
+    solve_seconds: float = 0.0
+    bind_seconds: float = 0.0
+
+    @property
+    def pods_per_second(self) -> float:
+        return self.bound / self.wall_seconds if self.wall_seconds > 0 else 0.0
+
+    def to_json(self) -> str:
+        d = self.__dict__.copy()
+        d["pods_per_second"] = round(self.pods_per_second, 2)
+        return json.dumps(d)
+
+
+@dataclass
+class MetricsRegistry:
+    """Process counters (Prometheus-style names, in-memory registry)."""
+
+    counters: dict[str, int] = field(default_factory=dict)
+    cycles: list[CycleMetrics] = field(default_factory=list)
+    started_at: float = field(default_factory=time.time)
+
+    def inc(self, name: str, value: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + value
+
+    def observe_cycle(self, m: CycleMetrics) -> None:
+        self.cycles.append(m)
+        self.inc("scheduler_cycles_total")
+        self.inc("scheduler_pods_bound_total", m.bound)
+        self.inc("scheduler_pods_unschedulable_total", m.unschedulable)
+
+    def snapshot(self) -> dict:
+        out = dict(self.counters)
+        if self.cycles:
+            last = self.cycles[-1]
+            out["scheduler_last_cycle_seconds"] = last.wall_seconds
+            out["scheduler_last_pods_per_second"] = last.pods_per_second
+        return out

@@ -1,0 +1,94 @@
+"""Structured tracing — the build-side answer to the reference's flat
+``tracing_subscriber::fmt()`` INFO logging (``src/main.rs:129``; SURVEY.md §5
+calls for per-cycle spans + optional device profiler traces).
+
+``span("name")`` times a block, logs it, and records the duration into the
+active ``Trace`` (if any).  ``device_profile(dir)`` wraps ``jax.profiler`` for
+TPU traces of the scoring step; it is a no-op if profiling can't start.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+
+logger = logging.getLogger("tpu_scheduler")
+
+__all__ = ["span", "Trace", "current_trace", "device_profile", "configure_logging"]
+
+_active: list["Trace"] = []
+
+
+def configure_logging(level: str = "INFO") -> None:
+    """Process-wide log init (the main.rs:129 equivalent), level configurable
+    — the reference hard-codes INFO."""
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+
+class Trace:
+    """Accumulates named span durations (seconds) for one scope (e.g. one
+    scheduling cycle)."""
+
+    def __init__(self):
+        self.durations: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    def record(self, name: str, seconds: float) -> None:
+        self.durations[name] += seconds
+        self.counts[name] += 1
+
+    def __enter__(self) -> "Trace":
+        _active.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _active.remove(self)
+
+    def summary(self) -> dict[str, float]:
+        return dict(self.durations)
+
+
+def current_trace() -> Trace | None:
+    return _active[-1] if _active else None
+
+
+@contextlib.contextmanager
+def span(name: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        tr = current_trace()
+        if tr is not None:
+            tr.record(name, dt)
+        logger.debug("span %s took %.6fs", name, dt)
+
+
+@contextlib.contextmanager
+def device_profile(log_dir: str | None):
+    """jax.profiler trace around a block; inert when log_dir is None."""
+    if not log_dir:
+        yield
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # pragma: no cover - profiler availability varies
+        logger.warning("device profiling unavailable: %s", e)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover
+                pass
