@@ -47,6 +47,7 @@ struct Parsed {
     unsigned __int128 mantissa;  // digits with the dot removed (saturating)
     int dec_exp;                 // power of ten (fraction digits + suffix/exponent)
     int bin_exp;                 // power of two (binary SI suffixes)
+    bool inexact;                // saturation dropped digits: result is approximate
 };
 
 // Python's parse_quantity does s.strip(): allow any surrounding whitespace.
@@ -58,7 +59,7 @@ static bool at_end(const char* c) {
 // Grammar: sign? digits ('.' digits?)? (suffix | [eE] sign? digits)?
 // suffix: n u m k M G T P E | Ki Mi Gi Ti Pi Ei       (api/quantity.py)
 static Parsed parse(const char* s) {
-    Parsed p = {false, false, 0, 0, 0};
+    Parsed p = {false, false, 0, 0, 0, false};
     if (s == nullptr) return p;
     const char* c = s;
     while (isspace((unsigned char)*c)) c++;
@@ -73,9 +74,13 @@ static Parsed parse(const char* s) {
         if (*c >= '0' && *c <= '9') {
             any_digit = true;
             if (!saturated) {
-                unsigned __int128 next = p.mantissa * 10 + (unsigned)(*c - '0');
-                if (next < p.mantissa) saturated = true;
-                else p.mantissa = next;
+                // Overflow-safe: 10*m+d wraps mod 2^128 and can land back
+                // above m, so a post-hoc `next < m` test misses wraps —
+                // check against the ceiling before multiplying.
+                const unsigned __int128 MAX_U128 = ~(unsigned __int128)0;
+                unsigned d = (unsigned)(*c - '0');
+                if (p.mantissa > (MAX_U128 - d) / 10) { saturated = true; p.inexact = true; }
+                else p.mantissa = p.mantissa * 10 + d;
             }
             if (saturated && !in_frac) p.dec_exp++;  // keep magnitude
             if (in_frac && !saturated) frac_digits++;
@@ -145,8 +150,9 @@ static Parsed parse(const char* s) {
 
 // ceil(value * scale) clamped to int64, where scale is 10^scale_exp10.
 // cpu -> millicores: scale_exp10 = 3; memory -> bytes: scale_exp10 = 0.
-static bool to_int_ceil(const Parsed& p, int scale_exp10, int64_t* out) {
+static bool to_int_ceil(const Parsed& p, int scale_exp10, int64_t* out, bool* inexact) {
     if (!p.ok) return false;
+    if (p.inexact && inexact) *inexact = true;
     int dec = p.dec_exp + scale_exp10;
     unsigned __int128 m = p.mantissa;
     if (m > (unsigned __int128)I128_MAX_SENTINEL) m = (unsigned __int128)I128_MAX_SENTINEL;
@@ -155,6 +161,10 @@ static bool to_int_ceil(const Parsed& p, int scale_exp10, int64_t* out) {
     if (dec >= 0) num = mul_sat(num, pow_sat(10, dec));
     else den = pow_sat(10, -dec);
     num = mul_sat(num, pow_sat(2, p.bin_exp > 0 ? p.bin_exp : 0));
+    // Any rail hit in the scaling math means digits of precision were lost;
+    // equality with the rail is conservatively treated as a hit (the caller
+    // re-derives the exact value through the Python oracle).
+    if (inexact && (num >= I128_MAX_SENTINEL || den >= I128_MAX_SENTINEL)) *inexact = true;
 
     __int128 q;
     if (p.negative) {
@@ -180,40 +190,55 @@ enum { MODE_CPU_MILLIS = 0, MODE_MEM_BYTES = 1 };
 int tpusched_parse(const char* s, int mode, int64_t* out) {
     Parsed p = parse(s);
     if (!p.ok) return 0;
-    return to_int_ceil(p, mode == MODE_CPU_MILLIS ? 3 : 0, out) ? 1 : 0;
+    return to_int_ceil(p, mode == MODE_CPU_MILLIS ? 3 : 0, out, nullptr) ? 1 : 0;
 }
 
 // Batch parse: returns -1 on full success, else the index of the first
 // invalid quantity.  `strs` is an array of NUL-terminated UTF-8 strings.
-int64_t tpusched_batch_parse(const char** strs, int64_t n, int mode, int64_t* out) {
+// `inexact` (nullable, [n]) is set to 1 where saturation made the result
+// approximate — the Python wrapper recomputes those via the exact oracle.
+int64_t tpusched_batch_parse_ex(const char** strs, int64_t n, int mode, int64_t* out, unsigned char* inexact) {
     int scale = (mode == MODE_CPU_MILLIS) ? 3 : 0;
     for (int64_t i = 0; i < n; i++) {
+        bool inx = false;
         Parsed p = parse(strs[i]);
-        if (!p.ok || !to_int_ceil(p, scale, &out[i])) return i;
+        if (!p.ok || !to_int_ceil(p, scale, &out[i], &inx)) return i;
+        if (inexact) inexact[i] = inx ? 1 : 0;
     }
     return -1;
+}
+
+int64_t tpusched_batch_parse(const char** strs, int64_t n, int mode, int64_t* out) {
+    return tpusched_batch_parse_ex(strs, n, mode, out, nullptr);
 }
 
 // Batch pack of pod requests: given per-pod (cpu_str, mem_str) arrays,
 // produce the int32 (millicores, KiB-ceil) rows of ops/pack.py, clamped to
 // int32 — the tensor-packing fast path.  Returns -1 or first bad index.
-int64_t tpusched_pack_requests(const char** cpu_strs, const char** mem_strs, int64_t n, int32_t* out /* [n,2] */) {
+int64_t tpusched_pack_requests_ex(const char** cpu_strs, const char** mem_strs, int64_t n, int32_t* out /* [n,2] */,
+                                  unsigned char* inexact /* nullable, [n] */) {
     const int64_t I32_MAX = 2147483647LL;
     for (int64_t i = 0; i < n; i++) {
         int64_t cpu = 0, mem = 0;
+        bool inx = false;
         if (cpu_strs[i] != nullptr) {
             Parsed p = parse(cpu_strs[i]);
-            if (!p.ok || !to_int_ceil(p, 3, &cpu)) return i;
+            if (!p.ok || !to_int_ceil(p, 3, &cpu, &inx)) return i;
         }
         if (mem_strs[i] != nullptr) {
             Parsed p = parse(mem_strs[i]);
-            if (!p.ok || !to_int_ceil(p, 0, &mem)) return i;
+            if (!p.ok || !to_int_ceil(p, 0, &mem, &inx)) return i;
         }
+        if (inexact) inexact[i] = inx ? 1 : 0;
         int64_t kib = (mem >= 0) ? (mem + 1023) / 1024 : mem / 1024;
         out[i * 2] = (int32_t)(cpu > I32_MAX ? I32_MAX : (cpu < -I32_MAX ? -I32_MAX : cpu));
         out[i * 2 + 1] = (int32_t)(kib > I32_MAX ? I32_MAX : (kib < -I32_MAX ? -I32_MAX : kib));
     }
     return -1;
+}
+
+int64_t tpusched_pack_requests(const char** cpu_strs, const char** mem_strs, int64_t n, int32_t* out /* [n,2] */) {
+    return tpusched_pack_requests_ex(cpu_strs, mem_strs, n, out, nullptr);
 }
 
 }  // extern "C"

@@ -17,3 +17,34 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
+
+import subprocess  # noqa: E402
+
+import pytest  # noqa: E402
+
+NATIVE_DIR = "/root/repo/native"
+
+
+class FakeClock:
+    """Deterministic time source for requeue-backoff tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def fake_clock():
+    return FakeClock()
+
+
+def ensure_native_shim():
+    """Build libtpusched.so via make if missing; idempotent."""
+    from tpu_scheduler.ops import native_ext
+
+    if not native_ext.available():
+        subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
+        native_ext._lib.cache_clear()
+    assert native_ext.available(), "libtpusched.so failed to build"

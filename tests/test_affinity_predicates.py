@@ -1,0 +1,184 @@
+"""Scalar-oracle tests for the config-5 predicates: inter-pod anti-affinity
+(both directions, namespace scoping, singleton domains) and hard topology
+spread.  These define the semantics the batched backends must reproduce."""
+
+import pytest
+
+from tpu_scheduler.api.objects import PodAntiAffinityTerm, TopologySpreadConstraint
+from tpu_scheduler.core.predicates import (
+    InvalidNodeReason,
+    anti_affinity_ok,
+    check_node_validity,
+    labels_match_selector,
+    node_topology_domain,
+    topology_spread_ok,
+)
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.testing import make_node, make_pod
+
+
+def zone_nodes():
+    return [
+        make_node("n0", cpu=16, memory="64Gi", labels={"zone": "a"}),
+        make_node("n1", cpu=16, memory="64Gi", labels={"zone": "a"}),
+        make_node("n2", cpu=16, memory="64Gi", labels={"zone": "b"}),
+        make_node("n3", cpu=16, memory="64Gi"),  # keyless → singleton domain
+    ]
+
+
+def snap(nodes, pods):
+    return ClusterSnapshot.build(nodes, pods)
+
+
+# --- selector + domain helpers -----------------------------------------------
+
+
+def test_empty_selector_matches_nothing():
+    assert not labels_match_selector(None, {"a": "b"})
+    assert not labels_match_selector({}, {"a": "b"})
+    assert not labels_match_selector({"a": "b"}, None)
+    assert labels_match_selector({"a": "b"}, {"a": "b", "c": "d"})
+    assert not labels_match_selector({"a": "b", "x": "y"}, {"a": "b"})
+
+
+def test_node_topology_domain_singleton_for_keyless():
+    n = make_node("nx", labels={"zone": "a"})
+    assert node_topology_domain(n, "zone") == ("zone", "a")
+    assert node_topology_domain(n, "rack") == ("~node", "nx")
+
+
+# --- anti-affinity -----------------------------------------------------------
+
+
+def term(labels, key="zone"):
+    return [PodAntiAffinityTerm(match_labels=labels, topology_key=key)]
+
+
+def test_anti_affinity_direction_a_blocks_same_domain():
+    nodes = zone_nodes()
+    placed = make_pod("web-0", labels={"app": "web"}, node_name="n0", phase="Running")
+    s = snap(nodes, [placed])
+    pod = make_pod("web-1", labels={"app": "web"}, anti_affinity=term({"app": "web"}))
+    assert not anti_affinity_ok(pod, nodes[0], s)  # same zone a
+    assert not anti_affinity_ok(pod, nodes[1], s)  # other node, same zone a
+    assert anti_affinity_ok(pod, nodes[2], s)  # zone b
+    assert anti_affinity_ok(pod, nodes[3], s)  # keyless singleton
+
+
+def test_anti_affinity_direction_b_symmetric():
+    nodes = zone_nodes()
+    # The *placed* pod carries the term; the incoming pod carries only labels.
+    placed = make_pod(
+        "guard", labels={"app": "web"}, node_name="n0", phase="Running", anti_affinity=term({"app": "web"})
+    )
+    s = snap(nodes, [placed])
+    incoming = make_pod("web-1", labels={"app": "web"})
+    assert not anti_affinity_ok(incoming, nodes[1], s)  # zone a blocked by guard's term
+    assert anti_affinity_ok(incoming, nodes[2], s)
+
+
+def test_anti_affinity_namespace_scoped():
+    nodes = zone_nodes()
+    placed = make_pod("web-0", namespace="other", labels={"app": "web"}, node_name="n0", phase="Running")
+    s = snap(nodes, [placed])
+    pod = make_pod("web-1", namespace="default", labels={"app": "web"}, anti_affinity=term({"app": "web"}))
+    assert anti_affinity_ok(pod, nodes[0], s)  # different namespace → no conflict
+
+
+def test_anti_affinity_keyless_node_is_per_node():
+    nodes = zone_nodes()
+    placed = make_pod("web-0", labels={"app": "web"}, node_name="n3", phase="Running")
+    s = snap(nodes, [placed])
+    pod = make_pod("web-1", labels={"app": "web"}, anti_affinity=term({"app": "web"}, key="rack"))
+    # All four nodes lack "rack" → singleton domains: only n3 conflicts.
+    assert not anti_affinity_ok(pod, nodes[3], s)
+    assert anti_affinity_ok(pod, nodes[0], s)
+
+
+def test_anti_affinity_empty_selector_is_vacuous():
+    nodes = zone_nodes()
+    placed = make_pod("web-0", labels={"app": "web"}, node_name="n0", phase="Running")
+    s = snap(nodes, [placed])
+    pod = make_pod("web-1", labels={"app": "web"}, anti_affinity=term(None))
+    assert anti_affinity_ok(pod, nodes[0], s)
+
+
+# --- topology spread ---------------------------------------------------------
+
+
+def spread(key="zone", skew=1, labels=None):
+    return [TopologySpreadConstraint(topology_key=key, max_skew=skew, match_labels=labels or {"app": "web"})]
+
+
+def test_spread_blocks_skewed_domain():
+    nodes = zone_nodes()
+    placed = [
+        make_pod("w0", labels={"app": "web"}, node_name="n0", phase="Running"),
+        make_pod("w1", labels={"app": "web"}, node_name="n1", phase="Running"),
+    ]
+    s = snap(nodes, placed)
+    pod = make_pod("w2", labels={"app": "web"}, topology_spread=spread())
+    # zone a has 2, zone b has 0 → landing in a gives skew 3 > 1; b gives 1-0=1 ok.
+    assert not topology_spread_ok(pod, nodes[0], s)
+    assert topology_spread_ok(pod, nodes[2], s)
+
+
+def test_spread_keyless_node_exempt():
+    nodes = zone_nodes()
+    placed = [
+        make_pod("w0", labels={"app": "web"}, node_name="n0", phase="Running"),
+        make_pod("w1", labels={"app": "web"}, node_name="n1", phase="Running"),
+    ]
+    s = snap(nodes, placed)
+    pod = make_pod("w2", labels={"app": "web"}, topology_spread=spread())
+    assert topology_spread_ok(pod, nodes[3], s)  # n3 lacks "zone" → exempt
+
+
+def test_spread_counts_ignore_keyless_and_other_namespace():
+    nodes = zone_nodes()
+    placed = [
+        make_pod("w0", labels={"app": "web"}, node_name="n3", phase="Running"),  # keyless node
+        make_pod("w1", namespace="other", labels={"app": "web"}, node_name="n0", phase="Running"),
+    ]
+    s = snap(nodes, placed)
+    pod = make_pod("w2", labels={"app": "web"}, topology_spread=spread())
+    # Neither placed pod counts → all zone counts 0 → skew 1 anywhere labeled.
+    assert topology_spread_ok(pod, nodes[0], s)
+    assert topology_spread_ok(pod, nodes[2], s)
+
+
+def test_spread_max_skew_two():
+    nodes = zone_nodes()
+    placed = [
+        make_pod("w0", labels={"app": "web"}, node_name="n0", phase="Running"),
+    ]
+    s = snap(nodes, placed)
+    pod = make_pod("w1", labels={"app": "web"}, topology_spread=spread(skew=2))
+    assert topology_spread_ok(pod, nodes[0], s)  # 1+1-0 = 2 ≤ 2
+
+
+# --- chain integration -------------------------------------------------------
+
+
+def test_chain_reports_affinity_reasons():
+    nodes = zone_nodes()
+    placed = make_pod("web-0", labels={"app": "web"}, node_name="n0", phase="Running")
+    s = snap(nodes, [placed])
+    pod = make_pod("web-1", labels={"app": "web"}, anti_affinity=term({"app": "web"}))
+    assert check_node_validity(pod, nodes[0], s) is InvalidNodeReason.ANTI_AFFINITY_VIOLATION
+
+    placed2 = [
+        placed,
+        make_pod("web-2", labels={"app": "web"}, node_name="n1", phase="Running"),
+    ]
+    s2 = snap(nodes, placed2)
+    pod2 = make_pod("web-3", labels={"app": "web"}, topology_spread=spread())
+    assert check_node_validity(pod2, nodes[0], s2) is InvalidNodeReason.TOPOLOGY_SPREAD_VIOLATION
+
+
+def test_chain_passes_without_affinity():
+    nodes = zone_nodes()
+    s = snap(nodes, [])
+    pod = make_pod("plain")
+    for n in nodes:
+        assert check_node_validity(pod, n, s) is None

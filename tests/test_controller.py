@@ -13,12 +13,7 @@ from tpu_scheduler.runtime.fake_api import FakeApiServer
 from tpu_scheduler.testing import make_node, make_pod, synth_cluster
 
 
-class FakeClock:
-    def __init__(self):
-        self.t = 0.0
-
-    def __call__(self):
-        return self.t
+from conftest import FakeClock
 
 
 def make_cluster_api(n_nodes=10, n_pending=40, seed=0, **kw):

@@ -1,9 +1,7 @@
 """Native (C++) packing shim: exact agreement with the Python quantity
 oracle, fuzzed over the grammar; builds via make if missing."""
 
-import math
 import random
-import subprocess
 
 import numpy as np
 import pytest
@@ -11,15 +9,12 @@ import pytest
 from tpu_scheduler.api.quantity import QuantityError, cpu_to_millis, memory_to_bytes
 from tpu_scheduler.ops import native_ext
 
-NATIVE_DIR = "/root/repo/native"
-
 
 @pytest.fixture(scope="module", autouse=True)
 def built_lib():
-    if not native_ext.available():
-        subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
-        native_ext._lib.cache_clear()
-    assert native_ext.available(), "libtpusched.so failed to build"
+    from conftest import ensure_native_shim
+
+    ensure_native_shim()
 
 
 CASES = [

@@ -54,12 +54,7 @@ def test_negative_priority_parity_sharded():
     assert (native.assigned == sharded.assigned).all()
 
 
-class FakeClock:
-    def __init__(self):
-        self.t = 0.0
-
-    def __call__(self):
-        return self.t
+from conftest import FakeClock
 
 
 def test_requeue_cleared_when_pod_deleted():
@@ -97,14 +92,11 @@ def test_requeue_cleared_on_successful_bind():
 
 
 def test_shim_accepts_whitespace_like_python():
+    from conftest import ensure_native_shim
     from tpu_scheduler.api.quantity import memory_to_bytes
     from tpu_scheduler.ops import native_ext
 
-    if not native_ext.available():
-        import subprocess
-
-        subprocess.run(["make", "-C", "/root/repo/native"], check=True, capture_output=True)
-        native_ext._lib.cache_clear()
+    ensure_native_shim()
     for s in ["1Gi ", " 1Gi", "\t2Ki\n", " 500 "]:
         assert native_ext.batch_parse([s], native_ext.MODE_MEM_BYTES)[0] == memory_to_bytes(s)
 

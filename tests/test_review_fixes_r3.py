@@ -1,0 +1,216 @@
+"""Regression tests for the third code-review round: shim 128-bit mantissa
+wrap, affinity enforcement in the sample policy, fallback scoping."""
+
+import random
+
+import pytest
+
+from conftest import ensure_native_shim
+from tpu_scheduler.api.objects import PodAntiAffinityTerm, TopologySpreadConstraint
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.errors import BackendUnavailable
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+
+def test_shim_huge_mantissa_matches_python():
+    """A 39-digit mantissa wraps unsigned __int128; the shim must saturate
+    (and then clamp like the oracle), not return wrapped garbage."""
+    from tpu_scheduler.api.quantity import cpu_to_millis, memory_to_bytes
+    from tpu_scheduler.ops import native_ext
+
+    ensure_native_shim()
+
+    def clamp64(v):
+        return max(-(2**63 - 1), min(2**63 - 1, v))
+
+    cases = [
+        "510423550381407695195061911147652317184e-24",  # wraps to >= mantissa
+        "340282366920938463463374607431768211456",  # 2^128 exactly
+        "99999999999999999999999999999999999999999e-30",
+        "170141183460469231731687303715884105727e-20",
+        "-510423550381407695195061911147652317184e-24",
+        "1.00000000000000000000000000000000000000000001e2",
+    ]
+    for s in cases:
+        assert native_ext.batch_parse([s], native_ext.MODE_CPU_MILLIS)[0] == clamp64(cpu_to_millis(s)), s
+        assert native_ext.batch_parse([s], native_ext.MODE_MEM_BYTES)[0] == clamp64(memory_to_bytes(s)), s
+    rows = native_ext.pack_requests(["99999999999999999999999999999999999999999e-30"], ["2Gi"])
+    assert rows[0, 0] == min(2**31 - 1, clamp64(cpu_to_millis("99999999999999999999999999999999999999999e-30")))
+    assert rows[0, 1] == 2 * 1024 * 1024
+
+
+def zone_api():
+    api = FakeApiServer()
+    api.create_node(make_node("n0", cpu="16", memory="64Gi", labels={"zone": "a"}))
+    api.create_node(make_node("n1", cpu="16", memory="64Gi", labels={"zone": "a"}))
+    api.create_node(make_node("n2", cpu="16", memory="64Gi", labels={"zone": "b"}))
+    return api
+
+
+def test_sample_policy_enforces_anti_affinity():
+    api = zone_api()
+    api.create_pod(make_pod("web-0", labels={"app": "web"}, node_name="n0", phase="Running"))
+    api.create_pod(
+        make_pod(
+            "web-1",
+            labels={"app": "web"},
+            anti_affinity=[PodAntiAffinityTerm(match_labels={"app": "web"}, topology_key="zone")],
+        )
+    )
+    sched = Scheduler(api, NativeBackend(), policy="sample", rng=random.Random(0), attempts=50)
+    m = sched.run_cycle()
+    assert m.bound == 1
+    bound = [p for p in api.list_pods() if p.metadata.name == "web-1"]
+    assert bound[0].spec.node_name == "n2"  # only zone b is legal
+
+
+def test_sample_policy_enforces_anti_affinity_between_cycle_peers():
+    # Two pending peers with mutual anti-affinity: the second must see the
+    # first's same-cycle placement via the overlay and avoid its zone.
+    api = zone_api()
+    term = [PodAntiAffinityTerm(match_labels={"app": "web"}, topology_key="zone")]
+    api.create_pod(make_pod("web-a", labels={"app": "web"}, anti_affinity=term))
+    api.create_pod(make_pod("web-b", labels={"app": "web"}, anti_affinity=term))
+    sched = Scheduler(api, NativeBackend(), policy="sample", rng=random.Random(0), attempts=100)
+    sched.run_cycle()
+    zones = {}
+    for p in api.list_pods():
+        if p.spec.node_name is not None:
+            zones[p.metadata.name] = {"n0": "a", "n1": "a", "n2": "b"}[p.spec.node_name]
+    assert len(zones) == 2
+    assert zones["web-a"] != zones["web-b"]
+
+
+def test_sample_policy_enforces_topology_spread():
+    api = zone_api()
+    api.create_pod(make_pod("w0", labels={"app": "web"}, node_name="n0", phase="Running"))
+    api.create_pod(make_pod("w1", labels={"app": "web"}, node_name="n1", phase="Running"))
+    api.create_pod(
+        make_pod(
+            "w2",
+            labels={"app": "web"},
+            topology_spread=[TopologySpreadConstraint(topology_key="zone", max_skew=1, match_labels={"app": "web"})],
+        )
+    )
+    sched = Scheduler(api, NativeBackend(), policy="sample", rng=random.Random(0), attempts=50)
+    m = sched.run_cycle()
+    assert m.bound == 1
+    w2 = [p for p in api.list_pods() if p.metadata.name == "w2"][0]
+    assert w2.spec.node_name == "n2"  # zone a would give skew 3 > 1
+
+
+class BuggyBackend(NativeBackend):
+    name = "buggy"
+
+    def assign(self, packed, profile):
+        raise TypeError("programming error, not a device failure")
+
+
+def test_programming_errors_do_not_trigger_fallback():
+    api = zone_api()
+    api.create_pod(make_pod("p", cpu="1", memory="1Gi"))
+    sched = Scheduler(api, BuggyBackend(), fallback_backend=NativeBackend())
+    with pytest.raises(TypeError):
+        sched.run_cycle()
+
+
+class UnavailableBackend(NativeBackend):
+    name = "unavailable"
+
+    def assign(self, packed, profile):
+        raise BackendUnavailable("device lost")
+
+
+def test_unavailability_still_falls_back():
+    api = zone_api()
+    api.create_pod(make_pod("p", cpu="1", memory="1Gi"))
+    sched = Scheduler(api, UnavailableBackend(), fallback_backend=NativeBackend())
+    m = sched.run_cycle()
+    assert m.bound == 1
+
+
+def test_batch_policy_enforces_anti_affinity():
+    # The default batch policy must hold affinity-constrained pods out of the
+    # tensor pass and schedule them through the exact sequential chain.
+    api = zone_api()
+    api.create_pod(make_pod("web-0", labels={"app": "web"}, node_name="n0", phase="Running"))
+    api.create_pod(
+        make_pod(
+            "web-1",
+            labels={"app": "web"},
+            anti_affinity=[PodAntiAffinityTerm(match_labels={"app": "web"}, topology_key="zone")],
+        )
+    )
+    api.create_pod(make_pod("plain", cpu="1", memory="1Gi"))
+    sched = Scheduler(api, NativeBackend())  # policy="batch" default
+    m = sched.run_cycle()
+    assert m.bound == 2
+    placed = {p.metadata.name: p.spec.node_name for p in api.list_pods() if p.spec.node_name}
+    assert placed["web-1"] == "n2"  # zones a (n0, n1) are forbidden
+
+
+def test_batch_policy_direction_b_holds_back_plain_pod():
+    # A plain pod matched by a *placed* pod's term must go through the chain.
+    api = zone_api()
+    api.create_pod(
+        make_pod(
+            "guard",
+            labels={"app": "web"},
+            node_name="n0",
+            phase="Running",
+            anti_affinity=[PodAntiAffinityTerm(match_labels={"app": "web"}, topology_key="zone")],
+        )
+    )
+    api.create_pod(make_pod("web-1", labels={"app": "web"}))
+    sched = Scheduler(api, NativeBackend())
+    m = sched.run_cycle()
+    assert m.bound == 1
+    placed = {p.metadata.name: p.spec.node_name for p in api.list_pods() if p.spec.node_name}
+    assert placed["web-1"] == "n2"
+
+
+def test_batch_policy_enforces_topology_spread():
+    api = zone_api()
+    api.create_pod(make_pod("w0", labels={"app": "web"}, node_name="n0", phase="Running"))
+    api.create_pod(make_pod("w1", labels={"app": "web"}, node_name="n1", phase="Running"))
+    api.create_pod(
+        make_pod(
+            "w2",
+            labels={"app": "web"},
+            topology_spread=[TopologySpreadConstraint(topology_key="zone", max_skew=1, match_labels={"app": "web"})],
+        )
+    )
+    sched = Scheduler(api, NativeBackend())
+    m = sched.run_cycle()
+    assert m.bound == 1
+    w2 = [p for p in api.list_pods() if p.metadata.name == "w2"][0]
+    assert w2.spec.node_name == "n2"
+
+
+def test_batch_policy_anti_affine_peers_spread_out():
+    # Two pending peers with mutual anti-affinity in one batch cycle: the
+    # sequential phase sees the first one's commitment via the overlay.
+    api = zone_api()
+    term = [PodAntiAffinityTerm(match_labels={"app": "web"}, topology_key="zone")]
+    api.create_pod(make_pod("web-a", labels={"app": "web"}, anti_affinity=term))
+    api.create_pod(make_pod("web-b", labels={"app": "web"}, anti_affinity=term))
+    sched = Scheduler(api, NativeBackend())
+    m = sched.run_cycle()
+    assert m.bound == 2
+    zmap = {"n0": "a", "n1": "a", "n2": "b"}
+    zones = [zmap[p.spec.node_name] for p in api.list_pods() if p.spec.node_name]
+    assert sorted(zones) == ["a", "b"]
+
+
+def test_batch_policy_unschedulable_constrained_pod_requeues():
+    api = zone_api()
+    term = [PodAntiAffinityTerm(match_labels={"app": "web"}, topology_key="zone")]
+    for name, zone_node in [("w-a", "n0"), ("w-b", "n2")]:
+        api.create_pod(make_pod(name, labels={"app": "web"}, node_name=zone_node, phase="Running"))
+    api.create_pod(make_pod("w-c", labels={"app": "web"}, anti_affinity=term))
+    sched = Scheduler(api, NativeBackend())
+    m = sched.run_cycle()
+    assert m.bound == 0 and m.unschedulable == 1
+    assert "default/w-c" in sched.requeue_at

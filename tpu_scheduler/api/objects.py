@@ -23,6 +23,8 @@ __all__ = [
     "ObjectMeta",
     "ResourceRequirements",
     "Container",
+    "PodAntiAffinityTerm",
+    "TopologySpreadConstraint",
     "PodSpec",
     "PodStatus",
     "Pod",
@@ -66,15 +68,53 @@ class Container:
 
 
 @dataclass
+class PodAntiAffinityTerm:
+    """Required inter-pod anti-affinity term (BASELINE.json config 5).
+
+    The pod may not land in a topology domain (the set of nodes sharing the
+    same value of ``topology_key``) that already holds a pod whose labels
+    carry every pair in ``match_labels`` *and* whose namespace equals this
+    pod's.  Semantics notes (deviations from full Kubernetes, by design):
+
+      • an empty/None ``match_labels`` matches *nothing* (K8s: everything);
+      • a node lacking ``topology_key`` is its own singleton domain, so the
+        term degrades to per-node (hostname-like) anti-affinity there;
+      • the term is enforced symmetrically: an already-placed pod's term also
+        blocks an incoming pod that matches it (as kube-scheduler does).
+    """
+
+    match_labels: dict[str, str] | None = None
+    topology_key: str = "kubernetes.io/hostname"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    """Hard (DoNotSchedule) topology-spread constraint (config 5).
+
+    Counts pods matching ``match_labels`` in the pod's namespace per domain
+    of ``topology_key``; placing the pod on a node must keep
+    ``count(domain)+1 − min(count over the key's named domains) ≤ max_skew``.
+    Nodes lacking the key are exempt from the constraint and excluded from
+    the minimum (matching kube-scheduler's default node-exclusion).
+    ``match_labels=None`` matches nothing → the constraint is vacuous.
+    """
+
+    topology_key: str
+    max_skew: int = 1
+    match_labels: dict[str, str] | None = None
+
+
+@dataclass
 class PodSpec:
     containers: list[Container] = field(default_factory=list)
     node_selector: dict[str, str] | None = None
     node_name: str | None = None
     priority: int = 0
-    # Topology-spread / anti-affinity surface (BASELINE.json config 5):
-    # topology key -> max skew; anti-affinity label selector terms.
-    topology_spread: dict[str, int] | None = None
-    anti_affinity_labels: dict[str, str] | None = None
+    # Inter-pod anti-affinity / topology-spread surface (BASELINE.json
+    # config 5) — the reference has neither (it stops at resources +
+    # nodeSelector, src/predicates.rs:63-77).
+    anti_affinity: list[PodAntiAffinityTerm] | None = None
+    topology_spread: list[TopologySpreadConstraint] | None = None
 
 
 @dataclass
@@ -110,13 +150,40 @@ class Pod:
                 )
                 for c in spec_d.get("containers", [])
             ]
+            anti = None
+            terms = (
+                ((spec_d.get("affinity") or {}).get("podAntiAffinity") or {}).get(
+                    "requiredDuringSchedulingIgnoredDuringExecution"
+                )
+                or []
+            )
+            if terms:
+                anti = [
+                    PodAntiAffinityTerm(
+                        match_labels=(t.get("labelSelector") or {}).get("matchLabels"),
+                        topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
+                    )
+                    for t in terms
+                ]
+            spread = None
+            constraints = spec_d.get("topologySpreadConstraints") or []
+            hard = [c for c in constraints if c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"]
+            if hard:  # ScheduleAnyway (soft) constraints are not yet scored
+                spread = [
+                    TopologySpreadConstraint(
+                        topology_key=c.get("topologyKey", ""),
+                        max_skew=c.get("maxSkew", 1),
+                        match_labels=(c.get("labelSelector") or {}).get("matchLabels"),
+                    )
+                    for c in hard
+                ]
             spec = PodSpec(
                 containers=containers,
                 node_selector=spec_d.get("nodeSelector"),
                 node_name=spec_d.get("nodeName"),
                 priority=spec_d.get("priority", 0),
-                topology_spread=spec_d.get("topologySpread"),
-                anti_affinity_labels=spec_d.get("antiAffinityLabels"),
+                anti_affinity=anti,
+                topology_spread=spread,
             )
         status = PodStatus(phase=d.get("status", {}).get("phase", "Pending"))
         return Pod(

@@ -37,24 +37,30 @@ class TpuBackend(SchedulingBackend):
 
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         jax = self._jax
-        a = packed.device_arrays()
-        put = {k: jax.device_put(v, self.device) for k, v in a.items()}
-        weights = jax.device_put(profile.weights(), self.device)
-        assigned, rounds, _avail = assign_cycle(
-            put["node_alloc"],
-            put["node_avail"],
-            put["node_labels"],
-            put["node_valid"],
-            put["pod_req"],
-            put["pod_sel"],
-            put["pod_sel_count"],
-            put["pod_prio"],
-            put["pod_valid"],
-            weights,
-            max_rounds=profile.max_rounds,
-            block=profile.pod_block,
-        )
-        return np.asarray(jax.device_get(assigned)), int(rounds)
+        try:
+            a = packed.device_arrays()
+            put = {k: jax.device_put(v, self.device) for k, v in a.items()}
+            weights = jax.device_put(profile.weights(), self.device)
+            assigned, rounds, _avail = assign_cycle(
+                put["node_alloc"],
+                put["node_avail"],
+                put["node_labels"],
+                put["node_valid"],
+                put["pod_req"],
+                put["pod_sel"],
+                put["pod_sel_count"],
+                put["pod_prio"],
+                put["pod_valid"],
+                weights,
+                max_rounds=profile.max_rounds,
+                block=profile.pod_block,
+            )
+            return np.asarray(jax.device_get(assigned)), int(rounds)
+        except jax.errors.JaxRuntimeError as e:
+            # Device-runtime failure (OOM, device lost, …) — the recovery
+            # scenario the native fallback exists for (SURVEY.md §5).  Python
+            # programming errors deliberately propagate instead.
+            raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
 
 
 def make_backend(name: str, **kw) -> SchedulingBackend:

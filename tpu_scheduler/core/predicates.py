@@ -22,17 +22,23 @@ __all__ = [
     "InvalidNodeReason",
     "pod_fits_resources",
     "node_selector_matches",
+    "anti_affinity_ok",
+    "topology_spread_ok",
+    "labels_match_selector",
+    "node_topology_domain",
     "check_node_validity",
     "PREDICATE_CHAIN",
 ]
 
 
 class InvalidNodeReason(enum.Enum):
-    """Typed failure reason — reference ``predicates.rs:14-18``."""
+    """Typed failure reason — reference ``predicates.rs:14-18``; the last two
+    variants are beyond the reference (BASELINE.json config 5)."""
 
     NOT_ENOUGH_RESOURCES = "NotEnoughResources"
     NODE_SELECTOR_MISMATCH = "NodeSelectorMismatch"
-    ANTI_AFFINITY_VIOLATION = "AntiAffinityViolation"  # beyond reference (config 5)
+    ANTI_AFFINITY_VIOLATION = "AntiAffinityViolation"
+    TOPOLOGY_SPREAD_VIOLATION = "TopologySpreadViolation"
 
 
 def pod_fits_resources(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> bool:
@@ -64,11 +70,125 @@ def node_selector_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None
     return all(labels.get(k) == v for k, v in pod.spec.node_selector.items())
 
 
+def labels_match_selector(selector: dict[str, str] | None, labels: dict[str, str] | None) -> bool:
+    """True iff ``labels`` carries every pair of ``selector``.
+
+    An empty/None selector matches *nothing* (documented deviation from the
+    Kubernetes empty-selector-matches-all rule — see PodAntiAffinityTerm).
+    """
+    if not selector or not labels:
+        return False
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def node_topology_domain(node: Node, topology_key: str) -> tuple[str, str]:
+    """The topology domain of a node under ``topology_key``.
+
+    Named domain ``(key, value)`` when the node carries the label; otherwise
+    the node is its own singleton domain ``("~node", name)`` — a keyless node
+    degrades to per-node (hostname-like) granularity.
+    """
+    labels = node.metadata.labels or {}
+    v = labels.get(topology_key)
+    return (topology_key, v) if v is not None else ("~node", node.name)
+
+
+def _placed_pods(snapshot: ClusterSnapshot) -> list[tuple[Pod, Node]]:
+    """(pod, node) for every pod bound to a node present in the snapshot
+    (cached on the immutable snapshot — O(1) per predicate call)."""
+    return snapshot.placed_pods()
+
+
+def anti_affinity_ok(
+    pod: Pod,
+    node: Node,
+    snapshot: ClusterSnapshot,
+    extra_placed: tuple[tuple[Pod, Node], ...] = (),
+) -> bool:
+    """Inter-pod anti-affinity predicate (config 5; absent in the reference).
+
+    Enforced in both directions, as kube-scheduler does:
+      A. none of ``pod``'s terms may match a placed pod in ``node``'s domain;
+      B. no placed pod in ``node``'s domain may carry a term matching ``pod``.
+    Terms are namespace-scoped: a term only sees pods sharing the namespace
+    of the pod that declares it.  ``extra_placed`` lets a sequential caller
+    overlay same-cycle commitments not yet visible in the snapshot.
+    """
+    my_terms = (pod.spec.anti_affinity or []) if pod.spec is not None else []
+    my_ns = pod.metadata.namespace
+    # Direction A: my term vs placed pods' labels (skipped when term-free).
+    if my_terms:
+        for q, qnode in snapshot.placed_pods() + list(extra_placed):
+            if q.metadata.namespace != my_ns:
+                continue
+            for t in my_terms:
+                if labels_match_selector(t.match_labels, q.metadata.labels) and node_topology_domain(
+                    qnode, t.topology_key
+                ) == node_topology_domain(node, t.topology_key):
+                    return False
+    # Direction B: placed pods' terms vs my labels (only term-carriers scanned).
+    term_carriers = snapshot.placed_pods_with_terms() + [
+        (q, qn) for q, qn in extra_placed if q.spec is not None and q.spec.anti_affinity
+    ]
+    for q, qnode in term_carriers:
+        if q.metadata.namespace != my_ns:
+            continue
+        for t in q.spec.anti_affinity:
+            if labels_match_selector(t.match_labels, pod.metadata.labels) and node_topology_domain(
+                qnode, t.topology_key
+            ) == node_topology_domain(node, t.topology_key):
+                return False
+    return True
+
+
+def topology_spread_ok(
+    pod: Pod,
+    node: Node,
+    snapshot: ClusterSnapshot,
+    extra_placed: tuple[tuple[Pod, Node], ...] = (),
+) -> bool:
+    """Hard topology-spread predicate (config 5; absent in the reference).
+
+    For each constraint: count placed pods matching the selector (in the
+    pod's namespace) per *named* domain of the key; placing here must keep
+    ``count(domain(node)) + 1 − min(counts) ≤ max_skew``.  A node lacking the
+    key is exempt; keyless nodes' pods don't enter the counts or the min.
+    ``extra_placed`` overlays same-cycle commitments not yet in the snapshot.
+    """
+    if pod.spec is None or not pod.spec.topology_spread:
+        return True
+    my_ns = pod.metadata.namespace
+    placed = _placed_pods(snapshot) + list(extra_placed)
+    for c in pod.spec.topology_spread:
+        labels = node.metadata.labels or {}
+        if c.topology_key not in labels:
+            continue  # node exempt from this constraint
+        # Named domains of this key over all snapshot nodes.
+        counts: dict[str, int] = {}
+        for n in snapshot.nodes:
+            v = (n.metadata.labels or {}).get(c.topology_key)
+            if v is not None:
+                counts.setdefault(v, 0)
+        for q, qnode in placed:
+            v = (qnode.metadata.labels or {}).get(c.topology_key)
+            if v is None or q.metadata.namespace != my_ns:
+                continue
+            if labels_match_selector(c.match_labels, q.metadata.labels):
+                counts[v] = counts.get(v, 0) + 1
+        here = labels[c.topology_key]
+        if counts.get(here, 0) + 1 - min(counts.values(), default=0) > c.max_skew:
+            return False
+    return True
+
+
 # Ordered chain: fixed resource-then-selector order, as in the reference
-# (``predicates.rs:68,72``).  Each entry: (reason-on-failure, predicate fn).
+# (``predicates.rs:68,72``), extended with the config-5 predicates.  Each
+# entry: (reason-on-failure, predicate fn).
 PREDICATE_CHAIN: list[tuple[InvalidNodeReason, Callable[[Pod, Node, ClusterSnapshot], bool]]] = [
     (InvalidNodeReason.NOT_ENOUGH_RESOURCES, pod_fits_resources),
     (InvalidNodeReason.NODE_SELECTOR_MISMATCH, node_selector_matches),
+    (InvalidNodeReason.ANTI_AFFINITY_VIOLATION, anti_affinity_ok),
+    (InvalidNodeReason.TOPOLOGY_SPREAD_VIOLATION, topology_spread_ok),
 ]
 
 

@@ -47,14 +47,33 @@ class ClusterSnapshot:
     nodes: tuple[Node, ...]
     pods: tuple[Pod, ...]
     _pods_by_node: dict[str, list[Pod]] = field(default_factory=dict, compare=False, repr=False)
+    # Caches for the affinity predicates (built once; snapshots are immutable):
+    # all (pod, node) placements, and the subset whose pod carries
+    # anti-affinity terms (the direction-B forbidders).
+    _placed: list = field(default_factory=list, compare=False, repr=False)
+    _placed_with_terms: list = field(default_factory=list, compare=False, repr=False)
 
     @staticmethod
     def build(nodes: Iterable[Node], pods: Iterable[Pod]) -> "ClusterSnapshot":
         snap = ClusterSnapshot(nodes=tuple(nodes), pods=tuple(pods))
+        by_name = {n.name: n for n in snap.nodes}
         for p in snap.pods:
             if p.spec is not None and p.spec.node_name is not None:
                 snap._pods_by_node.setdefault(p.spec.node_name, []).append(p)
+                node = by_name.get(p.spec.node_name)
+                if node is not None:
+                    snap._placed.append((p, node))
+                    if p.spec.anti_affinity:
+                        snap._placed_with_terms.append((p, node))
         return snap
+
+    def placed_pods(self) -> list:
+        """All (pod, node) placements onto nodes present in the snapshot."""
+        return self._placed
+
+    def placed_pods_with_terms(self) -> list:
+        """Placements whose pod declares anti-affinity terms."""
+        return self._placed_with_terms
 
     def pods_on_node(self, node_name: str) -> list[Pod]:
         """Snapshot equivalent of the reference's live field-selector list

@@ -51,6 +51,23 @@ def _lib():
                 ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_int32),
             ]
+            if hasattr(lib, "tpusched_batch_parse_ex"):
+                lib.tpusched_batch_parse_ex.restype = ctypes.c_int64
+                lib.tpusched_batch_parse_ex.argtypes = [
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.c_int64,
+                    ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_uint8),
+                ]
+                lib.tpusched_pack_requests_ex.restype = ctypes.c_int64
+                lib.tpusched_pack_requests_ex.argtypes = [
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_uint8),
+                ]
             return lib
     return None
 
@@ -66,16 +83,46 @@ def _to_char_pp(strs: list[str | None]):
     return arr
 
 
+_I64_MAX = 2**63 - 1
+
+
+def _clamp64(v: int) -> int:
+    return max(-_I64_MAX, min(_I64_MAX, v))
+
+
+def _oracle(s: str, mode: int) -> int:
+    """Exact Python parse in shim units (int64-clamped)."""
+    from ..api.quantity import cpu_to_millis, memory_to_bytes
+
+    return _clamp64(cpu_to_millis(s) if mode == MODE_CPU_MILLIS else memory_to_bytes(s))
+
+
 def batch_parse(strs: list[str], mode: int) -> np.ndarray:
     """Parse quantities to int64 base units (millicores / bytes).
 
     Raises ValueError naming the first invalid quantity, matching the Python
-    parser's behaviour.
+    parser's behaviour.  Entries whose >38-digit mantissas saturate the
+    shim's 128-bit arithmetic are flagged by the C side and recomputed here
+    through the exact Python oracle, so agreement is exact for every input.
     """
     lib = _lib()
     if lib is None:
         raise RuntimeError("native shim not built (make -C native)")
     out = np.zeros(len(strs), dtype=np.int64)
+    if hasattr(lib, "tpusched_batch_parse_ex"):
+        inexact = np.zeros(len(strs), dtype=np.uint8)
+        bad = lib.tpusched_batch_parse_ex(
+            _to_char_pp(strs),
+            len(strs),
+            mode,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            inexact.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if bad >= 0:
+            raise ValueError(f"invalid quantity: {strs[bad]!r}")
+        for i in np.flatnonzero(inexact):
+            out[i] = _oracle(strs[i], mode)
+        return out
     bad = lib.tpusched_batch_parse(
         _to_char_pp(strs), len(strs), mode, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
     )
@@ -84,14 +131,38 @@ def batch_parse(strs: list[str], mode: int) -> np.ndarray:
     return out
 
 
+_I32_MAX = 2**31 - 1
+
+
 def pack_requests(cpu_strs: list[str | None], mem_strs: list[str | None]) -> np.ndarray:
     """[n,2] int32 (millicores, KiB-ceil) request rows — the ops/pack.py
-    unit/rounding convention, computed natively."""
+    unit/rounding convention, computed natively.  Saturation-flagged rows are
+    recomputed via the exact Python oracle (see batch_parse)."""
     lib = _lib()
     if lib is None:
         raise RuntimeError("native shim not built (make -C native)")
     assert len(cpu_strs) == len(mem_strs)
     out = np.zeros((len(cpu_strs), 2), dtype=np.int32)
+    if hasattr(lib, "tpusched_pack_requests_ex"):
+        inexact = np.zeros(len(cpu_strs), dtype=np.uint8)
+        bad = lib.tpusched_pack_requests_ex(
+            _to_char_pp(cpu_strs),
+            _to_char_pp(mem_strs),
+            len(cpu_strs),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            inexact.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if bad >= 0:
+            raise ValueError(f"invalid quantity in row {bad}: cpu={cpu_strs[bad]!r} mem={mem_strs[bad]!r}")
+        for i in np.flatnonzero(inexact):
+            cpu = _oracle(cpu_strs[i], MODE_CPU_MILLIS) if cpu_strs[i] is not None else 0
+            mem = _oracle(mem_strs[i], MODE_MEM_BYTES) if mem_strs[i] is not None else 0
+            # Matches the C row convention: ceil for non-negative, C-style
+            # truncation toward zero for negative.
+            kib = (mem + 1023) // 1024 if mem >= 0 else -((-mem) // 1024)
+            out[i, 0] = max(-_I32_MAX, min(_I32_MAX, cpu))
+            out[i, 1] = max(-_I32_MAX, min(_I32_MAX, kib))
+        return out
     bad = lib.tpusched_pack_requests(
         _to_char_pp(cpu_strs),
         _to_char_pp(mem_strs),

@@ -205,16 +205,24 @@ class ShardedBackend(SchedulingBackend):
         self.mesh = mesh if mesh is not None else make_mesh(tp=tp)
 
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
-        tp = self.mesh.shape["tp"]
-        a = dict(packed.device_arrays())
-        # Node padding to the tp multiple happens here; pod padding to the dp
-        # multiple happens inside the jitted run, after the priority permute.
-        n_pad = round_up(packed.padded_nodes, tp)
-        for k in ("node_alloc", "node_avail", "node_labels"):
-            a[k] = np.pad(a[k], ((0, n_pad - packed.padded_nodes), (0, 0)))
-        a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - packed.padded_nodes),))
-        assigned, rounds, _avail = sharded_assign_cycle(self.mesh, a, packed_weights(profile), profile.max_rounds)
-        return np.asarray(jax.device_get(assigned)), int(rounds)
+        try:
+            tp = self.mesh.shape["tp"]
+            a = dict(packed.device_arrays())
+            # Node padding to the tp multiple happens here; pod padding to the dp
+            # multiple happens inside the jitted run, after the priority permute.
+            n_pad = round_up(packed.padded_nodes, tp)
+            for k in ("node_alloc", "node_avail", "node_labels"):
+                a[k] = np.pad(a[k], ((0, n_pad - packed.padded_nodes), (0, 0)))
+            a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - packed.padded_nodes),))
+            assigned, rounds, _avail = sharded_assign_cycle(self.mesh, a, packed_weights(profile), profile.max_rounds)
+            return np.asarray(jax.device_get(assigned)), int(rounds)
+        except jax.errors.JaxRuntimeError as e:
+            # Same contract as TpuBackend: device-runtime failures become the
+            # explicit unavailability signal the controller's fallback keys
+            # on; programming errors propagate.
+            from ..errors import BackendUnavailable
+
+            raise BackendUnavailable(f"sharded backend runtime failure: {e}") from e
 
 
 def packed_weights(profile: SchedulingProfile):

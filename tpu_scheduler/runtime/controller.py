@@ -30,9 +30,15 @@ import time
 
 from ..api.objects import Node, ObjectReference, Pod, PodResources, full_name, is_pod_bound, total_pod_resources
 from ..backends.base import SchedulingBackend
-from ..core.predicates import InvalidNodeReason, node_selector_matches
+from ..core.predicates import (
+    InvalidNodeReason,
+    anti_affinity_ok,
+    labels_match_selector,
+    node_selector_matches,
+    topology_spread_ok,
+)
 from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
-from ..errors import CreateBindingFailed, NoNodeFound
+from ..errors import BackendUnavailable, CreateBindingFailed, NoNodeFound
 from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
 from ..ops.pack import pack_snapshot, repack_incremental
 from ..utils.metrics import CycleMetrics, MetricsRegistry
@@ -146,37 +152,140 @@ class Scheduler:
         self._packed = packed
         return packed
 
+    def _split_affinity_pending(self, snapshot: ClusterSnapshot, pending: list[Pod]) -> tuple[list[Pod], list[Pod]]:
+        """Split pending pods into (plain, constrained) for the batch path.
+
+        Constrained = the pod declares anti-affinity/topology-spread, or a
+        placed pod's anti-affinity term matches it (direction B).  Until the
+        packed tensors carry affinity state, constrained pods are scheduled
+        through the exact sequential chain after the tensor cycle — correct
+        first, then fast (config 5 tensorization is the ops-layer milestone).
+        """
+        carriers = snapshot.placed_pods_with_terms()
+        plain: list[Pod] = []
+        constrained: list[Pod] = []
+        for p in pending:
+            if p.spec is not None and (p.spec.anti_affinity or p.spec.topology_spread):
+                constrained.append(p)
+                continue
+            hit = any(
+                q.metadata.namespace == p.metadata.namespace
+                and any(labels_match_selector(t.match_labels, p.metadata.labels) for t in q.spec.anti_affinity)
+                for q, _ in carriers
+            )
+            (constrained if hit else plain).append(p)
+        return plain, constrained
+
+    @staticmethod
+    def _scalar_score(pod: Pod, node: Node, snapshot: ClusterSnapshot, ledger: dict[str, PodResources], weights) -> float:
+        """LeastRequested + BalancedAllocation for one (pod, node) — the
+        scalar twin of ops/score.py (without the tie-break jitter; the
+        sequential phase breaks ties by node order instead)."""
+        alloc = node_allocatable(node)
+        used = node_used_resources(snapshot, node.name)
+        assumed = ledger.get(node.name)
+        if assumed is not None:
+            used += assumed
+        req = total_pod_resources(pod)
+        fc = (used.cpu + req.cpu) / alloc.cpu if alloc.cpu > 0 else 1.0
+        fm = (used.memory + req.memory) / alloc.memory if alloc.memory > 0 else 1.0
+        lr = ((1.0 - fc) + (1.0 - fm)) * 50.0
+        ba = (1.0 - abs(fc - fm)) * 100.0
+        return float(weights[0]) * lr + float(weights[1]) * ba
+
+    def _run_constrained_phase(
+        self, snapshot: ClusterSnapshot, constrained: list[Pod], placed: list[tuple[Pod, Node]]
+    ) -> tuple[int, int]:
+        """Schedule affinity-constrained pods sequentially with the full
+        predicate chain: exhaustive over nodes (not sampled), best score
+        wins, commitments tracked in the ledger + overlay."""
+        ledger: dict[str, PodResources] = {}
+        for pod, node in placed:  # batch commitments consume capacity
+            committed = ledger.setdefault(node.name, PodResources())
+            committed += total_pod_resources(pod)
+        weights = self.profile.weights()
+        bound = 0
+        unschedulable = 0
+        order = sorted(constrained, key=lambda p: -(p.spec.priority if p.spec is not None else 0))
+        for pod in order:
+            best: Node | None = None
+            best_score = 0.0
+            for node in snapshot.nodes:
+                if self._check_with_ledger(pod, node, snapshot, ledger, placed) is not None:
+                    continue
+                score = self._scalar_score(pod, node, snapshot, ledger, weights)
+                if best is None or score > best_score:
+                    best, best_score = node, score
+            if best is None:
+                self._requeue(full_name(pod), "no-node-found")
+                unschedulable += 1
+                continue
+            if self._bind(pod.metadata.namespace or "default", pod.metadata.name, best.name):
+                bound += 1
+                committed = ledger.setdefault(best.name, PodResources())
+                committed += total_pod_resources(pod)
+                placed.append((pod, best))
+        return bound, unschedulable
+
     def _run_batch_cycle(self, snapshot: ClusterSnapshot, trace: Trace) -> tuple[int, int, int]:
+        pending = snapshot.pending_pods()
+        plain, constrained = self._split_affinity_pending(snapshot, pending)
+        if constrained:
+            held = {id(p) for p in constrained}
+            batch_snapshot = ClusterSnapshot.build(snapshot.nodes, [p for p in snapshot.pods if id(p) not in held])
+        else:
+            batch_snapshot = snapshot
         with span("pack"):
-            packed = self._pack(snapshot)
+            packed = self._pack(batch_snapshot)
         with span("solve"):
             try:
                 result = self.backend.schedule(packed, self.profile)
-            except Exception as e:
+            except BackendUnavailable as e:
+                # Only the explicit unavailability signal triggers fallback;
+                # programming errors in a backend must surface, not be
+                # silently absorbed as degraded-mode cycles forever.
                 if self.fallback_backend is None:
                     raise
                 logger.error("backend %s failed (%s); falling back to %s", self.backend.name, e, self.fallback_backend.name)
                 self.metrics.inc("scheduler_backend_fallbacks_total")
                 result = self.fallback_backend.schedule(packed, self.profile)
         bound = 0
+        placed: list[tuple[Pod, Node]] = []
+        node_by_name = {n.name: n for n in snapshot.nodes}
+        pod_by_full = {full_name(p): p for p in pending}
         with span("bind"):
             for pod_full, node_name in result.bindings:
                 namespace, _, name = pod_full.rpartition("/")
                 if self._bind(namespace or "default", name, node_name):
                     bound += 1
+                    pod_obj, node_obj = pod_by_full.get(pod_full), node_by_name.get(node_name)
+                    if pod_obj is not None and node_obj is not None:
+                        placed.append((pod_obj, node_obj))
             for pod_full in result.unschedulable:
                 self._requeue(pod_full, "no-node-found")
-        return bound, len(result.unschedulable), result.rounds
+        unschedulable = len(result.unschedulable)
+        if constrained:
+            with span("constrained"):
+                seq_bound, seq_unsched = self._run_constrained_phase(snapshot, constrained, placed)
+            bound += seq_bound
+            unschedulable += seq_unsched
+        return bound, unschedulable, result.rounds
 
     # -- sample policy (reference main.rs:49-71) ---------------------------
 
-    def _select_node_sample(self, pod: Pod, snapshot: ClusterSnapshot, ledger: dict[str, PodResources]) -> Node | None:
+    def _select_node_sample(
+        self,
+        pod: Pod,
+        snapshot: ClusterSnapshot,
+        ledger: dict[str, PodResources],
+        placed: list[tuple[Pod, Node]],
+    ) -> Node | None:
         nodes = self.reflector.nodes.state()
         if not nodes:
             return None
         for _ in range(self.attempts):
             candidate = self.rng.choice(nodes)  # with replacement, main.rs:56
-            reason = self._check_with_ledger(pod, candidate, snapshot, ledger)
+            reason = self._check_with_ledger(pod, candidate, snapshot, ledger, placed)
             if reason is None:
                 return candidate
             logger.debug("Node %s failed validity check for pod %s: %s", candidate.name, full_name(pod), reason)
@@ -184,10 +293,15 @@ class Scheduler:
 
     @staticmethod
     def _check_with_ledger(
-        pod: Pod, node: Node, snapshot: ClusterSnapshot, ledger: dict[str, PodResources]
+        pod: Pod,
+        node: Node,
+        snapshot: ClusterSnapshot,
+        ledger: dict[str, PodResources],
+        placed: list[tuple[Pod, Node]],
     ) -> InvalidNodeReason | None:
-        """Predicate chain vs snapshot + this-loop commitments (the assumed-
-        resources ledger that closes the reference's TOCTOU race)."""
+        """Full predicate chain vs snapshot + this-cycle commitments: the
+        assumed-resources ledger (closing the reference's TOCTOU race) and
+        the ``placed`` overlay so affinity/spread see same-cycle bindings."""
         available = node_allocatable(node)
         available -= node_used_resources(snapshot, node.name)
         assumed = ledger.get(node.name)
@@ -198,14 +312,19 @@ class Scheduler:
             return InvalidNodeReason.NOT_ENOUGH_RESOURCES
         if not node_selector_matches(pod, node):
             return InvalidNodeReason.NODE_SELECTOR_MISMATCH
+        if not anti_affinity_ok(pod, node, snapshot, extra_placed=tuple(placed)):
+            return InvalidNodeReason.ANTI_AFFINITY_VIOLATION
+        if not topology_spread_ok(pod, node, snapshot, extra_placed=tuple(placed)):
+            return InvalidNodeReason.TOPOLOGY_SPREAD_VIOLATION
         return None
 
     def _run_sample_cycle(self, snapshot: ClusterSnapshot, pending: list[Pod]) -> tuple[int, int]:
         ledger: dict[str, PodResources] = {}
+        placed: list[tuple[Pod, Node]] = []
         bound = 0
         unschedulable = 0
         for pod in pending:
-            node = self._select_node_sample(pod, snapshot, ledger)
+            node = self._select_node_sample(pod, snapshot, ledger, placed)
             if node is None:
                 self._requeue(full_name(pod), "no-node-found")
                 unschedulable += 1
@@ -214,6 +333,7 @@ class Scheduler:
                 bound += 1
                 committed = ledger.setdefault(node.name, PodResources())
                 committed += total_pod_resources(pod)
+                placed.append((pod, node))
         return bound, unschedulable
 
     # -- the loop ----------------------------------------------------------

@@ -7,7 +7,18 @@ from __future__ import annotations
 
 import random
 
-from .api.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodSpec, PodStatus, ResourceRequirements
+from .api.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAntiAffinityTerm,
+    PodSpec,
+    PodStatus,
+    ResourceRequirements,
+    TopologySpreadConstraint,
+)
 from .core.snapshot import ClusterSnapshot
 
 __all__ = ["make_node", "make_pod", "synth_cluster"]
@@ -41,8 +52,8 @@ def make_pod(
     phase: str = "Pending",
     priority: int = 0,
     labels: dict[str, str] | None = None,
-    topology_spread: dict[str, int] | None = None,
-    anti_affinity_labels: dict[str, str] | None = None,
+    anti_affinity: list[PodAntiAffinityTerm] | None = None,
+    topology_spread: list[TopologySpreadConstraint] | None = None,
 ) -> Pod:
     return Pod(
         metadata=ObjectMeta(name=name, namespace=namespace, labels=labels),
@@ -53,8 +64,8 @@ def make_pod(
             node_selector=node_selector,
             node_name=node_name,
             priority=priority,
+            anti_affinity=anti_affinity,
             topology_spread=topology_spread,
-            anti_affinity_labels=anti_affinity_labels,
         ),
         status=PodStatus(phase=phase),
     )
@@ -67,6 +78,8 @@ def synth_cluster(
     seed: int = 0,
     selector_fraction: float = 0.2,
     multi_container_fraction: float = 0.1,
+    anti_affinity_fraction: float = 0.0,
+    spread_fraction: float = 0.0,
 ) -> ClusterSnapshot:
     """Generate a synthetic cluster snapshot.
 
@@ -74,7 +87,10 @@ def synth_cluster(
     pool labels; ``multi_container_fraction`` get a second container so the
     request-summation path (reference ``util.rs:54-75``) is exercised.
     Bound pods are spread round-robin over nodes so resource-fit sees
-    realistic partially-full nodes.
+    realistic partially-full nodes.  ``anti_affinity_fraction`` of pending
+    pods declare self-anti-affinity (against their own ``app`` label) on the
+    hostname-like ``name`` key; ``spread_fraction`` declare a hard zone
+    topology-spread constraint over their ``app`` label (config 5 shapes).
     """
     rng = random.Random(seed)
     if n_nodes == 0:
@@ -108,13 +124,22 @@ def synth_cluster(
                 selector = {"zone": rng.choice(_ZONES)}
             else:
                 selector = {"pool": rng.choice(_POOLS)}
+        app = f"app-{rng.randrange(0, 50)}"
+        anti = None
+        if rng.random() < anti_affinity_fraction:
+            anti = [PodAntiAffinityTerm(match_labels={"app": app}, topology_key="name")]
+        spread = None
+        if rng.random() < spread_fraction:
+            spread = [TopologySpreadConstraint(topology_key="zone", max_skew=rng.choice([1, 2]), match_labels={"app": app})]
         pod = make_pod(
             f"pending-{i}",
             cpu=f"{rng.choice([100, 250, 500, 1000, 2000])}m",
             memory=f"{rng.choice([128, 256, 512, 1024, 4096])}Mi",
             node_selector=selector,
             priority=rng.randrange(0, 10),
-            labels={"app": f"app-{rng.randrange(0, 50)}"},
+            labels={"app": app},
+            anti_affinity=anti,
+            topology_spread=spread,
         )
         if rng.random() < multi_container_fraction:
             pod.spec.containers.append(
