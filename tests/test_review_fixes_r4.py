@@ -1,0 +1,337 @@
+"""Tests for the round-4 review fixes: matchExpressions selector support,
+global priority ordering across the plain/constrained batch split, and the
+precomputed affinity/spread checkers agreeing with the one-shot predicates."""
+
+import random
+
+from tpu_scheduler.api.objects import (
+    LabelSelectorRequirement,
+    Pod,
+    PodAntiAffinityTerm,
+    TopologySpreadConstraint,
+)
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.core.predicates import (
+    anti_affinity_ok,
+    make_affinity_checker,
+    make_spread_checker,
+    selector_matches,
+    topology_spread_ok,
+)
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+
+# --- selector_matches / matchExpressions -------------------------------------
+
+
+def expr(key, op, values=None):
+    return LabelSelectorRequirement(key=key, operator=op, values=values)
+
+
+def test_selector_matches_in_operator():
+    assert selector_matches(None, [expr("app", "In", ["db", "web"])], {"app": "db"})
+    assert not selector_matches(None, [expr("app", "In", ["db", "web"])], {"app": "cache"})
+    assert not selector_matches(None, [expr("app", "In", ["db"])], {})  # key absent
+    assert not selector_matches(None, [expr("app", "In", None)], {"app": "db"})  # no values
+
+
+def test_selector_matches_notin_operator():
+    assert not selector_matches(None, [expr("app", "NotIn", ["db"])], {"app": "db"})
+    assert selector_matches(None, [expr("app", "NotIn", ["db"])], {"app": "web"})
+    assert selector_matches(None, [expr("app", "NotIn", ["db"])], {})  # absent key satisfies NotIn
+
+
+def test_selector_matches_exists_operators():
+    assert selector_matches(None, [expr("app", "Exists")], {"app": "anything"})
+    assert not selector_matches(None, [expr("app", "Exists")], {"other": "x"})
+    assert selector_matches(None, [expr("app", "DoesNotExist")], {"other": "x"})
+    assert not selector_matches(None, [expr("app", "DoesNotExist")], {"app": "x"})
+
+
+def test_selector_matches_unknown_operator_fails_closed():
+    assert not selector_matches(None, [expr("app", "Gt", ["1"])], {"app": "2"})
+
+
+def test_selector_matches_combines_labels_and_expressions():
+    ml = {"tier": "front"}
+    ex = [expr("app", "In", ["web"])]
+    assert selector_matches(ml, ex, {"tier": "front", "app": "web"})
+    assert not selector_matches(ml, ex, {"tier": "front", "app": "db"})
+    assert not selector_matches(ml, ex, {"tier": "back", "app": "web"})
+
+
+def test_empty_selector_still_matches_nothing():
+    assert not selector_matches(None, None, {"a": "b"})
+    assert not selector_matches({}, [], {"a": "b"})
+
+
+def test_from_dict_parses_match_expressions_anti_affinity():
+    pod = Pod.from_dict(
+        {
+            "metadata": {"name": "db-1", "labels": {"app": "db"}},
+            "spec": {
+                "containers": [],
+                "affinity": {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {
+                                    "matchExpressions": [{"key": "app", "operator": "In", "values": ["db"]}]
+                                },
+                                "topologyKey": "zone",
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    )
+    terms = pod.spec.anti_affinity
+    assert terms is not None and len(terms) == 1
+    assert terms[0].match_expressions[0].operator == "In"
+    assert terms[0].match_expressions[0].values == ["db"]
+
+
+def test_match_expressions_anti_affinity_enforced():
+    """A required anti-affinity term expressed only via matchExpressions must
+    separate replicas (the review's silently-unenforced scenario)."""
+    nodes = [
+        make_node("n0", cpu=16, memory="64Gi", labels={"zone": "a"}),
+        make_node("n1", cpu=16, memory="64Gi", labels={"zone": "b"}),
+    ]
+    term = PodAntiAffinityTerm(
+        match_labels=None,
+        match_expressions=[LabelSelectorRequirement(key="app", operator="In", values=["db"])],
+        topology_key="zone",
+    )
+    placed = make_pod("db-0", labels={"app": "db"}, node_name="n0", phase="Running")
+    incoming = make_pod("db-1", labels={"app": "db"}, anti_affinity=[term])
+    s = ClusterSnapshot.build(nodes, [placed, incoming])
+    assert not anti_affinity_ok(incoming, nodes[0], s)  # same zone blocked
+    assert anti_affinity_ok(incoming, nodes[1], s)
+
+
+def test_match_expressions_spread_enforced():
+    nodes = [
+        make_node("n0", cpu=16, memory="64Gi", labels={"zone": "a"}),
+        make_node("n1", cpu=16, memory="64Gi", labels={"zone": "b"}),
+    ]
+    c = TopologySpreadConstraint(
+        topology_key="zone",
+        max_skew=1,
+        match_labels=None,
+        match_expressions=[LabelSelectorRequirement(key="app", operator="Exists")],
+    )
+    placed = make_pod("w0", labels={"app": "web"}, node_name="n0", phase="Running")
+    incoming = make_pod("w1", labels={"app": "web"}, topology_spread=[c])
+    s = ClusterSnapshot.build(nodes, [placed, incoming])
+    assert not topology_spread_ok(incoming, nodes[0], s)  # skew would hit 2
+    assert topology_spread_ok(incoming, nodes[1], s)
+
+
+# --- precomputed checkers agree with one-shot predicates ---------------------
+
+
+def test_checkers_agree_with_oracle_randomized():
+    rng = random.Random(11)
+    zones = ["a", "b", "c"]
+    nodes = [
+        make_node(f"n{i}", cpu=64, memory="256Gi", labels={"zone": rng.choice(zones)} if rng.random() < 0.8 else None)
+        for i in range(12)
+    ]
+    apps = ["web", "db", "cache"]
+    placed = [
+        make_pod(
+            f"placed-{i}",
+            labels={"app": rng.choice(apps)},
+            node_name=f"n{rng.randrange(12)}",
+            phase="Running",
+            anti_affinity=(
+                [PodAntiAffinityTerm(match_labels={"app": rng.choice(apps)}, topology_key="zone")]
+                if rng.random() < 0.4
+                else None
+            ),
+        )
+        for i in range(20)
+    ]
+    for trial in range(25):
+        pod = make_pod(
+            f"cand-{trial}",
+            labels={"app": rng.choice(apps)},
+            anti_affinity=(
+                [PodAntiAffinityTerm(match_labels={"app": rng.choice(apps)}, topology_key=rng.choice(["zone", "rack"]))]
+                if rng.random() < 0.6
+                else None
+            ),
+            topology_spread=(
+                [TopologySpreadConstraint(topology_key="zone", max_skew=rng.choice([1, 2]), match_labels={"app": rng.choice(apps)})]
+                if rng.random() < 0.6
+                else None
+            ),
+        )
+        s = ClusterSnapshot.build(nodes, placed + [pod])
+        aff = make_affinity_checker(pod, s)
+        spr = make_spread_checker(pod, s)
+        for n in nodes:
+            assert aff(n) == anti_affinity_ok(pod, n, s), (trial, n.name)
+            assert spr(n) == topology_spread_ok(pod, n, s), (trial, n.name)
+
+
+# --- global priority order across the plain/constrained split ----------------
+
+
+def get_pod(api, name, namespace="default"):
+    for p in api.list_pods():
+        if p.metadata.name == name and (p.metadata.namespace or "default") == namespace:
+            return p
+    raise KeyError(name)
+
+
+def one_slot_cluster():
+    """One node with room for exactly one more 1-cpu pod."""
+    return [make_node("n0", cpu="1", memory="4Gi", labels={"zone": "a"})]
+
+
+def test_high_priority_constrained_pod_wins_slot_over_plain():
+    """Review scenario: capacity for one pod; plain pod prio 0 vs constrained
+    pod prio 9 — the constrained pod must win the slot."""
+    nodes = one_slot_cluster()
+    plain = make_pod("plain", cpu="1", memory="1Gi", priority=0)
+    constrained = make_pod(
+        "vip",
+        cpu="1",
+        memory="1Gi",
+        priority=9,
+        topology_spread=[
+            TopologySpreadConstraint(topology_key="zone", max_skew=5, match_labels={"app": "vip"})
+        ],
+    )
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=[plain, constrained])
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+    m = sched.run_cycle()
+    assert m.bound == 1
+    assert get_pod(api, "vip").spec.node_name == "n0"
+    assert get_pod(api, "plain").spec.node_name is None
+
+
+def test_high_priority_plain_pod_wins_slot_over_constrained():
+    """And the mirror image: plain prio 9 vs constrained prio 0."""
+    nodes = one_slot_cluster()
+    plain = make_pod("vip-plain", cpu="1", memory="1Gi", priority=9)
+    constrained = make_pod(
+        "lowly",
+        cpu="1",
+        memory="1Gi",
+        priority=0,
+        topology_spread=[
+            TopologySpreadConstraint(topology_key="zone", max_skew=5, match_labels={"app": "x"})
+        ],
+    )
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=[plain, constrained])
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+    m = sched.run_cycle()
+    assert m.bound == 1
+    assert get_pod(api, "vip-plain").spec.node_name == "n0"
+    assert get_pod(api, "lowly").spec.node_name is None
+
+
+def test_interleaved_segments_all_bind_when_capacity_allows():
+    """Mixed priorities/kinds with ample capacity: everything binds, and
+    same-cycle placements are visible across segments (no oversubscription)."""
+    nodes = [make_node(f"n{i}", cpu="4", memory="16Gi", labels={"zone": "a" if i % 2 else "b"}) for i in range(4)]
+    pods = []
+    for i in range(6):
+        pods.append(make_pod(f"plain-{i}", cpu="1", memory="1Gi", priority=i % 3))
+    for i in range(4):
+        pods.append(
+            make_pod(
+                f"spread-{i}",
+                cpu="1",
+                memory="1Gi",
+                priority=(i + 1) % 4,
+                labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(topology_key="zone", max_skew=2, match_labels={"app": "web"})
+                ],
+            )
+        )
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=pods)
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+    m = sched.run_cycle()
+    assert m.bound == 10
+    # No node oversubscribed: 4 cpus each, 10 x 1cpu placed somewhere legal.
+    from tpu_scheduler.core.snapshot import node_allocatable, node_used_resources
+
+    s = ClusterSnapshot.build(nodes, [get_pod(api, p.metadata.name) for p in pods])
+    for n in nodes:
+        assert node_used_resources(s, n.name).cpu <= node_allocatable(n).cpu
+
+
+def test_pending_carrier_blocks_plain_classification():
+    """A pod with no terms of its own, but matched by a *pending* pod's
+    anti-affinity term, must not co-schedule into that term's domain when the
+    carrier lands first (higher priority)."""
+    nodes = [
+        make_node("n0", cpu="4", memory="16Gi", labels={"zone": "a"}),
+        make_node("n1", cpu="4", memory="16Gi", labels={"zone": "b"}),
+    ]
+    carrier = make_pod(
+        "db-0",
+        cpu="1",
+        memory="1Gi",
+        priority=5,
+        labels={"app": "db"},
+        anti_affinity=[PodAntiAffinityTerm(match_labels={"app": "db"}, topology_key="zone")],
+    )
+    victim = make_pod("db-1", cpu="1", memory="1Gi", priority=0, labels={"app": "db"})
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=[carrier, victim])
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+    m = sched.run_cycle()
+    assert m.bound == 2
+    z0 = get_pod(api, "db-0").spec.node_name
+    z1 = get_pod(api, "db-1").spec.node_name
+    assert z0 is not None and z1 is not None and z0 != z1
+
+
+def test_equal_priority_levels_coalesce_segments():
+    """Equal-priority interleaved plain/constrained arrival must not shatter
+    into per-pod segments: one plain batch + one constrained batch."""
+    nodes = [make_node(f"n{i}", cpu="8", memory="32Gi", labels={"zone": "a"}) for i in range(4)]
+    pods = []
+    for i in range(12):  # alternating kinds, all priority 0
+        if i % 2 == 0:
+            pods.append(make_pod(f"plain-{i}", cpu="250m", memory="512Mi"))
+        else:
+            pods.append(
+                make_pod(
+                    f"spread-{i}",
+                    cpu="250m",
+                    memory="512Mi",
+                    labels={"app": "web"},
+                    topology_spread=[
+                        TopologySpreadConstraint(topology_key="zone", max_skew=9, match_labels={"app": "web"})
+                    ],
+                )
+            )
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=pods)
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+
+    calls = []
+    orig = sched._schedule_batch
+
+    def counting(batch_snapshot, placed):
+        calls.append(len(batch_snapshot.pending_pods()))
+        return orig(batch_snapshot, placed)
+
+    sched._schedule_batch = counting
+    m = sched.run_cycle()
+    assert m.bound == 12
+    assert len(calls) == 1 and calls[0] == 6  # one tensor batch for all plain pods

@@ -23,6 +23,7 @@ __all__ = [
     "ObjectMeta",
     "ResourceRequirements",
     "Container",
+    "LabelSelectorRequirement",
     "PodAntiAffinityTerm",
     "TopologySpreadConstraint",
     "PodSpec",
@@ -68,15 +69,31 @@ class Container:
 
 
 @dataclass
+class LabelSelectorRequirement:
+    """One ``matchExpressions`` entry of a Kubernetes label selector.
+
+    Operators (k8s semantics): ``In`` — key present and value ∈ values;
+    ``NotIn`` — key absent or value ∉ values; ``Exists`` — key present;
+    ``DoesNotExist`` — key absent.
+    """
+
+    key: str
+    operator: str
+    values: list[str] | None = None
+
+
+@dataclass
 class PodAntiAffinityTerm:
     """Required inter-pod anti-affinity term (BASELINE.json config 5).
 
     The pod may not land in a topology domain (the set of nodes sharing the
     same value of ``topology_key``) that already holds a pod whose labels
-    carry every pair in ``match_labels`` *and* whose namespace equals this
+    satisfy the term's selector (``match_labels`` pairs AND every
+    ``match_expressions`` requirement) *and* whose namespace equals this
     pod's.  Semantics notes (deviations from full Kubernetes, by design):
 
-      • an empty/None ``match_labels`` matches *nothing* (K8s: everything);
+      • an entirely empty selector (no pairs, no expressions) matches
+        *nothing* (K8s: everything);
       • a node lacking ``topology_key`` is its own singleton domain, so the
         term degrades to per-node (hostname-like) anti-affinity there;
       • the term is enforced symmetrically: an already-placed pod's term also
@@ -85,23 +102,25 @@ class PodAntiAffinityTerm:
 
     match_labels: dict[str, str] | None = None
     topology_key: str = "kubernetes.io/hostname"
+    match_expressions: list[LabelSelectorRequirement] | None = None
 
 
 @dataclass
 class TopologySpreadConstraint:
     """Hard (DoNotSchedule) topology-spread constraint (config 5).
 
-    Counts pods matching ``match_labels`` in the pod's namespace per domain
+    Counts pods matching the selector in the pod's namespace per domain
     of ``topology_key``; placing the pod on a node must keep
     ``count(domain)+1 − min(count over the key's named domains) ≤ max_skew``.
     Nodes lacking the key are exempt from the constraint and excluded from
     the minimum (matching kube-scheduler's default node-exclusion).
-    ``match_labels=None`` matches nothing → the constraint is vacuous.
+    An empty selector matches nothing → the constraint is vacuous.
     """
 
     topology_key: str
     max_skew: int = 1
     match_labels: dict[str, str] | None = None
+    match_expressions: list[LabelSelectorRequirement] | None = None
 
 
 @dataclass
@@ -150,6 +169,19 @@ class Pod:
                 )
                 for c in spec_d.get("containers", [])
             ]
+            def parse_expressions(selector: Mapping[str, Any] | None) -> list[LabelSelectorRequirement] | None:
+                exprs = (selector or {}).get("matchExpressions")
+                if not exprs:
+                    return None
+                return [
+                    LabelSelectorRequirement(
+                        key=e.get("key", ""),
+                        operator=e.get("operator", ""),
+                        values=e.get("values"),
+                    )
+                    for e in exprs
+                ]
+
             anti = None
             terms = (
                 ((spec_d.get("affinity") or {}).get("podAntiAffinity") or {}).get(
@@ -162,6 +194,7 @@ class Pod:
                     PodAntiAffinityTerm(
                         match_labels=(t.get("labelSelector") or {}).get("matchLabels"),
                         topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
+                        match_expressions=parse_expressions(t.get("labelSelector")),
                     )
                     for t in terms
                 ]
@@ -174,6 +207,7 @@ class Pod:
                         topology_key=c.get("topologyKey", ""),
                         max_skew=c.get("maxSkew", 1),
                         match_labels=(c.get("labelSelector") or {}).get("matchLabels"),
+                        match_expressions=parse_expressions(c.get("labelSelector")),
                     )
                     for c in hard
                 ]

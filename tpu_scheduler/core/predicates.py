@@ -13,9 +13,10 @@ chain can grow (the reference hard-codes two, ``predicates.rs:63-77``).
 from __future__ import annotations
 
 import enum
-from typing import Callable
+from itertools import chain
+from typing import Callable, Sequence
 
-from ..api.objects import Node, Pod, total_pod_resources
+from ..api.objects import LabelSelectorRequirement, Node, Pod, total_pod_resources
 from .snapshot import ClusterSnapshot, node_allocatable, node_used_resources
 
 __all__ = [
@@ -25,7 +26,11 @@ __all__ = [
     "anti_affinity_ok",
     "topology_spread_ok",
     "labels_match_selector",
+    "selector_matches",
+    "term_matches",
     "node_topology_domain",
+    "make_affinity_checker",
+    "make_spread_checker",
     "check_node_validity",
     "PREDICATE_CHAIN",
 ]
@@ -81,6 +86,43 @@ def labels_match_selector(selector: dict[str, str] | None, labels: dict[str, str
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def _expression_matches(r: LabelSelectorRequirement, labels: dict[str, str]) -> bool:
+    if r.operator == "In":
+        return r.key in labels and labels[r.key] in (r.values or [])
+    if r.operator == "NotIn":
+        return r.key not in labels or labels[r.key] not in (r.values or [])
+    if r.operator == "Exists":
+        return r.key in labels
+    if r.operator == "DoesNotExist":
+        return r.key not in labels
+    return False  # unknown operator matches nothing (fail closed)
+
+
+def selector_matches(
+    match_labels: dict[str, str] | None,
+    match_expressions: Sequence[LabelSelectorRequirement] | None,
+    labels: dict[str, str] | None,
+) -> bool:
+    """Full label-selector match: every ``match_labels`` pair AND every
+    ``match_expressions`` requirement must hold.
+
+    An entirely empty selector (no pairs, no expressions) matches *nothing*
+    (the documented deviation — see PodAntiAffinityTerm).
+    """
+    if not match_labels and not match_expressions:
+        return False
+    if match_labels and not labels_match_selector(match_labels, labels):
+        return False
+    labels = labels or {}
+    return all(_expression_matches(r, labels) for r in match_expressions or [])
+
+
+def term_matches(term, labels: dict[str, str] | None) -> bool:
+    """Selector match of an anti-affinity term or spread constraint against
+    a pod's labels (both carry ``match_labels`` + ``match_expressions``)."""
+    return selector_matches(term.match_labels, getattr(term, "match_expressions", None), labels)
+
+
 def node_topology_domain(node: Node, topology_key: str) -> tuple[str, str]:
     """The topology domain of a node under ``topology_key``.
 
@@ -93,19 +135,13 @@ def node_topology_domain(node: Node, topology_key: str) -> tuple[str, str]:
     return (topology_key, v) if v is not None else ("~node", node.name)
 
 
-def _placed_pods(snapshot: ClusterSnapshot) -> list[tuple[Pod, Node]]:
-    """(pod, node) for every pod bound to a node present in the snapshot
-    (cached on the immutable snapshot — O(1) per predicate call)."""
-    return snapshot.placed_pods()
-
-
-def anti_affinity_ok(
+def make_affinity_checker(
     pod: Pod,
-    node: Node,
     snapshot: ClusterSnapshot,
-    extra_placed: tuple[tuple[Pod, Node], ...] = (),
-) -> bool:
-    """Inter-pod anti-affinity predicate (config 5; absent in the reference).
+    extra_placed: Sequence[tuple[Pod, Node]] = (),
+) -> Callable[[Node], bool]:
+    """Precompute ``pod``'s anti-affinity state into a set of blocked
+    topology domains, returning an O(#keys) per-node checker.
 
     Enforced in both directions, as kube-scheduler does:
       A. none of ``pod``'s terms may match a placed pod in ``node``'s domain;
@@ -113,41 +149,65 @@ def anti_affinity_ok(
     Terms are namespace-scoped: a term only sees pods sharing the namespace
     of the pod that declares it.  ``extra_placed`` lets a sequential caller
     overlay same-cycle commitments not yet visible in the snapshot.
+
+    A node is blocked iff its domain under some relevant topology key is in
+    the blocked set.  Merging keys into one set is exact: a keyless-node
+    domain ``("~node", name)`` can only collide across keys when the
+    candidate *is* that placed pod's node, in which case every generating
+    term blocks it anyway (same node ⇒ same domain under any key).
     """
     my_terms = (pod.spec.anti_affinity or []) if pod.spec is not None else []
     my_ns = pod.metadata.namespace
-    # Direction A: my term vs placed pods' labels (skipped when term-free).
+    blocked: set[tuple[str, str]] = set()
+    keys: set[str] = set()
+
+    # Direction A: domains holding a pod matched by one of my terms.
     if my_terms:
-        for q, qnode in snapshot.placed_pods() + list(extra_placed):
+        for q, qnode in chain(snapshot.placed_pods(), extra_placed):
             if q.metadata.namespace != my_ns:
                 continue
             for t in my_terms:
-                if labels_match_selector(t.match_labels, q.metadata.labels) and node_topology_domain(
-                    qnode, t.topology_key
-                ) == node_topology_domain(node, t.topology_key):
-                    return False
-    # Direction B: placed pods' terms vs my labels (only term-carriers scanned).
-    term_carriers = snapshot.placed_pods_with_terms() + [
-        (q, qn) for q, qn in extra_placed if q.spec is not None and q.spec.anti_affinity
-    ]
-    for q, qnode in term_carriers:
+                if term_matches(t, q.metadata.labels):
+                    blocked.add(node_topology_domain(qnode, t.topology_key))
+                    keys.add(t.topology_key)
+    # Direction B: domains of placed term-carriers whose term matches me.
+    carriers = chain(
+        snapshot.placed_pods_with_terms(),
+        ((q, qn) for q, qn in extra_placed if q.spec is not None and q.spec.anti_affinity),
+    )
+    for q, qnode in carriers:
         if q.metadata.namespace != my_ns:
             continue
         for t in q.spec.anti_affinity:
-            if labels_match_selector(t.match_labels, pod.metadata.labels) and node_topology_domain(
-                qnode, t.topology_key
-            ) == node_topology_domain(node, t.topology_key):
-                return False
-    return True
+            if term_matches(t, pod.metadata.labels):
+                blocked.add(node_topology_domain(qnode, t.topology_key))
+                keys.add(t.topology_key)
+
+    if not blocked:
+        return lambda node: True
+    return lambda node: all(node_topology_domain(node, k) not in blocked for k in keys)
 
 
-def topology_spread_ok(
+def anti_affinity_ok(
     pod: Pod,
     node: Node,
     snapshot: ClusterSnapshot,
-    extra_placed: tuple[tuple[Pod, Node], ...] = (),
+    extra_placed: Sequence[tuple[Pod, Node]] = (),
 ) -> bool:
-    """Hard topology-spread predicate (config 5; absent in the reference).
+    """Inter-pod anti-affinity predicate (config 5; absent in the reference).
+
+    One-shot form of :func:`make_affinity_checker` — see it for semantics.
+    """
+    return make_affinity_checker(pod, snapshot, extra_placed)(node)
+
+
+def make_spread_checker(
+    pod: Pod,
+    snapshot: ClusterSnapshot,
+    extra_placed: Sequence[tuple[Pod, Node]] = (),
+) -> Callable[[Node], bool]:
+    """Precompute per-constraint domain counts once, returning an
+    O(#constraints) per-node checker for the hard topology-spread predicate.
 
     For each constraint: count placed pods matching the selector (in the
     pod's namespace) per *named* domain of the key; placing here must keep
@@ -155,30 +215,49 @@ def topology_spread_ok(
     key is exempt; keyless nodes' pods don't enter the counts or the min.
     ``extra_placed`` overlays same-cycle commitments not yet in the snapshot.
     """
-    if pod.spec is None or not pod.spec.topology_spread:
-        return True
+    constraints = (pod.spec.topology_spread or []) if pod.spec is not None else []
+    if not constraints:
+        return lambda node: True
     my_ns = pod.metadata.namespace
-    placed = _placed_pods(snapshot) + list(extra_placed)
-    for c in pod.spec.topology_spread:
-        labels = node.metadata.labels or {}
-        if c.topology_key not in labels:
-            continue  # node exempt from this constraint
-        # Named domains of this key over all snapshot nodes.
+    per_constraint: list[tuple[str, int, dict[str, int], int]] = []
+    for c in constraints:
         counts: dict[str, int] = {}
         for n in snapshot.nodes:
             v = (n.metadata.labels or {}).get(c.topology_key)
             if v is not None:
                 counts.setdefault(v, 0)
-        for q, qnode in placed:
+        for q, qnode in chain(snapshot.placed_pods(), extra_placed):
             v = (qnode.metadata.labels or {}).get(c.topology_key)
             if v is None or q.metadata.namespace != my_ns:
                 continue
-            if labels_match_selector(c.match_labels, q.metadata.labels):
+            if term_matches(c, q.metadata.labels):
                 counts[v] = counts.get(v, 0) + 1
-        here = labels[c.topology_key]
-        if counts.get(here, 0) + 1 - min(counts.values(), default=0) > c.max_skew:
-            return False
-    return True
+        per_constraint.append((c.topology_key, c.max_skew, counts, min(counts.values(), default=0)))
+
+    def check(node: Node) -> bool:
+        labels = node.metadata.labels or {}
+        for key, max_skew, counts, lo in per_constraint:
+            here = labels.get(key)
+            if here is None:
+                continue  # node exempt from this constraint
+            if counts.get(here, 0) + 1 - lo > max_skew:
+                return False
+        return True
+
+    return check
+
+
+def topology_spread_ok(
+    pod: Pod,
+    node: Node,
+    snapshot: ClusterSnapshot,
+    extra_placed: Sequence[tuple[Pod, Node]] = (),
+) -> bool:
+    """Hard topology-spread predicate (config 5; absent in the reference).
+
+    One-shot form of :func:`make_spread_checker` — see it for semantics.
+    """
+    return make_spread_checker(pod, snapshot, extra_placed)(node)
 
 
 # Ordered chain: fixed resource-then-selector order, as in the reference

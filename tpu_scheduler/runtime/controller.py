@@ -28,13 +28,18 @@ import logging
 import random
 import time
 
-from ..api.objects import Node, ObjectReference, Pod, PodResources, full_name, is_pod_bound, total_pod_resources
+from dataclasses import replace
+from itertools import chain, groupby
+
+from ..api.objects import Node, ObjectReference, Pod, PodResources, PodSpec, full_name, is_pod_bound, total_pod_resources
 from ..backends.base import SchedulingBackend
 from ..core.predicates import (
     InvalidNodeReason,
     anti_affinity_ok,
-    labels_match_selector,
+    make_affinity_checker,
+    make_spread_checker,
     node_selector_matches,
+    term_matches,
     topology_spread_ok,
 )
 from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
@@ -155,24 +160,45 @@ class Scheduler:
     def _split_affinity_pending(self, snapshot: ClusterSnapshot, pending: list[Pod]) -> tuple[list[Pod], list[Pod]]:
         """Split pending pods into (plain, constrained) for the batch path.
 
-        Constrained = the pod declares anti-affinity/topology-spread, or a
-        placed pod's anti-affinity term matches it (direction B).  Until the
-        packed tensors carry affinity state, constrained pods are scheduled
-        through the exact sequential chain after the tensor cycle — correct
-        first, then fast (config 5 tensorization is the ops-layer milestone).
+        Constrained = the pod declares anti-affinity/topology-spread, or an
+        anti-affinity term of a *placed* pod or of another *pending* pod
+        matches it (direction B — including carriers that may be placed later
+        this same cycle, so a plain-classified pod can never be affected by
+        any affinity term).  Until the packed tensors carry affinity state,
+        constrained pods are scheduled through the exact sequential chain —
+        correct first, then fast (config 5 tensorization is the ops-layer
+        milestone).
         """
-        carriers = snapshot.placed_pods_with_terms()
+        # Index carrier terms so classification stays near-linear: a term with
+        # match_labels can only match a pod carrying its first (key, value)
+        # pair, so candidates probe the index with their own labels; terms
+        # with only match_expressions (rare) fall into a per-namespace
+        # residual list.
+        carriers = [q for q, _ in snapshot.placed_pods_with_terms()] + [
+            q for q in pending if q.spec is not None and q.spec.anti_affinity
+        ]
+        indexed: dict[tuple[str | None, str, str], list] = {}
+        residual: dict[str | None, list] = {}
+        for q in carriers:
+            ns = q.metadata.namespace
+            for t in q.spec.anti_affinity:
+                if t.match_labels:
+                    k, v = next(iter(t.match_labels.items()))
+                    indexed.setdefault((ns, k, v), []).append(t)
+                else:
+                    residual.setdefault(ns, []).append(t)
+
         plain: list[Pod] = []
         constrained: list[Pod] = []
         for p in pending:
             if p.spec is not None and (p.spec.anti_affinity or p.spec.topology_spread):
                 constrained.append(p)
                 continue
-            hit = any(
-                q.metadata.namespace == p.metadata.namespace
-                and any(labels_match_selector(t.match_labels, p.metadata.labels) for t in q.spec.anti_affinity)
-                for q, _ in carriers
-            )
+            ns = p.metadata.namespace
+            labels = p.metadata.labels or {}
+            candidates = residual.get(ns, [])
+            probed = [t for kv in labels.items() for t in indexed.get((ns, kv[0], kv[1]), ())]
+            hit = any(term_matches(t, labels) for t in chain(candidates, probed))
             (constrained if hit else plain).append(p)
         return plain, constrained
 
@@ -208,10 +234,17 @@ class Scheduler:
         unschedulable = 0
         order = sorted(constrained, key=lambda p: -(p.spec.priority if p.spec is not None else 0))
         for pod in order:
+            # Precompute the pod's affinity/spread state once — the node loop
+            # is then O(1) per candidate instead of re-scanning all placements.
+            affinity_checker = make_affinity_checker(pod, snapshot, placed)
+            spread_checker = make_spread_checker(pod, snapshot, placed)
             best: Node | None = None
             best_score = 0.0
             for node in snapshot.nodes:
-                if self._check_with_ledger(pod, node, snapshot, ledger, placed) is not None:
+                reason = self._check_with_ledger(
+                    pod, node, snapshot, ledger, placed, affinity_checker=affinity_checker, spread_checker=spread_checker
+                )
+                if reason is not None:
                     continue
                 score = self._scalar_score(pod, node, snapshot, ledger, weights)
                 if best is None or score > best_score:
@@ -227,14 +260,17 @@ class Scheduler:
                 placed.append((pod, best))
         return bound, unschedulable
 
-    def _run_batch_cycle(self, snapshot: ClusterSnapshot, trace: Trace) -> tuple[int, int, int]:
-        pending = snapshot.pending_pods()
-        plain, constrained = self._split_affinity_pending(snapshot, pending)
-        if constrained:
-            held = {id(p) for p in constrained}
-            batch_snapshot = ClusterSnapshot.build(snapshot.nodes, [p for p in snapshot.pods if id(p) not in held])
-        else:
-            batch_snapshot = snapshot
+    @staticmethod
+    def _bound_clone(pod: Pod, node: Node) -> Pod:
+        """A copy of ``pod`` with ``spec.nodeName`` set — lets a same-cycle
+        placement consume capacity in a later segment's packed snapshot."""
+        spec = replace(pod.spec, node_name=node.name) if pod.spec is not None else PodSpec(node_name=node.name)
+        return replace(pod, spec=spec)
+
+    def _schedule_batch(self, batch_snapshot: ClusterSnapshot, placed: list[tuple[Pod, Node]]) -> tuple[int, int, int]:
+        """Pack + solve + bind one batch of plain pending pods; successful
+        placements append to ``placed``.  Returns (bound, unschedulable,
+        rounds)."""
         with span("pack"):
             packed = self._pack(batch_snapshot)
         with span("solve"):
@@ -250,9 +286,8 @@ class Scheduler:
                 self.metrics.inc("scheduler_backend_fallbacks_total")
                 result = self.fallback_backend.schedule(packed, self.profile)
         bound = 0
-        placed: list[tuple[Pod, Node]] = []
-        node_by_name = {n.name: n for n in snapshot.nodes}
-        pod_by_full = {full_name(p): p for p in pending}
+        node_by_name = {n.name: n for n in batch_snapshot.nodes}
+        pod_by_full = {full_name(p): p for p in batch_snapshot.pending_pods()}
         with span("bind"):
             for pod_full, node_name in result.bindings:
                 namespace, _, name = pod_full.rpartition("/")
@@ -263,13 +298,53 @@ class Scheduler:
                         placed.append((pod_obj, node_obj))
             for pod_full in result.unschedulable:
                 self._requeue(pod_full, "no-node-found")
-        unschedulable = len(result.unschedulable)
-        if constrained:
-            with span("constrained"):
-                seq_bound, seq_unsched = self._run_constrained_phase(snapshot, constrained, placed)
-            bound += seq_bound
-            unschedulable += seq_unsched
-        return bound, unschedulable, result.rounds
+        return bound, len(result.unschedulable), result.rounds
+
+    def _run_batch_cycle(self, snapshot: ClusterSnapshot, trace: Trace) -> tuple[int, int, int]:
+        pending = snapshot.pending_pods()
+        _, constrained = self._split_affinity_pending(snapshot, pending)
+        placed: list[tuple[Pod, Node]] = []
+        if not constrained:
+            # Fast path — one tensor cycle over every pending pod (and the
+            # incremental device-resident pack stays hot).
+            return self._schedule_batch(snapshot, placed)
+
+        # Mixed cycle: schedule in global priority order so a plain pod never
+        # takes capacity from a higher-priority constrained pod (or vice
+        # versa).  Equal-priority pods carry no ordering obligation, so each
+        # priority level contributes at most one plain segment (tensor path)
+        # and one constrained segment (exact sequential chain) — adjacent
+        # same-kind segments across levels coalesce — and every segment sees
+        # all earlier placements as consumed capacity.
+        constrained_ids = {id(p) for p in constrained}
+        pending_ids = {id(p) for p in pending}
+        priority_of = lambda p: p.spec.priority if p.spec is not None else 0  # noqa: E731
+        order = sorted(pending, key=lambda p: -priority_of(p))
+        segments: list[tuple[bool, list[Pod]]] = []
+        for _, level in groupby(order, key=priority_of):
+            for pod in sorted(level, key=lambda p: id(p) in constrained_ids):  # plain first within a level
+                is_constrained = id(pod) in constrained_ids
+                if segments and segments[-1][0] == is_constrained:
+                    segments[-1][1].append(pod)
+                else:
+                    segments.append((is_constrained, [pod]))
+        base_pods = [p for p in snapshot.pods if id(p) not in pending_ids]
+        bound = unschedulable = rounds = 0
+        for is_constrained, segment in segments:
+            if is_constrained:
+                with span("constrained"):
+                    b, u = self._run_constrained_phase(snapshot, segment, placed)
+                r = 0
+            else:
+                batch_snapshot = ClusterSnapshot.build(
+                    snapshot.nodes,
+                    base_pods + [self._bound_clone(q, qn) for q, qn in placed] + segment,
+                )
+                b, u, r = self._schedule_batch(batch_snapshot, placed)
+            bound += b
+            unschedulable += u
+            rounds += r
+        return bound, unschedulable, rounds
 
     # -- sample policy (reference main.rs:49-71) ---------------------------
 
@@ -298,10 +373,18 @@ class Scheduler:
         snapshot: ClusterSnapshot,
         ledger: dict[str, PodResources],
         placed: list[tuple[Pod, Node]],
+        affinity_checker=None,
+        spread_checker=None,
     ) -> InvalidNodeReason | None:
         """Full predicate chain vs snapshot + this-cycle commitments: the
         assumed-resources ledger (closing the reference's TOCTOU race) and
-        the ``placed`` overlay so affinity/spread see same-cycle bindings."""
+        the ``placed`` overlay so affinity/spread see same-cycle bindings.
+
+        A caller looping over many nodes for one pod passes prebuilt
+        ``affinity_checker``/``spread_checker`` (make_affinity_checker /
+        make_spread_checker over the same snapshot+placed) to amortise the
+        placement scans; semantics are identical either way.
+        """
         available = node_allocatable(node)
         available -= node_used_resources(snapshot, node.name)
         assumed = ledger.get(node.name)
@@ -312,9 +395,15 @@ class Scheduler:
             return InvalidNodeReason.NOT_ENOUGH_RESOURCES
         if not node_selector_matches(pod, node):
             return InvalidNodeReason.NODE_SELECTOR_MISMATCH
-        if not anti_affinity_ok(pod, node, snapshot, extra_placed=tuple(placed)):
+        affinity_fine = (
+            affinity_checker(node) if affinity_checker is not None else anti_affinity_ok(pod, node, snapshot, extra_placed=placed)
+        )
+        if not affinity_fine:
             return InvalidNodeReason.ANTI_AFFINITY_VIOLATION
-        if not topology_spread_ok(pod, node, snapshot, extra_placed=tuple(placed)):
+        spread_fine = (
+            spread_checker(node) if spread_checker is not None else topology_spread_ok(pod, node, snapshot, extra_placed=placed)
+        )
+        if not spread_fine:
             return InvalidNodeReason.TOPOLOGY_SPREAD_VIOLATION
         return None
 
