@@ -22,7 +22,7 @@ __all__ = ["TpuBackend"]
 class TpuBackend(SchedulingBackend):
     name = "tpu"
 
-    def __init__(self, device=None):
+    def __init__(self, device=None, use_pallas: bool | None = None):
         try:
             import jax
         except Exception as e:  # pragma: no cover - jax is baked into the image
@@ -34,6 +34,10 @@ class TpuBackend(SchedulingBackend):
                 raise BackendUnavailable("no jax devices")
             device = devices[0]
         self.device = device
+        # The fused Pallas choose kernel (ops/pallas_choose.py) is
+        # Mosaic/TPU-only; every other platform runs the jnp path (tests
+        # exercise the kernel itself in interpreter mode).
+        self.use_pallas = (device.platform == "tpu") if use_pallas is None else use_pallas
 
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         jax = self._jax
@@ -54,6 +58,7 @@ class TpuBackend(SchedulingBackend):
                 weights,
                 max_rounds=profile.max_rounds,
                 block=profile.pod_block,
+                use_pallas=self.use_pallas,
             )
             return np.asarray(jax.device_get(assigned)), int(rounds)
         except jax.errors.JaxRuntimeError as e:

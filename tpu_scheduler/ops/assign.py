@@ -70,8 +70,18 @@ def _seg_scan_op(x, y):
     return fx | fy, jnp.where(fy, vy, _sat_add(vx, vy))
 
 
-def _choose_block(avail, node_alloc, node_labels, node_valid, weights, breq, bsel, bselc, bact, bidx):
-    """[B] best feasible node (+feasibility flag) for one block of pods."""
+def _choose_block(avail, node_alloc, node_labels, node_valid, weights, breq, bsel, bselc, bact, bidx, pallas_pack=None):
+    """[B] best feasible node (+feasibility flag) for one block of pods.
+
+    With ``pallas_pack`` (node_info, labels_t, interpret) the fused Pallas
+    kernel runs (ops/pallas_choose.py — bit-identical results, one VMEM
+    pass); otherwise the xp-generic jnp expression tree.
+    """
+    if pallas_pack is not None:
+        from .pallas_choose import choose_block_pallas
+
+        node_info, labels_t, interpret = pallas_pack
+        return choose_block_pallas(breq, bsel, bselc, bact, bidx, node_info, labels_t, weights, interpret=interpret)
     node_idx = jnp.arange(avail.shape[0], dtype=jnp.uint32)
     m = feasibility_block(jnp, breq, bsel, bselc, bact, avail, node_labels, node_valid)
     sc = score_block(jnp, breq, node_alloc, avail, weights, bidx, node_idx)
@@ -79,7 +89,10 @@ def _choose_block(avail, node_alloc, node_labels, node_valid, weights, breq, bse
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
 
-def _choose(avail, active, req, sel, selc, ranks, n_active, node_alloc, node_labels, node_valid, weights, block):
+def _choose(
+    avail, active, req, sel, selc, ranks, n_active, node_alloc, node_labels, node_valid, weights, block,
+    use_pallas=False, pallas_interpret=False,
+):
     """Per-pod best feasible node vs current capacity, blockwise over pods.
 
     Never materialises the full [P,N] score matrix: peak live memory is one
@@ -91,8 +104,17 @@ def _choose(avail, active, req, sel, selc, ranks, n_active, node_alloc, node_lab
     """
     p = req.shape[0]
 
+    pallas_pack = None
+    if use_pallas:
+        from .pallas_choose import build_node_info
+
+        # Rebuilt each round (avail changes); O(N) next to the O(B·N) choose.
+        pallas_pack = (build_node_info(avail, node_alloc, node_valid), node_labels.T, pallas_interpret)
+
     if block >= p:
-        return _choose_block(avail, node_alloc, node_labels, node_valid, weights, req, sel, selc, active, ranks)
+        return _choose_block(
+            avail, node_alloc, node_labels, node_valid, weights, req, sel, selc, active, ranks, pallas_pack
+        )
 
     nb_occupied = (n_active + block - 1) // block  # traced; caller pads p % block == 0
 
@@ -114,6 +136,7 @@ def _choose(avail, active, req, sel, selc, ranks, n_active, node_alloc, node_lab
             lax.dynamic_slice_in_dim(selc, lo, block),
             lax.dynamic_slice_in_dim(active, lo, block),
             lax.dynamic_slice_in_dim(ranks, lo, block),
+            pallas_pack,
         )
         choice = lax.dynamic_update_slice_in_dim(choice, bc, lo, axis=0)
         has = lax.dynamic_update_slice_in_dim(has, bh, lo, axis=0)
@@ -123,7 +146,7 @@ def _choose(avail, active, req, sel, selc, ranks, n_active, node_alloc, node_lab
     return choice, has
 
 
-@partial(jax.jit, static_argnames=("max_rounds", "block"))
+@partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret"))
 def assign_cycle(
     node_alloc,
     node_avail,
@@ -137,6 +160,8 @@ def assign_cycle(
     weights,
     max_rounds: int = 32,
     block: int = 4096,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ):
     """Assign all pending pods to nodes in one on-device cycle.
 
@@ -189,7 +214,8 @@ def assign_cycle(
     def body(state):
         avail, req, sel, selc, ranks, assigned, active, n_active, rounds = state
         choice, has = _choose(
-            avail, active, req, sel, selc, ranks, n_active, node_alloc, node_labels, node_valid, weights, block
+            avail, active, req, sel, selc, ranks, n_active, node_alloc, node_labels, node_valid, weights, block,
+            use_pallas, pallas_interpret,
         )
         cand = active & has
         ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants

@@ -1,0 +1,213 @@
+"""Pallas TPU kernel for the auction's hot op: fused feasibility + score +
+masked argmax ("choose") for a block of pods against all nodes.
+
+The jnp path (ops/masks.py + ops/score.py + argmax in ops/assign.py)
+materialises ~8 [B, N] f32/i32 intermediates per block in HBM unless XLA
+fuses them all; this kernel keeps every intermediate in VMEM, streaming node
+tiles through a running (max, argmax) scratch — one HBM read of the node
+tensors and one [B] write per block, the minimum possible traffic.
+
+Bitwise parity with the jnp/NumPy expression tree is preserved by computing
+the *same* f32 operations in the same order (ops/score.py), the same exact
+int32 arithmetic for resource fit (ops/masks.py), and the same uint32
+Knuth-multiplicative jitter hash; the running cross-tile max uses a strict
+``>`` so ties resolve to the lowest node index, exactly like ``jnp.argmax``
+over the full row (tests/test_pallas_choose.py asserts equality).
+
+Node-side layout: resources ride in one ``[8, N] int32`` array (rows: avail
+cpu/mem, alloc cpu/mem, valid, 3× pad) so the int32 (8, 128) min-tile is hit
+exactly; labels ride transposed ``[L, N]`` so the selector-count matmul
+``sel @ labelsT`` feeds the MXU directly.
+
+Reference capability anchor: this is the batched form of the predicate chain
+``check_node_validity`` (reference ``src/predicates.rs:63-77``) plus scoring
+the reference lacks (it takes the first feasible random candidate,
+``src/main.rs:51-71``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["choose_block_pallas", "build_node_info"]
+
+# Row indices of the packed [8, N] node-resource array.
+ROW_AVAIL_CPU, ROW_AVAIL_MEM, ROW_ALLOC_CPU, ROW_ALLOC_MEM, ROW_VALID = 0, 1, 2, 3, 4
+
+NEG_INF = float("-inf")
+
+
+def build_node_info(node_avail, node_alloc, node_valid):
+    """Pack node resources into the kernel's [8, N] int32 layout."""
+    n = node_avail.shape[0]
+    rows = [
+        node_avail[:, 0],
+        node_avail[:, 1],
+        node_alloc[:, 0],
+        node_alloc[:, 1],
+        node_valid.astype(jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+    ]
+    return jnp.stack(rows, axis=0)
+
+
+def _choose_kernel(
+    weights_ref,  # [1, 4] f32 SMEM  (w_lr, w_ba, w_jitter, pad)
+    req_ref,  # [BP, 2] i32
+    sel_ref,  # [BP, L] f32
+    selc_ref,  # [BP, 1] f32
+    act_ref,  # [BP, 1] i32
+    idx_ref,  # [BP, 1] u32  (priority ranks, jitter hash input)
+    info_ref,  # [8, TN] i32  (node resources, see ROW_*)
+    labels_ref,  # [L, TN] f32
+    choice_ref,  # [BP, 1] i32 out
+    has_ref,  # [BP, 1] i32 out
+    best_ref,  # [BP, 1] f32 scratch
+    bestidx_ref,  # [BP, 1] i32 scratch
+):
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    tn = info_ref.shape[1]
+    f32 = jnp.float32
+
+    @pl.when(j == 0)
+    def _():
+        best_ref[:] = jnp.full_like(best_ref, NEG_INF)
+        bestidx_ref[:] = jnp.zeros_like(bestidx_ref)
+
+    avail = info_ref[0:2, :]  # [2, TN] i32
+    alloc = info_ref[2:4, :]
+    valid = info_ref[ROW_VALID : ROW_VALID + 1, :]  # [1, TN] i32
+
+    req_cpu = req_ref[:, 0:1]  # [BP, 1] i32
+    req_mem = req_ref[:, 1:2]
+
+    # PodFitsResources — exact int32, identical to ops/masks.py.
+    fit = (req_cpu <= avail[0:1, :]) & (req_mem <= avail[1:2, :])  # [BP, TN]
+
+    # nodeSelector — selector-pair counting matmul (MXU; counts are tiny
+    # integers, exact in f32).
+    counts = jnp.dot(sel_ref[:], labels_ref[:], preferred_element_type=f32)  # [BP, TN]
+    sel_ok = counts == selc_ref[:]
+
+    mask = fit & sel_ok & (valid > 0) & (act_ref[:] > 0)
+
+    # LeastRequested + BalancedAllocation — same op order as ops/score.py.
+    used_cpu = (alloc[0:1, :] - avail[0:1, :]) + req_cpu  # [BP, TN] i32
+    used_mem = (alloc[1:2, :] - avail[1:2, :]) + req_mem
+    safe_cpu = alloc[0:1, :] > 0
+    safe_mem = alloc[1:2, :] > 0
+    denom_cpu = jnp.where(safe_cpu, alloc[0:1, :].astype(f32), f32(1.0))
+    denom_mem = jnp.where(safe_mem, alloc[1:2, :].astype(f32), f32(1.0))
+    frac_cpu = jnp.where(safe_cpu, used_cpu.astype(f32) / denom_cpu, f32(1.0))
+    frac_mem = jnp.where(safe_mem, used_mem.astype(f32) / denom_mem, f32(1.0))
+    least_requested = ((f32(1.0) - frac_cpu) + (f32(1.0) - frac_mem)) * f32(50.0)
+    balanced = (f32(1.0) - jnp.abs(frac_cpu - frac_mem)) * f32(100.0)
+    score = weights_ref[0, 0] * least_requested + weights_ref[0, 1] * balanced
+
+    # Deterministic tie-break jitter — same uint32 hash as ops/score.py.
+    u32 = jnp.uint32
+    node_idx = (j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
+    h = idx_ref[:].astype(u32) * u32(2654435761) + node_idx * u32(2246822519)
+    h = (h ^ (h >> u32(15))) & u32(0xFFFF)
+    # Mosaic lacks a direct uint32→f32 cast; h < 2^16 so int32 is exact.
+    score = score + weights_ref[0, 2] * (h.astype(jnp.int32).astype(f32) / f32(65536.0))
+
+    sc = jnp.where(mask, score.astype(f32), NEG_INF)
+
+    tile_best = jnp.max(sc, axis=1, keepdims=True)  # [BP, 1]
+    tile_arg = jnp.argmax(sc, axis=1).reshape(-1, 1).astype(jnp.int32) + j * tn
+
+    improve = tile_best > best_ref[:]
+    bestidx_ref[:] = jnp.where(improve, tile_arg, bestidx_ref[:])
+    best_ref[:] = jnp.where(improve, tile_best, best_ref[:])
+
+    @pl.when(j == nb - 1)
+    def _():
+        choice_ref[:] = bestidx_ref[:]
+        has_ref[:] = (best_ref[:] > NEG_INF).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("pod_tile", "node_tile", "interpret"))
+def choose_block_pallas(
+    req,  # [B, 2] i32
+    sel,  # [B, L] f32
+    selc,  # [B] f32
+    act,  # [B] bool
+    ranks,  # [B] u32
+    node_info,  # [8, N] i32 (build_node_info)
+    labels_t,  # [L, N] f32
+    weights,  # [3] f32
+    pod_tile: int = 256,
+    node_tile: int = 512,
+    interpret: bool = False,
+):
+    """Fused choose over a block of pods: returns (choice [B] i32, has [B] bool).
+
+    Pads pods/nodes up to tile multiples internally; padded pods are
+    inactive, padded nodes invalid, so results are unaffected.
+    """
+    b, n = req.shape[0], node_info.shape[1]
+    l = sel.shape[1]
+    bp = min(pod_tile, max(8, b))
+    pb = -(-b // bp)
+    nbt = -(-n // node_tile)
+    b_pad, n_pad = pb * bp, nbt * node_tile
+
+    if b_pad != b:
+        req = jnp.pad(req, ((0, b_pad - b), (0, 0)))
+        sel = jnp.pad(sel, ((0, b_pad - b), (0, 0)))
+        selc = jnp.pad(selc, ((0, b_pad - b),))
+        act = jnp.pad(act, ((0, b_pad - b),))
+        ranks = jnp.pad(ranks, ((0, b_pad - b),))
+    if n_pad != n:
+        node_info = jnp.pad(node_info, ((0, 0), (0, n_pad - n)))
+        labels_t = jnp.pad(labels_t, ((0, 0), (0, n_pad - n)))
+
+    w = jnp.pad(weights.astype(jnp.float32), (0, 1)).reshape(1, 4)
+
+    grid = (pb, nbt)
+    choice, has = pl.pallas_call(
+        _choose_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 4), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bp, 2), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, l), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((8, node_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((l, node_tile), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((b_pad, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bp, 1), jnp.float32),
+            pltpu.VMEM((bp, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        w,
+        req,
+        sel,
+        selc.reshape(-1, 1),
+        act.astype(jnp.int32).reshape(-1, 1),
+        ranks.astype(jnp.uint32).reshape(-1, 1),
+        node_info,
+        labels_t,
+    )
+    return choice[:b, 0], has[:b, 0].astype(bool)
