@@ -63,7 +63,7 @@ def _both_paths(a, weights, pod_tile=8, node_tile=128):
 @pytest.mark.parametrize("seed", [0, 1, 2])
 @pytest.mark.parametrize("n_nodes,n_pending", [(24, 40), (64, 96), (17, 33)])
 def test_pallas_choose_matches_jnp(seed, n_nodes, n_pending):
-    a, weights = _both_paths.__globals__["_case"](n_nodes, n_pending, seed)
+    a, weights = _case(n_nodes, n_pending, seed)
     jc, jh, pc, ph = _both_paths(a, weights)
     np.testing.assert_array_equal(jh, ph)
     # choice only defined where feasible

@@ -37,6 +37,8 @@ __all__ = [
     "total_pod_resources",
     "is_pod_bound",
     "full_name",
+    "pod_to_dict",
+    "node_to_dict",
 ]
 
 _uid_counter = itertools.count(1)
@@ -46,13 +48,25 @@ def _next_uid() -> str:
     return f"uid-{next(_uid_counter)}"
 
 
+def _parse_resource_version(rv) -> "int | str":
+    """Kubernetes resourceVersion is an opaque string; keep it numeric when
+    it parses (the in-repo servers use ints) and opaque otherwise — every
+    consumer (change detection, signatures) only needs equality."""
+    if rv is None or rv == "":
+        return 0
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return str(rv)
+
+
 @dataclass
 class ObjectMeta:
     name: str = ""
     namespace: str | None = None
     labels: dict[str, str] | None = None
     uid: str = field(default_factory=_next_uid)
-    resource_version: int = 0
+    resource_version: int | str = 0
 
 
 @dataclass
@@ -220,15 +234,108 @@ class Pod:
                 topology_spread=spread,
             )
         status = PodStatus(phase=d.get("status", {}).get("phase", "Pending"))
-        return Pod(
-            metadata=ObjectMeta(
-                name=meta.get("name", ""),
-                namespace=meta.get("namespace"),
-                labels=meta.get("labels"),
-            ),
-            spec=spec,
-            status=status,
+        obj_meta = ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace"),
+            labels=meta.get("labels"),
+            resource_version=_parse_resource_version(meta.get("resourceVersion")),
         )
+        if "uid" in meta:
+            obj_meta.uid = meta["uid"]
+        return Pod(metadata=obj_meta, spec=spec, status=status)
+
+
+def _selector_to_dict(match_labels, match_expressions) -> dict[str, Any] | None:
+    sel: dict[str, Any] = {}
+    if match_labels:
+        sel["matchLabels"] = dict(match_labels)
+    if match_expressions:
+        sel["matchExpressions"] = [
+            {"key": e.key, "operator": e.operator, **({"values": list(e.values)} if e.values is not None else {})}
+            for e in match_expressions
+        ]
+    return sel or None
+
+
+def pod_to_dict(pod: "Pod") -> dict[str, Any]:
+    """Serialize to the k8s-manifest shape ``Pod.from_dict`` accepts (the
+    REST wire format of runtime/kube_http.py).  Lossless round-trip for
+    every field the scheduler reads."""
+    meta: dict[str, Any] = {"name": pod.metadata.name, "uid": pod.metadata.uid}
+    if pod.metadata.namespace is not None:
+        meta["namespace"] = pod.metadata.namespace
+    if pod.metadata.labels:
+        meta["labels"] = dict(pod.metadata.labels)
+    if pod.metadata.resource_version:
+        meta["resourceVersion"] = str(pod.metadata.resource_version)
+    out: dict[str, Any] = {"kind": "Pod", "metadata": meta, "status": {"phase": pod.status.phase}}
+    if pod.spec is None:
+        return out
+    spec: dict[str, Any] = {
+        "containers": [
+            {
+                "name": c.name,
+                **(
+                    {
+                        "resources": {
+                            k: v
+                            for k, v in (
+                                ("requests", c.resources.requests),
+                                ("limits", c.resources.limits),
+                            )
+                            if v is not None
+                        }
+                    }
+                    if c.resources is not None
+                    else {}
+                ),
+            }
+            for c in pod.spec.containers
+        ]
+    }
+    if pod.spec.node_selector:
+        spec["nodeSelector"] = dict(pod.spec.node_selector)
+    if pod.spec.node_name is not None:
+        spec["nodeName"] = pod.spec.node_name
+    if pod.spec.priority:
+        spec["priority"] = pod.spec.priority
+    if pod.spec.anti_affinity:
+        terms = []
+        for t in pod.spec.anti_affinity:
+            term: dict[str, Any] = {"topologyKey": t.topology_key}
+            sel = _selector_to_dict(t.match_labels, t.match_expressions)
+            if sel:
+                term["labelSelector"] = sel
+            terms.append(term)
+        spec["affinity"] = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": terms}}
+    if pod.spec.topology_spread:
+        constraints = []
+        for c in pod.spec.topology_spread:
+            constraint: dict[str, Any] = {
+                "topologyKey": c.topology_key,
+                "maxSkew": c.max_skew,
+                "whenUnsatisfiable": "DoNotSchedule",
+            }
+            sel = _selector_to_dict(c.match_labels, c.match_expressions)
+            if sel:
+                constraint["labelSelector"] = sel
+            constraints.append(constraint)
+        spec["topologySpreadConstraints"] = constraints
+    out["spec"] = spec
+    return out
+
+
+def node_to_dict(node: "Node") -> dict[str, Any]:
+    """Serialize to the k8s-manifest shape ``Node.from_dict`` accepts."""
+    meta: dict[str, Any] = {"name": node.metadata.name, "uid": node.metadata.uid}
+    if node.metadata.labels:
+        meta["labels"] = dict(node.metadata.labels)
+    if node.metadata.resource_version:
+        meta["resourceVersion"] = str(node.metadata.resource_version)
+    out: dict[str, Any] = {"kind": "Node", "metadata": meta}
+    if node.status is not None and node.status.allocatable is not None:
+        out["status"] = {"allocatable": dict(node.status.allocatable)}
+    return out
 
 
 @dataclass
@@ -250,12 +357,16 @@ class Node:
     def from_dict(d: Mapping[str, Any]) -> "Node":
         meta = d.get("metadata", {})
         status_d = d.get("status")
+        obj_meta = ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace"),
+            labels=meta.get("labels"),
+            resource_version=_parse_resource_version(meta.get("resourceVersion")),
+        )
+        if "uid" in meta:
+            obj_meta.uid = meta["uid"]
         return Node(
-            metadata=ObjectMeta(
-                name=meta.get("name", ""),
-                namespace=meta.get("namespace"),
-                labels=meta.get("labels"),
-            ),
+            metadata=obj_meta,
             status=NodeStatus(allocatable=status_d.get("allocatable")) if status_d else None,
         )
 

@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-fallback", action="store_true", help="disable tpu->native failure fallback")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace of the cycles here")
+    p.add_argument("--checkpoint-dir", default=None, help="restore scheduler state from here at startup, save at exit")
+    p.add_argument("--http-port", type=int, default=None, help="serve /metrics, /healthz and the k8s REST surface on this port")
+    p.add_argument("--api-server", default=None, help="schedule against a remote k8s-style REST endpoint (URL) instead of the synthetic in-process cluster")
+    p.add_argument("--api-token", default=None, help="bearer token for --api-server")
     return p
 
 
@@ -47,9 +51,14 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     configure_logging(args.log_level)
 
-    api = FakeApiServer()
-    snap = synth_cluster(n_nodes=args.nodes, n_pending=args.pods, n_bound=args.bound_pods, seed=args.seed)
-    api.load(snap.nodes, snap.pods)
+    if args.api_server:
+        from .runtime.http_api import KubeApiClient, RemoteApiAdapter
+
+        api = RemoteApiAdapter(KubeApiClient(args.api_server, token=args.api_token))
+    else:
+        api = FakeApiServer()
+        snap = synth_cluster(n_nodes=args.nodes, n_pending=args.pods, n_bound=args.bound_pods, seed=args.seed)
+        api.load(snap.nodes, snap.pods)
 
     if args.backend == "native":
         backend = NativeBackend()
@@ -70,10 +79,33 @@ def main(argv: list[str] | None = None) -> int:
         fallback_backend=fallback,
     )
 
+    if args.checkpoint_dir:
+        from .runtime.checkpoint import restore_scheduler
+
+        restore_scheduler(sched, args.checkpoint_dir)
+
+    http_server = None
+    if args.http_port is not None:
+        from .runtime.http_api import HttpApiServer
+
+        # Against a remote cluster we serve metrics/health only — the remote
+        # API server owns the cluster state.
+        local_api = None if args.api_server else api
+        http_server = HttpApiServer(local_api, metrics=sched.metrics, port=args.http_port).start()
+        print(json.dumps({"http": True, "url": http_server.base_url}), file=sys.stderr)
+
     from .utils.tracing import device_profile
 
-    with device_profile(args.profile_dir):
-        metrics = sched.run(max_cycles=args.cycles, until_settled=args.cycles is None)
+    try:
+        with device_profile(args.profile_dir):
+            metrics = sched.run(max_cycles=args.cycles, until_settled=args.cycles is None)
+    finally:
+        if args.checkpoint_dir:
+            from .runtime.checkpoint import save_scheduler
+
+            save_scheduler(sched, args.checkpoint_dir)
+        if http_server is not None:
+            http_server.stop()
 
     for m in metrics:
         print(m.to_json())

@@ -1,0 +1,129 @@
+"""Checkpoint/resume of scheduler-local state.
+
+The reference has no checkpointing at all: on restart it rebuilds everything
+from API-server watches (SURVEY.md §5 — "the API server *is* the
+checkpoint").  This framework keeps that property for cluster state, and
+additionally snapshots the two things a restart would otherwise lose or have
+to recompute:
+
+  • the requeue ledger — without it, a restarted scheduler immediately
+    retries pods that had failed moments earlier (the reference's behavior:
+    its 5-minute error_policy backoff, ``src/main.rs:122-125``, evaporates
+    on restart);
+  • the packed node-side tensors + selector vocabulary — the device-resident
+    cache (ops/pack.py) that lets the first post-restart cycle take the
+    cheap incremental path instead of a full repack.
+
+Requeue deadlines are stored as *remaining seconds* because the scheduler
+clock is monotonic (not wall) time; metric counters ride along so
+``*_total`` series survive restarts, as Prometheus counters should.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from ..ops.pack import PackedCluster
+
+__all__ = ["save_scheduler", "restore_scheduler", "CHECKPOINT_VERSION"]
+
+CHECKPOINT_VERSION = 1
+
+_STATE_FILE = "state.json"
+_TENSORS_FILE = "node_tensors.npz"
+
+
+def save_scheduler(scheduler, path: str) -> None:
+    """Write a checkpoint directory atomically (tmp + rename)."""
+    os.makedirs(path, exist_ok=True)
+    now = scheduler.clock()
+    state = {
+        "version": CHECKPOINT_VERSION,
+        "cycle_count": scheduler._cycle_count,
+        "counters": dict(scheduler.metrics.counters),
+        # monotonic deadlines -> remaining seconds (clamped at 0)
+        "requeue_remaining": {k: max(0.0, v - now) for k, v in scheduler.requeue_at.items()},
+        "node_sig": [list(pair) for pair in scheduler._node_sig] if scheduler._node_sig else None,
+    }
+    packed = scheduler._packed
+    if packed is not None:
+        state["vocab"] = [[k, v, i] for (k, v), i in packed.vocab.items()]
+        state["node_names"] = list(packed.node_names)
+        fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
+        with os.fdopen(fd, "wb") as f:  # file object: savez can't append ".npz"
+            np.savez(
+                f,
+                node_alloc=packed.node_alloc,
+                node_avail=packed.node_avail,
+                node_labels=packed.node_labels,
+                node_valid=packed.node_valid,
+            )
+        os.replace(tmp, os.path.join(path, _TENSORS_FILE))
+    fd, tmp = tempfile.mkstemp(dir=path, suffix=".json.tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, os.path.join(path, _STATE_FILE))
+
+
+def restore_scheduler(scheduler, path: str) -> bool:
+    """Fold a checkpoint into a freshly constructed Scheduler.
+
+    Returns False (scheduler untouched) when no checkpoint exists; raises
+    ``ValueError`` on a version mismatch.  The packed node tensors are only
+    adopted as a *cache seed*: the controller's own signature check
+    (Scheduler._pack) still verifies the node set before reusing them, so a
+    stale checkpoint can cost one full repack but never a wrong decision.
+    """
+    state_path = os.path.join(path, _STATE_FILE)
+    if not os.path.exists(state_path):
+        return False
+    with open(state_path) as f:
+        state = json.load(f)
+    if state.get("version") != CHECKPOINT_VERSION:
+        raise ValueError(f"checkpoint version {state.get('version')} != {CHECKPOINT_VERSION}")
+
+    scheduler._cycle_count = state.get("cycle_count", 0)
+    for name, value in state.get("counters", {}).items():
+        scheduler.metrics.counters[name] = value
+    now = scheduler.clock()
+    scheduler.requeue_at = {k: now + rem for k, rem in state.get("requeue_remaining", {}).items()}
+    if state.get("node_sig"):
+        scheduler._node_sig = tuple((name, rv) for name, rv in state["node_sig"])
+
+    tensors_path = os.path.join(path, _TENSORS_FILE)
+    if state.get("vocab") is not None and os.path.exists(tensors_path):
+        with np.load(tensors_path) as z:
+            vocab = {(k, v): i for k, v, i in state["vocab"]}
+            n_pad = z["node_alloc"].shape[0]
+            consistent = (
+                z["node_avail"].shape == z["node_alloc"].shape == (n_pad, 2)
+                and z["node_labels"].shape[0] == n_pad
+                and z["node_valid"].shape == (n_pad,)
+                and len(vocab) <= z["node_labels"].shape[1]
+                and len(state.get("node_names", [])) <= n_pad
+            )
+            if not consistent:
+                # A mismatched npz/state pair (e.g. partial write of an old
+                # checkpoint) must never seed the cache — the scheduler just
+                # does one full repack instead.
+                return True
+            p = scheduler.pod_block
+            scheduler._packed = PackedCluster(
+                node_alloc=z["node_alloc"],
+                node_avail=z["node_avail"],
+                node_labels=z["node_labels"],
+                node_valid=z["node_valid"],
+                node_names=tuple(state.get("node_names", [])),
+                pod_req=np.zeros((p, 2), np.int32),
+                pod_sel=np.zeros((p, z["node_labels"].shape[1]), np.float32),
+                pod_sel_count=np.zeros((p,), np.float32),
+                pod_prio=np.zeros((p,), np.int32),
+                pod_valid=np.zeros((p,), bool),
+                pod_names=(),
+                vocab=vocab,
+            )
+    return True

@@ -1,0 +1,284 @@
+"""Kubernetes-style REST boundary: HTTP server + client adapter.
+
+The reference talks to a real API server two ways — typed list/watch
+(``src/main.rs:131-141``, ``src/predicates.rs:21-34``) and a raw HTTP POST of
+the Binding subresource (``src/main.rs:94-109``).  This module provides both
+sides of that boundary for this framework:
+
+  • ``HttpApiServer`` — serves a :class:`FakeApiServer` over the minimal
+    Kubernetes REST surface the scheduler consumes (list nodes/pods with
+    field selectors, the pods/binding subresource) plus the observability
+    routes the reference lacks (``/metrics`` Prometheus text, ``/healthz``,
+    ``/readyz``) — SURVEY.md §5.
+  • ``KubeApiClient`` — stdlib-only (http.client) client for that surface;
+    pointed at a real kube-apiserver (with a bearer token) it is the
+    real-cluster edge adapter SURVEY.md §7 step 5 calls for.
+  • ``RemoteApiAdapter`` — adapts the client to the poll-watch interface the
+    reflectors and controller expect (watch_nodes/watch_pods/create_binding),
+    emulating watches by list+diff relists — the "relist reflector" pattern;
+    the HTTP round-trip is the process boundary the reference crosses on
+    every watch reconnect (``main.rs:135-136``).
+
+Everything is exercised end-to-end over real sockets in
+tests/test_http_api.py: Scheduler → RemoteApiAdapter → HTTP → HttpApiServer
+→ FakeApiServer.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..api.objects import Node, ObjectReference, Pod, node_to_dict, pod_to_dict
+from ..errors import CreateBindingFailed
+from .fake_api import ApiError, FakeApiServer, WatchEvent
+
+__all__ = ["HttpApiServer", "KubeApiClient", "RemoteApiAdapter", "PollingWatch"]
+
+
+class HttpApiServer:
+    """Serve a FakeApiServer (+ optional MetricsRegistry) over HTTP.
+
+    With ``api=None`` only the observability routes are served (metrics-only
+    mode — the shape a scheduler pointed at a *remote* cluster runs, where
+    it has no cluster state of its own to serve); the cluster routes answer
+    503."""
+
+    def __init__(self, api: FakeApiServer | None, metrics=None, host: str = "127.0.0.1", port: int = 0):
+        self.api = api
+        self.metrics = metrics
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, content_type: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, obj):
+                self._send(code, json.dumps(obj).encode())
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                q = parse_qs(parsed.query)
+                selector = q.get("fieldSelector", [None])[0]
+                try:
+                    if parsed.path == "/healthz" or parsed.path == "/readyz":
+                        self._send(200, b"ok", "text/plain")
+                    elif parsed.path == "/metrics":
+                        text = outer.metrics.to_prometheus() if outer.metrics is not None else ""
+                        self._send(200, text.encode(), "text/plain; version=0.0.4")
+                    elif outer.api is None and parsed.path.startswith("/api/"):
+                        self._send_json(503, {"message": "metrics-only server: no cluster state here"})
+                    elif parsed.path == "/api/v1/nodes":
+                        items = [node_to_dict(n) for n in outer.api.list_nodes()]
+                        self._send_json(200, {"kind": "NodeList", "items": items})
+                    elif parsed.path == "/api/v1/pods":
+                        items = [pod_to_dict(p) for p in outer.api.list_pods(field_selector=selector)]
+                        self._send_json(200, {"kind": "PodList", "items": items})
+                    else:
+                        self._send_json(404, {"message": f"not found: {parsed.path}"})
+                except ApiError as e:
+                    self._send_json(e.code, {"message": str(e)})
+
+            def do_POST(self):
+                parsed = urlparse(self.path)
+                parts = parsed.path.strip("/").split("/")
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if outer.api is None:
+                    self._send_json(503, {"message": "metrics-only server: no cluster state here"})
+                    return
+                # /api/v1/namespaces/{ns}/pods/{name}/binding  (main.rs:94-109)
+                if (
+                    len(parts) == 7
+                    and parts[:3] == ["api", "v1", "namespaces"]
+                    and parts[4] == "pods"
+                    and parts[6] == "binding"
+                ):
+                    ns, name = parts[3], parts[5]
+                    target = (body.get("target") or {}).get("name")
+                    try:
+                        outer.api.create_binding(ns, name, ObjectReference(name=target))
+                        self._send_json(201, {"kind": "Status", "status": "Success"})
+                    except CreateBindingFailed as e:
+                        self._send_json(500, {"message": str(e)})
+                    except ApiError as e:
+                        self._send_json(e.code, {"message": str(e)})
+                else:
+                    self._send_json(404, {"message": f"not found: {parsed.path}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "HttpApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class KubeApiClient:
+    """Minimal Kubernetes REST client (stdlib http.client only).
+
+    Speaks exactly the surface the reference consumes: list nodes, list pods
+    by field selector, POST binding subresource.  ``token`` becomes a Bearer
+    header for real-cluster use; TLS contexts can be layered by passing an
+    ``http.client.HTTPSConnection`` factory via ``connection_factory``.
+    """
+
+    def __init__(self, base_url: str, token: str | None = None, timeout: float = 10.0, connection_factory=None):
+        parsed = urlparse(base_url)
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        self._token = token
+        self._timeout = timeout
+        if connection_factory is None:
+            cls = http.client.HTTPSConnection if parsed.scheme == "https" else http.client.HTTPConnection
+            connection_factory = lambda: cls(self._host, self._port, timeout=self._timeout)  # noqa: E731
+        self._connect = connection_factory
+
+    def _request(self, method: str, path: str, body=None) -> tuple[int, dict]:
+        conn = self._connect()
+        try:
+            headers = {"Accept": "application/json"}
+            if self._token:
+                headers["Authorization"] = f"Bearer {self._token}"
+            payload = None
+            if body is not None:
+                payload = json.dumps(body).encode()
+                headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, (json.loads(data) if data else {})
+        finally:
+            conn.close()
+
+    def list_nodes(self) -> list[Node]:
+        code, body = self._request("GET", "/api/v1/nodes")
+        if code != 200:
+            raise ApiError(code, body.get("message", "list nodes failed"))
+        return [Node.from_dict(d) for d in body.get("items", [])]
+
+    def list_pods(self, field_selector: str | None = None) -> list[Pod]:
+        path = "/api/v1/pods"
+        if field_selector:
+            from urllib.parse import quote
+
+            path += f"?fieldSelector={quote(field_selector)}"
+        code, body = self._request("GET", path)
+        if code != 200:
+            raise ApiError(code, body.get("message", "list pods failed"))
+        return [Pod.from_dict(d) for d in body.get("items", [])]
+
+    def create_binding(self, namespace: str, pod_name: str, target: ObjectReference) -> None:
+        # The Binding document the reference builds at main.rs:83-91.
+        body = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": pod_name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": target.kind, "name": target.name},
+        }
+        code, resp = self._request("POST", f"/api/v1/namespaces/{namespace}/pods/{pod_name}/binding", body)
+        if code == 500:
+            raise CreateBindingFailed(resp.get("message", "binding failed"))
+        if code not in (200, 201):
+            raise ApiError(code, resp.get("message", "binding rejected"))
+
+    def healthz(self) -> bool:
+        try:
+            code, _ = self._request("GET", "/healthz")
+            return code == 200
+        except OSError:
+            return False
+
+
+class PollingWatch:
+    """Emulate a watch stream by list+diff — each poll() relists and emits
+    ADDED/MODIFIED/DELETED events vs the previously seen state (keyed by
+    resourceVersion when present, else object equality)."""
+
+    def __init__(self, list_fn, key_fn):
+        self._list = list_fn
+        self._key = key_fn
+        self._seen: dict = {}
+
+    def poll(self) -> list[WatchEvent]:
+        fresh = {self._key(o): o for o in self._list()}
+        events: list[WatchEvent] = []
+        for key, obj in fresh.items():
+            if key not in self._seen:
+                events.append(WatchEvent("ADDED", obj))
+            elif self._changed(self._seen[key], obj):
+                events.append(WatchEvent("MODIFIED", obj))
+        for key, obj in self._seen.items():
+            if key not in fresh:
+                events.append(WatchEvent("DELETED", obj))
+        self._seen = fresh
+        return events
+
+    @staticmethod
+    def _changed(old, new) -> bool:
+        if old.metadata.resource_version and new.metadata.resource_version:
+            return old.metadata.resource_version != new.metadata.resource_version
+        # No resourceVersion on the wire: compare serialized forms minus the
+        # uid, which from_dict regenerates per parse — plain object equality
+        # would flag every object as MODIFIED on every relist.
+        return PollingWatch._wire_form(old) != PollingWatch._wire_form(new)
+
+    @staticmethod
+    def _wire_form(obj) -> dict:
+        d = pod_to_dict(obj) if isinstance(obj, Pod) else node_to_dict(obj)
+        d.get("metadata", {}).pop("uid", None)
+        return d
+
+    def close(self) -> None:
+        self._seen = {}
+
+
+class RemoteApiAdapter:
+    """Duck-typed stand-in for FakeApiServer over a KubeApiClient — plugs the
+    HTTP boundary into ClusterReflector/Scheduler unchanged."""
+
+    def __init__(self, client: KubeApiClient):
+        self.client = client
+
+    def watch_nodes(self, field_selector: str | None = None, send_initial: bool = True):
+        return PollingWatch(self.client.list_nodes, key_fn=lambda n: n.name)
+
+    def watch_pods(self, field_selector: str | None = None, send_initial: bool = True):
+        sel = field_selector
+
+        def list_pods():
+            return self.client.list_pods(field_selector=sel)
+
+        return PollingWatch(list_pods, key_fn=lambda p: (p.metadata.namespace, p.metadata.name))
+
+    def list_nodes(self):
+        return self.client.list_nodes()
+
+    def list_pods(self, field_selector: str | None = None):
+        return self.client.list_pods(field_selector=field_selector)
+
+    def create_binding(self, namespace: str, pod_name: str, target: ObjectReference) -> None:
+        self.client.create_binding(namespace, pod_name, target)

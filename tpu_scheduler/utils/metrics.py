@@ -58,3 +58,25 @@ class MetricsRegistry:
             out["scheduler_last_cycle_seconds"] = last.wall_seconds
             out["scheduler_last_pods_per_second"] = last.pods_per_second
         return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4) of the registry —
+        counters, last-cycle gauges, and process uptime.  The reference has
+        no metrics endpoint at all (SURVEY.md §5); this feeds the
+        /metrics route of runtime/http_api.py.  Derived from ``snapshot()``
+        so there is one source of truth for exported values."""
+        snap = self.snapshot()
+        gauges = {k: v for k, v in snap.items() if k not in self.counters}
+        gauges["scheduler_uptime_seconds"] = time.time() - self.started_at
+        if self.cycles:
+            last = self.cycles[-1]
+            gauges["scheduler_last_cycle_pending"] = float(last.pending)
+            gauges["scheduler_last_cycle_rounds"] = float(last.rounds)
+        lines = []
+        for name in sorted(self.counters):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {self.counters[name]}")
+        for name in sorted(gauges):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {gauges[name]}")
+        return "\n".join(lines) + "\n"
