@@ -134,3 +134,38 @@ def test_version_mismatch_raises(tmp_path):
         json.dump(state, f)
     with pytest.raises(ValueError):
         restore_scheduler(build(), str(tmp_path))
+
+
+def test_reordered_node_cache_falls_back_not_crash(tmp_path):
+    """A restored cache whose node ORDER differs from the live reflector's
+    (sorted signature matches, order-sensitive pack doesn't) must degrade to
+    a full repack, not crash every cycle (review finding)."""
+    api = FakeApiServer()
+    api.load(nodes=[make_node("a", cpu="8", memory="32Gi"), make_node("c", cpu="8", memory="32Gi")], pods=[])
+    sched = build(api)
+    sched.run_cycle()
+    api.create_node(make_node("b", cpu="8", memory="32Gi"))
+    api.create_pod(make_pod("p0"))
+    sched.run_cycle()  # reflector order now (a, c, b)
+    save_scheduler(sched, str(tmp_path))
+
+    # Restarted process relists in name order (a, b, c): same sorted
+    # signature, different order.
+    api2 = FakeApiServer()
+    api2.load(
+        nodes=[
+            make_node("a", cpu="8", memory="32Gi"),
+            make_node("b", cpu="8", memory="32Gi"),
+            make_node("c", cpu="8", memory="32Gi"),
+        ],
+        pods=[make_pod("q0")],
+    )
+    # Give the restarted store identical (name, rv) pairs so the sorted
+    # signature matches the checkpoint's.
+    by_name = {n.name: n for n in api2.list_nodes()}
+    for old in api.list_nodes():
+        by_name[old.name].metadata.resource_version = old.metadata.resource_version
+    sched2 = build(api2)
+    restore_scheduler(sched2, str(tmp_path))
+    m = sched2.run_cycle()  # must not raise
+    assert m.bound == 1

@@ -164,3 +164,29 @@ def test_cli_against_http_server(served, capsys):
     out = capsys.readouterr().out.strip().splitlines()
     summary = json.loads(out[-1])
     assert summary["bound_total"] == 5
+
+
+def test_malformed_json_body_returns_400(served):
+    _, server, _ = served
+    req = urllib.request.Request(
+        server.base_url + "/api/v1/namespaces/default/pods/a/binding",
+        data=b"not-json",
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_client_reuses_connection_and_survives_drop(served):
+    api, server, _ = served
+    api.load(nodes=[make_node("n1")], pods=[make_pod("a")])
+    client = KubeApiClient(server.base_url)
+    client.list_nodes()
+    first_conn = client._conn
+    assert first_conn is not None
+    client.list_pods()
+    assert client._conn is first_conn  # keep-alive reused
+    client._conn.close()  # simulate server-side drop
+    assert {n.name for n in client.list_nodes()} == {"n1"}  # reconnects

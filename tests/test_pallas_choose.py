@@ -36,11 +36,13 @@ def _both_paths(a, weights, pod_tile=8, node_tile=128):
         a["node_avail"],
         a["node_alloc"],
         a["node_labels"],
+        a["node_taints"],
         a["node_valid"],
         weights,
         a["pod_req"],
         a["pod_sel"],
         a["pod_sel_count"],
+        a["pod_ntol"],
         a["pod_valid"],
         ranks,
     )
@@ -48,10 +50,12 @@ def _both_paths(a, weights, pod_tile=8, node_tile=128):
         a["pod_req"],
         a["pod_sel"],
         a["pod_sel_count"],
+        a["pod_ntol"],
         a["pod_valid"],
         ranks,
         build_node_info(a["node_avail"], a["node_alloc"], a["node_valid"]),
         a["node_labels"].T,
+        a["node_taints"].T,
         weights,
         pod_tile=pod_tile,
         node_tile=node_tile,
@@ -103,10 +107,12 @@ def test_assign_cycle_pallas_flag_smoke():
         a["node_alloc"],
         a["node_avail"],
         a["node_labels"],
+        a["node_taints"],
         a["node_valid"],
         a["pod_req"],
         a["pod_sel"],
         a["pod_sel_count"],
+        a["pod_ntol"],
         a["pod_prio"],
         a["pod_valid"],
         weights,

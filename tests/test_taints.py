@@ -1,0 +1,197 @@
+"""Taints/tolerations + node-cordon tests: scalar semantics (the oracle),
+tensorization (pack bitmaps), batched-backend parity on tainted clusters, and
+the control loop honoring both predicates end-to-end."""
+
+import numpy as np
+import pytest
+
+from tpu_scheduler.api.objects import Node, Pod, Taint, Toleration, node_to_dict, pod_to_dict
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.core.predicates import (
+    InvalidNodeReason,
+    check_node_validity,
+    node_schedulable,
+    taints_tolerated,
+)
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.ops.pack import build_taint_vocab, pack_snapshot
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+def snap(nodes, pods):
+    return ClusterSnapshot.build(nodes, pods)
+
+
+# --- toleration matching semantics ------------------------------------------
+
+
+def test_toleration_equal_matches():
+    t = Toleration(key="pool", operator="Equal", value="gpu", effect="NoSchedule")
+    assert t.tolerates(Taint(key="pool", value="gpu", effect="NoSchedule"))
+    assert not t.tolerates(Taint(key="pool", value="cpu", effect="NoSchedule"))
+    assert not t.tolerates(Taint(key="other", value="gpu", effect="NoSchedule"))
+
+
+def test_toleration_exists_ignores_value():
+    t = Toleration(key="pool", operator="Exists")
+    assert t.tolerates(Taint(key="pool", value="anything", effect="NoSchedule"))
+    assert t.tolerates(Taint(key="pool", value="else", effect="NoExecute"))  # empty effect matches any
+    assert not t.tolerates(Taint(key="other", value="x", effect="NoSchedule"))
+
+
+def test_toleration_empty_key_exists_tolerates_everything():
+    t = Toleration(operator="Exists")
+    assert t.tolerates(Taint(key="anything", value="v", effect="NoExecute"))
+
+
+def test_toleration_effect_scoping():
+    t = Toleration(key="k", operator="Exists", effect="NoSchedule")
+    assert t.tolerates(Taint(key="k", effect="NoSchedule"))
+    assert not t.tolerates(Taint(key="k", effect="NoExecute"))
+
+
+def test_empty_key_equal_operator_matches_nothing():
+    t = Toleration(operator="Equal")  # empty key with Equal: not a tolerate-all
+    assert not t.tolerates(Taint(key="k", value="", effect="NoSchedule"))
+
+
+# --- scalar predicates -------------------------------------------------------
+
+
+def test_taints_tolerated_predicate():
+    node = make_node("n1", taints=[Taint(key="pool", value="gpu", effect="NoSchedule")])
+    plain = make_pod("plain")
+    tolerant = make_pod("tol", tolerations=[Toleration(key="pool", operator="Equal", value="gpu", effect="NoSchedule")])
+    assert not taints_tolerated(plain, node)
+    assert taints_tolerated(tolerant, node)
+
+
+def test_prefer_no_schedule_is_soft():
+    node = make_node("n1", taints=[Taint(key="pool", value="gpu", effect="PreferNoSchedule")])
+    assert taints_tolerated(make_pod("plain"), node)
+
+
+def test_node_schedulable_cordon():
+    assert node_schedulable(make_pod("p"), make_node("n1"))
+    assert not node_schedulable(make_pod("p"), make_node("n2", unschedulable=True))
+
+
+def test_chain_reports_taint_and_cordon_reasons():
+    tainted = make_node("n1", taints=[Taint(key="k", effect="NoSchedule")])
+    cordoned = make_node("n2", unschedulable=True)
+    pod = make_pod("p")
+    s = snap([tainted, cordoned], [pod])
+    assert check_node_validity(pod, tainted, s) is InvalidNodeReason.TAINT_NOT_TOLERATED
+    assert check_node_validity(pod, cordoned, s) is InvalidNodeReason.NODE_UNSCHEDULABLE
+
+
+# --- serialization -----------------------------------------------------------
+
+
+def test_taint_toleration_roundtrip():
+    node = make_node("n1", taints=[Taint(key="pool", value="gpu", effect="NoExecute")], unschedulable=True)
+    assert Node.from_dict(node_to_dict(node)) == node
+    pod = make_pod("p", tolerations=[Toleration(key="pool", operator="Exists", effect="NoSchedule")])
+    assert Pod.from_dict(pod_to_dict(pod)) == pod
+
+
+# --- tensorization -----------------------------------------------------------
+
+
+def test_taint_vocab_hard_effects_only():
+    nodes = [
+        make_node("n1", taints=[Taint(key="a", value="1", effect="NoSchedule")]),
+        make_node("n2", taints=[Taint(key="b", value="2", effect="PreferNoSchedule")]),
+        make_node("n3", taints=[Taint(key="c", value="3", effect="NoExecute")]),
+    ]
+    vocab = build_taint_vocab(nodes)
+    assert ("a", "1", "NoSchedule") in vocab
+    assert ("c", "3", "NoExecute") in vocab
+    assert all(e != "PreferNoSchedule" for (_, _, e) in vocab)
+
+
+def test_pack_taint_bitmaps_match_scalar_oracle():
+    s = synth_cluster(n_nodes=20, n_pending=40, n_bound=10, seed=3, tainted_fraction=0.5, cordoned_fraction=0.2)
+    packed = pack_snapshot(s, pod_block=8, node_block=8)
+    pending = s.pending_pods()
+    for i, pod in enumerate(pending):
+        for j, node in enumerate(s.nodes):
+            # tensor verdict: tolerable iff no untolerated taint lands on node
+            untol = float(packed.pod_ntol[i] @ packed.node_taints[j])
+            assert (untol == 0) == taints_tolerated(pod, node), (pod.name, node.name)
+            assert bool(packed.node_valid[j]) == node_schedulable(pod, node), node.name
+
+
+def test_cordoned_node_invalid_in_pack():
+    nodes = [make_node("n1"), make_node("n2", unschedulable=True)]
+    s = snap(nodes, [make_pod("p")])
+    packed = pack_snapshot(s, pod_block=8, node_block=8)
+    assert bool(packed.node_valid[0]) and not bool(packed.node_valid[1])
+
+
+# --- batched parity + end-to-end --------------------------------------------
+
+
+def test_native_backend_respects_taints():
+    nodes = [
+        make_node("gpu-node", cpu="8", memory="32Gi", taints=[Taint(key="pool", value="gpu", effect="NoSchedule")]),
+        make_node("cpu-node", cpu="8", memory="32Gi"),
+    ]
+    pods = [make_pod(f"plain-{i}") for i in range(4)] + [
+        make_pod(
+            f"gpu-{i}",
+            tolerations=[Toleration(key="pool", operator="Equal", value="gpu", effect="NoSchedule")],
+        )
+        for i in range(2)
+    ]
+    s = snap(nodes, pods)
+    packed = pack_snapshot(s, pod_block=8, node_block=8)
+    result = NativeBackend().schedule(packed)
+    by_pod = dict(result.bindings)
+    for i in range(4):
+        assert by_pod[f"default/plain-{i}"] == "cpu-node"  # taint keeps them off gpu-node
+
+
+def test_backend_parity_tainted_cluster():
+    s = synth_cluster(n_nodes=30, n_pending=120, n_bound=20, seed=11, tainted_fraction=0.4, cordoned_fraction=0.15)
+    packed = pack_snapshot(s, pod_block=32, node_block=8)
+    from tpu_scheduler.backends.tpu import TpuBackend
+
+    rn = NativeBackend().schedule(packed)
+    rt = TpuBackend().schedule(packed)
+    np.testing.assert_array_equal(rn.assigned, rt.assigned)
+
+
+def test_scheduler_never_binds_to_cordoned_or_untolerated():
+    nodes = [
+        make_node("ok", cpu="16", memory="64Gi"),
+        make_node("cordoned", cpu="16", memory="64Gi", unschedulable=True),
+        make_node("tainted", cpu="16", memory="64Gi", taints=[Taint(key="dedicated", effect="NoSchedule")]),
+    ]
+    pods = [make_pod(f"p{i}", cpu="250m", memory="512Mi") for i in range(10)]
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=pods)
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+    m = sched.run_cycle()
+    assert m.bound == 10
+    for p in api.list_pods():
+        assert p.spec.node_name == "ok"
+
+
+def test_sample_policy_respects_taints():
+    import random
+
+    nodes = [
+        make_node("ok", cpu="16", memory="64Gi"),
+        make_node("tainted", cpu="16", memory="64Gi", taints=[Taint(key="dedicated", effect="NoExecute")]),
+    ]
+    pods = [make_pod(f"p{i}", cpu="250m", memory="512Mi") for i in range(8)]
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=pods)
+    sched = Scheduler(api, NativeBackend(), policy="sample", attempts=50, rng=random.Random(4))
+    sched.run_cycle()
+    for p in api.list_pods():
+        if p.spec.node_name is not None:
+            assert p.spec.node_name == "ok"

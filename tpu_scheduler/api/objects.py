@@ -29,7 +29,10 @@ __all__ = [
     "PodSpec",
     "PodStatus",
     "Pod",
+    "Taint",
+    "Toleration",
     "NodeStatus",
+    "NodeSpec",
     "Node",
     "ObjectReference",
     "Binding",
@@ -138,6 +141,41 @@ class TopologySpreadConstraint:
 
 
 @dataclass
+class Taint:
+    """Node taint.  Effects enforced as hard filters: NoSchedule and
+    NoExecute; PreferNoSchedule is soft and not (yet) scored."""
+
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"
+
+
+@dataclass
+class Toleration:
+    """Pod toleration (k8s semantics): matches a taint iff
+      • key matches (empty key + Exists tolerates everything), and
+      • operator Exists, or Equal with equal value, and
+      • effect matches (empty toleration effect matches any effect).
+    """
+
+    key: str = ""
+    operator: str = "Equal"
+    value: str = ""
+    effect: str = ""
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if not self.key:
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.operator == "Equal" and self.value == taint.value
+
+
+@dataclass
 class PodSpec:
     containers: list[Container] = field(default_factory=list)
     node_selector: dict[str, str] | None = None
@@ -148,6 +186,7 @@ class PodSpec:
     # nodeSelector, src/predicates.rs:63-77).
     anti_affinity: list[PodAntiAffinityTerm] | None = None
     topology_spread: list[TopologySpreadConstraint] | None = None
+    tolerations: list[Toleration] | None = None
 
 
 @dataclass
@@ -225,6 +264,15 @@ class Pod:
                     )
                     for c in hard
                 ]
+            tolerations = [
+                Toleration(
+                    key=t.get("key", ""),
+                    operator=t.get("operator", "Equal"),
+                    value=t.get("value", ""),
+                    effect=t.get("effect", ""),
+                )
+                for t in spec_d.get("tolerations", [])
+            ] or None
             spec = PodSpec(
                 containers=containers,
                 node_selector=spec_d.get("nodeSelector"),
@@ -232,6 +280,7 @@ class Pod:
                 priority=spec_d.get("priority", 0),
                 anti_affinity=anti,
                 topology_spread=spread,
+                tolerations=tolerations,
             )
         status = PodStatus(phase=d.get("status", {}).get("phase", "Pending"))
         obj_meta = ObjectMeta(
@@ -299,6 +348,16 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
         spec["nodeName"] = pod.spec.node_name
     if pod.spec.priority:
         spec["priority"] = pod.spec.priority
+    if pod.spec.tolerations:
+        spec["tolerations"] = [
+            {
+                **({"key": t.key} if t.key else {}),
+                "operator": t.operator,
+                **({"value": t.value} if t.value else {}),
+                **({"effect": t.effect} if t.effect else {}),
+            }
+            for t in pod.spec.tolerations
+        ]
     if pod.spec.anti_affinity:
         terms = []
         for t in pod.spec.anti_affinity:
@@ -335,6 +394,14 @@ def node_to_dict(node: "Node") -> dict[str, Any]:
     out: dict[str, Any] = {"kind": "Node", "metadata": meta}
     if node.status is not None and node.status.allocatable is not None:
         out["status"] = {"allocatable": dict(node.status.allocatable)}
+    if node.spec is not None:
+        spec: dict[str, Any] = {}
+        if node.spec.taints:
+            spec["taints"] = [{"key": t.key, "value": t.value, "effect": t.effect} for t in node.spec.taints]
+        if node.spec.unschedulable:
+            spec["unschedulable"] = True
+        if spec:
+            out["spec"] = spec
     return out
 
 
@@ -345,9 +412,16 @@ class NodeStatus:
 
 
 @dataclass
+class NodeSpec:
+    taints: list[Taint] | None = None
+    unschedulable: bool = False  # kubectl cordon
+
+
+@dataclass
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     status: NodeStatus | None = None
+    spec: NodeSpec | None = None
 
     @property
     def name(self) -> str:
@@ -357,6 +431,14 @@ class Node:
     def from_dict(d: Mapping[str, Any]) -> "Node":
         meta = d.get("metadata", {})
         status_d = d.get("status")
+        spec_d = d.get("spec")
+        spec = None
+        if spec_d is not None:
+            taints = [
+                Taint(key=t.get("key", ""), value=t.get("value", ""), effect=t.get("effect", "NoSchedule"))
+                for t in spec_d.get("taints", [])
+            ] or None
+            spec = NodeSpec(taints=taints, unschedulable=bool(spec_d.get("unschedulable", False)))
         obj_meta = ObjectMeta(
             name=meta.get("name", ""),
             namespace=meta.get("namespace"),
@@ -368,6 +450,7 @@ class Node:
         return Node(
             metadata=obj_meta,
             status=NodeStatus(allocatable=status_d.get("allocatable")) if status_d else None,
+            spec=spec,
         )
 
 

@@ -29,6 +29,7 @@ class NativeBackend(SchedulingBackend):
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         node_alloc, node_avail = packed.node_alloc, packed.node_avail
         node_labels, node_valid = packed.node_labels, packed.node_valid
+        node_taints = packed.node_taints
         weights = profile.weights()
         p = packed.padded_pods
         n = packed.padded_nodes
@@ -38,6 +39,7 @@ class NativeBackend(SchedulingBackend):
         req = packed.pod_req[perm]
         sel = packed.pod_sel[perm]
         selc = packed.pod_sel_count[perm]
+        ntol = packed.pod_ntol[perm]
         valid = packed.pod_valid[perm]
 
         avail = node_avail.copy()
@@ -51,7 +53,10 @@ class NativeBackend(SchedulingBackend):
             node_idx = np.arange(n, dtype=np.uint32)
             for lo in range(0, p, block):
                 hi = min(lo + block, p)
-                m = feasibility_block(np, req[lo:hi], sel[lo:hi], selc[lo:hi], active[lo:hi], avail, node_labels, node_valid)
+                m = feasibility_block(
+                    np, req[lo:hi], sel[lo:hi], selc[lo:hi], active[lo:hi], avail, node_labels, node_valid,
+                    ntol[lo:hi], node_taints,
+                )
                 pod_idx = np.arange(lo, hi, dtype=np.uint32)
                 sc = score_block(np, req[lo:hi], node_alloc, avail, weights, pod_idx, node_idx)
                 sc = np.where(m, sc, -np.inf)

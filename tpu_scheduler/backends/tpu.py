@@ -49,10 +49,12 @@ class TpuBackend(SchedulingBackend):
                 put["node_alloc"],
                 put["node_avail"],
                 put["node_labels"],
+                put["node_taints"],
                 put["node_valid"],
                 put["pod_req"],
                 put["pod_sel"],
                 put["pod_sel_count"],
+                put["pod_ntol"],
                 put["pod_prio"],
                 put["pod_valid"],
                 weights,

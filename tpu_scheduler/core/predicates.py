@@ -23,6 +23,9 @@ __all__ = [
     "InvalidNodeReason",
     "pod_fits_resources",
     "node_selector_matches",
+    "node_schedulable",
+    "taints_tolerated",
+    "HARD_TAINT_EFFECTS",
     "anti_affinity_ok",
     "topology_spread_ok",
     "labels_match_selector",
@@ -37,11 +40,14 @@ __all__ = [
 
 
 class InvalidNodeReason(enum.Enum):
-    """Typed failure reason — reference ``predicates.rs:14-18``; the last two
-    variants are beyond the reference (BASELINE.json config 5)."""
+    """Typed failure reason — reference ``predicates.rs:14-18``; variants
+    beyond the first two extend the reference (BASELINE.json config 5 +
+    standard kube-scheduler predicates)."""
 
     NOT_ENOUGH_RESOURCES = "NotEnoughResources"
     NODE_SELECTOR_MISMATCH = "NodeSelectorMismatch"
+    NODE_UNSCHEDULABLE = "NodeUnschedulable"
+    TAINT_NOT_TOLERATED = "TaintNotTolerated"
     ANTI_AFFINITY_VIOLATION = "AntiAffinityViolation"
     TOPOLOGY_SPREAD_VIOLATION = "TopologySpreadViolation"
 
@@ -73,6 +79,32 @@ def node_selector_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None
     if not labels:
         return False
     return all(labels.get(k) == v for k, v in pod.spec.node_selector.items())
+
+
+HARD_TAINT_EFFECTS = ("NoSchedule", "NoExecute")
+
+
+def node_schedulable(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
+    """False iff the node is cordoned (``spec.unschedulable`` — kubectl
+    cordon).  Beyond the reference, which has no Node.spec handling."""
+    return not (node.spec is not None and node.spec.unschedulable)
+
+
+def taints_tolerated(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
+    """Taints/tolerations predicate (standard kube-scheduler; absent in the
+    reference).  Every NoSchedule/NoExecute taint on the node must be
+    matched by some toleration of the pod; PreferNoSchedule is soft and
+    ignored by the hard filter."""
+    taints = (node.spec.taints or []) if node.spec is not None else []
+    if not taints:
+        return True
+    tolerations = (pod.spec.tolerations or []) if pod.spec is not None else []
+    for taint in taints:
+        if taint.effect not in HARD_TAINT_EFFECTS:
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            return False
+    return True
 
 
 def labels_match_selector(selector: dict[str, str] | None, labels: dict[str, str] | None) -> bool:
@@ -266,6 +298,8 @@ def topology_spread_ok(
 PREDICATE_CHAIN: list[tuple[InvalidNodeReason, Callable[[Pod, Node, ClusterSnapshot], bool]]] = [
     (InvalidNodeReason.NOT_ENOUGH_RESOURCES, pod_fits_resources),
     (InvalidNodeReason.NODE_SELECTOR_MISMATCH, node_selector_matches),
+    (InvalidNodeReason.NODE_UNSCHEDULABLE, node_schedulable),
+    (InvalidNodeReason.TAINT_NOT_TOLERATED, taints_tolerated),
     (InvalidNodeReason.ANTI_AFFINITY_VIOLATION, anti_affinity_ok),
     (InvalidNodeReason.TOPOLOGY_SPREAD_VIOLATION, topology_spread_ok),
 ]

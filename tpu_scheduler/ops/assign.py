@@ -70,28 +70,32 @@ def _seg_scan_op(x, y):
     return fx | fy, jnp.where(fy, vy, _sat_add(vx, vy))
 
 
-def _choose_block(avail, node_alloc, node_labels, node_valid, weights, breq, bsel, bselc, bact, bidx, pallas_pack=None):
+def _choose_block(
+    avail, node_alloc, node_labels, node_taints, node_valid, weights, breq, bsel, bselc, bntol, bact, bidx, pallas_pack=None
+):
     """[B] best feasible node (+feasibility flag) for one block of pods.
 
-    With ``pallas_pack`` (node_info, labels_t, interpret) the fused Pallas
-    kernel runs (ops/pallas_choose.py — bit-identical results, one VMEM
-    pass); otherwise the xp-generic jnp expression tree.
+    With ``pallas_pack`` (node_info, labels_t, taints_t, interpret) the fused
+    Pallas kernel runs (ops/pallas_choose.py — bit-identical results, one
+    VMEM pass); otherwise the xp-generic jnp expression tree.
     """
     if pallas_pack is not None:
         from .pallas_choose import choose_block_pallas
 
-        node_info, labels_t, interpret = pallas_pack
-        return choose_block_pallas(breq, bsel, bselc, bact, bidx, node_info, labels_t, weights, interpret=interpret)
+        node_info, labels_t, taints_t, interpret = pallas_pack
+        return choose_block_pallas(
+            breq, bsel, bselc, bntol, bact, bidx, node_info, labels_t, taints_t, weights, interpret=interpret
+        )
     node_idx = jnp.arange(avail.shape[0], dtype=jnp.uint32)
-    m = feasibility_block(jnp, breq, bsel, bselc, bact, avail, node_labels, node_valid)
+    m = feasibility_block(jnp, breq, bsel, bselc, bact, avail, node_labels, node_valid, bntol, node_taints)
     sc = score_block(jnp, breq, node_alloc, avail, weights, bidx, node_idx)
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
 
 def _choose(
-    avail, active, req, sel, selc, ranks, n_active, node_alloc, node_labels, node_valid, weights, block,
-    use_pallas=False, pallas_interpret=False,
+    avail, active, req, sel, selc, ntol, ranks, n_active, node_alloc, node_labels, node_taints, node_valid, weights,
+    block, use_pallas=False, pallas_interpret=False,
 ):
     """Per-pod best feasible node vs current capacity, blockwise over pods.
 
@@ -109,11 +113,12 @@ def _choose(
         from .pallas_choose import build_node_info
 
         # Rebuilt each round (avail changes); O(N) next to the O(B·N) choose.
-        pallas_pack = (build_node_info(avail, node_alloc, node_valid), node_labels.T, pallas_interpret)
+        pallas_pack = (build_node_info(avail, node_alloc, node_valid), node_labels.T, node_taints.T, pallas_interpret)
 
     if block >= p:
         return _choose_block(
-            avail, node_alloc, node_labels, node_valid, weights, req, sel, selc, active, ranks, pallas_pack
+            avail, node_alloc, node_labels, node_taints, node_valid, weights, req, sel, selc, ntol, active, ranks,
+            pallas_pack,
         )
 
     nb_occupied = (n_active + block - 1) // block  # traced; caller pads p % block == 0
@@ -129,11 +134,13 @@ def _choose(
             avail,
             node_alloc,
             node_labels,
+            node_taints,
             node_valid,
             weights,
             lax.dynamic_slice_in_dim(req, lo, block),
             lax.dynamic_slice_in_dim(sel, lo, block),
             lax.dynamic_slice_in_dim(selc, lo, block),
+            lax.dynamic_slice_in_dim(ntol, lo, block),
             lax.dynamic_slice_in_dim(active, lo, block),
             lax.dynamic_slice_in_dim(ranks, lo, block),
             pallas_pack,
@@ -151,10 +158,12 @@ def assign_cycle(
     node_alloc,
     node_avail,
     node_labels,
+    node_taints,
     node_valid,
     pod_req,
     pod_sel,
     pod_sel_count,
+    pod_ntol,
     pod_prio,
     pod_valid,
     weights,
@@ -180,6 +189,7 @@ def assign_cycle(
     req = pod_req[perm]
     sel = pod_sel[perm]
     selc = pod_sel_count[perm]
+    ntol = pod_ntol[perm]
     valid = pod_valid[perm]
 
     # Pad the pod axis to a block multiple so the blockwise choose path is
@@ -192,6 +202,7 @@ def assign_cycle(
         req = jnp.pad(req, ((0, extra), (0, 0)))
         sel = jnp.pad(sel, ((0, extra), (0, 0)))
         selc = jnp.pad(selc, ((0, extra),))
+        ntol = jnp.pad(ntol, ((0, extra), (0, 0)))
         valid = jnp.pad(valid, ((0, extra),))
         p = p + extra
 
@@ -201,21 +212,23 @@ def assign_cycle(
     # handled by compacting once before the loop via n_active = p.
     ranks0 = jnp.arange(p, dtype=jnp.uint32)
 
-    def compact(req, sel, selc, ranks, assigned, active):
+    def compact(req, sel, selc, ntol, ranks, assigned, active):
         order = jnp.argsort(~active, stable=True)
-        return req[order], sel[order], selc[order], ranks[order], assigned[order], active[order]
+        return req[order], sel[order], selc[order], ntol[order], ranks[order], assigned[order], active[order]
 
-    req, sel, selc, ranks, assigned0, active0 = compact(req, sel, selc, ranks0, jnp.full((p,), -1, jnp.int32), valid)
+    req, sel, selc, ntol, ranks, assigned0, active0 = compact(
+        req, sel, selc, ntol, ranks0, jnp.full((p,), -1, jnp.int32), valid
+    )
 
     def cond(state):
-        _, _, _, _, _, _, _, n_active, rounds = state
+        _, _, _, _, _, _, _, _, n_active, rounds = state
         return (rounds < max_rounds) & (n_active > 0)
 
     def body(state):
-        avail, req, sel, selc, ranks, assigned, active, n_active, rounds = state
+        avail, req, sel, selc, ntol, ranks, assigned, active, n_active, rounds = state
         choice, has = _choose(
-            avail, active, req, sel, selc, ranks, n_active, node_alloc, node_labels, node_valid, weights, block,
-            use_pallas, pallas_interpret,
+            avail, active, req, sel, selc, ntol, ranks, n_active, node_alloc, node_labels, node_taints, node_valid,
+            weights, block, use_pallas, pallas_interpret,
         )
         cand = active & has
         ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
@@ -238,11 +251,11 @@ def assign_cycle(
         dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], req, 0))
         avail = avail - dec[:n]
         active = cand & ~accepted
-        req, sel, selc, ranks, assigned, active = compact(req, sel, selc, ranks, assigned, active)
-        return avail, req, sel, selc, ranks, assigned, active, active.sum(dtype=jnp.int32), rounds + 1
+        req, sel, selc, ntol, ranks, assigned, active = compact(req, sel, selc, ntol, ranks, assigned, active)
+        return avail, req, sel, selc, ntol, ranks, assigned, active, active.sum(dtype=jnp.int32), rounds + 1
 
-    state0 = (node_avail, req, sel, selc, ranks, assigned0, active0, active0.sum(dtype=jnp.int32), jnp.int32(0))
-    avail, _, _, _, ranks, assigned, _, _, rounds = lax.while_loop(cond, body, state0)
+    state0 = (node_avail, req, sel, selc, ntol, ranks, assigned0, active0, active0.sum(dtype=jnp.int32), jnp.int32(0))
+    avail, _, _, _, _, ranks, assigned, _, _, rounds = lax.while_loop(cond, body, state0)
 
     # Undo compaction (rank space), then the priority permutation (original
     # pod order), dropping block padding.

@@ -3,10 +3,12 @@
 Replaces the reference's per-(pod, node) checks (``src/predicates.rs:20-61``)
 with one [pods × nodes] boolean mask:
 
-  fit[p,n]  = all_r( pod_req[p,r] <= node_avail[n,r] )          (PodFitsResources)
-  sel[p,n]  = (pod_sel[p] · node_labels[n]) == pod_sel_count[p] (nodeSelector)
-  mask      = fit & sel & pod_active & node_valid
+  fit[p,n]   = all_r( pod_req[p,r] <= node_avail[n,r] )          (PodFitsResources)
+  sel[p,n]   = (pod_sel[p] · node_labels[n]) == pod_sel_count[p] (nodeSelector)
+  taint[p,n] = (pod_ntol[p] · node_taints[n]) == 0               (taints/tolerations)
+  mask       = fit & sel & taint & pod_active & node_valid
 
+node_valid carries both padding and cordoned (spec.unschedulable) nodes.
 Written against an ``xp`` array namespace (numpy or jax.numpy) so the native
 and TPU backends share one expression tree — bit-identical semantics by
 construction (tests/test_backends_parity.py).
@@ -17,16 +19,25 @@ from __future__ import annotations
 __all__ = ["feasibility_block"]
 
 
-def feasibility_block(xp, pod_req, pod_sel, pod_sel_count, pod_active, node_avail, node_labels, node_valid):
+def feasibility_block(
+    xp, pod_req, pod_sel, pod_sel_count, pod_active, node_avail, node_labels, node_valid, pod_ntol=None, node_taints=None
+):
     """[B, N] feasibility of a block of pods against all nodes.
 
     pod_req [B,2] int32, pod_sel [B,L] f32, pod_sel_count [B] f32,
     pod_active [B] bool, node_avail [N,2] int32, node_labels [N,L] f32,
-    node_valid [N] bool.
+    node_valid [N] bool, pod_ntol [B,T] f32 / node_taints [N,T] f32
+    (optional together — omitted means no taints in the cluster).
     """
     fit = (pod_req[:, None, :] <= node_avail[None, :, :]).all(-1)
     # Selector-pair counting: matches iff the node carries every selector pair.
     # Counts are tiny integers — exact even through a bf16 MXU pass.
     counts = pod_sel @ node_labels.T
     sel = counts == pod_sel_count[:, None]
-    return fit & sel & node_valid[None, :] & pod_active[:, None]
+    mask = fit & sel & node_valid[None, :] & pod_active[:, None]
+    if pod_ntol is not None and node_taints is not None:
+        # Untolerated-taint counting: schedulable iff zero of the node's hard
+        # taints land in the pod's not-tolerated set.
+        untol = pod_ntol @ node_taints.T
+        mask = mask & (untol == 0)
+    return mask

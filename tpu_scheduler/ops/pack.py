@@ -8,9 +8,18 @@ with a one-shot pack of the whole cluster:
   node_alloc[N,2]  int32   total allocatable  (cpu millicores, memory KiB)
   node_avail[N,2]  int32   remaining = allocatable − Σ bound-pod requests
   node_labels[N,L] float32 bitmap over the selector-pair vocabulary
+  node_taints[N,T] float32 bitmap over the hard-taint vocabulary
   pod_req[P,2]     int32   pending-pod requests (millicores, KiB ceil)
   pod_sel[P,L]     float32 selector bitmap; pod_sel_count[P] = #selector keys
+  pod_ntol[P,T]    float32 1 where the pod does NOT tolerate vocab taint t
   pod_prio[P]      int32   pod priority (commit order tie-break)
+
+Taints tensorize dually to selectors: the vocabulary is the set of hard
+(NoSchedule/NoExecute) taint triples present on nodes; toleration semantics
+(Exists/Equal, empty-key, empty-effect — api/objects.py Toleration) are
+evaluated host-side into the pod_ntol bitmap, so the device check is one
+matmul: a node is tolerable iff (pod_ntol · node_taints[n]) == 0.  Cordoned
+nodes (spec.unschedulable) fold into node_valid.
 
 Unit choice: memory is KiB (not bytes) so everything fits int32 without
 enabling jax_enable_x64 (int64 on TPU is emulated and slow).  Rounding is
@@ -48,6 +57,7 @@ __all__ = [
     "repack_avail",
     "repack_incremental",
     "build_selector_vocab",
+    "build_taint_vocab",
     "round_up",
     "INT32_MAX",
 ]
@@ -76,18 +86,21 @@ class PackedCluster:
     node_alloc: np.ndarray  # [N,2] int32 — total allocatable (millis, KiB)
     node_avail: np.ndarray  # [N,2] int32 — remaining after bound pods
     node_labels: np.ndarray  # [N,L] float32 — selector-pair bitmap
-    node_valid: np.ndarray  # [N]  bool
+    node_taints: np.ndarray  # [N,T] float32 — hard-taint bitmap
+    node_valid: np.ndarray  # [N]  bool (padding + cordoned nodes are False)
     node_names: tuple[str, ...]  # real nodes only (len = num_nodes)
 
     # Pending pods (padded to P)
     pod_req: np.ndarray  # [P,2] int32 — (millis, KiB ceil)
     pod_sel: np.ndarray  # [P,L] float32
     pod_sel_count: np.ndarray  # [P] float32
+    pod_ntol: np.ndarray  # [P,T] float32 — 1 where vocab taint NOT tolerated
     pod_prio: np.ndarray  # [P] int32
     pod_valid: np.ndarray  # [P]  bool
     pod_names: tuple[str, ...]  # full names of real pending pods
 
     vocab: dict[tuple[str, str], int]
+    taint_vocab: dict[tuple[str, str, str], int]
 
     @property
     def num_nodes(self) -> int:
@@ -111,10 +124,12 @@ class PackedCluster:
             "node_alloc": self.node_alloc,
             "node_avail": self.node_avail,
             "node_labels": self.node_labels,
+            "node_taints": self.node_taints,
             "node_valid": self.node_valid,
             "pod_req": self.pod_req,
             "pod_sel": self.pod_sel,
             "pod_sel_count": self.pod_sel_count,
+            "pod_ntol": self.pod_ntol,
             "pod_prio": self.pod_prio,
             "pod_valid": self.pod_valid,
         }
@@ -129,6 +144,38 @@ def build_selector_vocab(pods: list[Pod]) -> dict[tuple[str, str], int]:
                 if kv not in vocab:
                     vocab[kv] = len(vocab)
     return vocab
+
+
+def build_taint_vocab(nodes) -> dict[tuple[str, str, str], int]:
+    """Vocabulary of hard (key, value, effect) taint triples over the nodes."""
+    from ..core.predicates import HARD_TAINT_EFFECTS
+
+    vocab: dict[tuple[str, str, str], int] = {}
+    for n in nodes:
+        if n.spec is not None and n.spec.taints:
+            for t in n.spec.taints:
+                if t.effect in HARD_TAINT_EFFECTS:
+                    triple = (t.key, t.value, t.effect)
+                    if triple not in vocab:
+                        vocab[triple] = len(vocab)
+    return vocab
+
+
+def _pack_ntol(pending: list[Pod], taint_vocab: dict, p_pad: int, t_pad: int) -> np.ndarray:
+    """[P,T] 1.0 where the pod does NOT tolerate vocab taint t (padding
+    rows/columns are 0 = vacuously tolerated)."""
+    from ..api.objects import Taint
+
+    ntol = np.zeros((p_pad, t_pad), dtype=np.float32)
+    if not taint_vocab:
+        return ntol
+    triples = [(idx, Taint(key=k, value=v, effect=e)) for (k, v, e), idx in taint_vocab.items()]
+    for i, pod in enumerate(pending):
+        tolerations = (pod.spec.tolerations or []) if pod.spec is not None else []
+        for j, taint in triples:
+            if not any(t.tolerates(taint) for t in tolerations):
+                ntol[i, j] = 1.0
+    return ntol
 
 
 def _alloc_and_used64(snapshot: ClusterSnapshot, n_pad: int) -> tuple[np.ndarray, np.ndarray, dict[str, int]]:
@@ -169,6 +216,7 @@ def pack_snapshot(
     node_block: int = 128,
     label_block: int = 8,
     vocab: dict[tuple[str, str], int] | None = None,
+    taint_vocab: dict[tuple[str, str, str], int] | None = None,
 ) -> PackedCluster:
     """Pack a snapshot into static-shape tensors.
 
@@ -186,30 +234,48 @@ def pack_snapshot(
     p_pad = round_up(p_real, pod_block)
     l_pad = round_up(len(vocab), label_block)
 
+    if taint_vocab is None:
+        taint_vocab = build_taint_vocab(nodes)
+    t_pad = round_up(len(taint_vocab), label_block)
+
     alloc64, used64, _ = _alloc_and_used64(snapshot, n_pad)
     node_labels = np.zeros((n_pad, l_pad), dtype=np.float32)
+    node_taints = np.zeros((n_pad, t_pad), dtype=np.float32)
     node_valid = np.zeros((n_pad,), dtype=bool)
+    from ..core.predicates import HARD_TAINT_EFFECTS
+
     for i, node in enumerate(nodes):
-        node_valid[i] = True
+        node_valid[i] = not (node.spec is not None and node.spec.unschedulable)
         labels = node.metadata.labels
         if labels:
             for kv in labels.items():
                 j = vocab.get(kv)
                 if j is not None:
                     node_labels[i, j] = 1.0
+        if node.spec is not None and node.spec.taints:
+            for t in node.spec.taints:
+                if t.effect in HARD_TAINT_EFFECTS:
+                    j = taint_vocab.get((t.key, t.value, t.effect))
+                    if j is None:
+                        raise KeyError(f"taint {(t.key, t.value, t.effect)} missing from supplied taint_vocab")
+                    node_taints[i, j] = 1.0
 
     node_alloc = _clamp_i32(np.stack([alloc64[:, CPU], alloc64[:, MEM] // 1024], axis=1))
     node_avail = _avail_i32(alloc64, used64)
 
     pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad)
+    pod_ntol = _pack_ntol(pending, taint_vocab, p_pad, t_pad)
 
     return PackedCluster(
         node_alloc=node_alloc,
         node_avail=node_avail,
         node_labels=node_labels,
+        node_taints=node_taints,
         node_valid=node_valid,
         node_names=tuple(n.name for n in nodes),
         vocab=dict(vocab),
+        taint_vocab=dict(taint_vocab),
+        pod_ntol=pod_ntol,
         **pod_tensors,
     )
 
@@ -279,4 +345,5 @@ def repack_incremental(packed: PackedCluster, snapshot: ClusterSnapshot, pod_blo
     pending = snapshot.pending_pods()
     p_pad = max(packed.padded_pods, round_up(len(pending), pod_block))
     pod_tensors = _pack_pods(pending, packed.vocab, p_pad, packed.pod_sel.shape[1])
-    return replace(packed, node_avail=_avail_i32(alloc64, used64), **pod_tensors)
+    pod_ntol = _pack_ntol(pending, packed.taint_vocab, p_pad, packed.node_taints.shape[1])
+    return replace(packed, node_avail=_avail_i32(alloc64, used64), pod_ntol=pod_ntol, **pod_tensors)

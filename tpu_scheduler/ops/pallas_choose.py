@@ -63,10 +63,12 @@ def _choose_kernel(
     req_ref,  # [BP, 2] i32
     sel_ref,  # [BP, L] f32
     selc_ref,  # [BP, 1] f32
+    ntol_ref,  # [BP, T] f32  (1 where vocab taint NOT tolerated)
     act_ref,  # [BP, 1] i32
     idx_ref,  # [BP, 1] u32  (priority ranks, jitter hash input)
     info_ref,  # [8, TN] i32  (node resources, see ROW_*)
     labels_ref,  # [L, TN] f32
+    taints_ref,  # [T, TN] f32
     choice_ref,  # [BP, 1] i32 out
     has_ref,  # [BP, 1] i32 out
     best_ref,  # [BP, 1] f32 scratch
@@ -97,7 +99,11 @@ def _choose_kernel(
     counts = jnp.dot(sel_ref[:], labels_ref[:], preferred_element_type=f32)  # [BP, TN]
     sel_ok = counts == selc_ref[:]
 
-    mask = fit & sel_ok & (valid > 0) & (act_ref[:] > 0)
+    # taints/tolerations — untolerated-taint counting matmul (ops/masks.py).
+    untol = jnp.dot(ntol_ref[:], taints_ref[:], preferred_element_type=f32)  # [BP, TN]
+    taint_ok = untol == f32(0.0)
+
+    mask = fit & sel_ok & taint_ok & (valid > 0) & (act_ref[:] > 0)
 
     # LeastRequested + BalancedAllocation — same op order as ops/score.py.
     used_cpu = (alloc[0:1, :] - avail[0:1, :]) + req_cpu  # [BP, TN] i32
@@ -140,10 +146,12 @@ def choose_block_pallas(
     req,  # [B, 2] i32
     sel,  # [B, L] f32
     selc,  # [B] f32
+    ntol,  # [B, T] f32
     act,  # [B] bool
     ranks,  # [B] u32
     node_info,  # [8, N] i32 (build_node_info)
     labels_t,  # [L, N] f32
+    taints_t,  # [T, N] f32
     weights,  # [3] f32
     pod_tile: int = 256,
     node_tile: int = 512,
@@ -156,6 +164,7 @@ def choose_block_pallas(
     """
     b, n = req.shape[0], node_info.shape[1]
     l = sel.shape[1]
+    t = ntol.shape[1]
     bp = min(pod_tile, max(8, b))
     pb = -(-b // bp)
     nbt = -(-n // node_tile)
@@ -165,11 +174,13 @@ def choose_block_pallas(
         req = jnp.pad(req, ((0, b_pad - b), (0, 0)))
         sel = jnp.pad(sel, ((0, b_pad - b), (0, 0)))
         selc = jnp.pad(selc, ((0, b_pad - b),))
+        ntol = jnp.pad(ntol, ((0, b_pad - b), (0, 0)))
         act = jnp.pad(act, ((0, b_pad - b),))
         ranks = jnp.pad(ranks, ((0, b_pad - b),))
     if n_pad != n:
         node_info = jnp.pad(node_info, ((0, 0), (0, n_pad - n)))
         labels_t = jnp.pad(labels_t, ((0, 0), (0, n_pad - n)))
+        taints_t = jnp.pad(taints_t, ((0, 0), (0, n_pad - n)))
 
     w = jnp.pad(weights.astype(jnp.float32), (0, 1)).reshape(1, 4)
 
@@ -182,10 +193,12 @@ def choose_block_pallas(
             pl.BlockSpec((bp, 2), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, l), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, t), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((8, node_tile), lambda i, j: (0, j)),
             pl.BlockSpec((l, node_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((t, node_tile), lambda i, j: (0, j)),
         ],
         out_specs=[
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
@@ -205,9 +218,11 @@ def choose_block_pallas(
         req,
         sel,
         selc.reshape(-1, 1),
+        ntol,
         act.astype(jnp.int32).reshape(-1, 1),
         ranks.astype(jnp.uint32).reshape(-1, 1),
         node_info,
         labels_t,
+        taints_t,
     )
     return choice[:b, 0], has[:b, 0].astype(bool)

@@ -52,6 +52,7 @@ def save_scheduler(scheduler, path: str) -> None:
     packed = scheduler._packed
     if packed is not None:
         state["vocab"] = [[k, v, i] for (k, v), i in packed.vocab.items()]
+        state["taint_vocab"] = [[k, v, e, i] for (k, v, e), i in packed.taint_vocab.items()]
         state["node_names"] = list(packed.node_names)
         fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
         with os.fdopen(fd, "wb") as f:  # file object: savez can't append ".npz"
@@ -60,6 +61,7 @@ def save_scheduler(scheduler, path: str) -> None:
                 node_alloc=packed.node_alloc,
                 node_avail=packed.node_avail,
                 node_labels=packed.node_labels,
+                node_taints=packed.node_taints,
                 node_valid=packed.node_valid,
             )
         os.replace(tmp, os.path.join(path, _TENSORS_FILE))
@@ -98,12 +100,16 @@ def restore_scheduler(scheduler, path: str) -> bool:
     if state.get("vocab") is not None and os.path.exists(tensors_path):
         with np.load(tensors_path) as z:
             vocab = {(k, v): i for k, v, i in state["vocab"]}
+            taint_vocab = {(k, v, e): i for k, v, e, i in state.get("taint_vocab", [])}
             n_pad = z["node_alloc"].shape[0]
             consistent = (
                 z["node_avail"].shape == z["node_alloc"].shape == (n_pad, 2)
                 and z["node_labels"].shape[0] == n_pad
+                and "node_taints" in z
+                and z["node_taints"].shape[0] == n_pad
                 and z["node_valid"].shape == (n_pad,)
                 and len(vocab) <= z["node_labels"].shape[1]
+                and len(taint_vocab) <= z["node_taints"].shape[1]
                 and len(state.get("node_names", [])) <= n_pad
             )
             if not consistent:
@@ -116,14 +122,17 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 node_alloc=z["node_alloc"],
                 node_avail=z["node_avail"],
                 node_labels=z["node_labels"],
+                node_taints=z["node_taints"],
                 node_valid=z["node_valid"],
                 node_names=tuple(state.get("node_names", [])),
                 pod_req=np.zeros((p, 2), np.int32),
                 pod_sel=np.zeros((p, z["node_labels"].shape[1]), np.float32),
                 pod_sel_count=np.zeros((p,), np.float32),
+                pod_ntol=np.zeros((p, z["node_taints"].shape[1]), np.float32),
                 pod_prio=np.zeros((p,), np.int32),
                 pod_valid=np.zeros((p,), bool),
                 pod_names=(),
                 vocab=vocab,
+                taint_vocab=taint_vocab,
             )
     return True

@@ -38,7 +38,9 @@ from ..core.predicates import (
     anti_affinity_ok,
     make_affinity_checker,
     make_spread_checker,
+    node_schedulable,
     node_selector_matches,
+    taints_tolerated,
     term_matches,
     topology_spread_ok,
 )
@@ -148,8 +150,18 @@ class Scheduler:
                 for kv in p.spec.node_selector.items()
             )
         ):
-            packed = repack_incremental(self._packed, snapshot, pod_block=self.pod_block)
-            self.metrics.inc("scheduler_incremental_packs_total")
+            try:
+                packed = repack_incremental(self._packed, snapshot, pod_block=self.pod_block)
+                self.metrics.inc("scheduler_incremental_packs_total")
+            except (ValueError, KeyError):
+                # The cached node tensors don't match the live node order
+                # after all (e.g. a checkpoint-restored cache whose reflector
+                # relisted in a different order: the signature is sorted, the
+                # pack is order-sensitive).  Degrade to a full pack — never
+                # crash the cycle on a stale cache.
+                packed = pack_snapshot(snapshot, pod_block=self.pod_block, node_block=self.node_block)
+                self._node_sig = sig
+                self.metrics.inc("scheduler_full_packs_total")
         else:
             packed = pack_snapshot(snapshot, pod_block=self.pod_block, node_block=self.node_block)
             self._node_sig = sig
@@ -395,6 +407,10 @@ class Scheduler:
             return InvalidNodeReason.NOT_ENOUGH_RESOURCES
         if not node_selector_matches(pod, node):
             return InvalidNodeReason.NODE_SELECTOR_MISMATCH
+        if not node_schedulable(pod, node):
+            return InvalidNodeReason.NODE_UNSCHEDULABLE
+        if not taints_tolerated(pod, node):
+            return InvalidNodeReason.TAINT_NOT_TOLERATED
         affinity_fine = (
             affinity_checker(node) if affinity_checker is not None else anti_affinity_ok(pod, node, snapshot, extra_placed=placed)
         )

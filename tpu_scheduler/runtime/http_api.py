@@ -93,7 +93,11 @@ class HttpApiServer:
                 parsed = urlparse(self.path)
                 parts = parsed.path.strip("/").split("/")
                 length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError as e:
+                    self._send_json(400, {"message": f"malformed JSON body: {e}"})
+                    return
                 if outer.api is None:
                     self._send_json(503, {"message": "metrics-only server: no cluster state here"})
                     return
@@ -156,23 +160,39 @@ class KubeApiClient:
             cls = http.client.HTTPSConnection if parsed.scheme == "https" else http.client.HTTPConnection
             connection_factory = lambda: cls(self._host, self._port, timeout=self._timeout)  # noqa: E731
         self._connect = connection_factory
+        self._conn = None  # persistent keep-alive connection
 
     def _request(self, method: str, path: str, body=None) -> tuple[int, dict]:
-        conn = self._connect()
-        try:
-            headers = {"Accept": "application/json"}
-            if self._token:
-                headers["Authorization"] = f"Bearer {self._token}"
-            payload = None
-            if body is not None:
-                payload = json.dumps(body).encode()
-                headers["Content-Type"] = "application/json"
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            return resp.status, (json.loads(data) if data else {})
-        finally:
-            conn.close()
+        """One round-trip over a persistent connection (a binding-heavy cycle
+        issues thousands of POSTs — per-request TCP/TLS handshakes would
+        dominate bind latency).  One reconnect on a dropped keep-alive."""
+        headers = {"Accept": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        payload = None
+        if body is not None:
+            payload = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = self._connect()
+            try:
+                self._conn.request(method, path, body=payload, headers=headers)
+                resp = self._conn.getresponse()
+                data = resp.read()
+                return resp.status, (json.loads(data) if data else {})
+            except (http.client.HTTPException, ConnectionError, BrokenPipeError, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
 
     def list_nodes(self) -> list[Node]:
         code, body = self._request("GET", "/api/v1/nodes")

@@ -10,6 +10,7 @@ import random
 from .api.objects import (
     Container,
     Node,
+    NodeSpec,
     NodeStatus,
     ObjectMeta,
     Pod,
@@ -17,6 +18,8 @@ from .api.objects import (
     PodSpec,
     PodStatus,
     ResourceRequirements,
+    Taint,
+    Toleration,
     TopologySpreadConstraint,
 )
 from .core.snapshot import ClusterSnapshot
@@ -35,10 +38,14 @@ def make_node(
     cpu: str | int = "8",
     memory: str | int = "32Gi",
     labels: dict[str, str] | None = None,
+    taints: list[Taint] | None = None,
+    unschedulable: bool = False,
 ) -> Node:
+    spec = NodeSpec(taints=taints, unschedulable=unschedulable) if (taints or unschedulable) else None
     return Node(
         metadata=ObjectMeta(name=name, labels=labels),
         status=NodeStatus(allocatable={"cpu": cpu, "memory": memory}),
+        spec=spec,
     )
 
 
@@ -54,6 +61,7 @@ def make_pod(
     labels: dict[str, str] | None = None,
     anti_affinity: list[PodAntiAffinityTerm] | None = None,
     topology_spread: list[TopologySpreadConstraint] | None = None,
+    tolerations: list[Toleration] | None = None,
 ) -> Pod:
     return Pod(
         metadata=ObjectMeta(name=name, namespace=namespace, labels=labels),
@@ -66,6 +74,7 @@ def make_pod(
             priority=priority,
             anti_affinity=anti_affinity,
             topology_spread=topology_spread,
+            tolerations=tolerations,
         ),
         status=PodStatus(phase=phase),
     )
@@ -80,6 +89,8 @@ def synth_cluster(
     multi_container_fraction: float = 0.1,
     anti_affinity_fraction: float = 0.0,
     spread_fraction: float = 0.0,
+    tainted_fraction: float = 0.0,
+    cordoned_fraction: float = 0.0,
 ) -> ClusterSnapshot:
     """Generate a synthetic cluster snapshot.
 
@@ -91,6 +102,9 @@ def synth_cluster(
     pods declare self-anti-affinity (against their own ``app`` label) on the
     hostname-like ``name`` key; ``spread_fraction`` declare a hard zone
     topology-spread constraint over their ``app`` label (config 5 shapes).
+    ``tainted_fraction`` of nodes carry a NoSchedule pool taint which the
+    pods destined for that pool tolerate; ``cordoned_fraction`` are
+    cordoned (spec.unschedulable).
     """
     rng = random.Random(seed)
     if n_nodes == 0:
@@ -98,12 +112,17 @@ def synth_cluster(
     nodes = []
     for i in range(n_nodes):
         cores, gib = _NODE_SHAPES[i % len(_NODE_SHAPES)]
+        pool = _POOLS[i % len(_POOLS)]
         labels = {
             "zone": _ZONES[i % len(_ZONES)],
-            "pool": _POOLS[i % len(_POOLS)],
+            "pool": pool,
             "name": f"node-{i}",
         }
-        nodes.append(make_node(f"node-{i}", cpu=cores, memory=f"{gib}Gi", labels=labels))
+        taints = [Taint(key="pool", value=pool, effect="NoSchedule")] if rng.random() < tainted_fraction else None
+        cordoned = rng.random() < cordoned_fraction
+        nodes.append(
+            make_node(f"node-{i}", cpu=cores, memory=f"{gib}Gi", labels=labels, taints=taints, unschedulable=cordoned)
+        )
 
     pods: list[Pod] = []
     for i in range(n_bound):
@@ -131,6 +150,13 @@ def synth_cluster(
         spread = None
         if rng.random() < spread_fraction:
             spread = [TopologySpreadConstraint(topology_key="zone", max_skew=rng.choice([1, 2]), match_labels={"app": app})]
+        tols = None
+        if tainted_fraction and rng.random() < 0.5:
+            # Half the pods tolerate one pool's taint (Equal) or all taints (Exists).
+            if rng.random() < 0.3:
+                tols = [Toleration(operator="Exists")]
+            else:
+                tols = [Toleration(key="pool", operator="Equal", value=rng.choice(_POOLS), effect="NoSchedule")]
         pod = make_pod(
             f"pending-{i}",
             cpu=f"{rng.choice([100, 250, 500, 1000, 2000])}m",
@@ -140,6 +166,7 @@ def synth_cluster(
             labels={"app": app},
             anti_affinity=anti,
             topology_spread=spread,
+            tolerations=tols,
         )
         if rng.random() < multi_container_fraction:
             pod.spec.containers.append(
