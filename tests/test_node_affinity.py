@@ -1,0 +1,191 @@
+"""Required node-affinity tests: operator semantics incl. Gt/Lt (scalar
+oracle), term-vocabulary tensorization, backend parity on affinity-heavy
+clusters, and end-to-end enforcement in every policy."""
+
+import numpy as np
+import pytest
+
+from tpu_scheduler.api.objects import (
+    LabelSelectorRequirement as Req,
+    NodeSelectorTerm,
+    Pod,
+    pod_to_dict,
+)
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.core.predicates import (
+    InvalidNodeReason,
+    check_node_validity,
+    node_affinity_matches,
+    node_selector_term_matches,
+)
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.ops.pack import build_affinity_vocab, pack_snapshot
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+def term(*exprs):
+    return NodeSelectorTerm(match_expressions=list(exprs))
+
+
+# --- operator semantics ------------------------------------------------------
+
+
+def test_term_in_notin_exists():
+    labels = {"zone": "a", "pool": "compute"}
+    assert node_selector_term_matches(term(Req("zone", "In", ["a", "b"])), labels)
+    assert not node_selector_term_matches(term(Req("zone", "In", ["c"])), labels)
+    assert node_selector_term_matches(term(Req("zone", "NotIn", ["c"])), labels)
+    assert node_selector_term_matches(term(Req("gpu", "DoesNotExist")), labels)
+    assert not node_selector_term_matches(term(Req("zone", "DoesNotExist")), labels)
+    # expressions AND within a term
+    assert node_selector_term_matches(term(Req("zone", "In", ["a"]), Req("pool", "Exists")), labels)
+    assert not node_selector_term_matches(term(Req("zone", "In", ["a"]), Req("pool", "In", ["x"])), labels)
+
+
+def test_term_gt_lt_numeric():
+    labels = {"slot": "7"}
+    assert node_selector_term_matches(term(Req("slot", "Gt", ["5"])), labels)
+    assert not node_selector_term_matches(term(Req("slot", "Gt", ["7"])), labels)
+    assert node_selector_term_matches(term(Req("slot", "Lt", ["8"])), labels)
+    assert not node_selector_term_matches(term(Req("slot", "Lt", ["7"])), labels)
+    # non-numeric label or missing key never matches
+    assert not node_selector_term_matches(term(Req("slot", "Gt", ["5"])), {"slot": "abc"})
+    assert not node_selector_term_matches(term(Req("other", "Gt", ["5"])), labels)
+
+
+def test_empty_term_matches_nothing():
+    assert not node_selector_term_matches(term(), {"zone": "a"})
+
+
+def test_affinity_terms_are_ored():
+    pod = make_pod("p", node_affinity=[term(Req("zone", "In", ["a"])), term(Req("zone", "In", ["b"]))])
+    na = make_node("na", labels={"zone": "a"})
+    nb = make_node("nb", labels={"zone": "b"})
+    nc = make_node("nc", labels={"zone": "c"})
+    assert node_affinity_matches(pod, na)
+    assert node_affinity_matches(pod, nb)
+    assert not node_affinity_matches(pod, nc)
+
+
+def test_no_affinity_is_vacuous():
+    assert node_affinity_matches(make_pod("p"), make_node("n"))
+
+
+def test_chain_reports_affinity_reason():
+    pod = make_pod("p", node_affinity=[term(Req("zone", "In", ["a"]))])
+    node = make_node("n", labels={"zone": "b"})
+    s = ClusterSnapshot.build([node], [pod])
+    assert check_node_validity(pod, node, s) is InvalidNodeReason.NODE_AFFINITY_MISMATCH
+
+
+# --- serialization -----------------------------------------------------------
+
+
+def test_node_affinity_roundtrip():
+    pod = make_pod(
+        "p",
+        node_affinity=[
+            term(Req("zone", "In", ["a", "b"]), Req("slot", "Gt", ["3"])),
+            term(Req("gpu", "Exists")),
+        ],
+    )
+    back = Pod.from_dict(pod_to_dict(pod))
+    assert back.spec.node_affinity == pod.spec.node_affinity
+
+
+# --- tensorization -----------------------------------------------------------
+
+
+def test_affinity_vocab_dedupes_canonical_terms():
+    t1 = term(Req("zone", "In", ["a"]), Req("slot", "Gt", ["3"]))
+    t2 = term(Req("slot", "Gt", ["3"]), Req("zone", "In", ["a"]))  # same, reordered
+    pods = [make_pod("p1", node_affinity=[t1]), make_pod("p2", node_affinity=[t2])]
+    vocab = build_affinity_vocab(pods)
+    assert len(vocab) == 1
+
+
+def test_pack_affinity_bitmaps_match_scalar_oracle():
+    s = synth_cluster(n_nodes=24, n_pending=60, n_bound=8, seed=5, node_affinity_fraction=0.6)
+    packed = pack_snapshot(s, pod_block=8, node_block=8)
+    pending = s.pending_pods()
+    for i, pod in enumerate(pending):
+        has = bool(packed.pod_has_aff[i])
+        assert has == bool(pod.spec.node_affinity), pod.name
+        for j, node in enumerate(s.nodes):
+            tensor_ok = (not has) or float(packed.pod_aff[i] @ packed.node_aff[j]) > 0
+            assert tensor_ok == node_affinity_matches(pod, node), (pod.name, node.name)
+
+
+# --- backends + end-to-end ---------------------------------------------------
+
+
+def test_backend_parity_affinity_cluster():
+    s = synth_cluster(
+        n_nodes=30, n_pending=150, n_bound=20, seed=13, node_affinity_fraction=0.5, tainted_fraction=0.2
+    )
+    packed = pack_snapshot(s, pod_block=32, node_block=8)
+    from tpu_scheduler.backends.tpu import TpuBackend
+
+    rn = NativeBackend().schedule(packed)
+    rt = TpuBackend().schedule(packed)
+    np.testing.assert_array_equal(rn.assigned, rt.assigned)
+
+
+def test_batch_bindings_respect_affinity():
+    nodes = [
+        make_node("za", cpu="16", memory="64Gi", labels={"zone": "a", "slot": "2"}),
+        make_node("zb", cpu="16", memory="64Gi", labels={"zone": "b", "slot": "9"}),
+    ]
+    pods = [make_pod(f"a-{i}", node_affinity=[term(Req("zone", "In", ["a"]))]) for i in range(3)]
+    pods += [make_pod(f"hi-{i}", node_affinity=[term(Req("slot", "Gt", ["5"]))]) for i in range(3)]
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=pods)
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+    m = sched.run_cycle()
+    assert m.bound == 6
+    for p in api.list_pods():
+        want = "za" if p.metadata.name.startswith("a-") else "zb"
+        assert p.spec.node_name == want, (p.metadata.name, p.spec.node_name)
+
+
+def test_unsatisfiable_affinity_requeues():
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n", labels={"zone": "a"})],
+        pods=[make_pod("p", node_affinity=[term(Req("zone", "In", ["nowhere"]))])],
+    )
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+    m = sched.run_cycle()
+    assert m.bound == 0 and m.unschedulable == 1
+    assert "default/p" in sched.requeue_at
+
+
+def test_sample_policy_respects_affinity():
+    import random
+
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("good", labels={"zone": "a"}), make_node("bad", labels={"zone": "b"})],
+        pods=[make_pod(f"p{i}", node_affinity=[term(Req("zone", "In", ["a"]))]) for i in range(5)],
+    )
+    sched = Scheduler(api, NativeBackend(), policy="sample", attempts=50, rng=random.Random(2))
+    sched.run_cycle()
+    for p in api.list_pods():
+        if p.spec.node_name is not None:
+            assert p.spec.node_name == "good"
+
+
+def test_new_affinity_term_forces_full_repack():
+    """The incremental-pack gate must notice a pending pod whose affinity
+    term is not in the cached vocabulary."""
+    api = FakeApiServer()
+    api.load(nodes=[make_node("n", labels={"zone": "a"})], pods=[make_pod("p0")])
+    sched = Scheduler(api, NativeBackend(), policy="batch")
+    sched.run_cycle()
+    assert sched.metrics.counters["scheduler_full_packs_total"] == 1
+    api.create_pod(make_pod("p1", node_affinity=[term(Req("zone", "In", ["a"]))]))
+    m = sched.run_cycle()
+    assert m.bound == 1
+    assert sched.metrics.counters["scheduler_full_packs_total"] == 2

@@ -32,30 +32,31 @@ def _case(n_nodes, n_pending, seed, n_bound=None):
 def _both_paths(a, weights, pod_tile=8, node_tile=128):
     p = a["pod_req"].shape[0]
     ranks = jnp.arange(p, dtype=jnp.uint32)
-    jc, jh = _choose_block(
-        a["node_avail"],
-        a["node_alloc"],
-        a["node_labels"],
-        a["node_taints"],
-        a["node_valid"],
-        weights,
-        a["pod_req"],
-        a["pod_sel"],
-        a["pod_sel_count"],
-        a["pod_ntol"],
-        a["pod_valid"],
-        ranks,
-    )
+    nodes = {k: v for k, v in a.items() if k.startswith("node_")}
+    blk = {
+        "pod_req": a["pod_req"],
+        "pod_sel": a["pod_sel"],
+        "pod_sel_count": a["pod_sel_count"],
+        "pod_ntol": a["pod_ntol"],
+        "pod_aff": a["pod_aff"],
+        "pod_has_aff": a["pod_has_aff"],
+        "active": a["pod_valid"],
+        "ranks": ranks,
+    }
+    jc, jh = _choose_block(a["node_avail"], nodes, weights, blk)
     pc, ph = choose_block_pallas(
         a["pod_req"],
         a["pod_sel"],
         a["pod_sel_count"],
         a["pod_ntol"],
+        a["pod_aff"],
+        a["pod_has_aff"],
         a["pod_valid"],
         ranks,
         build_node_info(a["node_avail"], a["node_alloc"], a["node_valid"]),
         a["node_labels"].T,
         a["node_taints"].T,
+        a["node_aff"].T,
         weights,
         pod_tile=pod_tile,
         node_tile=node_tile,
@@ -100,25 +101,14 @@ def test_pallas_choose_inactive_pods_masked():
 def test_assign_cycle_pallas_flag_smoke():
     """assign_cycle(use_pallas=True) must produce identical assignments to
     the jnp path (interpret mode forced via module flag on CPU)."""
-    from tpu_scheduler.ops.assign import assign_cycle
+    from tpu_scheduler.ops.assign import assign_cycle, split_device_arrays
 
     a, weights = _case(24, 40, seed=9)
-    args = (
-        a["node_alloc"],
-        a["node_avail"],
-        a["node_labels"],
-        a["node_taints"],
-        a["node_valid"],
-        a["pod_req"],
-        a["pod_sel"],
-        a["pod_sel_count"],
-        a["pod_ntol"],
-        a["pod_prio"],
-        a["pod_valid"],
-        weights,
+    nodes, pods = split_device_arrays(a)
+    base_assigned, base_rounds, base_avail = assign_cycle(nodes, pods, weights, max_rounds=16, block=16)
+    p_assigned, p_rounds, p_avail = assign_cycle(
+        nodes, pods, weights, max_rounds=16, block=16, use_pallas=True, pallas_interpret=True
     )
-    base_assigned, base_rounds, base_avail = assign_cycle(*args, max_rounds=16, block=16)
-    p_assigned, p_rounds, p_avail = assign_cycle(*args, max_rounds=16, block=16, use_pallas=True, pallas_interpret=True)
     np.testing.assert_array_equal(np.asarray(base_assigned), np.asarray(p_assigned))
     assert int(base_rounds) == int(p_rounds)
     np.testing.assert_array_equal(np.asarray(base_avail), np.asarray(p_avail))

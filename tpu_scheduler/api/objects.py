@@ -26,6 +26,7 @@ __all__ = [
     "LabelSelectorRequirement",
     "PodAntiAffinityTerm",
     "TopologySpreadConstraint",
+    "NodeSelectorTerm",
     "PodSpec",
     "PodStatus",
     "Pod",
@@ -141,6 +142,28 @@ class TopologySpreadConstraint:
 
 
 @dataclass
+class NodeSelectorTerm:
+    """One nodeSelectorTerms entry of required node affinity: its
+    ``match_expressions`` are ANDed; terms in a list are ORed.  Node-affinity
+    expressions additionally support ``Gt``/``Lt`` (numeric label compare).
+    A term with no expressions matches nothing (the empty-selector deviation,
+    see PodAntiAffinityTerm)."""
+
+    match_expressions: list[LabelSelectorRequirement] | None = None
+
+    def key(self) -> tuple:
+        """Canonical hashable form — the affinity-term vocabulary key.
+
+        In/NotIn values are sets semantically, so their order is
+        canonicalized too; Gt/Lt values stay positional (single value)."""
+        def vals(r):
+            v = tuple(r.values or ())
+            return tuple(sorted(v)) if r.operator in ("In", "NotIn") else v
+
+        return tuple(sorted((r.key, r.operator, vals(r)) for r in self.match_expressions or []))
+
+
+@dataclass
 class Taint:
     """Node taint.  Effects enforced as hard filters: NoSchedule and
     NoExecute; PreferNoSchedule is soft and not (yet) scored."""
@@ -187,6 +210,7 @@ class PodSpec:
     anti_affinity: list[PodAntiAffinityTerm] | None = None
     topology_spread: list[TopologySpreadConstraint] | None = None
     tolerations: list[Toleration] | None = None
+    node_affinity: list[NodeSelectorTerm] | None = None  # required terms, ORed
 
 
 @dataclass
@@ -220,7 +244,7 @@ class Pod:
                     if c.get("resources") is not None
                     else None,
                 )
-                for c in spec_d.get("containers", [])
+                for c in spec_d.get("containers") or []
             ]
             def parse_expressions(selector: Mapping[str, Any] | None) -> list[LabelSelectorRequirement] | None:
                 exprs = (selector or {}).get("matchExpressions")
@@ -264,6 +288,16 @@ class Pod:
                     )
                     for c in hard
                 ]
+            node_aff = None
+            node_sel_terms = (
+                (((spec_d.get("affinity") or {}).get("nodeAffinity") or {}).get(
+                    "requiredDuringSchedulingIgnoredDuringExecution"
+                ) or {}
+                ).get("nodeSelectorTerms")
+                or []
+            )
+            if node_sel_terms:
+                node_aff = [NodeSelectorTerm(match_expressions=parse_expressions(t)) for t in node_sel_terms]
             tolerations = [
                 Toleration(
                     key=t.get("key", ""),
@@ -271,7 +305,7 @@ class Pod:
                     value=t.get("value", ""),
                     effect=t.get("effect", ""),
                 )
-                for t in spec_d.get("tolerations", [])
+                for t in spec_d.get("tolerations") or []
             ] or None
             spec = PodSpec(
                 containers=containers,
@@ -281,6 +315,7 @@ class Pod:
                 anti_affinity=anti,
                 topology_spread=spread,
                 tolerations=tolerations,
+                node_affinity=node_aff,
             )
         status = PodStatus(phase=d.get("status", {}).get("phase", "Pending"))
         obj_meta = ObjectMeta(
@@ -358,6 +393,7 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
             }
             for t in pod.spec.tolerations
         ]
+    affinity: dict[str, Any] = {}
     if pod.spec.anti_affinity:
         terms = []
         for t in pod.spec.anti_affinity:
@@ -366,7 +402,17 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
             if sel:
                 term["labelSelector"] = sel
             terms.append(term)
-        spec["affinity"] = {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": terms}}
+        affinity["podAntiAffinity"] = {"requiredDuringSchedulingIgnoredDuringExecution": terms}
+    if pod.spec.node_affinity:
+        affinity["nodeAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    _selector_to_dict(None, t.match_expressions) or {} for t in pod.spec.node_affinity
+                ]
+            }
+        }
+    if affinity:
+        spec["affinity"] = affinity
     if pod.spec.topology_spread:
         constraints = []
         for c in pod.spec.topology_spread:
@@ -436,7 +482,7 @@ class Node:
         if spec_d is not None:
             taints = [
                 Taint(key=t.get("key", ""), value=t.get("value", ""), effect=t.get("effect", "NoSchedule"))
-                for t in spec_d.get("taints", [])
+                for t in spec_d.get("taints") or []
             ] or None
             spec = NodeSpec(taints=taints, unschedulable=bool(spec_d.get("unschedulable", False)))
         obj_meta = ObjectMeta(

@@ -30,6 +30,7 @@ class NativeBackend(SchedulingBackend):
         node_alloc, node_avail = packed.node_alloc, packed.node_avail
         node_labels, node_valid = packed.node_labels, packed.node_valid
         node_taints = packed.node_taints
+        node_aff = packed.node_aff
         weights = profile.weights()
         p = packed.padded_pods
         n = packed.padded_nodes
@@ -40,6 +41,8 @@ class NativeBackend(SchedulingBackend):
         sel = packed.pod_sel[perm]
         selc = packed.pod_sel_count[perm]
         ntol = packed.pod_ntol[perm]
+        aff = packed.pod_aff[perm]
+        has_aff = packed.pod_has_aff[perm]
         valid = packed.pod_valid[perm]
 
         avail = node_avail.copy()
@@ -55,7 +58,7 @@ class NativeBackend(SchedulingBackend):
                 hi = min(lo + block, p)
                 m = feasibility_block(
                     np, req[lo:hi], sel[lo:hi], selc[lo:hi], active[lo:hi], avail, node_labels, node_valid,
-                    ntol[lo:hi], node_taints,
+                    ntol[lo:hi], node_taints, aff[lo:hi], has_aff[lo:hi], node_aff,
                 )
                 pod_idx = np.arange(lo, hi, dtype=np.uint32)
                 sc = score_block(np, req[lo:hi], node_alloc, avail, weights, pod_idx, node_idx)

@@ -12,7 +12,7 @@ import numpy as np
 
 from ..errors import BackendUnavailable
 from ..models.profiles import SchedulingProfile
-from ..ops.assign import assign_cycle
+from ..ops.assign import assign_cycle, split_device_arrays
 from ..ops.pack import PackedCluster
 from .base import SchedulingBackend
 
@@ -45,18 +45,10 @@ class TpuBackend(SchedulingBackend):
             a = packed.device_arrays()
             put = {k: jax.device_put(v, self.device) for k, v in a.items()}
             weights = jax.device_put(profile.weights(), self.device)
+            nodes, pods = split_device_arrays(put)
             assigned, rounds, _avail = assign_cycle(
-                put["node_alloc"],
-                put["node_avail"],
-                put["node_labels"],
-                put["node_taints"],
-                put["node_valid"],
-                put["pod_req"],
-                put["pod_sel"],
-                put["pod_sel_count"],
-                put["pod_ntol"],
-                put["pod_prio"],
-                put["pod_valid"],
+                nodes,
+                pods,
                 weights,
                 max_rounds=profile.max_rounds,
                 block=profile.pod_block,

@@ -25,6 +25,8 @@ __all__ = [
     "node_selector_matches",
     "node_schedulable",
     "taints_tolerated",
+    "node_affinity_matches",
+    "node_selector_term_matches",
     "HARD_TAINT_EFFECTS",
     "anti_affinity_ok",
     "topology_spread_ok",
@@ -36,6 +38,7 @@ __all__ = [
     "make_spread_checker",
     "check_node_validity",
     "PREDICATE_CHAIN",
+    "NODE_LOCAL_PREDICATES",
 ]
 
 
@@ -46,6 +49,7 @@ class InvalidNodeReason(enum.Enum):
 
     NOT_ENOUGH_RESOURCES = "NotEnoughResources"
     NODE_SELECTOR_MISMATCH = "NodeSelectorMismatch"
+    NODE_AFFINITY_MISMATCH = "NodeAffinityMismatch"
     NODE_UNSCHEDULABLE = "NodeUnschedulable"
     TAINT_NOT_TOLERATED = "TaintNotTolerated"
     ANTI_AFFINITY_VIOLATION = "AntiAffinityViolation"
@@ -82,6 +86,43 @@ def node_selector_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None
 
 
 HARD_TAINT_EFFECTS = ("NoSchedule", "NoExecute")
+
+
+def _node_expression_matches(r: LabelSelectorRequirement, labels: dict[str, str]) -> bool:
+    """Node-affinity expression match — label-selector operators plus the
+    numeric ``Gt``/``Lt`` (single integer value; a missing or non-integer
+    label never matches)."""
+    if r.operator in ("Gt", "Lt"):
+        if r.key not in labels or not r.values:
+            return False
+        try:
+            label_num = int(labels[r.key])
+            want = int(r.values[0])
+        except (TypeError, ValueError):
+            return False
+        return label_num > want if r.operator == "Gt" else label_num < want
+    return _expression_matches(r, labels)
+
+
+def node_selector_term_matches(term, labels: dict[str, str] | None) -> bool:
+    """A nodeSelectorTerm matches iff every expression holds; a term with no
+    expressions matches nothing (the empty-selector deviation)."""
+    exprs = term.match_expressions
+    if not exprs:
+        return False
+    labels = labels or {}
+    return all(_node_expression_matches(r, labels) for r in exprs)
+
+
+def node_affinity_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
+    """Required node-affinity predicate (standard kube-scheduler; absent in
+    the reference).  Terms are ORed; a pod without affinity matches
+    vacuously."""
+    terms = (pod.spec.node_affinity or []) if pod.spec is not None else []
+    if not terms:
+        return True
+    labels = node.metadata.labels
+    return any(node_selector_term_matches(t, labels) for t in terms)
 
 
 def node_schedulable(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
@@ -295,11 +336,19 @@ def topology_spread_ok(
 # Ordered chain: fixed resource-then-selector order, as in the reference
 # (``predicates.rs:68,72``), extended with the config-5 predicates.  Each
 # entry: (reason-on-failure, predicate fn).
-PREDICATE_CHAIN: list[tuple[InvalidNodeReason, Callable[[Pod, Node, ClusterSnapshot], bool]]] = [
-    (InvalidNodeReason.NOT_ENOUGH_RESOURCES, pod_fits_resources),
+# Pure (pod, node) predicates that need no snapshot-wide state — the middle
+# of the chain, shared verbatim by the controller's ledger-adjusted path so a
+# predicate added here is enforced everywhere at once.
+NODE_LOCAL_PREDICATES: list[tuple[InvalidNodeReason, Callable[[Pod, Node, ClusterSnapshot], bool]]] = [
     (InvalidNodeReason.NODE_SELECTOR_MISMATCH, node_selector_matches),
+    (InvalidNodeReason.NODE_AFFINITY_MISMATCH, node_affinity_matches),
     (InvalidNodeReason.NODE_UNSCHEDULABLE, node_schedulable),
     (InvalidNodeReason.TAINT_NOT_TOLERATED, taints_tolerated),
+]
+
+PREDICATE_CHAIN: list[tuple[InvalidNodeReason, Callable[[Pod, Node, ClusterSnapshot], bool]]] = [
+    (InvalidNodeReason.NOT_ENOUGH_RESOURCES, pod_fits_resources),
+    *NODE_LOCAL_PREDICATES,
     (InvalidNodeReason.ANTI_AFFINITY_VIOLATION, anti_affinity_ok),
     (InvalidNodeReason.TOPOLOGY_SPREAD_VIOLATION, topology_spread_ok),
 ]

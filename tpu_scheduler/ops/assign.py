@@ -29,6 +29,11 @@ Round structure (all under ``lax.while_loop``; shapes static):
      hash, so results are bit-identical to the uncompacted algorithm and to
      the native backend.
 
+Pod- and node-side tensors travel as dicts (the PackedCluster
+``device_arrays`` names, split by prefix), so adding a predicate tensor is a
+one-key change: the permutation, padding, compaction, and block slicing are
+generic over the pod dict.
+
 Every round with any claimant accepts at least the highest-priority claimant
 of each contended node, so the loop strictly progresses; ``max_rounds`` is a
 safety cap only.
@@ -51,7 +56,18 @@ from .masks import feasibility_block
 from .pack import INT32_MAX
 from .score import score_block
 
-__all__ = ["assign_cycle", "INT32_MAX"]
+__all__ = ["assign_cycle", "split_device_arrays", "INT32_MAX"]
+
+# Pod-side keys the choose step consumes (sliced per block); the rest of the
+# pod state (assigned, active bookkeeping) never enters the score math.
+_CHOOSE_KEYS = ("pod_req", "pod_sel", "pod_sel_count", "pod_ntol", "pod_aff", "pod_has_aff", "active", "ranks")
+
+
+def split_device_arrays(arrays: dict) -> tuple[dict, dict]:
+    """Split a PackedCluster.device_arrays() dict into (node_side, pod_side)."""
+    nodes = {k: v for k, v in arrays.items() if k.startswith("node_")}
+    pods = {k: v for k, v in arrays.items() if k.startswith("pod_")}
+    return nodes, pods
 
 
 def _sat_add(a, b):
@@ -70,56 +86,82 @@ def _seg_scan_op(x, y):
     return fx | fy, jnp.where(fy, vy, _sat_add(vx, vy))
 
 
-def _choose_block(
-    avail, node_alloc, node_labels, node_taints, node_valid, weights, breq, bsel, bselc, bntol, bact, bidx, pallas_pack=None
-):
+def _choose_block(avail, nodes, weights, blk, pallas_pack=None):
     """[B] best feasible node (+feasibility flag) for one block of pods.
 
-    With ``pallas_pack`` (node_info, labels_t, taints_t, interpret) the fused
-    Pallas kernel runs (ops/pallas_choose.py — bit-identical results, one
-    VMEM pass); otherwise the xp-generic jnp expression tree.
+    ``blk`` is the pod-side dict sliced to one block.  With ``pallas_pack``
+    (node_info, labels_t, taints_t, interpret) the fused Pallas kernel runs
+    (ops/pallas_choose.py — bit-identical results, one VMEM pass); otherwise
+    the xp-generic jnp expression tree.
     """
     if pallas_pack is not None:
         from .pallas_choose import choose_block_pallas
 
-        node_info, labels_t, taints_t, interpret = pallas_pack
+        node_info, labels_t, taints_t, aff_t, interpret = pallas_pack
         return choose_block_pallas(
-            breq, bsel, bselc, bntol, bact, bidx, node_info, labels_t, taints_t, weights, interpret=interpret
+            blk["pod_req"],
+            blk["pod_sel"],
+            blk["pod_sel_count"],
+            blk["pod_ntol"],
+            blk["pod_aff"],
+            blk["pod_has_aff"],
+            blk["active"],
+            blk["ranks"],
+            node_info,
+            labels_t,
+            taints_t,
+            aff_t,
+            weights,
+            interpret=interpret,
         )
     node_idx = jnp.arange(avail.shape[0], dtype=jnp.uint32)
-    m = feasibility_block(jnp, breq, bsel, bselc, bact, avail, node_labels, node_valid, bntol, node_taints)
-    sc = score_block(jnp, breq, node_alloc, avail, weights, bidx, node_idx)
+    m = feasibility_block(
+        jnp,
+        blk["pod_req"],
+        blk["pod_sel"],
+        blk["pod_sel_count"],
+        blk["active"],
+        avail,
+        nodes["node_labels"],
+        nodes["node_valid"],
+        blk["pod_ntol"],
+        nodes["node_taints"],
+        blk["pod_aff"],
+        blk["pod_has_aff"],
+        nodes["node_aff"],
+    )
+    sc = score_block(jnp, blk["pod_req"], nodes["node_alloc"], avail, weights, blk["ranks"], node_idx)
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
 
-def _choose(
-    avail, active, req, sel, selc, ntol, ranks, n_active, node_alloc, node_labels, node_taints, node_valid, weights,
-    block, use_pallas=False, pallas_interpret=False,
-):
+def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas_interpret=False):
     """Per-pod best feasible node vs current capacity, blockwise over pods.
 
     Never materialises the full [P,N] score matrix: peak live memory is one
     [block, N] tile (HBM-bandwidth friendly; the pipeline analogue of
     SURVEY.md §2b PP).  Pods are compacted (active-first), so only the
     first ``ceil(n_active / block)`` blocks are evaluated — a dynamic bound
-    on a ``lax.while_loop`` over blocks.  ``ranks`` carries each pod's
+    on a ``lax.while_loop`` over blocks.  ``ps["ranks"]`` carries each pod's
     original priority rank into the score-jitter hash.
     """
-    p = req.shape[0]
+    p = ps["pod_req"].shape[0]
 
     pallas_pack = None
     if use_pallas:
         from .pallas_choose import build_node_info
 
         # Rebuilt each round (avail changes); O(N) next to the O(B·N) choose.
-        pallas_pack = (build_node_info(avail, node_alloc, node_valid), node_labels.T, node_taints.T, pallas_interpret)
+        pallas_pack = (
+            build_node_info(avail, nodes["node_alloc"], nodes["node_valid"]),
+            nodes["node_labels"].T,
+            nodes["node_taints"].T,
+            nodes["node_aff"].T,
+            pallas_interpret,
+        )
 
     if block >= p:
-        return _choose_block(
-            avail, node_alloc, node_labels, node_taints, node_valid, weights, req, sel, selc, ntol, active, ranks,
-            pallas_pack,
-        )
+        return _choose_block(avail, nodes, weights, {k: ps[k] for k in _CHOOSE_KEYS}, pallas_pack)
 
     nb_occupied = (n_active + block - 1) // block  # traced; caller pads p % block == 0
 
@@ -130,21 +172,8 @@ def _choose(
     def body(s):
         i, choice, has = s
         lo = i * block
-        bc, bh = _choose_block(
-            avail,
-            node_alloc,
-            node_labels,
-            node_taints,
-            node_valid,
-            weights,
-            lax.dynamic_slice_in_dim(req, lo, block),
-            lax.dynamic_slice_in_dim(sel, lo, block),
-            lax.dynamic_slice_in_dim(selc, lo, block),
-            lax.dynamic_slice_in_dim(ntol, lo, block),
-            lax.dynamic_slice_in_dim(active, lo, block),
-            lax.dynamic_slice_in_dim(ranks, lo, block),
-            pallas_pack,
-        )
+        blk = {k: lax.dynamic_slice_in_dim(ps[k], lo, block) for k in _CHOOSE_KEYS}
+        bc, bh = _choose_block(avail, nodes, weights, blk, pallas_pack)
         choice = lax.dynamic_update_slice_in_dim(choice, bc, lo, axis=0)
         has = lax.dynamic_update_slice_in_dim(has, bh, lo, axis=0)
         return i + 1, choice, has
@@ -153,19 +182,14 @@ def _choose(
     return choice, has
 
 
+def _pad0(v, extra):
+    return jnp.pad(v, ((0, extra),) + ((0, 0),) * (v.ndim - 1))
+
+
 @partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret"))
 def assign_cycle(
-    node_alloc,
-    node_avail,
-    node_labels,
-    node_taints,
-    node_valid,
-    pod_req,
-    pod_sel,
-    pod_sel_count,
-    pod_ntol,
-    pod_prio,
-    pod_valid,
+    nodes: dict,
+    pods: dict,
     weights,
     max_rounds: int = 32,
     block: int = 4096,
@@ -174,23 +198,20 @@ def assign_cycle(
 ):
     """Assign all pending pods to nodes in one on-device cycle.
 
-    Returns (assigned [P] int32 — node index or −1, rounds int32,
-    remaining node_avail [N,2] int32).
+    ``nodes``/``pods`` are the PackedCluster device arrays split by prefix
+    (see :func:`split_device_arrays`).  Returns (assigned [P] int32 — node
+    index or −1, rounds int32, remaining node_avail [N,2] int32).
     """
-    p_out = pod_req.shape[0]
-    n = node_avail.shape[0]
+    p_out = pods["pod_req"].shape[0]
+    n = nodes["node_avail"].shape[0]
 
     # Priority order (priority desc, FIFO index asc); stable sort keeps FIFO.
     # The permutation happens BEFORE any block padding: rank positions feed
     # the score-jitter hash and must equal the native backend's (which never
     # pads) for binding parity — padding first would shift ranks whenever a
     # pod has negative priority.
-    perm = jnp.argsort(-pod_prio, stable=True)
-    req = pod_req[perm]
-    sel = pod_sel[perm]
-    selc = pod_sel_count[perm]
-    ntol = pod_ntol[perm]
-    valid = pod_valid[perm]
+    perm = jnp.argsort(-pods["pod_prio"], stable=True)
+    ps = {k: v[perm] for k, v in pods.items() if k != "pod_prio"}
 
     # Pad the pod axis to a block multiple so the blockwise choose path is
     # always exact — otherwise a remainder would silently materialise the
@@ -199,40 +220,33 @@ def assign_cycle(
     p = p_out
     if block < p and p % block != 0:
         extra = block - p % block
-        req = jnp.pad(req, ((0, extra), (0, 0)))
-        sel = jnp.pad(sel, ((0, extra), (0, 0)))
-        selc = jnp.pad(selc, ((0, extra),))
-        ntol = jnp.pad(ntol, ((0, extra), (0, 0)))
-        valid = jnp.pad(valid, ((0, extra),))
+        ps = {k: _pad0(v, extra) for k, v in ps.items()}
         p = p + extra
 
     # Compaction state: pod arrays are kept active-first; ``ranks`` maps each
     # slot back to its original priority rank (for the jitter hash and the
     # final unpermute).  The initial order (rank order, actives scattered) is
-    # handled by compacting once before the loop via n_active = p.
-    ranks0 = jnp.arange(p, dtype=jnp.uint32)
+    # handled by compacting once before the loop.
+    ps["ranks"] = jnp.arange(p, dtype=jnp.uint32)
+    ps["assigned"] = jnp.full((p,), -1, jnp.int32)
+    ps["active"] = ps.pop("pod_valid")
 
-    def compact(req, sel, selc, ntol, ranks, assigned, active):
-        order = jnp.argsort(~active, stable=True)
-        return req[order], sel[order], selc[order], ntol[order], ranks[order], assigned[order], active[order]
+    def compact(ps):
+        order = jnp.argsort(~ps["active"], stable=True)
+        return {k: v[order] for k, v in ps.items()}
 
-    req, sel, selc, ntol, ranks, assigned0, active0 = compact(
-        req, sel, selc, ntol, ranks0, jnp.full((p,), -1, jnp.int32), valid
-    )
+    ps = compact(ps)
 
     def cond(state):
-        _, _, _, _, _, _, _, _, n_active, rounds = state
+        _, _, n_active, rounds = state
         return (rounds < max_rounds) & (n_active > 0)
 
     def body(state):
-        avail, req, sel, selc, ntol, ranks, assigned, active, n_active, rounds = state
-        choice, has = _choose(
-            avail, active, req, sel, selc, ntol, ranks, n_active, node_alloc, node_labels, node_taints, node_valid,
-            weights, block, use_pallas, pallas_interpret,
-        )
-        cand = active & has
+        avail, ps, n_active, rounds = state
+        choice, has = _choose(avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret)
+        cand = ps["active"] & has
         ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
-        claim = jnp.where(cand[:, None], req, 0)
+        claim = jnp.where(cand[:, None], ps["pod_req"], 0)
 
         # Group claimants per node; the stable sort preserves the compacted
         # (= priority) order among each node's claimants.
@@ -247,18 +261,18 @@ def assign_cycle(
         acc_s = fits_prefix & (ch_s < n)
         accepted = jnp.zeros((p,), bool).at[order].set(acc_s)
 
-        assigned = jnp.where(accepted, choice, assigned)
-        dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], req, 0))
+        ps["assigned"] = jnp.where(accepted, choice, ps["assigned"])
+        dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], ps["pod_req"], 0))
         avail = avail - dec[:n]
-        active = cand & ~accepted
-        req, sel, selc, ntol, ranks, assigned, active = compact(req, sel, selc, ntol, ranks, assigned, active)
-        return avail, req, sel, selc, ntol, ranks, assigned, active, active.sum(dtype=jnp.int32), rounds + 1
+        ps["active"] = cand & ~accepted
+        ps = compact(ps)
+        return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1
 
-    state0 = (node_avail, req, sel, selc, ntol, ranks, assigned0, active0, active0.sum(dtype=jnp.int32), jnp.int32(0))
-    avail, _, _, _, _, ranks, assigned, _, _, rounds = lax.while_loop(cond, body, state0)
+    state0 = (nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32), jnp.int32(0))
+    avail, ps, _, rounds = lax.while_loop(cond, body, state0)
 
     # Undo compaction (rank space), then the priority permutation (original
     # pod order), dropping block padding.
-    assigned_rank = jnp.zeros((p,), jnp.int32).at[ranks].set(assigned)
+    assigned_rank = jnp.zeros((p,), jnp.int32).at[ps["ranks"]].set(ps["assigned"])
     out = jnp.full((p_out,), -1, jnp.int32).at[perm].set(assigned_rank[:p_out])
     return out, rounds, avail

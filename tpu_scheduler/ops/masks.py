@@ -6,7 +6,8 @@ with one [pods × nodes] boolean mask:
   fit[p,n]   = all_r( pod_req[p,r] <= node_avail[n,r] )          (PodFitsResources)
   sel[p,n]   = (pod_sel[p] · node_labels[n]) == pod_sel_count[p] (nodeSelector)
   taint[p,n] = (pod_ntol[p] · node_taints[n]) == 0               (taints/tolerations)
-  mask       = fit & sel & taint & pod_active & node_valid
+  aff[p,n]   = no-affinity or (pod_aff[p] · node_aff[n]) > 0     (node affinity, ORed terms)
+  mask       = fit & sel & taint & aff & pod_active & node_valid
 
 node_valid carries both padding and cordoned (spec.unschedulable) nodes.
 Written against an ``xp`` array namespace (numpy or jax.numpy) so the native
@@ -20,7 +21,19 @@ __all__ = ["feasibility_block"]
 
 
 def feasibility_block(
-    xp, pod_req, pod_sel, pod_sel_count, pod_active, node_avail, node_labels, node_valid, pod_ntol=None, node_taints=None
+    xp,
+    pod_req,
+    pod_sel,
+    pod_sel_count,
+    pod_active,
+    node_avail,
+    node_labels,
+    node_valid,
+    pod_ntol=None,
+    node_taints=None,
+    pod_aff=None,
+    pod_has_aff=None,
+    node_aff=None,
 ):
     """[B, N] feasibility of a block of pods against all nodes.
 
@@ -40,4 +53,9 @@ def feasibility_block(
         # taints land in the pod's not-tolerated set.
         untol = pod_ntol @ node_taints.T
         mask = mask & (untol == 0)
+    if pod_aff is not None and node_aff is not None and pod_has_aff is not None:
+        # Node affinity: terms are ORed — eligible iff the pod has no
+        # affinity, or the node satisfies at least one of its terms.
+        aff_hits = pod_aff @ node_aff.T
+        mask = mask & ((aff_hits > 0) | (pod_has_aff[:, None] == 0))
     return mask

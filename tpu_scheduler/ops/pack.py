@@ -9,10 +9,21 @@ with a one-shot pack of the whole cluster:
   node_avail[N,2]  int32   remaining = allocatable − Σ bound-pod requests
   node_labels[N,L] float32 bitmap over the selector-pair vocabulary
   node_taints[N,T] float32 bitmap over the hard-taint vocabulary
+  node_aff[N,A]    float32 bitmap: node satisfies affinity-term vocab entry
   pod_req[P,2]     int32   pending-pod requests (millicores, KiB ceil)
   pod_sel[P,L]     float32 selector bitmap; pod_sel_count[P] = #selector keys
   pod_ntol[P,T]    float32 1 where the pod does NOT tolerate vocab taint t
+  pod_aff[P,A]     float32 bitmap of the pod's node-affinity terms
+  pod_has_aff[P]   float32 1 if the pod declares required node affinity
   pod_prio[P]      int32   pod priority (commit order tie-break)
+
+Node affinity tensorizes through a *term vocabulary*: each distinct
+nodeSelectorTerm (canonical form, NodeSelectorTerm.key()) among the pending
+pods becomes a column; the full operator semantics (In/NotIn/Exists/
+DoesNotExist/Gt/Lt, core/predicates.py) are evaluated host-side once per
+(term, node) — O(A·N) per node-set change, amortised across cycles — so the
+device check is one matmul: eligible iff no affinity, or
+(pod_aff · node_aff[n]) > 0 (terms are ORed).
 
 Taints tensorize dually to selectors: the vocabulary is the set of hard
 (NoSchedule/NoExecute) taint triples present on nodes; toleration semantics
@@ -58,6 +69,7 @@ __all__ = [
     "repack_incremental",
     "build_selector_vocab",
     "build_taint_vocab",
+    "build_affinity_vocab",
     "round_up",
     "INT32_MAX",
 ]
@@ -87,6 +99,7 @@ class PackedCluster:
     node_avail: np.ndarray  # [N,2] int32 — remaining after bound pods
     node_labels: np.ndarray  # [N,L] float32 — selector-pair bitmap
     node_taints: np.ndarray  # [N,T] float32 — hard-taint bitmap
+    node_aff: np.ndarray  # [N,A] float32 — affinity-term satisfaction bitmap
     node_valid: np.ndarray  # [N]  bool (padding + cordoned nodes are False)
     node_names: tuple[str, ...]  # real nodes only (len = num_nodes)
 
@@ -95,12 +108,15 @@ class PackedCluster:
     pod_sel: np.ndarray  # [P,L] float32
     pod_sel_count: np.ndarray  # [P] float32
     pod_ntol: np.ndarray  # [P,T] float32 — 1 where vocab taint NOT tolerated
+    pod_aff: np.ndarray  # [P,A] float32 — the pod's affinity-term bitmap
+    pod_has_aff: np.ndarray  # [P] float32 — 1 if pod declares node affinity
     pod_prio: np.ndarray  # [P] int32
     pod_valid: np.ndarray  # [P]  bool
     pod_names: tuple[str, ...]  # full names of real pending pods
 
     vocab: dict[tuple[str, str], int]
     taint_vocab: dict[tuple[str, str, str], int]
+    aff_vocab: dict[tuple, int]  # NodeSelectorTerm.key() -> column
 
     @property
     def num_nodes(self) -> int:
@@ -125,11 +141,14 @@ class PackedCluster:
             "node_avail": self.node_avail,
             "node_labels": self.node_labels,
             "node_taints": self.node_taints,
+            "node_aff": self.node_aff,
             "node_valid": self.node_valid,
             "pod_req": self.pod_req,
             "pod_sel": self.pod_sel,
             "pod_sel_count": self.pod_sel_count,
             "pod_ntol": self.pod_ntol,
+            "pod_aff": self.pod_aff,
+            "pod_has_aff": self.pod_has_aff,
             "pod_prio": self.pod_prio,
             "pod_valid": self.pod_valid,
         }
@@ -144,6 +163,62 @@ def build_selector_vocab(pods: list[Pod]) -> dict[tuple[str, str], int]:
                 if kv not in vocab:
                     vocab[kv] = len(vocab)
     return vocab
+
+
+def build_affinity_vocab(pods: list[Pod]) -> dict[tuple, int]:
+    """Vocabulary of canonical node-affinity terms over the pending pods."""
+    vocab: dict[tuple, int] = {}
+    for p in pods:
+        if p.spec is not None and p.spec.node_affinity:
+            for term in p.spec.node_affinity:
+                k = term.key()
+                if k not in vocab:
+                    vocab[k] = len(vocab)
+    return vocab
+
+
+def _term_from_key(key: tuple):
+    from ..api.objects import LabelSelectorRequirement, NodeSelectorTerm
+
+    return NodeSelectorTerm(
+        match_expressions=[
+            LabelSelectorRequirement(key=k, operator=op, values=list(vals) if vals else None) for k, op, vals in key
+        ]
+    )
+
+
+def _pack_node_affinity(nodes, aff_vocab: dict, n_pad: int, a_pad: int) -> np.ndarray:
+    """[N,A] node-satisfies-term bitmap, host-evaluated with the full scalar
+    operator semantics (core/predicates.node_selector_term_matches)."""
+    from ..core.predicates import node_selector_term_matches
+
+    node_aff = np.zeros((n_pad, a_pad), dtype=np.float32)
+    if not aff_vocab:
+        return node_aff
+    terms = [(idx, _term_from_key(key)) for key, idx in aff_vocab.items()]
+    for i, node in enumerate(nodes):
+        labels = node.metadata.labels
+        for j, term in terms:
+            if node_selector_term_matches(term, labels):
+                node_aff[i, j] = 1.0
+    return node_aff
+
+
+def _pack_affinity(pending: list[Pod], aff_vocab: dict, p_pad: int, a_pad: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pod-side affinity bitmaps ([P,A] term membership, [P] has-affinity)."""
+    pod_aff = np.zeros((p_pad, a_pad), dtype=np.float32)
+    pod_has = np.zeros((p_pad,), dtype=np.float32)
+    for i, pod in enumerate(pending):
+        terms = (pod.spec.node_affinity or []) if pod.spec is not None else []
+        if not terms:
+            continue
+        pod_has[i] = 1.0
+        for term in terms:
+            j = aff_vocab.get(term.key())
+            if j is None:
+                raise KeyError(f"affinity term {term.key()} missing from supplied aff_vocab")
+            pod_aff[i, j] = 1.0
+    return pod_aff, pod_has
 
 
 def build_taint_vocab(nodes) -> dict[tuple[str, str, str], int]:
@@ -170,11 +245,29 @@ def _pack_ntol(pending: list[Pod], taint_vocab: dict, p_pad: int, t_pad: int) ->
     if not taint_vocab:
         return ntol
     triples = [(idx, Taint(key=k, value=v, effect=e)) for (k, v, e), idx in taint_vocab.items()]
+
+    # Most pods share a handful of toleration lists (or none at all, whose
+    # row is all-ones over the vocab); cache rows by toleration content so
+    # the per-cycle incremental repack stays O(P) instead of O(P·T) Python.
+    default_row = np.zeros((t_pad,), dtype=np.float32)
+    for j, _ in triples:
+        default_row[j] = 1.0
+    rows: dict[tuple, np.ndarray] = {}
+
+    def row_for(tolerations) -> np.ndarray:
+        key = tuple((t.key, t.operator, t.value, t.effect) for t in tolerations)
+        row = rows.get(key)
+        if row is None:
+            row = np.zeros((t_pad,), dtype=np.float32)
+            for j, taint in triples:
+                if not any(t.tolerates(taint) for t in tolerations):
+                    row[j] = 1.0
+            rows[key] = row
+        return row
+
     for i, pod in enumerate(pending):
         tolerations = (pod.spec.tolerations or []) if pod.spec is not None else []
-        for j, taint in triples:
-            if not any(t.tolerates(taint) for t in tolerations):
-                ntol[i, j] = 1.0
+        ntol[i] = row_for(tolerations) if tolerations else default_row
     return ntol
 
 
@@ -217,6 +310,7 @@ def pack_snapshot(
     label_block: int = 8,
     vocab: dict[tuple[str, str], int] | None = None,
     taint_vocab: dict[tuple[str, str, str], int] | None = None,
+    aff_vocab: dict[tuple, int] | None = None,
 ) -> PackedCluster:
     """Pack a snapshot into static-shape tensors.
 
@@ -237,10 +331,14 @@ def pack_snapshot(
     if taint_vocab is None:
         taint_vocab = build_taint_vocab(nodes)
     t_pad = round_up(len(taint_vocab), label_block)
+    if aff_vocab is None:
+        aff_vocab = build_affinity_vocab(pending)
+    a_pad = round_up(len(aff_vocab), label_block)
 
     alloc64, used64, _ = _alloc_and_used64(snapshot, n_pad)
     node_labels = np.zeros((n_pad, l_pad), dtype=np.float32)
     node_taints = np.zeros((n_pad, t_pad), dtype=np.float32)
+    node_aff = _pack_node_affinity(nodes, aff_vocab, n_pad, a_pad)
     node_valid = np.zeros((n_pad,), dtype=bool)
     from ..core.predicates import HARD_TAINT_EFFECTS
 
@@ -265,17 +363,22 @@ def pack_snapshot(
 
     pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad)
     pod_ntol = _pack_ntol(pending, taint_vocab, p_pad, t_pad)
+    pod_aff, pod_has_aff = _pack_affinity(pending, aff_vocab, p_pad, a_pad)
 
     return PackedCluster(
         node_alloc=node_alloc,
         node_avail=node_avail,
         node_labels=node_labels,
         node_taints=node_taints,
+        node_aff=node_aff,
         node_valid=node_valid,
         node_names=tuple(n.name for n in nodes),
         vocab=dict(vocab),
         taint_vocab=dict(taint_vocab),
+        aff_vocab=dict(aff_vocab),
         pod_ntol=pod_ntol,
+        pod_aff=pod_aff,
+        pod_has_aff=pod_has_aff,
         **pod_tensors,
     )
 
@@ -346,4 +449,12 @@ def repack_incremental(packed: PackedCluster, snapshot: ClusterSnapshot, pod_blo
     p_pad = max(packed.padded_pods, round_up(len(pending), pod_block))
     pod_tensors = _pack_pods(pending, packed.vocab, p_pad, packed.pod_sel.shape[1])
     pod_ntol = _pack_ntol(pending, packed.taint_vocab, p_pad, packed.node_taints.shape[1])
-    return replace(packed, node_avail=_avail_i32(alloc64, used64), pod_ntol=pod_ntol, **pod_tensors)
+    pod_aff, pod_has_aff = _pack_affinity(pending, packed.aff_vocab, p_pad, packed.node_aff.shape[1])
+    return replace(
+        packed,
+        node_avail=_avail_i32(alloc64, used64),
+        pod_ntol=pod_ntol,
+        pod_aff=pod_aff,
+        pod_has_aff=pod_has_aff,
+        **pod_tensors,
+    )

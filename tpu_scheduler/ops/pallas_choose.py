@@ -64,11 +64,14 @@ def _choose_kernel(
     sel_ref,  # [BP, L] f32
     selc_ref,  # [BP, 1] f32
     ntol_ref,  # [BP, T] f32  (1 where vocab taint NOT tolerated)
+    aff_ref,  # [BP, A] f32  (the pod's affinity-term bitmap)
+    hasaff_ref,  # [BP, 1] f32  (1 if the pod declares node affinity)
     act_ref,  # [BP, 1] i32
     idx_ref,  # [BP, 1] u32  (priority ranks, jitter hash input)
     info_ref,  # [8, TN] i32  (node resources, see ROW_*)
     labels_ref,  # [L, TN] f32
     taints_ref,  # [T, TN] f32
+    aff_t_ref,  # [A, TN] f32  (node satisfies affinity-term bitmap, transposed)
     choice_ref,  # [BP, 1] i32 out
     has_ref,  # [BP, 1] i32 out
     best_ref,  # [BP, 1] f32 scratch
@@ -103,7 +106,11 @@ def _choose_kernel(
     untol = jnp.dot(ntol_ref[:], taints_ref[:], preferred_element_type=f32)  # [BP, TN]
     taint_ok = untol == f32(0.0)
 
-    mask = fit & sel_ok & taint_ok & (valid > 0) & (act_ref[:] > 0)
+    # node affinity — ORed terms: eligible iff no affinity or >=1 term hit.
+    aff_hits = jnp.dot(aff_ref[:], aff_t_ref[:], preferred_element_type=f32)  # [BP, TN]
+    aff_ok = (aff_hits > f32(0.0)) | (hasaff_ref[:] == f32(0.0))
+
+    mask = fit & sel_ok & taint_ok & aff_ok & (valid > 0) & (act_ref[:] > 0)
 
     # LeastRequested + BalancedAllocation — same op order as ops/score.py.
     used_cpu = (alloc[0:1, :] - avail[0:1, :]) + req_cpu  # [BP, TN] i32
@@ -147,11 +154,14 @@ def choose_block_pallas(
     sel,  # [B, L] f32
     selc,  # [B] f32
     ntol,  # [B, T] f32
+    aff,  # [B, A] f32
+    has_aff,  # [B] f32
     act,  # [B] bool
     ranks,  # [B] u32
     node_info,  # [8, N] i32 (build_node_info)
     labels_t,  # [L, N] f32
     taints_t,  # [T, N] f32
+    aff_t,  # [A, N] f32
     weights,  # [3] f32
     pod_tile: int = 256,
     node_tile: int = 512,
@@ -165,6 +175,7 @@ def choose_block_pallas(
     b, n = req.shape[0], node_info.shape[1]
     l = sel.shape[1]
     t = ntol.shape[1]
+    a_dim = aff.shape[1]
     bp = min(pod_tile, max(8, b))
     pb = -(-b // bp)
     nbt = -(-n // node_tile)
@@ -175,12 +186,15 @@ def choose_block_pallas(
         sel = jnp.pad(sel, ((0, b_pad - b), (0, 0)))
         selc = jnp.pad(selc, ((0, b_pad - b),))
         ntol = jnp.pad(ntol, ((0, b_pad - b), (0, 0)))
+        aff = jnp.pad(aff, ((0, b_pad - b), (0, 0)))
+        has_aff = jnp.pad(has_aff, ((0, b_pad - b),))
         act = jnp.pad(act, ((0, b_pad - b),))
         ranks = jnp.pad(ranks, ((0, b_pad - b),))
     if n_pad != n:
         node_info = jnp.pad(node_info, ((0, 0), (0, n_pad - n)))
         labels_t = jnp.pad(labels_t, ((0, 0), (0, n_pad - n)))
         taints_t = jnp.pad(taints_t, ((0, 0), (0, n_pad - n)))
+        aff_t = jnp.pad(aff_t, ((0, 0), (0, n_pad - n)))
 
     w = jnp.pad(weights.astype(jnp.float32), (0, 1)).reshape(1, 4)
 
@@ -194,11 +208,14 @@ def choose_block_pallas(
             pl.BlockSpec((bp, l), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, t), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, a_dim), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((8, node_tile), lambda i, j: (0, j)),
             pl.BlockSpec((l, node_tile), lambda i, j: (0, j)),
             pl.BlockSpec((t, node_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((a_dim, node_tile), lambda i, j: (0, j)),
         ],
         out_specs=[
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
@@ -219,10 +236,13 @@ def choose_block_pallas(
         sel,
         selc.reshape(-1, 1),
         ntol,
+        aff,
+        has_aff.astype(jnp.float32).reshape(-1, 1),
         act.astype(jnp.int32).reshape(-1, 1),
         ranks.astype(jnp.uint32).reshape(-1, 1),
         node_info,
         labels_t,
         taints_t,
+        aff_t,
     )
     return choice[:b, 0], has[:b, 0].astype(bool)

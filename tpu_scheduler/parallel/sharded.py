@@ -47,13 +47,16 @@ __all__ = ["sharded_assign_cycle", "ShardedBackend"]
 
 
 def _local_choose(
-    avail, active, req, sel, selc, ntol, node_alloc, node_labels, node_taints, node_valid, weights, pod_idx, node_idx
+    avail, active, req, sel, selc, ntol, aff, has_aff, node_alloc, node_labels, node_taints, node_aff, node_valid,
+    weights, pod_idx, node_idx,
 ):
     """Best local node per pod of this shard: (best_score, local idx, has).
 
     ``pod_idx``/``node_idx`` are *global* (rank-space) indices so the score
     jitter hash matches the single-device path exactly."""
-    m = feasibility_block(jnp, req, sel, selc, active, avail, node_labels, node_valid, ntol, node_taints)
+    m = feasibility_block(
+        jnp, req, sel, selc, active, avail, node_labels, node_valid, ntol, node_taints, aff, has_aff, node_aff
+    )
     sc = score_block(jnp, req, node_alloc, avail, weights, pod_idx, node_idx)
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.max(sc, axis=1), jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
@@ -66,7 +69,10 @@ def _build_sharded_fn(mesh, max_rounds: int):
     dp = mesh.shape["dp"]
     tp = mesh.shape["tp"]
 
-    def local_fn(node_alloc, node_avail, node_labels, node_taints, node_valid, req, sel, selc, ntol, valid, w):
+    def local_fn(
+        node_alloc, node_avail, node_labels, node_taints, node_aff, node_valid, req, sel, selc, ntol, aff, has_aff,
+        valid, w,
+    ):
         p_local = req.shape[0]
         n_local = node_avail.shape[0]
         p_tot = p_local * dp
@@ -86,8 +92,8 @@ def _build_sharded_fn(mesh, max_rounds: int):
 
             # 1. choose: local tile, then argmax across the tp axis.
             best_l, idx_l, _ = _local_choose(
-                avail, active, req, sel, selc, ntol, node_alloc, node_labels, node_taints, node_valid, w,
-                g_pod_idx, g_node_idx,
+                avail, active, req, sel, selc, ntol, aff, has_aff, node_alloc, node_labels, node_taints, node_aff,
+                node_valid, w, g_pod_idx, g_node_idx,
             )
             bests = lax.all_gather(best_l, "tp")  # [tp, p_local]
             idxs = lax.all_gather(idx_l + node_base, "tp")
@@ -144,11 +150,14 @@ def _build_sharded_fn(mesh, max_rounds: int):
             P("tp", None),  # node_avail
             P("tp", None),  # node_labels
             P("tp", None),  # node_taints
+            P("tp", None),  # node_aff
             P("tp"),  # node_valid
             P("dp", None),  # pod_req
             P("dp", None),  # pod_sel
             P("dp"),  # pod_sel_count
             P("dp", None),  # pod_ntol
+            P("dp", None),  # pod_aff
+            P("dp"),  # pod_has_aff
             P("dp"),  # pod_valid (already priority-permuted)
             P(),  # weights
         ),
@@ -168,6 +177,8 @@ def _build_sharded_fn(mesh, max_rounds: int):
         sel = a["pod_sel"][perm]
         selc = a["pod_sel_count"][perm]
         ntol = a["pod_ntol"][perm]
+        aff = a["pod_aff"][perm]
+        has_aff = a["pod_has_aff"][perm]
         valid = a["pod_valid"][perm]
         extra = (-p_tot) % dp
         if extra:
@@ -175,17 +186,22 @@ def _build_sharded_fn(mesh, max_rounds: int):
             sel = jnp.pad(sel, ((0, extra), (0, 0)))
             selc = jnp.pad(selc, ((0, extra),))
             ntol = jnp.pad(ntol, ((0, extra), (0, 0)))
+            aff = jnp.pad(aff, ((0, extra), (0, 0)))
+            has_aff = jnp.pad(has_aff, ((0, extra),))
             valid = jnp.pad(valid, ((0, extra),))
         assigned_p, rounds, avail = sharded(
             a["node_alloc"],
             a["node_avail"],
             a["node_labels"],
             a["node_taints"],
+            a["node_aff"],
             a["node_valid"],
             req,
             sel,
             selc,
             ntol,
+            aff,
+            has_aff,
             valid,
             w,
         )
@@ -220,7 +236,7 @@ class ShardedBackend(SchedulingBackend):
             # Node padding to the tp multiple happens here; pod padding to the dp
             # multiple happens inside the jitted run, after the priority permute.
             n_pad = round_up(packed.padded_nodes, tp)
-            for k in ("node_alloc", "node_avail", "node_labels", "node_taints"):
+            for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff"):
                 a[k] = np.pad(a[k], ((0, n_pad - packed.padded_nodes), (0, 0)))
             a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - packed.padded_nodes),))
             assigned, rounds, _avail = sharded_assign_cycle(self.mesh, a, packed_weights(profile), profile.max_rounds)

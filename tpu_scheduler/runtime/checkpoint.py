@@ -53,6 +53,10 @@ def save_scheduler(scheduler, path: str) -> None:
     if packed is not None:
         state["vocab"] = [[k, v, i] for (k, v), i in packed.vocab.items()]
         state["taint_vocab"] = [[k, v, e, i] for (k, v, e), i in packed.taint_vocab.items()]
+        # affinity-term keys are tuples of (key, op, values-tuple) triples
+        state["aff_vocab"] = [
+            [[[k, op, list(vals)] for k, op, vals in key], i] for key, i in packed.aff_vocab.items()
+        ]
         state["node_names"] = list(packed.node_names)
         fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
         with os.fdopen(fd, "wb") as f:  # file object: savez can't append ".npz"
@@ -62,6 +66,7 @@ def save_scheduler(scheduler, path: str) -> None:
                 node_avail=packed.node_avail,
                 node_labels=packed.node_labels,
                 node_taints=packed.node_taints,
+                node_aff=packed.node_aff,
                 node_valid=packed.node_valid,
             )
         os.replace(tmp, os.path.join(path, _TENSORS_FILE))
@@ -101,12 +106,18 @@ def restore_scheduler(scheduler, path: str) -> bool:
         with np.load(tensors_path) as z:
             vocab = {(k, v): i for k, v, i in state["vocab"]}
             taint_vocab = {(k, v, e): i for k, v, e, i in state.get("taint_vocab", [])}
+            aff_vocab = {
+                tuple((k, op, tuple(vals)) for k, op, vals in key): i for key, i in state.get("aff_vocab", [])
+            }
             n_pad = z["node_alloc"].shape[0]
             consistent = (
                 z["node_avail"].shape == z["node_alloc"].shape == (n_pad, 2)
                 and z["node_labels"].shape[0] == n_pad
                 and "node_taints" in z
                 and z["node_taints"].shape[0] == n_pad
+                and "node_aff" in z
+                and z["node_aff"].shape[0] == n_pad
+                and len(aff_vocab) <= z["node_aff"].shape[1]
                 and z["node_valid"].shape == (n_pad,)
                 and len(vocab) <= z["node_labels"].shape[1]
                 and len(taint_vocab) <= z["node_taints"].shape[1]
@@ -123,16 +134,20 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 node_avail=z["node_avail"],
                 node_labels=z["node_labels"],
                 node_taints=z["node_taints"],
+                node_aff=z["node_aff"],
                 node_valid=z["node_valid"],
                 node_names=tuple(state.get("node_names", [])),
                 pod_req=np.zeros((p, 2), np.int32),
                 pod_sel=np.zeros((p, z["node_labels"].shape[1]), np.float32),
                 pod_sel_count=np.zeros((p,), np.float32),
                 pod_ntol=np.zeros((p, z["node_taints"].shape[1]), np.float32),
+                pod_aff=np.zeros((p, z["node_aff"].shape[1]), np.float32),
+                pod_has_aff=np.zeros((p,), np.float32),
                 pod_prio=np.zeros((p,), np.int32),
                 pod_valid=np.zeros((p,), bool),
                 pod_names=(),
                 vocab=vocab,
                 taint_vocab=taint_vocab,
+                aff_vocab=aff_vocab,
             )
     return True

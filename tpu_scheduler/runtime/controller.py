@@ -34,13 +34,11 @@ from itertools import chain, groupby
 from ..api.objects import Node, ObjectReference, Pod, PodResources, PodSpec, full_name, is_pod_bound, total_pod_resources
 from ..backends.base import SchedulingBackend
 from ..core.predicates import (
+    NODE_LOCAL_PREDICATES,
     InvalidNodeReason,
     anti_affinity_ok,
     make_affinity_checker,
     make_spread_checker,
-    node_schedulable,
-    node_selector_matches,
-    taints_tolerated,
     term_matches,
     topology_spread_ok,
 )
@@ -148,6 +146,12 @@ class Scheduler:
                 for p in pending
                 if p.spec is not None and p.spec.node_selector
                 for kv in p.spec.node_selector.items()
+            )
+            and all(
+                term.key() in self._packed.aff_vocab
+                for p in pending
+                if p.spec is not None and p.spec.node_affinity
+                for term in p.spec.node_affinity
             )
         ):
             try:
@@ -405,12 +409,9 @@ class Scheduler:
         req = total_pod_resources(pod)
         if not (req.cpu <= available.cpu and req.memory <= available.memory):
             return InvalidNodeReason.NOT_ENOUGH_RESOURCES
-        if not node_selector_matches(pod, node):
-            return InvalidNodeReason.NODE_SELECTOR_MISMATCH
-        if not node_schedulable(pod, node):
-            return InvalidNodeReason.NODE_UNSCHEDULABLE
-        if not taints_tolerated(pod, node):
-            return InvalidNodeReason.TAINT_NOT_TOLERATED
+        for reason, pred in NODE_LOCAL_PREDICATES:
+            if not pred(pod, node, snapshot):
+                return reason
         affinity_fine = (
             affinity_checker(node) if affinity_checker is not None else anti_affinity_ok(pod, node, snapshot, extra_placed=placed)
         )

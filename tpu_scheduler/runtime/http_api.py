@@ -173,7 +173,11 @@ class KubeApiClient:
         if body is not None:
             payload = json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
-        for attempt in (0, 1):
+        # Only idempotent GETs are auto-retried: a POST whose connection
+        # died after the request was sent may already have been processed
+        # (a re-sent binding would then surface as a spurious 409).
+        retries = (0, 1) if method == "GET" else (1,)
+        for attempt in retries:
             if self._conn is None:
                 self._conn = self._connect()
             try:

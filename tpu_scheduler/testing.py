@@ -9,7 +9,9 @@ import random
 
 from .api.objects import (
     Container,
+    LabelSelectorRequirement,
     Node,
+    NodeSelectorTerm,
     NodeSpec,
     NodeStatus,
     ObjectMeta,
@@ -62,6 +64,7 @@ def make_pod(
     anti_affinity: list[PodAntiAffinityTerm] | None = None,
     topology_spread: list[TopologySpreadConstraint] | None = None,
     tolerations: list[Toleration] | None = None,
+    node_affinity: list[NodeSelectorTerm] | None = None,
 ) -> Pod:
     return Pod(
         metadata=ObjectMeta(name=name, namespace=namespace, labels=labels),
@@ -75,6 +78,7 @@ def make_pod(
             anti_affinity=anti_affinity,
             topology_spread=topology_spread,
             tolerations=tolerations,
+            node_affinity=node_affinity,
         ),
         status=PodStatus(phase=phase),
     )
@@ -91,6 +95,7 @@ def synth_cluster(
     spread_fraction: float = 0.0,
     tainted_fraction: float = 0.0,
     cordoned_fraction: float = 0.0,
+    node_affinity_fraction: float = 0.0,
 ) -> ClusterSnapshot:
     """Generate a synthetic cluster snapshot.
 
@@ -104,7 +109,9 @@ def synth_cluster(
     topology-spread constraint over their ``app`` label (config 5 shapes).
     ``tainted_fraction`` of nodes carry a NoSchedule pool taint which the
     pods destined for that pool tolerate; ``cordoned_fraction`` are
-    cordoned (spec.unschedulable).
+    cordoned (spec.unschedulable).  ``node_affinity_fraction`` of pending
+    pods carry required node affinity exercising every operator (In/NotIn/
+    Exists/DoesNotExist/Gt/Lt over zone/pool/slot labels, ORed terms).
     """
     rng = random.Random(seed)
     if n_nodes == 0:
@@ -117,6 +124,7 @@ def synth_cluster(
             "zone": _ZONES[i % len(_ZONES)],
             "pool": pool,
             "name": f"node-{i}",
+            "slot": str(i % 16),  # numeric label for Gt/Lt affinity
         }
         taints = [Taint(key="pool", value=pool, effect="NoSchedule")] if rng.random() < tainted_fraction else None
         cordoned = rng.random() < cordoned_fraction
@@ -150,6 +158,32 @@ def synth_cluster(
         spread = None
         if rng.random() < spread_fraction:
             spread = [TopologySpreadConstraint(topology_key="zone", max_skew=rng.choice([1, 2]), match_labels={"app": app})]
+        node_aff = None
+        if rng.random() < node_affinity_fraction:
+            choice = rng.randrange(5)
+            if choice == 0:
+                exprs = [LabelSelectorRequirement(key="zone", operator="In", values=rng.sample(_ZONES, 2))]
+            elif choice == 1:
+                exprs = [LabelSelectorRequirement(key="pool", operator="NotIn", values=[rng.choice(_POOLS)])]
+            elif choice == 2:
+                exprs = [LabelSelectorRequirement(key="slot", operator="Gt", values=[str(rng.randrange(12))])]
+            elif choice == 3:
+                exprs = [
+                    LabelSelectorRequirement(key="slot", operator="Lt", values=[str(rng.randrange(4, 16))]),
+                    LabelSelectorRequirement(key="zone", operator="Exists"),
+                ]
+            else:
+                exprs = [LabelSelectorRequirement(key="missing-key", operator="DoesNotExist")]
+            terms = [NodeSelectorTerm(match_expressions=exprs)]
+            if rng.random() < 0.3:  # second ORed term
+                terms.append(
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            LabelSelectorRequirement(key="zone", operator="In", values=[rng.choice(_ZONES)])
+                        ]
+                    )
+                )
+            node_aff = terms
         tols = None
         if tainted_fraction and rng.random() < 0.5:
             # Half the pods tolerate one pool's taint (Equal) or all taints (Exists).
@@ -167,6 +201,7 @@ def synth_cluster(
             anti_affinity=anti,
             topology_spread=spread,
             tolerations=tols,
+            node_affinity=node_aff,
         )
         if rng.random() < multi_container_fraction:
             pod.spec.containers.append(
