@@ -38,23 +38,65 @@ class TpuBackend(SchedulingBackend):
         # Mosaic/TPU-only; every other platform runs the jnp path (tests
         # exercise the kernel itself in interpreter mode).
         self.use_pallas = (device.platform == "tpu") if use_pallas is None else use_pallas
+        # Until the fused kernel survives one real Mosaic compile+run on this
+        # device, a pallas failure downgrades to the jnp path instead of
+        # killing the cycle: Mosaic lowering errors are *not*
+        # JaxRuntimeError subclasses, so they would otherwise bypass the
+        # BackendUnavailable→native fallback on the flagship platform.
+        self._pallas_proven = False
+        self._pallas_strikes = 0
+
+    def _assign_once(self, packed: PackedCluster, profile: SchedulingProfile, use_pallas: bool):
+        jax = self._jax
+        a = packed.device_arrays()
+        put = {k: jax.device_put(v, self.device) for k, v in a.items()}
+        weights = jax.device_put(profile.weights(), self.device)
+        nodes, pods = split_device_arrays(put)
+        assigned, rounds, _avail = assign_cycle(
+            nodes,
+            pods,
+            weights,
+            max_rounds=profile.max_rounds,
+            block=profile.pod_block,
+            use_pallas=use_pallas,
+        )
+        return np.asarray(jax.device_get(assigned)), int(rounds)
 
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         jax = self._jax
+        if self.use_pallas and not self._pallas_proven:
+            try:
+                result = self._assign_once(packed, profile, use_pallas=True)
+                self._pallas_proven = True
+                return result
+            except Exception as e:  # noqa: BLE001 — first-compile guard, see __init__
+                import logging
+
+                log = logging.getLogger("tpu_scheduler.backend")
+                if isinstance(e, jax.errors.JaxRuntimeError):
+                    # Could be either a Mosaic compile rejection or a
+                    # transient device fault — indistinguishable without
+                    # parsing messages.  Strike-based: fall back to native
+                    # for this cycle (BackendUnavailable), keep pallas armed;
+                    # a deterministic compile failure strikes again next
+                    # cycle and is then disabled, while a transient device
+                    # fault clears and pallas proves itself.
+                    self._pallas_strikes += 1
+                    if self._pallas_strikes >= 2:
+                        log.warning("pallas kernel failed %d first-use attempts; disabling pallas", self._pallas_strikes)
+                        self.use_pallas = False
+                    raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
+                # Non-runtime exceptions (tracing/lowering errors) are
+                # deterministic kernel bugs — disable immediately and serve
+                # the cycle via the jnp path on the same device.
+                log.warning(
+                    "pallas choose kernel failed on first use (%s: %s); disabling pallas, retrying jnp path",
+                    type(e).__name__,
+                    e,
+                )
+                self.use_pallas = False
         try:
-            a = packed.device_arrays()
-            put = {k: jax.device_put(v, self.device) for k, v in a.items()}
-            weights = jax.device_put(profile.weights(), self.device)
-            nodes, pods = split_device_arrays(put)
-            assigned, rounds, _avail = assign_cycle(
-                nodes,
-                pods,
-                weights,
-                max_rounds=profile.max_rounds,
-                block=profile.pod_block,
-                use_pallas=self.use_pallas,
-            )
-            return np.asarray(jax.device_get(assigned)), int(rounds)
+            return self._assign_once(packed, profile, use_pallas=self.use_pallas)
         except jax.errors.JaxRuntimeError as e:
             # Device-runtime failure (OOM, device lost, …) — the recovery
             # scenario the native fallback exists for (SURVEY.md §5).  Python

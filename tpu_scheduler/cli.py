@@ -35,6 +35,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bound-pods", type=int, default=0, help="synthetic cluster: pre-bound pods")
     p.add_argument("--seed", type=int, default=0, help="synthetic cluster seed")
     p.add_argument("--cycles", type=int, default=None, help="max scheduling cycles (default: run until settled)")
+    p.add_argument("--daemon", action="store_true", help="serve forever: never exit on settle, idle between cycles (reference main.rs:146-149)")
+    p.add_argument("--interval", type=float, default=1.0, help="daemon mode: idle sleep between settled cycles (seconds)")
     p.add_argument("--attempts", type=int, default=ATTEMPTS, help="sample policy: candidates per pod (reference ATTEMPTS)")
     p.add_argument("--requeue-seconds", type=float, default=REQUEUE_SECONDS, help="failed-pod requeue delay")
     p.add_argument("--no-fallback", action="store_true", help="disable tpu->native failure fallback")
@@ -83,6 +85,9 @@ def main(argv: list[str] | None = None) -> int:
         from .runtime.checkpoint import restore_scheduler
 
         restore_scheduler(sched, args.checkpoint_dir)
+    # Counters restored from a checkpoint are all-time totals; remember the
+    # starting point so the summary line reports *this run's* work.
+    counters_at_start = sched.metrics.snapshot()
 
     http_server = None
     if args.http_port is not None:
@@ -98,7 +103,13 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         with device_profile(args.profile_dir):
-            metrics = sched.run(max_cycles=args.cycles, until_settled=args.cycles is None)
+            if args.daemon:
+                try:
+                    metrics = sched.run(max_cycles=args.cycles, daemon_interval=args.interval)
+                except KeyboardInterrupt:
+                    metrics = []  # per-cycle history not kept in daemon mode; counters survive below
+            else:
+                metrics = sched.run(max_cycles=args.cycles, until_settled=args.cycles is None)
     finally:
         if args.checkpoint_dir:
             from .runtime.checkpoint import save_scheduler
@@ -109,15 +120,19 @@ def main(argv: list[str] | None = None) -> int:
 
     for m in metrics:
         print(m.to_json())
-    total_bound = sum(m.bound for m in metrics)
+    counters = sched.metrics.snapshot()
+    # In daemon mode the per-cycle history is truncated (and empty after a
+    # Ctrl-C), so this run's totals come from counter deltas vs startup
+    # (checkpoint restore pre-loads all-time totals).
+    run_total = lambda name: counters.get(name, 0) - counters_at_start.get(name, 0)  # noqa: E731
     summary = {
         "summary": True,
         "backend": args.backend,
         "policy": args.policy,
-        "cycles": len(metrics),
-        "bound_total": total_bound,
+        "cycles": run_total("scheduler_cycles_total"),
+        "bound_total": run_total("scheduler_pods_bound_total"),
         "unschedulable_last": metrics[-1].unschedulable if metrics else 0,
-        "counters": sched.metrics.snapshot(),
+        "counters": counters,
     }
     print(json.dumps(summary))
     return 0

@@ -24,6 +24,7 @@ Shared semantics with the reference:
 
 from __future__ import annotations
 
+import http.client
 import logging
 import random
 import time
@@ -87,12 +88,13 @@ class Scheduler:
         self.rng = rng or random.Random()
         self.pod_block = pod_block
         self.node_block = node_block
-        self.reflector = ClusterReflector(api)
+        self.reflector = ClusterReflector(api, clock=clock)
         self.metrics = MetricsRegistry()
         self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
         self._cycle_count = 0
         self._packed = None
         self._node_sig = None
+        self._watch_errors_folded = 0
 
     # -- eligibility -------------------------------------------------------
 
@@ -129,6 +131,15 @@ class Scheduler:
                 logger.info("pod %s already bound; skipping", pod_full)
                 return False
             self._requeue(pod_full, f"api-error: {e}")
+            return False
+        except (OSError, http.client.HTTPException) as e:
+            # Transport/protocol failure mid-POST (dropped keep-alive,
+            # refused connection, server died mid-response →
+            # IncompleteRead/BadStatusLine): KubeApiClient deliberately does
+            # not auto-retry POSTs, so the error surfaces here — requeue
+            # this one pod instead of crashing the whole cycle
+            # (error_policy, main.rs:122-125).
+            self._requeue(pod_full, f"network-error: {type(e).__name__}: {e}")
             return False
 
     # -- batch policy ------------------------------------------------------
@@ -450,6 +461,13 @@ class Scheduler:
         with trace:
             with span("sync"):
                 self.reflector.sync()
+                err_delta = self.reflector.errors_seen - self._watch_errors_folded
+                if err_delta:
+                    # Watch failures become metrics, not crashes (the
+                    # reference drops them from the stream, main.rs:138);
+                    # the cycle proceeds on last-known reflector state.
+                    self.metrics.inc("scheduler_watch_errors_total", err_delta)
+                    self._watch_errors_folded = self.reflector.errors_seen
                 snapshot = self.reflector.snapshot()
             pending_all = snapshot.pending_pods()
             pending = self._eligible(pending_all)
@@ -496,13 +514,61 @@ class Scheduler:
         self.metrics.observe_cycle(m)
         return m
 
-    def run(self, max_cycles: int | None = None, until_settled: bool = False) -> list[CycleMetrics]:
+    def run(
+        self,
+        max_cycles: int | None = None,
+        until_settled: bool = False,
+        daemon_interval: float | None = None,
+        stop_event=None,
+        sleep=time.sleep,
+    ) -> list[CycleMetrics]:
         """Run cycles; with ``until_settled`` stop once a cycle binds nothing
-        and nothing new is pending (the steady state a test/bench wants)."""
+        and nothing new is pending (the steady state a test/bench wants).
+
+        ``daemon_interval`` switches to long-running daemon mode — the shape
+        the reference's ``tokio::select!`` loop serves (main.rs:146-149):
+        never exit on settle; after an idle cycle (nothing bound), sleep the
+        interval before polling the watches again instead of hot-spinning.
+        ``stop_event`` (a ``threading.Event``) requests a clean exit between
+        cycles.
+
+        A run may not settle on stale state: with ``until_settled``, an idle
+        cycle whose watches are erroring/backing off does NOT count as
+        settled (otherwise a transient API-server outage at startup would
+        exit 0 having scheduled nothing) — the loop rides out the backoff up
+        to ``settle_timeout`` seconds of consecutive unhealthy idling, then
+        fails loudly."""
         out = []
-        while max_cycles is None or len(out) < max_cycles:
+        ran = 0
+        settle_timeout = 60.0
+        unhealthy_idle = 0.0
+        while max_cycles is None or ran < max_cycles:
+            if stop_event is not None and stop_event.is_set():
+                break
             m = self.run_cycle()
             out.append(m)
-            if until_settled and m.bound == 0:
-                break
+            ran += 1
+            if daemon_interval is not None:
+                if len(out) > 256:
+                    del out[0]  # bounded history — a daemon runs unbounded cycles
+                if m.bound == 0:
+                    if stop_event is not None:
+                        stop_event.wait(daemon_interval)
+                    else:
+                        sleep(daemon_interval)
+            elif until_settled and m.bound == 0:
+                if self.reflector.healthy:
+                    break
+                # Sleep out the backoff window instead of spinning no-op
+                # cycles against the same stale snapshot.
+                wait = min(5.0, max(0.05, self.reflector.seconds_until_retry(self.clock())))
+                unhealthy_idle += wait
+                if unhealthy_idle >= settle_timeout:
+                    raise RuntimeError(
+                        f"watches unhealthy for {settle_timeout:.0f}s while settling; "
+                        f"last error: {self.reflector.last_error}"
+                    )
+                sleep(wait)
+            else:
+                unhealthy_idle = 0.0
         return out

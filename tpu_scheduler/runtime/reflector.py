@@ -6,30 +6,79 @@ feed (``main.rs:141-144``), generalised to both kinds.  The node cache is
 what becomes the device-resident node tensor (SURVEY.md §3.3); the pod cache
 replaces the reference's per-candidate live list (``predicates.rs:21-34``)
 so predicates never do I/O.
+
+Watch errors follow the reference's resilience contract (``main.rs:136-138``:
+``.backoff(ExponentialBackoff)`` + errors dropped from the stream): a failed
+poll emits no events, keeps the last-known store, and schedules the next
+attempt with exponential backoff + jitter instead of crashing the loop.
 """
 
 from __future__ import annotations
 
+import http.client
+import random
+import time
+import zlib
+
 from ..api.objects import Node, Pod
 from ..core.snapshot import ClusterSnapshot
-from .fake_api import Watch, WatchEvent
+from .fake_api import ApiError, Watch, WatchEvent
 
 __all__ = ["Reflector", "ClusterReflector"]
+
+# Transient faults a watch poll may surface: API-level errors (5xx relists),
+# any transport failure (ConnectionError/BrokenPipeError/timeouts are all
+# OSError subclasses), and protocol-level garbage — http.client raises
+# IncompleteRead/BadStatusLine (HTTPException, NOT OSError) when a server
+# dies mid-response.
+_TRANSIENT = (ApiError, OSError, http.client.HTTPException)
 
 
 class Reflector:
     """Applies watch events to a keyed store (kube-runtime reflector::store)."""
 
-    def __init__(self, watch: Watch, key_fn):
+    def __init__(
+        self,
+        watch: Watch,
+        key_fn,
+        clock=time.monotonic,
+        backoff_initial: float = 0.5,
+        backoff_max: float = 30.0,
+        rng: random.Random | None = None,
+    ):
         self._watch = watch
         self._key = key_fn
+        self._clock = clock
+        self._backoff_initial = backoff_initial
+        self._backoff_max = backoff_max
+        self._rng = rng or random.Random()
+        self._backoff = 0.0
+        self._retry_at = 0.0
         self.store: dict = {}
         self.events_seen = 0
+        self.errors_seen = 0
+        self.last_error: str | None = None
 
     def sync(self) -> list[WatchEvent]:
         """Drain the watch and fold events into the store; returns the events
-        (the ``touched_objects`` stream, main.rs:137)."""
-        events = self._watch.poll()
+        (the ``touched_objects`` stream, main.rs:137).  On a transient watch
+        failure: no events, store unchanged, exponential backoff until the
+        next attempt (main.rs:136) — the error is counted, never raised."""
+        now = self._clock()
+        if now < self._retry_at:
+            return []
+        try:
+            events = self._watch.poll()
+        except _TRANSIENT as e:
+            self.errors_seen += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            self._backoff = min(self._backoff_max, self._backoff * 2.0 if self._backoff else self._backoff_initial)
+            # Full jitter in [backoff/2, backoff] — decorrelates relist storms.
+            self._retry_at = now + self._backoff * (0.5 + 0.5 * self._rng.random())
+            return []
+        self._backoff = 0.0
+        self._retry_at = 0.0
+        self.last_error = None  # recovered — don't report stale errors
         for ev in events:
             key = self._key(ev.object)
             if ev.type == "DELETED":
@@ -39,27 +88,103 @@ class Reflector:
             self.events_seen += 1
         return events
 
+    @property
+    def healthy(self) -> bool:
+        """True when the last poll attempt succeeded (not in a backoff
+        window) — i.e. the store reflects a live watch, not stale state."""
+        return self._backoff == 0.0
+
+    def seconds_until_retry(self, now: float) -> float:
+        """Time until the backoff window opens (0 when healthy)."""
+        return max(0.0, self._retry_at - now) if not self.healthy else 0.0
+
     def state(self) -> list:
         """Snapshot of cached objects (reflector Store::state, main.rs:56)."""
         return list(self.store.values())
 
 
+def _node_content_signature(node: Node) -> int:
+    """Stable content hash of the fields packing depends on — used when the
+    API server omits resourceVersion (every relist parses to rv=0), where an
+    rv-only signature would never change and the incremental-pack path would
+    keep scheduling against stale label/taint/cordon tensors.  crc32 of a
+    canonical repr (not ``hash()``) so the signature survives process
+    restarts (PYTHONHASHSEED) and checkpoint/resume."""
+    alloc = node.status.allocatable if node.status is not None else None
+    content = (
+        tuple(sorted((node.metadata.labels or {}).items())),
+        tuple((t.key, t.value, t.effect) for t in (node.spec.taints if node.spec is not None else ()) or ()),
+        bool(node.spec.unschedulable) if node.spec is not None else False,
+        tuple(sorted(alloc.items())) if alloc else (),
+    )
+    return zlib.crc32(repr(content).encode())
+
+
 class ClusterReflector:
     """Node + pod reflectors combined into cycle snapshots."""
 
-    def __init__(self, api):
+    def __init__(self, api, clock=time.monotonic):
         self.api = api
-        self.nodes = Reflector(api.watch_nodes(), key_fn=lambda n: n.name)
-        self.pods = Reflector(api.watch_pods(), key_fn=lambda p: (p.metadata.namespace, p.metadata.name))
+        self.nodes = Reflector(api.watch_nodes(), key_fn=lambda n: n.name, clock=clock)
+        self.pods = Reflector(api.watch_pods(), key_fn=lambda p: (p.metadata.namespace, p.metadata.name), clock=clock)
+        # name -> (node_obj, content_sig): per-object memo for the rv-less
+        # signature path.  Keyed by identity of the stored object (the
+        # reflector replaces objects only on MODIFIED events), holding the
+        # reference so an id() can never alias a freed node.
+        self._content_sigs: dict[str, tuple[Node, int]] = {}
 
     def sync(self) -> tuple[int, int]:
         """Drain both watches; returns (node_events, pod_events)."""
         return len(self.nodes.sync()), len(self.pods.sync())
 
+    @property
+    def errors_seen(self) -> int:
+        return self.nodes.errors_seen + self.pods.errors_seen
+
+    @property
+    def healthy(self) -> bool:
+        return self.nodes.healthy and self.pods.healthy
+
+    @property
+    def last_error(self) -> str | None:
+        """Most relevant error: an *unhealthy* reflector's error first, so a
+        long-recovered hiccup on one watch never masks the live outage on
+        the other."""
+        for r in (self.pods, self.nodes):
+            if not r.healthy and r.last_error:
+                return r.last_error
+        return self.pods.last_error or self.nodes.last_error
+
+    def seconds_until_retry(self, now: float) -> float:
+        """Longest backoff window among unhealthy reflectors (0 if healthy)."""
+        return max(self.nodes.seconds_until_retry(now), self.pods.seconds_until_retry(now))
+
     def snapshot(self) -> ClusterSnapshot:
         return ClusterSnapshot.build(self.nodes.state(), self.pods.state())
 
+    def _cached_content_signature(self, node: Node) -> int:
+        hit = self._content_sigs.get(node.name)
+        if hit is not None and hit[0] is node:
+            return hit[1]
+        sig = _node_content_signature(node)
+        self._content_sigs[node.name] = (node, sig)
+        return sig
+
     def node_set_signature(self) -> tuple[tuple[str, int], ...]:
-        """(name, resourceVersion) per node — cheap change detection for
-        deciding between full repack and incremental avail refresh."""
-        return tuple(sorted((n.name, n.metadata.resource_version) for n in self.nodes.state()))
+        """(name, resourceVersion-or-content-hash) per node — cheap change
+        detection for deciding between full repack and incremental avail
+        refresh.  Falls back to a content hash for any node whose
+        resourceVersion is absent/0 (remote servers that don't echo it);
+        content hashes are memoized per stored object so the steady state
+        stays O(nodes) dict lookups, not O(nodes) serializations."""
+        sigs = tuple(
+            sorted(
+                (n.name, n.metadata.resource_version or self._cached_content_signature(n))
+                for n in self.nodes.state()
+            )
+        )
+        if len(self._content_sigs) > 2 * len(sigs):
+            # Drop memo entries for deleted nodes once they dominate.
+            live = {n.name for n in self.nodes.state()}
+            self._content_sigs = {k: v for k, v in self._content_sigs.items() if k in live}
+        return sigs

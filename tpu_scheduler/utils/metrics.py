@@ -47,6 +47,8 @@ class MetricsRegistry:
 
     def observe_cycle(self, m: CycleMetrics) -> None:
         self.cycles.append(m)
+        if len(self.cycles) > 1024:
+            del self.cycles[0]  # bounded — a daemon observes unbounded cycles
         self.inc("scheduler_cycles_total")
         self.inc("scheduler_pods_bound_total", m.bound)
         self.inc("scheduler_pods_unschedulable_total", m.unschedulable)
