@@ -3,7 +3,7 @@
 on the real TPU chip (BASELINE.md: 100k × 10k in < 1 s on v5e-1).
 
 Prints ONE JSON line to stdout:
-  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": target/value}
+  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": target/value, ...}
 (vs_baseline > 1 means faster than the 1 s north-star target; the reference
 publishes no numbers of its own — BASELINE.md.)
 
@@ -11,19 +11,149 @@ The timed cycle is the honest end-to-end device path: host→device transfer of
 the packed tensors, the full filter+score+commit auction, and fetching the
 per-pod assignments back.  Packing (host-side, amortisable/incremental in the
 controller) is reported separately on stderr.
+
+Hardened against the round-1 failure mode (BENCH_r01.json: rc=1, the axon
+backend was UNAVAILABLE before any work ran):
+  • device init retries with bounded backoff, via re-exec because jax caches
+    a failed backend init in-process (never SIGKILL mid-init — that wedges
+    the TPU tunnel; each attempt runs to completion or raises on its own);
+  • on persistent TPU unavailability, falls back to a smaller problem and
+    finally to CPU — the JSON line then carries "platform" honestly so a
+    degraded number is never mistaken for the flagship one;
+  • reports whether the fused Pallas kernel actually ran ("pallas": true) —
+    the TpuBackend's first-use guard may downgrade to the jnp path on a
+    Mosaic failure, and that must be visible, not silent.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
 
+INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "5"))
+ATTEMPT_ENV = "BENCH_INIT_ATTEMPT"
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def init_devices(force_cpu: bool = False):
+    """jax.devices() with re-exec retries (jax caches a failed backend).
+    Returns (jax, devices, platform)."""
+    attempt = int(os.environ.get(ATTEMPT_ENV, "0"))
+    import jax
+
+    if force_cpu:
+        # The axon sitecustomize overrides JAX_PLATFORMS at interpreter
+        # start; flipping jax.config after import is the only reliable way
+        # to stay off the TPU tunnel.
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+        log(f"devices (forced cpu): {devices}")
+        return jax, devices, "cpu"
+    try:
+        t0 = time.perf_counter()
+        devices = jax.devices()
+        log(f"devices ({time.perf_counter()-t0:.1f}s init, attempt {attempt}): {devices}")
+        return jax, devices, devices[0].platform
+    except Exception as e:  # noqa: BLE001 — diagnose, then retry or degrade
+        log(f"attempt {attempt}: device init failed: {type(e).__name__}: {e}")
+        log(
+            "diagnostics: PYTHONPATH site hook "
+            + ("present" if any("axon" in p for p in sys.path) else "MISSING — axon backend can't register")
+            + f"; JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')}"
+        )
+        if attempt + 1 < INIT_ATTEMPTS:
+            delay = min(120, 20 * (attempt + 1))
+            log(f"retrying in {delay}s (attempt {attempt + 1}/{INIT_ATTEMPTS})")
+            time.sleep(delay)
+            os.environ[ATTEMPT_ENV] = str(attempt + 1)
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        # Last resort: a CPU number honestly labeled beats no number.  Must
+        # re-exec — the failed backend init is cached in this process, so an
+        # in-process platform flip would re-raise (or re-enter the slow axon
+        # init).  --force-cpu flips jax.config before any device use.
+        log("TPU unavailable after all attempts; re-exec degrading to CPU (flagged in output)")
+        argv = [sys.executable] + sys.argv + (["--force-cpu"] if "--force-cpu" not in sys.argv else [])
+        os.execv(sys.executable, argv)
+
+
+def run_scale(jax, backend, profile, pods: int, nodes: int, bound: int, seed: int, block: int, repeats: int):
+    """Synth + pack + warmup + timed repeats at one problem size.  Returns
+    (median_seconds, bound_count, rounds, pack_seconds) or raises."""
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    t0 = time.perf_counter()
+    snap = synth_cluster(n_nodes=nodes, n_pending=pods, n_bound=bound, seed=seed)
+    log(f"synth cluster ({nodes} nodes, {pods} pending, {bound} bound): {time.perf_counter()-t0:.2f}s")
+
+    t0 = time.perf_counter()
+    packed = pack_snapshot(snap, pod_block=block, node_block=128)
+    pack_s = time.perf_counter() - t0
+    log(f"pack: {pack_s:.2f}s (padded {packed.padded_pods}x{packed.padded_nodes}, vocab={len(packed.vocab)})")
+
+    t0 = time.perf_counter()
+    result = backend.schedule(packed, profile)
+    log(
+        f"warmup (incl. compile): {time.perf_counter()-t0:.2f}s — bound {len(result.bindings)}/{packed.num_pods} "
+        f"in {result.rounds} rounds"
+    )
+
+    times = []
+    for i in range(repeats):
+        t0 = time.perf_counter()
+        r = backend.schedule(packed, profile)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        log(f"cycle {i}: {dt:.4f}s ({len(r.bindings)} bound, {r.rounds} rounds, {len(r.bindings)/dt:,.0f} pods/s)")
+    return statistics.median(times), len(r.bindings), r.rounds, pack_s
+
+
+def sharded_scaling_row(pods: int, nodes: int, seed: int) -> dict:
+    """Single-chip vs 8-way-mesh scaling check on a CPU-emulated mesh, run in
+    a subprocess so its platform/device-count overrides can't disturb the
+    main process's TPU backend.  Small shapes — this is a regression canary
+    for the sharded path (VERDICT r1 #9), not a perf claim."""
+    import subprocess
+
+    code = f"""
+import os, json, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpu_scheduler.ops.pack import pack_snapshot
+from tpu_scheduler.testing import synth_cluster
+from tpu_scheduler.parallel.sharded import ShardedBackend
+from tpu_scheduler.backends.tpu import TpuBackend
+from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+
+packed = pack_snapshot(synth_cluster(n_nodes={nodes}, n_pending={pods}, n_bound=0, seed={seed}), pod_block=1024)
+b = TpuBackend(use_pallas=False)
+b.schedule(packed, DEFAULT_PROFILE)  # warm
+t0 = time.perf_counter(); b.schedule(packed, DEFAULT_PROFILE); one = time.perf_counter() - t0
+sb = ShardedBackend(tp=2)
+sb.schedule(packed, DEFAULT_PROFILE)  # warm
+t0 = time.perf_counter(); sb.schedule(packed, DEFAULT_PROFILE); eight = time.perf_counter() - t0
+print(json.dumps({{"cpu1_seconds": round(one, 4), "cpu_dp4tp2_seconds": round(eight, 4)}}))
+"""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=600, cwd=os.path.dirname(os.path.abspath(__file__))
+        )
+        if out.returncode != 0:
+            log(f"sharded scaling row failed (rc={out.returncode}): {out.stderr[-500:]}")
+            return {}
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        log(f"sharded scaling row skipped: {type(e).__name__}: {e}")
+        return {}
 
 
 def main() -> int:
@@ -36,57 +166,54 @@ def main() -> int:
     ap.add_argument("--block", type=int, default=8192)
     ap.add_argument("--max-rounds", type=int, default=64)
     ap.add_argument("--target-seconds", type=float, default=1.0)
+    ap.add_argument("--no-sharded-row", action="store_true")
+    ap.add_argument("--force-cpu", action="store_true", help="testing: skip the TPU entirely")
     args = ap.parse_args()
 
-    import jax
+    jax, devices, platform = init_devices(force_cpu=args.force_cpu)
 
     from tpu_scheduler.backends.tpu import TpuBackend
     from tpu_scheduler.models.profiles import DEFAULT_PROFILE
-    from tpu_scheduler.ops.pack import pack_snapshot
-    from tpu_scheduler.testing import synth_cluster
-
-    n_bound = args.bound if args.bound is not None else 2 * args.nodes
-    log(f"devices: {jax.devices()}")
-
-    t0 = time.perf_counter()
-    snap = synth_cluster(n_nodes=args.nodes, n_pending=args.pods, n_bound=n_bound, seed=args.seed)
-    log(f"synth cluster ({args.nodes} nodes, {args.pods} pending, {n_bound} bound): {time.perf_counter()-t0:.2f}s")
-
-    t0 = time.perf_counter()
-    packed = pack_snapshot(snap, pod_block=args.block, node_block=128)
-    pack_s = time.perf_counter() - t0
-    log(f"pack: {pack_s:.2f}s (padded {packed.padded_pods}x{packed.padded_nodes}, vocab={len(packed.vocab)})")
 
     backend = TpuBackend()
     profile = DEFAULT_PROFILE.with_(pod_block=args.block, max_rounds=args.max_rounds)
+    n_bound = args.bound if args.bound is not None else 2 * args.nodes
 
-    # Warmup: compile + first execution.
-    t0 = time.perf_counter()
-    result = backend.schedule(packed, profile)
-    log(
-        f"warmup (incl. compile): {time.perf_counter()-t0:.2f}s — bound {len(result.bindings)}/{packed.num_pods} "
-        f"in {result.rounds} rounds"
-    )
+    # Downscale ladder: a partial number beats none (VERDICT r1 #1).
+    scales = [(args.pods, args.nodes, n_bound)]
+    if args.pods >= 100_000:
+        scales += [(50_000, args.nodes, n_bound), (25_000, 5_000, 10_000), (10_000, 1_000, 2_000)]
 
-    times = []
-    for i in range(args.repeats):
-        t0 = time.perf_counter()
-        r = backend.schedule(packed, profile)
-        dt = time.perf_counter() - t0
-        times.append(dt)
-        log(f"cycle {i}: {dt:.4f}s ({len(r.bindings)} bound, {r.rounds} rounds, {len(r.bindings)/dt:,.0f} pods/s)")
+    value = bound = rounds = None
+    used_pods = used_nodes = None
+    for pods, nodes, bnd in scales:
+        try:
+            value, bound, rounds, _pack_s = run_scale(
+                jax, backend, profile, pods, nodes, bnd, args.seed, args.block, args.repeats
+            )
+            used_pods, used_nodes = pods, nodes
+            break
+        except Exception as e:  # noqa: BLE001 — try the next scale down
+            log(f"scale {pods}x{nodes} failed: {type(e).__name__}: {str(e)[:300]}")
+    if value is None:
+        log("all scales failed")
+        return 1
 
-    value = statistics.median(times)
-    print(
-        json.dumps(
-            {
-                "metric": f"sched_cycle_seconds_{args.pods}x{args.nodes}",
-                "value": round(value, 4),
-                "unit": "s",
-                "vs_baseline": round(args.target_seconds / value, 2),
-            }
-        )
-    )
+    out = {
+        "metric": f"sched_cycle_seconds_{used_pods}x{used_nodes}",
+        "value": round(value, 4),
+        "unit": "s",
+        "vs_baseline": round(args.target_seconds / value, 2),
+        "platform": platform,
+        "pallas": bool(backend.use_pallas),
+        "pods_per_second": round(bound / value) if value > 0 else 0,
+        "rounds": rounds,
+    }
+    if used_pods != args.pods:
+        out["downscaled_from"] = f"{args.pods}x{args.nodes}"
+    if not args.no_sharded_row:
+        out.update(sharded_scaling_row(8192, 512, args.seed))
+    print(json.dumps(out))
     return 0
 
 

@@ -1,0 +1,281 @@
+"""Tensorized anti-affinity + topology-spread (ops/constraints.py) — the
+device-side form of BASELINE config 5 (VERDICT r1 item #2).
+
+Validity contract: replaying the auction's placements in acceptance order
+(round, then priority rank — exported in CycleResult.stats) through the
+scalar predicate chain (core/predicates.py) must show zero violations; and
+the native and TPU backends must agree binding-for-binding.
+"""
+
+import numpy as np
+import pytest
+
+from dataclasses import replace
+
+import tpu_scheduler.core.predicates as P
+from tpu_scheduler.api.objects import PodAntiAffinityTerm, TopologySpreadConstraint, full_name
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.backends.tpu import TpuBackend
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+from tpu_scheduler.ops.constraints import UntensorizableConstraints, pack_constraints
+from tpu_scheduler.ops.pack import pack_snapshot
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+def _packed_with_constraints(snap, **kw):
+    packed = pack_snapshot(snap)
+    cons = pack_constraints(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes, **kw
+    )
+    return replace(packed, constraints=cons) if cons is not None else packed
+
+
+def _replay_validity(snap, packed, result) -> int:
+    """Sequential-order certificate: the auction commits placements in
+    rounds; within a round the kept set is valid under *some* order (rank
+    order for anti-affinity, fill-height order for spread waves).  Verify by
+    multi-pass greedy replay through the scalar chain: rounds in order,
+    within a round keep sweeping for a placement whose scalar check passes.
+    Returns the number of placements for which no valid order exists."""
+    pending = snap.pending_pods()
+    node_by = {n.name: n for n in snap.nodes}
+    by_round: dict[int, list] = {}
+    for i in range(len(pending)):
+        j = int(result.assigned[i])
+        if j < 0:
+            continue
+        r = int(result.stats["acc_round"][i])
+        by_round.setdefault(r, []).append((int(result.stats["rank"][i]), pending[i], node_by[packed.node_names[j]]))
+    placed = []
+    stuck = 0
+    for r in sorted(by_round):
+        group = sorted(by_round[r])  # rank order first — right for AA
+        while group:
+            progressed = False
+            remaining = []
+            for rank, pod, node in group:
+                if P.anti_affinity_ok(pod, node, snap, extra_placed=placed) and P.topology_spread_ok(
+                    pod, node, snap, extra_placed=placed
+                ):
+                    placed.append((pod, node))
+                    progressed = True
+                else:
+                    remaining.append((rank, pod, node))
+            if not progressed:
+                stuck += len(remaining)
+                placed.extend((pod, node) for _, pod, node in remaining)
+                break
+            group = remaining
+    return stuck
+
+
+def _schedule_both(snap, **kw):
+    packed = _packed_with_constraints(snap, **kw)
+    rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
+    rt = TpuBackend().schedule(packed, DEFAULT_PROFILE)
+    assert rn.bindings == rt.bindings
+    assert (rn.stats["acc_round"] == rt.stats["acc_round"]).all()
+    return packed, rn
+
+
+# --- targeted scenarios ------------------------------------------------------
+
+
+def test_self_anti_affinity_spreads_replicas():
+    """Three replicas with hostname self-anti-affinity land on 3 distinct
+    nodes even though one node could hold all of them."""
+    nodes = [make_node(f"n{i}", cpu="32", memory="64Gi", labels={"name": f"n{i}"}) for i in range(4)]
+    term = [PodAntiAffinityTerm(match_labels={"app": "db"}, topology_key="name")]
+    pods = [
+        make_pod(f"db-{i}", cpu="500m", memory="1Gi", labels={"app": "db"}, anti_affinity=term) for i in range(3)
+    ]
+    snap = ClusterSnapshot.build(nodes, pods)
+    packed, r = _schedule_both(snap)
+    assert len(r.bindings) == 3
+    assert len({n for _, n in r.bindings}) == 3
+    assert _replay_validity(snap, packed, r) == 0
+
+
+def test_anti_affinity_respects_placed_pods():
+    """Direction A: a node whose zone already holds a matched placed pod is
+    blocked for a carrier."""
+    nodes = [
+        make_node("a1", labels={"zone": "a"}),
+        make_node("a2", labels={"zone": "a"}),
+        make_node("b1", labels={"zone": "b"}),
+    ]
+    placed = [make_pod("old", labels={"app": "db"}, node_name="a1", phase="Running")]
+    term = [PodAntiAffinityTerm(match_labels={"app": "db"}, topology_key="zone")]
+    incoming = [make_pod("new-db", labels={"app": "db"}, anti_affinity=term)]
+    snap = ClusterSnapshot.build(nodes, placed + incoming)
+    packed, r = _schedule_both(snap)
+    assert r.bindings == [("default/new-db", "b1")]
+
+
+def test_anti_affinity_direction_b_placed_carrier_blocks_matched():
+    """Direction B: a *placed* pod's term blocks an incoming pod that
+    matches it, even though the incoming pod declares nothing."""
+    nodes = [make_node("a1", labels={"zone": "a"}), make_node("b1", labels={"zone": "b"})]
+    term = [PodAntiAffinityTerm(match_labels={"app": "web"}, topology_key="zone")]
+    placed = [make_pod("carrier", labels={"app": "other"}, anti_affinity=term, node_name="a1", phase="Running")]
+    incoming = [make_pod("victim", labels={"app": "web"})]
+    snap = ClusterSnapshot.build(nodes, placed + incoming)
+    packed, r = _schedule_both(snap)
+    assert r.bindings == [("default/victim", "b1")]
+
+
+def test_anti_affinity_namespace_scoped():
+    """A term only sees pods in its own namespace."""
+    nodes = [make_node("a1", labels={"zone": "a"})]
+    term = [PodAntiAffinityTerm(match_labels={"app": "db"}, topology_key="zone")]
+    placed = [make_pod("other-ns", namespace="prod", labels={"app": "db"}, node_name="a1", phase="Running")]
+    incoming = [make_pod("new-db", namespace="dev", labels={"app": "db"}, anti_affinity=term)]
+    snap = ClusterSnapshot.build(nodes, placed + incoming)
+    packed, r = _schedule_both(snap)
+    assert r.bindings == [("dev/new-db", "a1")]
+
+
+def test_keyless_node_is_singleton_domain():
+    """A node lacking the topology key degrades to per-node granularity:
+    the matched placed pod blocks only its own node."""
+    nodes = [make_node("k1"), make_node("k2")]  # no zone labels at all
+    term = [PodAntiAffinityTerm(match_labels={"app": "db"}, topology_key="zone")]
+    placed = [make_pod("old", labels={"app": "db"}, node_name="k1", phase="Running")]
+    incoming = [make_pod("new-db", labels={"app": "db"}, anti_affinity=term)]
+    snap = ClusterSnapshot.build(nodes, placed + incoming)
+    packed, r = _schedule_both(snap)
+    assert r.bindings == [("default/new-db", "k2")]
+
+
+def test_spread_hard_skew_enforced():
+    """max_skew=1 over two zones: 4 replicas land 2+2."""
+    nodes = [
+        make_node("a1", cpu="32", memory="64Gi", labels={"zone": "a"}),
+        make_node("b1", cpu="32", memory="64Gi", labels={"zone": "b"}),
+    ]
+    spread = [TopologySpreadConstraint(topology_key="zone", max_skew=1, match_labels={"app": "web"})]
+    pods = [
+        make_pod(f"web-{i}", cpu="100m", memory="128Mi", labels={"app": "web"}, topology_spread=spread)
+        for i in range(4)
+    ]
+    snap = ClusterSnapshot.build(nodes, pods)
+    packed, r = _schedule_both(snap)
+    assert len(r.bindings) == 4
+    zones = [n[0] for _, n in r.bindings]  # a1 -> 'a', b1 -> 'b'
+    assert sorted(zones) == ["a", "a", "b", "b"]
+    assert _replay_validity(snap, packed, r) == 0
+
+
+def test_spread_mass_wave_commits_whole_levels():
+    """Water-filling quota: a mass spread workload converges in few rounds,
+    not one-pod-per-domain-per-round."""
+    nodes = [
+        make_node(f"n{i}", cpu="64", memory="256Gi", labels={"zone": f"z{i % 4}"}) for i in range(8)
+    ]
+    spread = [TopologySpreadConstraint(topology_key="zone", max_skew=1, match_labels={"app": "web"})]
+    pods = [
+        make_pod(f"web-{i}", cpu="50m", memory="64Mi", labels={"app": "web"}, topology_spread=spread)
+        for i in range(64)
+    ]
+    snap = ClusterSnapshot.build(nodes, pods)
+    packed, r = _schedule_both(snap)
+    assert len(r.bindings) == 64
+    assert r.rounds <= 24  # NOT 16 rounds-per-level × levels
+    assert _replay_validity(snap, packed, r) == 0
+    # Final counts within the skew band (all placements were new).
+    per_zone = {}
+    for _, n in r.bindings:
+        z = f"z{int(n[1:]) % 4}"
+        per_zone[z] = per_zone.get(z, 0) + 1
+    assert max(per_zone.values()) - min(per_zone.values()) <= 1
+
+
+def test_spread_exempts_keyless_nodes():
+    nodes = [make_node("a1", labels={"zone": "a"}), make_node("x1")]  # x1 keyless
+    spread = [TopologySpreadConstraint(topology_key="zone", max_skew=1, match_labels={"app": "web"})]
+    placed = [make_pod("w0", labels={"app": "web"}, node_name="a1", phase="Running")]
+    pods = [make_pod("w1", labels={"app": "web"}, topology_spread=spread)]
+    snap = ClusterSnapshot.build(nodes, placed + pods)
+    packed, r = _schedule_both(snap)
+    # zone a is at count 1 = skew + min(1... min over {a}=1 → 1+1-1 <= 1 ok;
+    # actually single-domain keys always pass; the point is x1 is legal too.
+    assert len(r.bindings) == 1
+
+
+def test_untensorizable_many_valued_shared_key_raises():
+    """A non-unique many-valued topology key must refuse tensorization."""
+    nodes = [
+        make_node(f"n{i}", labels={"rack": f"r{i // 2}"}) for i in range(40)
+    ]  # 20 racks, 2 nodes each
+    term = [PodAntiAffinityTerm(match_labels={"app": "db"}, topology_key="rack")]
+    pods = [make_pod("db-0", labels={"app": "db"}, anti_affinity=term)]
+    snap = ClusterSnapshot.build(nodes, pods)
+    packed = pack_snapshot(snap)
+    with pytest.raises(UntensorizableConstraints):
+        pack_constraints(
+            snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+            max_coarse_domains=8,
+        )
+
+
+# --- synthetic-cluster sweep (the VERDICT acceptance shape) ------------------
+
+
+@pytest.mark.parametrize("seed", [0, 3, 11])
+def test_synth_constrained_cluster_parity_and_validity(seed):
+    snap = synth_cluster(
+        n_nodes=60,
+        n_pending=400,
+        n_bound=100,
+        seed=seed,
+        anti_affinity_fraction=0.2,
+        spread_fraction=0.2,
+    )
+    packed, r = _schedule_both(snap)
+    assert _replay_validity(snap, packed, r) == 0
+    assert len(r.bindings) > 300  # the bulk schedules
+
+
+def test_scheduler_uses_tensor_path_for_constrained_cluster():
+    """End-to-end through the controller: a constrained synthetic cluster
+    schedules through the batch tensor backend (counter increments), with no
+    host-fallback, and every binding is valid."""
+    snap = synth_cluster(
+        n_nodes=40, n_pending=200, n_bound=50, seed=7, anti_affinity_fraction=0.2, spread_fraction=0.2
+    )
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, NativeBackend(), policy="batch", requeue_seconds=0.0)
+    sched.run(max_cycles=8, until_settled=True)
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_constraint_tensor_cycles_total", 0) >= 1
+    assert counters.get("scheduler_constraint_host_fallbacks_total", 0) == 0
+    assert counters["scheduler_bindings_total"] > 150
+
+    # Every final placement satisfies the scalar chain against the final
+    # cluster state minus itself (a necessary condition that is order-free).
+    final = ClusterSnapshot.build(api.list_nodes(), api.list_pods())
+    node_by = {n.name: n for n in final.nodes}
+    for pod, node in final.placed_pods():
+        if pod.spec is None or not (pod.spec.anti_affinity or pod.spec.topology_spread):
+            continue
+        # anti-affinity must hold in the final state (order-free invariant)
+        others = ClusterSnapshot.build(
+            final.nodes, [q for q in final.pods if q is not pod]
+        )
+        assert P.anti_affinity_ok(pod, node_by[node.name], others), full_name(pod)
+
+
+def test_plain_cycles_unchanged_by_constraint_plumbing():
+    """An unconstrained cluster must take the exact pre-existing path
+    (constraints=None) — guard against overhead/regression."""
+    snap = synth_cluster(n_nodes=30, n_pending=100, seed=1)
+    packed = pack_snapshot(snap)
+    assert packed.constraints is None
+    rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
+    rt = TpuBackend().schedule(packed, DEFAULT_PROFILE)
+    assert rn.bindings == rt.bindings
+    assert len(rn.unschedulable) == 0

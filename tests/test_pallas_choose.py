@@ -105,8 +105,8 @@ def test_assign_cycle_pallas_flag_smoke():
 
     a, weights = _case(24, 40, seed=9)
     nodes, pods = split_device_arrays(a)
-    base_assigned, base_rounds, base_avail = assign_cycle(nodes, pods, weights, max_rounds=16, block=16)
-    p_assigned, p_rounds, p_avail = assign_cycle(
+    base_assigned, base_rounds, base_avail, _, _ = assign_cycle(nodes, pods, weights, max_rounds=16, block=16)
+    p_assigned, p_rounds, p_avail, _, _ = assign_cycle(
         nodes, pods, weights, max_rounds=16, block=16, use_pallas=True, pallas_interpret=True
     )
     np.testing.assert_array_equal(np.asarray(base_assigned), np.asarray(p_assigned))

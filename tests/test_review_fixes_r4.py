@@ -9,6 +9,7 @@ from tpu_scheduler.api.objects import (
     Pod,
     PodAntiAffinityTerm,
     TopologySpreadConstraint,
+    pod_to_dict,
 )
 from tpu_scheduler.backends.native import NativeBackend
 from tpu_scheduler.core.predicates import (
@@ -327,11 +328,33 @@ def test_equal_priority_levels_coalesce_segments():
     calls = []
     orig = sched._schedule_batch
 
-    def counting(batch_snapshot, placed):
-        calls.append(len(batch_snapshot.pending_pods()))
-        return orig(batch_snapshot, placed)
+    def counting(batch_snapshot, placed, with_constraints=False):
+        calls.append((len(batch_snapshot.pending_pods()), with_constraints))
+        return orig(batch_snapshot, placed, with_constraints=with_constraints)
 
     sched._schedule_batch = counting
+    # Tensor-constraint path: ONE batch over all 12 pods, constraints attached.
     m = sched.run_cycle()
     assert m.bound == 12
-    assert len(calls) == 1 and calls[0] == 6  # one tensor batch for all plain pods
+    assert calls == [(12, True)]
+
+    # Fallback (untensorizable) path: segments must still coalesce — one
+    # plain tensor batch + the constrained host phase.
+    from tpu_scheduler.ops.constraints import UntensorizableConstraints
+
+    api2 = FakeApiServer()
+    api2.load(nodes=nodes, pods=[Pod.from_dict(pod_to_dict(p)) for p in pods])
+    sched2 = Scheduler(api2, NativeBackend(), policy="batch")
+    calls2 = []
+    orig2 = sched2._schedule_batch
+
+    def counting2(batch_snapshot, placed, with_constraints=False):
+        if with_constraints:
+            raise UntensorizableConstraints("forced by test")
+        calls2.append(len(batch_snapshot.pending_pods()))
+        return orig2(batch_snapshot, placed)
+
+    sched2._schedule_batch = counting2
+    m2 = sched2.run_cycle()
+    assert m2.bound == 12
+    assert len(calls2) == 1 and calls2[0] == 6  # one tensor batch for all plain pods

@@ -35,11 +35,16 @@ class SchedulingBackend(abc.ABC):
     name: str = "abstract"
 
     @abc.abstractmethod
-    def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
-        """Run the cycle over padded tensors; return (assigned [padded_pods], rounds)."""
+    def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple:
+        """Run the cycle over padded tensors; return (assigned [padded_pods],
+        rounds) or (assigned, rounds, extras) where ``extras`` carries
+        per-pod diagnostics (acceptance round, priority rank) into
+        ``CycleResult.stats``."""
 
     def schedule(self, packed: PackedCluster, profile: SchedulingProfile = DEFAULT_PROFILE) -> CycleResult:
-        assigned_padded, rounds = self.assign(packed, profile)
+        result = self.assign(packed, profile)
+        assigned_padded, rounds = result[0], result[1]
+        extras = result[2] if len(result) > 2 else {}
         assigned = np.asarray(assigned_padded)[: packed.num_pods]
         bindings = []
         unschedulable = []
@@ -49,10 +54,13 @@ class SchedulingBackend(abc.ABC):
                 bindings.append((pod_name, packed.node_names[j]))
             else:
                 unschedulable.append(pod_name)
+        stats = {"backend": self.name}
+        for k, v in extras.items():
+            stats[k] = np.asarray(v)[: packed.num_pods]
         return CycleResult(
             assigned=assigned,
             bindings=bindings,
             unschedulable=unschedulable,
             rounds=int(rounds),
-            stats={"backend": self.name},
+            stats=stats,
         )

@@ -45,12 +45,24 @@ class NativeBackend(SchedulingBackend):
         has_aff = packed.pod_has_aff[perm]
         valid = packed.pod_valid[perm]
 
+        cons = packed.constraints
+        cmeta = cstate = cpods = None
+        if cons is not None:
+            from ..ops.constraints import blocked_block, constraint_commit, constraint_filter, round_blocked_masks
+
+            cmeta = cons.meta_arrays()
+            cstate = {k: v.copy() for k, v in cons.state_arrays().items()}
+            cpods = {k: v[perm] for k, v in cons.pod_arrays().items()}
+
         avail = node_avail.copy()
         assigned = np.full((p,), -1, dtype=np.int32)
+        acc_round = np.full((p,), -1, dtype=np.int32)
         active = valid.copy()
+        ranks = np.arange(p, dtype=np.uint32)  # already in priority-rank order
         rounds = 0
 
         while rounds < profile.max_rounds and active.any():
+            round_masks = round_blocked_masks(np, cstate, cmeta) if cons is not None else None
             choice = np.zeros((p,), dtype=np.int32)
             has = np.zeros((p,), dtype=bool)
             node_idx = np.arange(n, dtype=np.uint32)
@@ -60,6 +72,9 @@ class NativeBackend(SchedulingBackend):
                     np, req[lo:hi], sel[lo:hi], selc[lo:hi], active[lo:hi], avail, node_labels, node_valid,
                     ntol[lo:hi], node_taints, aff[lo:hi], has_aff[lo:hi], node_aff,
                 )
+                if round_masks is not None:
+                    blk = {k: v[lo:hi] for k, v in cpods.items()}
+                    m = m & ~blocked_block(np, blk, round_masks)
                 pod_idx = np.arange(lo, hi, dtype=np.uint32)
                 sc = score_block(np, req[lo:hi], node_alloc, avail, weights, pod_idx, node_idx)
                 sc = np.where(m, sc, -np.inf)
@@ -85,7 +100,12 @@ class NativeBackend(SchedulingBackend):
             accepted = np.zeros((p,), dtype=bool)
             accepted[order] = acc_s
 
+            if cons is not None:
+                accepted = constraint_filter(np, accepted, choice, ranks, cpods, cstate, cmeta)
+                cstate = constraint_commit(np, accepted, choice, cpods, cstate, cmeta)
+
             assigned = np.where(accepted, choice, assigned)
+            acc_round = np.where(accepted, rounds, acc_round)
             dec = np.zeros((n + 1, 2), dtype=np.int64)
             np.add.at(dec, ch, np.where(accepted[:, None], req, 0).astype(np.int64))
             avail = (avail.astype(np.int64) - dec[:n]).astype(np.int32)
@@ -94,4 +114,8 @@ class NativeBackend(SchedulingBackend):
 
         out = np.full((p,), -1, dtype=np.int32)
         out[perm] = assigned
-        return out, rounds
+        out_acc = np.full((p,), -1, dtype=np.int32)
+        out_acc[perm] = acc_round
+        rank_of = np.zeros((p,), dtype=np.int32)
+        rank_of[perm] = np.arange(p, dtype=np.int32)
+        return out, rounds, {"acc_round": out_acc, "rank": rank_of}

@@ -52,19 +52,35 @@ class TpuBackend(SchedulingBackend):
         put = {k: jax.device_put(v, self.device) for k, v in a.items()}
         weights = jax.device_put(profile.weights(), self.device)
         nodes, pods = split_device_arrays(put)
-        assigned, rounds, _avail = assign_cycle(
+        cmeta = cstate = None
+        cons = packed.constraints
+        if cons is not None:
+            pods.update({k: jax.device_put(v, self.device) for k, v in cons.pod_arrays().items()})
+            cmeta = {k: jax.device_put(v, self.device) for k, v in cons.meta_arrays().items()}
+            cstate = {k: jax.device_put(v, self.device) for k, v in cons.state_arrays().items()}
+        assigned, rounds, _avail, acc_round, rank_of = assign_cycle(
             nodes,
             pods,
             weights,
             max_rounds=profile.max_rounds,
             block=profile.pod_block,
             use_pallas=use_pallas,
+            cmeta=cmeta,
+            cstate=cstate,
         )
-        return np.asarray(jax.device_get(assigned)), int(rounds)
+        extras = {
+            "acc_round": np.asarray(jax.device_get(acc_round)),
+            "rank": np.asarray(jax.device_get(rank_of)),
+        }
+        return np.asarray(jax.device_get(assigned)), int(rounds), extras
 
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         jax = self._jax
-        if self.use_pallas and not self._pallas_proven:
+        # Constraint cycles ride the jnp path (the fused Pallas kernel does
+        # not carry the blocked-domain matmuls yet) — and must NOT count as
+        # a proving run for the first-use guard below.
+        pallas_eligible = self.use_pallas and packed.constraints is None
+        if pallas_eligible and not self._pallas_proven:
             try:
                 result = self._assign_once(packed, profile, use_pallas=True)
                 self._pallas_proven = True
@@ -95,8 +111,9 @@ class TpuBackend(SchedulingBackend):
                     e,
                 )
                 self.use_pallas = False
+                pallas_eligible = False
         try:
-            return self._assign_once(packed, profile, use_pallas=self.use_pallas)
+            return self._assign_once(packed, profile, use_pallas=pallas_eligible and self.use_pallas)
         except jax.errors.JaxRuntimeError as e:
             # Device-runtime failure (OOM, device lost, …) — the recovery
             # scenario the native fallback exists for (SURVEY.md §5).  Python

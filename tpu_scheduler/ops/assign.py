@@ -61,6 +61,9 @@ __all__ = ["assign_cycle", "split_device_arrays", "INT32_MAX"]
 # Pod-side keys the choose step consumes (sliced per block); the rest of the
 # pod state (assigned, active bookkeeping) never enters the score math.
 _CHOOSE_KEYS = ("pod_req", "pod_sel", "pod_sel_count", "pod_ntol", "pod_aff", "pod_has_aff", "active", "ranks")
+# Constraint pod-side keys (present only when the cycle carries anti-affinity
+# or topology-spread tensors, ops/constraints.py).
+_CONSTRAINT_KEYS = ("pod_aa_carries", "pod_aa_matched", "pod_sp_declares", "pod_sp_matched")
 
 
 def split_device_arrays(arrays: dict) -> tuple[dict, dict]:
@@ -86,13 +89,14 @@ def _seg_scan_op(x, y):
     return fx | fy, jnp.where(fy, vy, _sat_add(vx, vy))
 
 
-def _choose_block(avail, nodes, weights, blk, pallas_pack=None):
+def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None):
     """[B] best feasible node (+feasibility flag) for one block of pods.
 
     ``blk`` is the pod-side dict sliced to one block.  With ``pallas_pack``
     (node_info, labels_t, taints_t, interpret) the fused Pallas kernel runs
     (ops/pallas_choose.py — bit-identical results, one VMEM pass); otherwise
-    the xp-generic jnp expression tree.
+    the xp-generic jnp expression tree.  ``round_masks`` (constraint cycles
+    only) adds the anti-affinity/spread blocked-node matmuls.
     """
     if pallas_pack is not None:
         from .pallas_choose import choose_block_pallas
@@ -130,12 +134,16 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None):
         blk["pod_has_aff"],
         nodes["node_aff"],
     )
+    if round_masks is not None:
+        from .constraints import blocked_block
+
+        m = m & ~blocked_block(jnp, blk, round_masks)
     sc = score_block(jnp, blk["pod_req"], nodes["node_alloc"], avail, weights, blk["ranks"], node_idx)
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
 
-def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas_interpret=False):
+def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas_interpret=False, round_masks=None):
     """Per-pod best feasible node vs current capacity, blockwise over pods.
 
     Never materialises the full [P,N] score matrix: peak live memory is one
@@ -160,8 +168,9 @@ def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas
             pallas_interpret,
         )
 
+    choose_keys = _CHOOSE_KEYS + (_CONSTRAINT_KEYS if round_masks is not None else ())
     if block >= p:
-        return _choose_block(avail, nodes, weights, {k: ps[k] for k in _CHOOSE_KEYS}, pallas_pack)
+        return _choose_block(avail, nodes, weights, {k: ps[k] for k in choose_keys}, pallas_pack, round_masks)
 
     nb_occupied = (n_active + block - 1) // block  # traced; caller pads p % block == 0
 
@@ -172,8 +181,8 @@ def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas
     def body(s):
         i, choice, has = s
         lo = i * block
-        blk = {k: lax.dynamic_slice_in_dim(ps[k], lo, block) for k in _CHOOSE_KEYS}
-        bc, bh = _choose_block(avail, nodes, weights, blk, pallas_pack)
+        blk = {k: lax.dynamic_slice_in_dim(ps[k], lo, block) for k in choose_keys}
+        bc, bh = _choose_block(avail, nodes, weights, blk, pallas_pack, round_masks)
         choice = lax.dynamic_update_slice_in_dim(choice, bc, lo, axis=0)
         has = lax.dynamic_update_slice_in_dim(has, bh, lo, axis=0)
         return i + 1, choice, has
@@ -195,13 +204,30 @@ def assign_cycle(
     block: int = 4096,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    cmeta: dict | None = None,
+    cstate: dict | None = None,
 ):
     """Assign all pending pods to nodes in one on-device cycle.
 
     ``nodes``/``pods`` are the PackedCluster device arrays split by prefix
     (see :func:`split_device_arrays`).  Returns (assigned [P] int32 — node
     index or −1, rounds int32, remaining node_avail [N,2] int32).
+
+    ``cmeta``/``cstate`` (ops/constraints.py meta_arrays/state_arrays) switch
+    on the anti-affinity + topology-spread path: choose gains the blocked-
+    domain matmuls, accept gains the within-round conflict filter, and the
+    domain state threads through the loop carry.  ``pods`` must then also
+    carry the constraint pod bitmaps (ConstraintSet.pod_arrays); the Pallas
+    fused kernel is bypassed on constraint cycles (jnp path only).
     """
+    # The fused Pallas kernel does not evaluate the constraint matmuls; a
+    # pallas choose on a constraint cycle would pick blocked nodes, the
+    # filter would reject them every round, and the pod would livelock to
+    # max_rounds.  Force the jnp path (static decision — both flags are
+    # trace constants).
+    if cmeta is not None:
+        use_pallas = False
+
     p_out = pods["pod_req"].shape[0]
     n = nodes["node_avail"].shape[0]
 
@@ -229,6 +255,7 @@ def assign_cycle(
     # handled by compacting once before the loop.
     ps["ranks"] = jnp.arange(p, dtype=jnp.uint32)
     ps["assigned"] = jnp.full((p,), -1, jnp.int32)
+    ps["acc_round"] = jnp.full((p,), -1, jnp.int32)  # round each pod was accepted in
     ps["active"] = ps.pop("pod_valid")
 
     def compact(ps):
@@ -238,12 +265,17 @@ def assign_cycle(
     ps = compact(ps)
 
     def cond(state):
-        _, _, n_active, rounds = state
+        _, _, n_active, rounds, _ = state
         return (rounds < max_rounds) & (n_active > 0)
 
     def body(state):
-        avail, ps, n_active, rounds = state
-        choice, has = _choose(avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret)
+        avail, ps, n_active, rounds, cst = state
+        round_masks = None
+        if cmeta is not None:
+            from .constraints import constraint_commit, constraint_filter, round_blocked_masks
+
+            round_masks = round_blocked_masks(jnp, cst, cmeta)
+        choice, has = _choose(avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks)
         cand = ps["active"] & has
         ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
         claim = jnp.where(cand[:, None], ps["pod_req"], 0)
@@ -261,18 +293,28 @@ def assign_cycle(
         acc_s = fits_prefix & (ch_s < n)
         accepted = jnp.zeros((p,), bool).at[order].set(acc_s)
 
+        if cmeta is not None:
+            # Within-round conflict resolution + domain-state commit
+            # (deferred pods stay active and retry next round).
+            accepted = constraint_filter(jnp, accepted, choice, ps["ranks"], ps, cst, cmeta)
+            cst = constraint_commit(jnp, accepted, choice, ps, cst, cmeta)
+
         ps["assigned"] = jnp.where(accepted, choice, ps["assigned"])
+        ps["acc_round"] = jnp.where(accepted, rounds, ps["acc_round"])
         dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], ps["pod_req"], 0))
         avail = avail - dec[:n]
         ps["active"] = cand & ~accepted
         ps = compact(ps)
-        return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1
+        return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1, cst
 
-    state0 = (nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32), jnp.int32(0))
-    avail, ps, _, rounds = lax.while_loop(cond, body, state0)
+    state0 = (nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32), jnp.int32(0), cstate)
+    avail, ps, _, rounds, _ = lax.while_loop(cond, body, state0)
 
     # Undo compaction (rank space), then the priority permutation (original
     # pod order), dropping block padding.
     assigned_rank = jnp.zeros((p,), jnp.int32).at[ps["ranks"]].set(ps["assigned"])
     out = jnp.full((p_out,), -1, jnp.int32).at[perm].set(assigned_rank[:p_out])
-    return out, rounds, avail
+    acc_round_rank = jnp.zeros((p,), jnp.int32).at[ps["ranks"]].set(ps["acc_round"])
+    acc_round = jnp.full((p_out,), -1, jnp.int32).at[perm].set(acc_round_rank[:p_out])
+    rank_of = jnp.zeros((p_out,), jnp.int32).at[perm].set(jnp.arange(p_out, dtype=jnp.int32))
+    return out, rounds, avail, acc_round, rank_of

@@ -1,0 +1,542 @@
+"""Tensorized inter-pod anti-affinity + topology spread (BASELINE config 5).
+
+The scalar predicates (core/predicates.py: anti_affinity_ok /
+topology_spread_ok) are pods×pods×nodes relations — the memory wall SURVEY.md
+§2b SP/CP warns about.  This module never materializes that 3-tensor.  The
+key observation: both predicates only consult *topology domains* (the set of
+nodes sharing a value of the term's topology key), so the device state is
+domain-granular:
+
+  AA term vocab T:  distinct (namespace, topology_key, selector) terms among
+                    pending + placed pods.
+  Spread vocab S:   distinct (namespace, key, max_skew, selector) constraints
+                    among pending pods.
+  Coarse domains D: (key, value) pairs over the referenced topology keys —
+                    node_dom_c[N, D] is each node's one-hot domain membership
+                    (one column per key it carries).
+  Fine domains:     keys whose values are unique per node (hostname-like) and
+                    nodes lacking a coarse key degrade to per-node singleton
+                    domains — state at node granularity [T, N], exactly as
+                    the scalar ``("~node", name)`` rule.
+
+Per auction round (ops/assign.py), the blocked pods×nodes mask is three
+matmuls — pod_carries[B,T] @ aa_matched_node[T,N] etc. — so constrained pods
+ride the same MXU path as everything else; per-round state updates are
+[T,P]@[P,D] matmuls plus O(P·T) scatters.
+
+Within-round conflicts (two mutually-anti-affine pods accepted into one
+domain in the same round; a domain over-filling past max_skew) are resolved
+by rank (the auction's priority order):
+  • AA: in each (term, domain) cell, a matched pod survives only if it
+    out-ranks every accepted carrier in the cell and vice versa (exact
+    min-rank rule; at worst it defers a pod the greedy oracle would accept
+    by one round — never admits a violation).
+  • Spread: per (constraint, domain) cell, a *water-filling* quota is
+    computed (8-step fixpoint of q = max_skew + lo − counts with lo the
+    rising min across the key's domains) and the cell keeps its quota's
+    worth of lowest-rank claimants — mass spread workloads commit whole
+    waves per round instead of one pod per domain.
+Deferred pods stay active and retry next round against the committed state;
+the round-start choose mask already blocks saturated domains, so every kept
+set is violation-free and the loop strictly progresses.
+
+Validity is *order-witnessed*: each round's kept set admits a sequential
+order in which every placement passes the scalar chain — rank order for
+anti-affinity (no conflicting pair survives the filter at all), ascending
+fill-height (c0 + position-in-cell) for spread waves: a height-h placement
+sees min-fill ≥ min(h, lo_fixpoint), so ``count+1−min ≤ max_skew`` holds at
+its turn (tests/test_constraints_tensor.py replays this certificate through
+core/predicates.py).  Caveat: a pod declaring *multiple* spread constraints
+joins each constraint's witness order; the per-constraint quotas are each
+respected but a single interleaving witnessing all of them simultaneously is
+not constructed — multi-constraint pods are conservative-safe per
+constraint, and the certificate test covers the (dominant) one-constraint
+shape.
+
+Everything is written against an ``xp`` namespace (numpy | jax.numpy) so the
+native and TPU backends share one expression tree — the same bit-parity
+contract as ops/masks.py.
+
+Scale guards: clusters whose constraint structure exceeds the static budgets
+(too many distinct terms, or a many-valued non-unique topology key) raise
+:class:`UntensorizableConstraints`; the controller then falls back to the
+exact host-side sequential phase (runtime/controller.py), so the tensor path
+is an accelerator, never a semantics change.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..api.objects import Pod
+from ..core.predicates import term_matches
+from .pack import round_up
+
+__all__ = [
+    "ConstraintSet",
+    "UntensorizableConstraints",
+    "pack_constraints",
+    "has_constraints",
+    "round_blocked_masks",
+    "blocked_block",
+    "constraint_filter",
+    "constraint_commit",
+    "RANK_INF",
+]
+
+RANK_INF = np.float32(3.0e38)
+
+# Default budgets (padded): tuned so [T,N]/[S,D] state stays a rounding error
+# next to the [block,N] choose tile at north-star scale.  Per-app selectors
+# (one term per deployment) are the common shape, so T/S budgets are sized
+# for dozens of distinct groups.
+MAX_AA_TERMS = 128
+MAX_SPREAD = 64
+MAX_COARSE_DOMAINS = 128
+
+
+class UntensorizableConstraints(Exception):
+    """Constraint structure exceeds the tensor budgets — use the host path."""
+
+
+def _canon_selector(match_labels, match_expressions) -> tuple:
+    ml = tuple(sorted((match_labels or {}).items()))
+    mx = tuple(
+        sorted(
+            (r.key, r.operator, tuple(sorted(r.values or ())) if r.operator in ("In", "NotIn") else tuple(r.values or ()))
+            for r in (match_expressions or [])
+        )
+    )
+    return (ml, mx)
+
+
+def _aa_key(ns, term) -> tuple:
+    return (ns, term.topology_key, _canon_selector(term.match_labels, term.match_expressions))
+
+
+def _sp_key(ns, c) -> tuple:
+    return (ns, c.topology_key, int(c.max_skew), _canon_selector(c.match_labels, c.match_expressions))
+
+
+@dataclass(frozen=True)
+class ConstraintSet:
+    """Device tensors for AA + spread over one packed cycle.
+
+    Pod rows align with PackedCluster's pending-pod order (padded to P).
+    State arrays are the *round-start* state (from placed pods); the auction
+    threads them through its while-loop carry.
+    """
+
+    # Pod side [P, T] / [P, S] float32
+    pod_aa_carries: np.ndarray
+    pod_aa_matched: np.ndarray
+    pod_sp_declares: np.ndarray
+    pod_sp_matched: np.ndarray
+    # Node side
+    node_dom_c: np.ndarray  # [N, D] float32 one-hot (one col per carried key)
+    # Term metadata
+    term_uses_dom: np.ndarray  # [T, D] float32 — domains of the term's key
+    sp_uses_dom: np.ndarray  # [S, D] float32
+    sp_skew: np.ndarray  # [S] float32
+    # Initial state (from placed pods)
+    aa_dom_m: np.ndarray  # [T, D] 0/1 — domain holds a pod matched by term
+    aa_dom_c: np.ndarray  # [T, D] 0/1 — domain holds a carrier of term
+    aa_node_m: np.ndarray  # [T, N] 0/1 — fine-granularity (singleton) twin
+    aa_node_c: np.ndarray  # [T, N] 0/1
+    sp_counts: np.ndarray  # [S, D] float32 — matching placed pods per domain
+
+    n_terms: int
+    n_spread: int
+
+    def pod_arrays(self) -> dict:
+        return {
+            "pod_aa_carries": self.pod_aa_carries,
+            "pod_aa_matched": self.pod_aa_matched,
+            "pod_sp_declares": self.pod_sp_declares,
+            "pod_sp_matched": self.pod_sp_matched,
+        }
+
+    def meta_arrays(self) -> dict:
+        return {
+            "node_dom_c": self.node_dom_c,
+            "term_uses_dom": self.term_uses_dom,
+            "sp_uses_dom": self.sp_uses_dom,
+            "sp_skew": self.sp_skew,
+        }
+
+    def state_arrays(self) -> dict:
+        return {
+            "aa_dom_m": self.aa_dom_m,
+            "aa_dom_c": self.aa_dom_c,
+            "aa_node_m": self.aa_node_m,
+            "aa_node_c": self.aa_node_c,
+            "sp_counts": self.sp_counts,
+        }
+
+
+def has_constraints(pending: list[Pod], snapshot) -> bool:
+    """Anything for this module to do this cycle?"""
+    if any(p.spec is not None and (p.spec.anti_affinity or p.spec.topology_spread) for p in pending):
+        return True
+    return bool(snapshot.placed_pods_with_terms())
+
+
+def pack_constraints(
+    snapshot,
+    pending: list[Pod],
+    padded_pods: int,
+    node_names: tuple[str, ...],
+    padded_nodes: int,
+    max_aa_terms: int = MAX_AA_TERMS,
+    max_spread: int = MAX_SPREAD,
+    max_coarse_domains: int = MAX_COARSE_DOMAINS,
+    label_block: int = 8,
+) -> ConstraintSet | None:
+    """Build constraint tensors for one cycle; None if nothing constrained.
+
+    Raises :class:`UntensorizableConstraints` when the structure exceeds the
+    budgets (the controller's cue to run the host sequential phase instead).
+    """
+    nodes = list(snapshot.nodes)
+    assert tuple(n.name for n in nodes) == tuple(node_names)
+
+    # --- vocabularies -----------------------------------------------------
+    aa_vocab: dict[tuple, tuple] = {}  # key -> (ns, term)
+    for p in pending:
+        if p.spec is not None and p.spec.anti_affinity:
+            for t in p.spec.anti_affinity:
+                aa_vocab.setdefault(_aa_key(p.metadata.namespace, t), (p.metadata.namespace, t))
+    placed_with_terms = snapshot.placed_pods_with_terms()
+    for q, _qn in placed_with_terms:
+        for t in q.spec.anti_affinity:
+            aa_vocab.setdefault(_aa_key(q.metadata.namespace, t), (q.metadata.namespace, t))
+    sp_vocab: dict[tuple, tuple] = {}
+    for p in pending:
+        if p.spec is not None and p.spec.topology_spread:
+            for c in p.spec.topology_spread:
+                sp_vocab.setdefault(_sp_key(p.metadata.namespace, c), (p.metadata.namespace, c))
+
+    if not aa_vocab and not sp_vocab:
+        return None
+    if len(aa_vocab) > max_aa_terms:
+        raise UntensorizableConstraints(f"{len(aa_vocab)} anti-affinity terms > budget {max_aa_terms}")
+    if len(sp_vocab) > max_spread:
+        raise UntensorizableConstraints(f"{len(sp_vocab)} spread constraints > budget {max_spread}")
+
+    # --- topology keys → coarse domains or fine (per-node) ----------------
+    keys = {k for (_ns, k, _sel) in aa_vocab} | {k for (_ns, k, _sk, _sel) in sp_vocab}
+    spread_keys = {k for (_ns, k, _sk, _sel) in sp_vocab}
+    key_values: dict[str, dict[str, list[int]]] = {k: {} for k in keys}
+    for i, n in enumerate(nodes):
+        labels = n.metadata.labels or {}
+        for k in keys:
+            v = labels.get(k)
+            if v is not None:
+                key_values[k].setdefault(v, []).append(i)
+
+    dom_vocab: dict[tuple[str, str], int] = {}  # (key, value) -> column
+    fine_keys: set[str] = set()
+    budget = max_coarse_domains
+    for k in sorted(keys):
+        vals = key_values[k]
+        if len(vals) <= budget - len(dom_vocab):
+            for v in sorted(vals):
+                dom_vocab[(k, v)] = len(dom_vocab)
+        elif all(len(nids) == 1 for nids in vals.values()):
+            # Hostname-like: unique value per node ⇒ domain ≡ node, exact at
+            # fine granularity with zero coarse columns.
+            fine_keys.add(k)
+            if k in spread_keys:
+                raise UntensorizableConstraints(f"spread key {k!r} is per-node-granular ({len(vals)} values)")
+        else:
+            raise UntensorizableConstraints(f"topology key {k!r} has {len(vals)} shared-value domains > budget")
+
+    d_pad = round_up(max(len(dom_vocab), 1), label_block)
+    t_pad = round_up(max(len(aa_vocab), 1), label_block)
+    s_pad = round_up(max(len(sp_vocab), 1), label_block)
+    n_pad = padded_nodes
+
+    node_dom_c = np.zeros((n_pad, d_pad), dtype=np.float32)
+    for (k, v), j in dom_vocab.items():
+        for i in key_values[k][v]:
+            node_dom_c[i, j] = 1.0
+
+    aa_terms = list(aa_vocab.items())  # [(key, (ns, term))]
+    sp_terms = list(sp_vocab.items())
+
+    term_uses_dom = np.zeros((t_pad, d_pad), dtype=np.float32)
+    for ti, (key, (_ns, term)) in enumerate(aa_terms):
+        if term.topology_key not in fine_keys:
+            for v in key_values.get(term.topology_key, ()):  # noqa: B007
+                term_uses_dom[ti, dom_vocab[(term.topology_key, v)]] = 1.0
+    sp_uses_dom = np.zeros((s_pad, d_pad), dtype=np.float32)
+    sp_skew = np.zeros((s_pad,), dtype=np.float32)
+    for si, (key, (_ns, c)) in enumerate(sp_terms):
+        sp_skew[si] = float(c.max_skew)
+        for v in key_values.get(c.topology_key, ()):
+            sp_uses_dom[si, dom_vocab[(c.topology_key, v)]] = 1.0
+
+    # --- pod-side bitmaps -------------------------------------------------
+    pod_aa_carries = np.zeros((padded_pods, t_pad), dtype=np.float32)
+    pod_aa_matched = np.zeros((padded_pods, t_pad), dtype=np.float32)
+    pod_sp_declares = np.zeros((padded_pods, s_pad), dtype=np.float32)
+    pod_sp_matched = np.zeros((padded_pods, s_pad), dtype=np.float32)
+    aa_index = {key: i for i, (key, _) in enumerate(aa_terms)}
+    sp_index = {key: i for i, (key, _) in enumerate(sp_terms)}
+    for pi, p in enumerate(pending):
+        ns, labels = p.metadata.namespace, p.metadata.labels
+        if p.spec is not None and p.spec.anti_affinity:
+            for t in p.spec.anti_affinity:
+                pod_aa_carries[pi, aa_index[_aa_key(ns, t)]] = 1.0
+        if p.spec is not None and p.spec.topology_spread:
+            for c in p.spec.topology_spread:
+                pod_sp_declares[pi, sp_index[_sp_key(ns, c)]] = 1.0
+        for ti, (_key, (t_ns, term)) in enumerate(aa_terms):
+            if t_ns == ns and term_matches(term, labels):
+                pod_aa_matched[pi, ti] = 1.0
+        for si, (_key, (c_ns, c)) in enumerate(sp_terms):
+            if c_ns == ns and term_matches(c, labels):
+                pod_sp_matched[pi, si] = 1.0
+
+    # --- initial state from placed pods -----------------------------------
+    aa_dom_m = np.zeros((t_pad, d_pad), dtype=np.float32)
+    aa_dom_c = np.zeros((t_pad, d_pad), dtype=np.float32)
+    aa_node_m = np.zeros((t_pad, n_pad), dtype=np.float32)
+    aa_node_c = np.zeros((t_pad, n_pad), dtype=np.float32)
+    sp_counts = np.zeros((s_pad, d_pad), dtype=np.float32)
+    node_index = {n.name: i for i, n in enumerate(nodes)}
+
+    def _mark(arr_dom, arr_node, ti, term, qnode_name):
+        ni = node_index[qnode_name]
+        k = term.topology_key
+        v = (nodes[ni].metadata.labels or {}).get(k)
+        if k not in fine_keys and v is not None:
+            arr_dom[ti, dom_vocab[(k, v)]] = 1.0
+        else:
+            arr_node[ti, ni] = 1.0
+
+    if aa_terms:
+        for q, qnode in snapshot.placed_pods():
+            q_ns, q_labels = q.metadata.namespace, q.metadata.labels
+            for ti, (_key, (t_ns, term)) in enumerate(aa_terms):
+                if t_ns == q_ns and term_matches(term, q_labels):
+                    _mark(aa_dom_m, aa_node_m, ti, term, qnode.name)
+        for q, qnode in placed_with_terms:
+            ns = q.metadata.namespace
+            for t in q.spec.anti_affinity:
+                _mark(aa_dom_c, aa_node_c, aa_index[_aa_key(ns, t)], t, qnode.name)
+    if sp_terms:
+        for q, qnode in snapshot.placed_pods():
+            q_ns, q_labels = q.metadata.namespace, q.metadata.labels
+            ni = node_index[qnode.name]
+            nlabels = nodes[ni].metadata.labels or {}
+            for si, (_key, (c_ns, c)) in enumerate(sp_terms):
+                if c_ns != q_ns:
+                    continue
+                v = nlabels.get(c.topology_key)
+                if v is not None and term_matches(c, q_labels):
+                    sp_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
+
+    return ConstraintSet(
+        pod_aa_carries=pod_aa_carries,
+        pod_aa_matched=pod_aa_matched,
+        pod_sp_declares=pod_sp_declares,
+        pod_sp_matched=pod_sp_matched,
+        node_dom_c=node_dom_c,
+        term_uses_dom=term_uses_dom,
+        sp_uses_dom=sp_uses_dom,
+        sp_skew=sp_skew,
+        aa_dom_m=aa_dom_m,
+        aa_dom_c=aa_dom_c,
+        aa_node_m=aa_node_m,
+        aa_node_c=aa_node_c,
+        sp_counts=sp_counts,
+        n_terms=len(aa_terms),
+        n_spread=len(sp_terms),
+    )
+
+
+# ---------------------------------------------------------------------------
+# xp-generic round engine (shared by ops/assign.py and backends/native.py)
+# ---------------------------------------------------------------------------
+
+
+def _clip01(xp, a):
+    return xp.minimum(a, 1.0)
+
+
+def round_blocked_masks(xp, state: dict, meta: dict) -> dict:
+    """Per-round [·, N] blocked-node masks from the current domain state.
+
+    aa_m_node[T,N]: node's domain (under term t's key) holds a matched pod —
+    blocks *carriers* of t.  aa_c_node[T,N]: holds a carrier — blocks
+    *matched* pods.  sp_node[S,N]: placing a matching pod there would exceed
+    ``max_skew + min(counts)`` — blocks *declarers* of s.
+    """
+    ndc_t = meta["node_dom_c"].T
+    aa_m_node = _clip01(xp, state["aa_dom_m"] @ ndc_t + state["aa_node_m"])
+    aa_c_node = _clip01(xp, state["aa_dom_c"] @ ndc_t + state["aa_node_c"])
+    uses = meta["sp_uses_dom"]
+    counts = state["sp_counts"]
+    lo = xp.min(xp.where(uses > 0, counts, RANK_INF), axis=1)
+    lo = xp.where(lo >= RANK_INF, 0.0, lo)
+    blockcell = uses * (counts >= (meta["sp_skew"] + lo)[:, None])
+    sp_node = _clip01(xp, blockcell @ ndc_t)
+    return {"aa_m_node": aa_m_node, "aa_c_node": aa_c_node, "sp_node": sp_node}
+
+
+def blocked_block(xp, blk: dict, masks: dict):
+    """[B, N] constraint-blocked mask for one pod block (three matmuls)."""
+    b = blk["pod_aa_carries"] @ masks["aa_m_node"]
+    b = b + blk["pod_aa_matched"] @ masks["aa_c_node"]
+    b = b + blk["pod_sp_declares"] @ masks["sp_node"]
+    return b > 0
+
+
+def _scatter_min(xp, size: int, idx, vals):
+    if xp is np:
+        out = np.full((size,), RANK_INF, dtype=np.float32)
+        np.minimum.at(out, idx, vals)
+        return out
+    return xp.full((size,), RANK_INF, dtype=xp.float32).at[idx].min(vals)
+
+
+def _scatter_max1(xp, arr, idx, vals):
+    """arr (flat) with arr[idx] = max(arr[idx], vals)."""
+    if xp is np:
+        out = arr.copy()
+        np.maximum.at(out, idx, vals)
+        return out
+    return arr.at[idx].max(vals)
+
+
+def _argsort_stable(xp, a):
+    if xp is np:
+        return np.argsort(a, kind="stable")
+    return xp.argsort(a, stable=True)
+
+
+def _cummax(xp, a):
+    if xp is np:
+        return np.maximum.accumulate(a)
+    from jax import lax
+
+    return lax.cummax(a, axis=0)
+
+
+def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: dict) -> object:
+    """Within-round conflict resolution — returns the surviving subset of
+    ``accepted`` (see module docstring for the rank rules)."""
+    ndc = meta["node_dom_c"]
+    d = ndc.shape[1]
+    n = ndc.shape[0]
+    nd = ndc[choice]  # [P, D] one-hot domains of each pod's chosen node
+    accf = accepted.astype(xp.float32)
+    rank_f = ranks.astype(xp.float32)
+
+    # ---- anti-affinity ----------------------------------------------------
+    uses = meta["term_uses_dom"]  # [T, D]
+    t = uses.shape[0]
+    cells = d + n
+    dom_ids = xp.arange(d, dtype=xp.float32)
+    cc = nd @ (uses * dom_ids[None, :]).T  # [P, T] coarse cell id (sum of ≤1 one-hot)
+    has_c = nd @ uses.T  # [P, T] 1 if the chosen node has the term's coarse key
+    cell = xp.where(has_c > 0, cc, d + choice[:, None].astype(xp.float32))
+    g = (xp.arange(t, dtype=xp.float32)[None, :] * cells + cell).astype(xp.int32)  # [P, T]
+    carr = ps["pod_aa_carries"] * accf[:, None]
+    matc = ps["pod_aa_matched"] * accf[:, None]
+    gf = g.reshape(-1)
+    min_carrier = _scatter_min(xp, t * cells, gf, xp.where(carr > 0, rank_f[:, None], RANK_INF).reshape(-1))
+    min_matched = _scatter_min(xp, t * cells, gf, xp.where(matc > 0, rank_f[:, None], RANK_INF).reshape(-1))
+    min_c_at = min_carrier[g]  # [P, T]
+    min_m_at = min_matched[g]
+    bad_aa = ((matc > 0) & (rank_f[:, None] > min_c_at)) | ((carr > 0) & (rank_f[:, None] > min_m_at))
+    keep = accepted & ~bad_aa.any(axis=1)
+
+    # ---- topology spread (vectorized over S) ------------------------------
+    uses_sp = meta["sp_uses_dom"]  # [S, D]
+    s_axis = uses_sp.shape[0]
+    skew = meta["sp_skew"]  # [S]
+    declares, matched = ps["pod_sp_declares"], ps["pod_sp_matched"]
+    in_cell = nd @ uses_sp.T  # [P, S] 1 iff chosen node carries the key
+    dm = accf[:, None] * declares * matched * in_cell  # declaring+matching
+    mo = accf[:, None] * (1.0 - declares) * matched  # matching-only (keyless→0 via matmul)
+    dn = accf[:, None] * declares * (1.0 - matched) * in_cell  # declaring-only
+    c0 = state["sp_counts"] + (mo.T @ nd) * uses_sp  # [S, D]
+    dem = (dm.T @ nd) * uses_sp  # [S, D]
+
+    def _masked_lo(c):
+        lo = xp.min(xp.where(uses_sp > 0, c, RANK_INF), axis=1)
+        return xp.where(lo >= RANK_INF, 0.0, lo)
+
+    lo = _masked_lo(c0)
+    for _ in range(8):  # water-filling fixpoint (lo is nondecreasing)
+        q = xp.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp
+        lo = _masked_lo(c0 + xp.minimum(dem, q))
+    q_final = xp.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp  # [S, D]
+
+    # Rank-prefix of each declaring+matching pod within its (s, domain) cell:
+    # flatten (s, p) s-major so a stable sort by cell id groups cells while
+    # preserving rank order, then position-in-segment via a cummax of segment
+    # starts.  Array order == rank order among this round's claimants.
+    p_axis = nd.shape[0]
+    cc_sp = nd @ (uses_sp * dom_ids[None, :]).T  # [P, S] coarse cell id
+    cells_sp = d + 1
+    sentinel = xp.float32(d)
+    cell_sp = xp.where(dm > 0, cc_sp, sentinel)  # non-claimants → shared sentinel cell
+    g_sp = (xp.arange(s_axis, dtype=xp.float32)[None, :] * cells_sp + cell_sp).T.reshape(-1)  # [S*P]
+    order = _argsort_stable(xp, g_sp)
+    g_sorted = g_sp[order]
+    idx = xp.arange(s_axis * p_axis, dtype=xp.float32)
+    is_start = xp.concatenate([xp.ones((1,), dtype=bool), g_sorted[1:] != g_sorted[:-1]])
+    seg_start = _cummax(xp, xp.where(is_start, idx, 0.0))
+    pos_sorted = idx - seg_start
+    if xp is np:
+        pos_flat = np.empty_like(pos_sorted)
+        pos_flat[order] = pos_sorted
+    else:
+        pos_flat = xp.zeros_like(pos_sorted).at[order].set(pos_sorted)
+    prefix = pos_flat.reshape(s_axis, p_axis).T  # [P, S]
+
+    q_at = nd @ q_final.T  # [P, S] quota of own cell (0 where keyless)
+    keep_dm = prefix < q_at
+    c_final = c0 + xp.minimum(dem, q_final)
+    lo_final = _masked_lo(c_final)
+    c_at = nd @ c_final.T  # [P, S]
+    keep_dn = (c_at + 1.0) <= (skew + lo_final)[None, :]
+    bad_sp = ((dm > 0) & ~keep_dm) | ((dn > 0) & ~keep_dn)
+    return keep & ~bad_sp.any(axis=1)
+
+
+def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict) -> dict:
+    """Fold the round's final accepted placements into the domain state."""
+    ndc = meta["node_dom_c"]
+    d = ndc.shape[1]
+    n = ndc.shape[0]
+    t = meta["term_uses_dom"].shape[0]
+    nd = ndc[choice]
+    accf = accepted.astype(xp.float32)
+    matc = ps["pod_aa_matched"] * accf[:, None]  # [P, T]
+    carr = ps["pod_aa_carries"] * accf[:, None]
+    uses = meta["term_uses_dom"]
+    aa_dom_m = _clip01(xp, state["aa_dom_m"] + (matc.T @ nd) * uses)
+    aa_dom_c = _clip01(xp, state["aa_dom_c"] + (carr.T @ nd) * uses)
+    # Fine-granularity: chosen node lacks the term's coarse key (or the key
+    # itself is fine) → the node is its own domain.
+    has_c = nd @ uses.T  # [P, T]
+    fine_m = (matc * (has_c == 0)).T.reshape(-1)  # [T*P]
+    fine_c = (carr * (has_c == 0)).T.reshape(-1)
+    gn = (xp.arange(t, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
+    aa_node_m = _scatter_max1(xp, state["aa_node_m"].reshape(-1), gn, fine_m).reshape(t, n)
+    aa_node_c = _scatter_max1(xp, state["aa_node_c"].reshape(-1), gn, fine_c).reshape(t, n)
+    sp_m = ps["pod_sp_matched"] * accf[:, None]  # [P, S]
+    sp_counts = state["sp_counts"] + (sp_m.T @ nd) * meta["sp_uses_dom"]
+    return {
+        "aa_dom_m": aa_dom_m,
+        "aa_dom_c": aa_dom_c,
+        "aa_node_m": aa_node_m,
+        "aa_node_c": aa_node_c,
+        "sp_counts": sp_counts,
+    }

@@ -118,6 +118,12 @@ class PackedCluster:
     taint_vocab: dict[tuple[str, str, str], int]
     aff_vocab: dict[tuple, int]  # NodeSelectorTerm.key() -> column
 
+    # Anti-affinity/topology-spread tensors for this cycle (ops/constraints
+    # .ConstraintSet) — attached per-cycle by the controller (the domain
+    # state depends on current placements, so it is never cached), None for
+    # unconstrained cycles.
+    constraints: object | None = None
+
     @property
     def num_nodes(self) -> int:
         return len(self.node_names)

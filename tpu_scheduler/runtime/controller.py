@@ -294,12 +294,36 @@ class Scheduler:
         spec = replace(pod.spec, node_name=node.name) if pod.spec is not None else PodSpec(node_name=node.name)
         return replace(pod, spec=spec)
 
-    def _schedule_batch(self, batch_snapshot: ClusterSnapshot, placed: list[tuple[Pod, Node]]) -> tuple[int, int, int]:
+    def _schedule_batch(
+        self, batch_snapshot: ClusterSnapshot, placed: list[tuple[Pod, Node]], with_constraints: bool = False
+    ) -> tuple[int, int, int]:
         """Pack + solve + bind one batch of plain pending pods; successful
         placements append to ``placed``.  Returns (bound, unschedulable,
-        rounds)."""
+        rounds).
+
+        ``with_constraints`` additionally packs the anti-affinity/topology-
+        spread tensors (ops/constraints.py) so constrained pods ride the
+        batch path; raises UntensorizableConstraints when the structure
+        exceeds the tensor budgets (caller falls back to the host phase).
+        """
         with span("pack"):
             packed = self._pack(batch_snapshot)
+            if with_constraints:
+                from ..ops.constraints import pack_constraints
+
+                cons = pack_constraints(
+                    batch_snapshot,
+                    batch_snapshot.pending_pods(),
+                    packed.padded_pods,
+                    packed.node_names,
+                    packed.padded_nodes,
+                )
+                if cons is not None:
+                    # Attached to a per-cycle copy only: the cached pack is
+                    # reused incrementally, but domain state depends on the
+                    # cycle's placements and is rebuilt every time.
+                    packed = replace(packed, constraints=cons)
+                    self.metrics.inc("scheduler_constraint_tensor_cycles_total")
         with span("solve"):
             try:
                 result = self.backend.schedule(packed, self.profile)
@@ -335,6 +359,19 @@ class Scheduler:
             # Fast path — one tensor cycle over every pending pod (and the
             # incremental device-resident pack stays hot).
             return self._schedule_batch(snapshot, placed)
+
+        # Constrained cycle, tensor-first: anti-affinity + topology-spread
+        # ride the device path as domain-bitmap tensors (ops/constraints.py)
+        # so the whole pending set schedules in ONE batch; the sequential
+        # host phase below survives only as the fallback for constraint
+        # structures beyond the tensor budgets.
+        from ..ops.constraints import UntensorizableConstraints
+
+        try:
+            return self._schedule_batch(snapshot, placed, with_constraints=True)
+        except UntensorizableConstraints as e:
+            logger.info("constraints not tensorizable (%s); host sequential fallback", e)
+            self.metrics.inc("scheduler_constraint_host_fallbacks_total")
 
         # Mixed cycle: schedule in global priority order so a plain pod never
         # takes capacity from a higher-priority constrained pod (or vice
