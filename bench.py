@@ -36,10 +36,27 @@ import time
 
 INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "5"))
 ATTEMPT_ENV = "BENCH_INIT_ATTEMPT"
+# Sibling probe (scripts/tpu_probe.py) records its last device-init outcome
+# here; a fresh failure report shrinks our retry budget so a known-down
+# tunnel doesn't cost INIT_ATTEMPTS × ~25 min before the CPU fallback.
+PROBE_STATUS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts", "tpu_status.json")
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _attempt_budget() -> int:
+    try:
+        with open(PROBE_STATUS) as f:
+            st = json.load(f)
+        age = time.time() - float(st.get("ts", 0))
+        if not st.get("ok") and age < 1800:
+            log(f"probe reported TPU down {age/60:.0f} min ago ({st.get('error', '')[:120]}); shrinking retries")
+            return min(2, INIT_ATTEMPTS)
+    except (OSError, ValueError, KeyError):
+        pass
+    return INIT_ATTEMPTS
 
 
 def init_devices(force_cpu: bool = False):
@@ -68,9 +85,10 @@ def init_devices(force_cpu: bool = False):
             + ("present" if any("axon" in p for p in sys.path) else "MISSING — axon backend can't register")
             + f"; JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')}"
         )
-        if attempt + 1 < INIT_ATTEMPTS:
+        budget = _attempt_budget()
+        if attempt + 1 < budget:
             delay = min(120, 20 * (attempt + 1))
-            log(f"retrying in {delay}s (attempt {attempt + 1}/{INIT_ATTEMPTS})")
+            log(f"retrying in {delay}s (attempt {attempt + 1}/{budget})")
             time.sleep(delay)
             os.environ[ATTEMPT_ENV] = str(attempt + 1)
             os.execv(sys.executable, [sys.executable] + sys.argv)

@@ -1,0 +1,57 @@
+"""Worker process for tests/test_multihost.py — one of N jax.distributed
+processes running the sharded cycle over a DCN-emulating TCP coordinator.
+
+Usage: python multihost_worker.py <coordinator> <num_processes> <process_id>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    coordinator, num_processes, process_id = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_scheduler.parallel.mesh import init_distributed, make_mesh
+
+    assert init_distributed(coordinator_address=coordinator, num_processes=num_processes, process_id=process_id)
+    assert jax.process_count() == num_processes, jax.process_count()
+    assert len(jax.devices()) == 4 * num_processes, jax.devices()
+
+    from tpu_scheduler.backends.native import NativeBackend
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.parallel.multihost import sharded_assign_multihost
+    from tpu_scheduler.testing import synth_cluster
+
+    # Every process packs the same snapshot (deterministic) — the multi-host
+    # contract.  tp=2 keeps the chatty axis intra-process; dp=4 spans both.
+    snap = synth_cluster(n_nodes=16, n_pending=64, n_bound=16, seed=2, tainted_fraction=0.2)
+    packed = pack_snapshot(snap, pod_block=16, node_block=8)
+    mesh = make_mesh(tp=2)
+    assert mesh.shape == {"dp": 2 * num_processes, "tp": 2}
+    # tp rows must be intra-process (ICI), dp crossing processes (DCN).
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1, "tp row crosses hosts"
+
+    profile = DEFAULT_PROFILE.with_(max_rounds=16)
+    assigned, rounds = sharded_assign_multihost(mesh, packed.device_arrays(), profile.weights(), max_rounds=16)
+
+    oracle, oracle_rounds, _ = NativeBackend().assign(packed, profile)
+    import numpy as np
+
+    if not np.array_equal(assigned, np.asarray(oracle)):
+        diff = int((assigned != np.asarray(oracle)).sum())
+        print(f"MULTIHOST_MISMATCH process={process_id} diff={diff}", flush=True)
+        return 1
+    bound = int((assigned >= 0).sum())
+    print(f"MULTIHOST_OK process={process_id} bound={bound} rounds={rounds}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -269,6 +269,31 @@ def test_scheduler_uses_tensor_path_for_constrained_cluster():
         assert P.anti_affinity_ok(pod, node_by[node.name], others), full_name(pod)
 
 
+def test_sharded_backend_refuses_constraints_and_controller_falls_back():
+    """ShardedBackend doesn't evaluate constraint tensors yet — it must
+    refuse them (not silently bind violations), and the controller must
+    route the cycle through the exact host phase instead."""
+    from tpu_scheduler.parallel.sharded import ShardedBackend
+
+    nodes = [make_node(f"n{i}", cpu="32", memory="64Gi", labels={"name": f"n{i}"}) for i in range(4)]
+    term = [PodAntiAffinityTerm(match_labels={"app": "db"}, topology_key="name")]
+    pods = [make_pod(f"db-{i}", labels={"app": "db"}, anti_affinity=term) for i in range(3)]
+    snap = ClusterSnapshot.build(nodes, pods)
+    packed = _packed_with_constraints(snap)
+    backend = ShardedBackend(tp=2)
+    with pytest.raises(UntensorizableConstraints):
+        backend.schedule(packed, DEFAULT_PROFILE)
+
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, backend, policy="batch", requeue_seconds=0.0)
+    sched.run(until_settled=True)
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_constraint_host_fallbacks_total", 0) >= 1
+    bound_nodes = {p.spec.node_name for p in api.list_pods() if p.spec.node_name}
+    assert len(bound_nodes) == 3  # anti-affinity respected via host phase
+
+
 def test_plain_cycles_unchanged_by_constraint_plumbing():
     """An unconstrained cluster must take the exact pre-existing path
     (constraints=None) — guard against overhead/regression."""

@@ -78,7 +78,6 @@ __all__ = [
     "ConstraintSet",
     "UntensorizableConstraints",
     "pack_constraints",
-    "has_constraints",
     "round_blocked_masks",
     "blocked_block",
     "constraint_filter",
@@ -174,13 +173,6 @@ class ConstraintSet:
             "aa_node_c": self.aa_node_c,
             "sp_counts": self.sp_counts,
         }
-
-
-def has_constraints(pending: list[Pod], snapshot) -> bool:
-    """Anything for this module to do this cycle?"""
-    if any(p.spec is not None and (p.spec.anti_affinity or p.spec.topology_spread) for p in pending):
-        return True
-    return bool(snapshot.placed_pods_with_terms())
 
 
 def pack_constraints(

@@ -1,0 +1,121 @@
+"""Multi-host (DCN) execution of the sharded scheduling cycle.
+
+Single-process JAX can hand plain numpy arrays to a jitted shard_map and let
+the runtime scatter them; across processes that is impossible — every
+process owns only its addressable shards.  This module is the thin layer
+that difference requires:
+
+  • the priority permute + block padding run host-side in numpy (bit-
+    identical to the jnp ops in parallel/sharded.py's single-process
+    wrapper: both are stable argsorts on int32 + zero pads);
+  • inputs become global ``jax.Array``s via ``make_array_from_callback``
+    against the shard_map IN_SPECS, so each process materialises exactly its
+    shards (node tensors split over tp, pod tensors over dp, weights
+    replicated);
+  • the *same* shard_map program as the single-process path executes
+    (parallel/sharded.py::_build_shard_map — per-round all_gather over tp on
+    ICI, one O(P) pod-claim all_gather over dp on DCN);
+  • the dp-sharded result is re-replicated with
+    ``multihost_utils.process_allgather`` so every host sees every binding.
+
+Every process must call :func:`sharded_assign_multihost` with the same
+arrays (each packs the same snapshot — packing is deterministic), mirroring
+how every host of a TPU pod slice feeds the same program.
+
+Proven by tests/test_multihost.py: two OS processes, a TCP coordinator
+(``mesh.init_distributed``), 4 virtual CPU devices each → a dp=4×tp=2 mesh
+spanning both, with bit-parity against the single-process native oracle.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from ..ops.pack import round_up
+from .sharded import IN_SPECS, _build_shard_map
+
+__all__ = ["sharded_assign_multihost", "make_global_array"]
+
+
+@lru_cache(maxsize=64)
+def _jitted_shard_map(mesh, max_rounds: int):
+    """Cached jit of the shard_map program — without this every cycle would
+    re-trace and re-compile (the single-process twin _build_sharded_fn is
+    lru_cached for the same reason)."""
+    import jax
+
+    return jax.jit(_build_shard_map(mesh, max_rounds))
+
+
+def make_global_array(mesh, spec, arr: np.ndarray):
+    """Build a global jax.Array from a (process-replicated) numpy array —
+    each process materialises only its addressable shards."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_callback(arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx])
+
+
+def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32):
+    """Run one scheduling cycle over a (possibly multi-host) mesh.
+
+    ``arrays`` is the PackedCluster ``device_arrays()`` dict (numpy, same on
+    every process).  Returns (assigned [P] np.int32, rounds int) replicated
+    to every process.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+    a = dict(arrays)
+
+    # Node padding to the tp multiple (host-side twin of ShardedBackend.assign).
+    n0 = a["node_avail"].shape[0]
+    n_pad = round_up(n0, tp)
+    for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff"):
+        a[k] = np.pad(a[k], ((0, n_pad - n0), (0, 0)))
+    a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - n0),))
+
+    # Priority permute BEFORE dp padding (rank parity with the native path),
+    # then pad pods to the dp multiple.
+    p_tot = a["pod_req"].shape[0]
+    perm = np.argsort(-a["pod_prio"], kind="stable")
+    pods = {
+        k: a[k][perm]
+        for k in ("pod_req", "pod_sel", "pod_sel_count", "pod_ntol", "pod_aff", "pod_has_aff", "pod_valid")
+    }
+    extra = (-p_tot) % dp
+    if extra:
+        for k, v in pods.items():
+            pods[k] = np.pad(v, ((0, extra),) + ((0, 0),) * (v.ndim - 1))
+
+    operands = (
+        a["node_alloc"],
+        a["node_avail"],
+        a["node_labels"],
+        a["node_taints"],
+        a["node_aff"],
+        a["node_valid"],
+        pods["pod_req"],
+        pods["pod_sel"],
+        pods["pod_sel_count"],
+        pods["pod_ntol"],
+        pods["pod_aff"],
+        pods["pod_has_aff"],
+        pods["pod_valid"],
+        np.asarray(weights, dtype=np.float32),
+    )
+    global_ins = [make_global_array(mesh, spec, arr) for spec, arr in zip(IN_SPECS, operands)]
+
+    fn = _jitted_shard_map(mesh, max_rounds)
+    assigned_p, rounds, _avail = fn(*global_ins)
+
+    assigned_full = np.asarray(multihost_utils.process_allgather(assigned_p, tiled=True))
+    out = np.full((p_tot,), -1, dtype=np.int32)
+    out[perm] = assigned_full[:p_tot]
+    # rounds comes out of the shard_map replicated (out_spec P()) — every
+    # process can read it locally, no gather needed.
+    rounds_val = int(np.asarray(rounds.addressable_data(0)))
+    return out, rounds_val

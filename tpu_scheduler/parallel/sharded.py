@@ -63,9 +63,10 @@ def _local_choose(
 
 
 @lru_cache(maxsize=64)
-def _build_sharded_fn(mesh, max_rounds: int):
-    """Jitted (mesh, max_rounds)-specialised cycle fn — cached so repeated
-    cycles reuse the compiled executable (jit re-specialises per shape)."""
+def _build_shard_map(mesh, max_rounds: int):
+    """The shard_map'd per-device cycle fn (not yet jitted/wrapped) — shared
+    by the single-process run wrapper below and the multi-host path
+    (parallel/multihost.py), so both execute the identical program."""
     dp = mesh.shape["dp"]
     tp = mesh.shape["tp"]
 
@@ -142,30 +143,44 @@ def _build_sharded_fn(mesh, max_rounds: int):
         avail, assigned, _, _, rounds = lax.while_loop(cond, body, state0)
         return assigned, rounds, avail
 
-    sharded = jax.shard_map(
+    return jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(
-            P("tp", None),  # node_alloc
-            P("tp", None),  # node_avail
-            P("tp", None),  # node_labels
-            P("tp", None),  # node_taints
-            P("tp", None),  # node_aff
-            P("tp"),  # node_valid
-            P("dp", None),  # pod_req
-            P("dp", None),  # pod_sel
-            P("dp"),  # pod_sel_count
-            P("dp", None),  # pod_ntol
-            P("dp", None),  # pod_aff
-            P("dp"),  # pod_has_aff
-            P("dp"),  # pod_valid (already priority-permuted)
-            P(),  # weights
-        ),
+        in_specs=IN_SPECS,
         out_specs=(P("dp"), P(), P("tp", None)),
         # The while-carry mixes tp-varying (avail) and dp-varying (assigned)
         # state that converges by construction; VMA inference can't see that.
         check_vma=False,
     )
+
+
+# shard_map input layout, shared with parallel/multihost.py: node tensors
+# over tp, pod tensors (pre-permuted to priority order) over dp, weights
+# replicated.
+IN_SPECS = (
+    P("tp", None),  # node_alloc
+    P("tp", None),  # node_avail
+    P("tp", None),  # node_labels
+    P("tp", None),  # node_taints
+    P("tp", None),  # node_aff
+    P("tp"),  # node_valid
+    P("dp", None),  # pod_req
+    P("dp", None),  # pod_sel
+    P("dp"),  # pod_sel_count
+    P("dp", None),  # pod_ntol
+    P("dp", None),  # pod_aff
+    P("dp"),  # pod_has_aff
+    P("dp"),  # pod_valid (already priority-permuted)
+    P(),  # weights
+)
+
+
+@lru_cache(maxsize=64)
+def _build_sharded_fn(mesh, max_rounds: int):
+    """Jitted (mesh, max_rounds)-specialised cycle fn — cached so repeated
+    cycles reuse the compiled executable (jit re-specialises per shape)."""
+    dp = mesh.shape["dp"]
+    sharded = _build_shard_map(mesh, max_rounds)
 
     @jax.jit
     def run(a, w):
@@ -230,6 +245,14 @@ class ShardedBackend(SchedulingBackend):
         self.mesh = mesh if mesh is not None else make_mesh(tp=tp)
 
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
+        if packed.constraints is not None:
+            # The sharded cycle doesn't evaluate the anti-affinity/spread
+            # tensors yet; dropping them silently would bind violating
+            # placements.  Raising the tensor-budget signal routes the
+            # controller to its exact host-side constrained phase.
+            from ..ops.constraints import UntensorizableConstraints
+
+            raise UntensorizableConstraints("sharded backend does not evaluate constraint tensors yet")
         try:
             tp = self.mesh.shape["tp"]
             a = dict(packed.device_arrays())
