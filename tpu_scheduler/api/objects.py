@@ -125,11 +125,14 @@ class PodAntiAffinityTerm:
 
 @dataclass
 class TopologySpreadConstraint:
-    """Hard (DoNotSchedule) topology-spread constraint (config 5).
+    """Topology-spread constraint (config 5).
 
     Counts pods matching the selector in the pod's namespace per domain
-    of ``topology_key``; placing the pod on a node must keep
-    ``count(domain)+1 − min(count over the key's named domains) ≤ max_skew``.
+    of ``topology_key``.  With ``when_unsatisfiable="DoNotSchedule"`` (hard,
+    the default) placing the pod on a node must keep
+    ``count(domain)+1 − min(count over the key's named domains) ≤ max_skew``;
+    with ``"ScheduleAnyway"`` (soft) the skew is allowed but emptier domains
+    score higher (weighted by the profile's ``topology_weight``).
     Nodes lacking the key are exempt from the constraint and excluded from
     the minimum (matching kube-scheduler's default node-exclusion).
     An empty selector matches nothing → the constraint is vacuous.
@@ -139,6 +142,11 @@ class TopologySpreadConstraint:
     max_skew: int = 1
     match_labels: dict[str, str] | None = None
     match_expressions: list[LabelSelectorRequirement] | None = None
+    when_unsatisfiable: str = "DoNotSchedule"
+
+    @property
+    def is_hard(self) -> bool:
+        return self.when_unsatisfiable != "ScheduleAnyway"
 
 
 @dataclass
@@ -165,8 +173,9 @@ class NodeSelectorTerm:
 
 @dataclass
 class Taint:
-    """Node taint.  Effects enforced as hard filters: NoSchedule and
-    NoExecute; PreferNoSchedule is soft and not (yet) scored."""
+    """Node taint.  NoSchedule and NoExecute are enforced as hard filters;
+    PreferNoSchedule is soft — untolerated ones subtract score (ops/score.py,
+    weighted by the profile's ``soft_taint_weight``)."""
 
     key: str
     value: str = ""
@@ -199,6 +208,17 @@ class Toleration:
 
 
 @dataclass
+class PreferredSchedulingTerm:
+    """One ``preferredDuringSchedulingIgnoredDuringExecution`` entry of node
+    affinity: a soft preference — nodes matching ``term`` gain ``weight``
+    (1-100, kube semantics) score points, scaled by the profile's
+    ``preferred_affinity_weight``."""
+
+    weight: int
+    term: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
 class PodSpec:
     containers: list[Container] = field(default_factory=list)
     node_selector: dict[str, str] | None = None
@@ -211,6 +231,7 @@ class PodSpec:
     topology_spread: list[TopologySpreadConstraint] | None = None
     tolerations: list[Toleration] | None = None
     node_affinity: list[NodeSelectorTerm] | None = None  # required terms, ORed
+    preferred_node_affinity: list[PreferredSchedulingTerm] | None = None  # soft, weighted
 
 
 @dataclass
@@ -277,27 +298,34 @@ class Pod:
                 ]
             spread = None
             constraints = spec_d.get("topologySpreadConstraints") or []
-            hard = [c for c in constraints if c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"]
-            if hard:  # ScheduleAnyway (soft) constraints are not yet scored
+            if constraints:  # hard (DoNotSchedule) and soft (ScheduleAnyway) alike
                 spread = [
                     TopologySpreadConstraint(
                         topology_key=c.get("topologyKey", ""),
                         max_skew=c.get("maxSkew", 1),
                         match_labels=(c.get("labelSelector") or {}).get("matchLabels"),
                         match_expressions=parse_expressions(c.get("labelSelector")),
+                        when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
                     )
-                    for c in hard
+                    for c in constraints
                 ]
             node_aff = None
+            node_affinity_d = (spec_d.get("affinity") or {}).get("nodeAffinity") or {}
             node_sel_terms = (
-                (((spec_d.get("affinity") or {}).get("nodeAffinity") or {}).get(
-                    "requiredDuringSchedulingIgnoredDuringExecution"
-                ) or {}
-                ).get("nodeSelectorTerms")
-                or []
-            )
+                node_affinity_d.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+            ).get("nodeSelectorTerms") or []
             if node_sel_terms:
                 node_aff = [NodeSelectorTerm(match_expressions=parse_expressions(t)) for t in node_sel_terms]
+            pref_aff = None
+            pref_terms = node_affinity_d.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+            if pref_terms:
+                pref_aff = [
+                    PreferredSchedulingTerm(
+                        weight=int(t.get("weight", 1)),
+                        term=NodeSelectorTerm(match_expressions=parse_expressions(t.get("preference"))),
+                    )
+                    for t in pref_terms
+                ]
             tolerations = [
                 Toleration(
                     key=t.get("key", ""),
@@ -316,6 +344,7 @@ class Pod:
                 topology_spread=spread,
                 tolerations=tolerations,
                 node_affinity=node_aff,
+                preferred_node_affinity=pref_aff,
             )
         status = PodStatus(phase=d.get("status", {}).get("phase", "Pending"))
         obj_meta = ObjectMeta(
@@ -403,14 +432,20 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
                 term["labelSelector"] = sel
             terms.append(term)
         affinity["podAntiAffinity"] = {"requiredDuringSchedulingIgnoredDuringExecution": terms}
-    if pod.spec.node_affinity:
-        affinity["nodeAffinity"] = {
-            "requiredDuringSchedulingIgnoredDuringExecution": {
+    if pod.spec.node_affinity or pod.spec.preferred_node_affinity:
+        node_affinity: dict[str, Any] = {}
+        if pod.spec.node_affinity:
+            node_affinity["requiredDuringSchedulingIgnoredDuringExecution"] = {
                 "nodeSelectorTerms": [
                     _selector_to_dict(None, t.match_expressions) or {} for t in pod.spec.node_affinity
                 ]
             }
-        }
+        if pod.spec.preferred_node_affinity:
+            node_affinity["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                {"weight": t.weight, "preference": _selector_to_dict(None, t.term.match_expressions) or {}}
+                for t in pod.spec.preferred_node_affinity
+            ]
+        affinity["nodeAffinity"] = node_affinity
     if affinity:
         spec["affinity"] = affinity
     if pod.spec.topology_spread:
@@ -419,7 +454,7 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
             constraint: dict[str, Any] = {
                 "topologyKey": c.topology_key,
                 "maxSkew": c.max_skew,
-                "whenUnsatisfiable": "DoNotSchedule",
+                "whenUnsatisfiable": c.when_unsatisfiable,
             }
             sel = _selector_to_dict(c.match_labels, c.match_expressions)
             if sel:

@@ -36,6 +36,9 @@ __all__ = [
     "node_topology_domain",
     "make_affinity_checker",
     "make_spread_checker",
+    "preferred_affinity_score",
+    "soft_taint_penalty",
+    "make_soft_spread_scorer",
     "check_node_validity",
     "PREDICATE_CHAIN",
     "NODE_LOCAL_PREDICATES",
@@ -288,7 +291,7 @@ def make_spread_checker(
     key is exempt; keyless nodes' pods don't enter the counts or the min.
     ``extra_placed`` overlays same-cycle commitments not yet in the snapshot.
     """
-    constraints = (pod.spec.topology_spread or []) if pod.spec is not None else []
+    constraints = [c for c in ((pod.spec.topology_spread or []) if pod.spec is not None else []) if c.is_hard]
     if not constraints:
         return lambda node: True
     my_ns = pod.metadata.namespace
@@ -331,6 +334,70 @@ def topology_spread_ok(
     One-shot form of :func:`make_spread_checker` — see it for semantics.
     """
     return make_spread_checker(pod, snapshot, extra_placed)(node)
+
+
+# --- soft (scoring) terms ---------------------------------------------------
+
+
+def preferred_affinity_score(pod: Pod, node: Node) -> float:
+    """Sum of weights of the pod's matching preferredDuringScheduling node-
+    affinity terms (kube NodeAffinity scoring, pre-normalization)."""
+    terms = (pod.spec.preferred_node_affinity or []) if pod.spec is not None else []
+    if not terms:
+        return 0.0
+    labels = node.metadata.labels
+    return float(sum(t.weight for t in terms if node_selector_term_matches(t.term, labels)))
+
+
+def soft_taint_penalty(pod: Pod, node: Node) -> int:
+    """Count of the node's PreferNoSchedule taints the pod does not
+    tolerate (kube TaintToleration scoring, pre-normalization)."""
+    taints = (node.spec.taints or []) if node.spec is not None else []
+    if not taints:
+        return 0
+    tolerations = (pod.spec.tolerations or []) if pod.spec is not None else []
+    n = 0
+    for taint in taints:
+        if taint.effect != "PreferNoSchedule":
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            n += 1
+    return n
+
+
+def make_soft_spread_scorer(
+    pod: Pod,
+    snapshot: ClusterSnapshot,
+    extra_placed: Sequence[tuple[Pod, Node]] = (),
+) -> Callable[[Node], float]:
+    """Penalty for the pod's ScheduleAnyway spread constraints: the count of
+    matching placed pods already in the node's domain (emptier domains score
+    higher).  Scaled by the profile's ``topology_weight`` at the call site."""
+    constraints = [c for c in ((pod.spec.topology_spread or []) if pod.spec is not None else []) if not c.is_hard]
+    if not constraints:
+        return lambda node: 0.0
+    my_ns = pod.metadata.namespace
+    per_constraint: list[tuple[str, dict[str, int]]] = []
+    for c in constraints:
+        counts: dict[str, int] = {}
+        for q, qnode in chain(snapshot.placed_pods(), extra_placed):
+            v = (qnode.metadata.labels or {}).get(c.topology_key)
+            if v is None or q.metadata.namespace != my_ns:
+                continue
+            if term_matches(c, q.metadata.labels):
+                counts[v] = counts.get(v, 0) + 1
+        per_constraint.append((c.topology_key, counts))
+
+    def penalty(node: Node) -> float:
+        labels = node.metadata.labels or {}
+        total = 0.0
+        for key, counts in per_constraint:
+            v = labels.get(key)
+            if v is not None:
+                total += counts.get(v, 0)
+        return total
+
+    return penalty
 
 
 # Ordered chain: fixed resource-then-selector order, as in the reference

@@ -28,12 +28,28 @@ class SchedulingProfile:
     max_rounds: int = 32
     # Pods per choose-block (caps peak [block, N] tile memory on device).
     pod_block: int = 4096
-    # Topology-spread / anti-affinity (BASELINE.json config 5); weight 0 = off.
-    topology_weight: float = 0.0
+    # Soft-term weights (ops/score.py):
+    #   preferred_affinity_weight — scale of preferredDuringScheduling node-
+    #     affinity points (pods declare 1-100 per term, kube-style);
+    #   soft_taint_weight — score subtracted per untolerated PreferNoSchedule
+    #     taint;
+    #   topology_weight — penalty per matching pod already in the node's
+    #     domain for ScheduleAnyway spread constraints (0 = off).
+    preferred_affinity_weight: float = 1.0
+    soft_taint_weight: float = 10.0
+    topology_weight: float = 1.0
 
     def weights(self) -> np.ndarray:
         return np.array(
-            [self.least_requested_weight, self.balanced_allocation_weight, self.spread_jitter], dtype=np.float32
+            [
+                self.least_requested_weight,
+                self.balanced_allocation_weight,
+                self.spread_jitter,
+                self.preferred_affinity_weight,
+                self.soft_taint_weight,
+                self.topology_weight,
+            ],
+            dtype=np.float32,
         )
 
     def with_(self, **kw) -> "SchedulingProfile":

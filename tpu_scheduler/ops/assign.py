@@ -60,7 +60,18 @@ __all__ = ["assign_cycle", "split_device_arrays", "INT32_MAX"]
 
 # Pod-side keys the choose step consumes (sliced per block); the rest of the
 # pod state (assigned, active bookkeeping) never enters the score math.
-_CHOOSE_KEYS = ("pod_req", "pod_sel", "pod_sel_count", "pod_ntol", "pod_aff", "pod_has_aff", "active", "ranks")
+_CHOOSE_KEYS = (
+    "pod_req",
+    "pod_sel",
+    "pod_sel_count",
+    "pod_ntol",
+    "pod_aff",
+    "pod_has_aff",
+    "pod_ntol_soft",
+    "pod_pref_w",
+    "active",
+    "ranks",
+)
 # Constraint pod-side keys (present only when the cycle carries anti-affinity
 # or topology-spread tensors, ops/constraints.py).
 _CONSTRAINT_KEYS = ("pod_aa_carries", "pod_aa_matched", "pod_sp_declares", "pod_sp_matched")
@@ -138,7 +149,24 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         from .constraints import blocked_block
 
         m = m & ~blocked_block(jnp, blk, round_masks)
-    sc = score_block(jnp, blk["pod_req"], nodes["node_alloc"], avail, weights, blk["ranks"], node_idx)
+    sc = score_block(
+        jnp,
+        blk["pod_req"],
+        nodes["node_alloc"],
+        avail,
+        weights,
+        blk["ranks"],
+        node_idx,
+        pod_pref_w=blk["pod_pref_w"],
+        node_pref=nodes["node_pref"],
+        pod_ntol_soft=blk["pod_ntol_soft"],
+        node_taints_soft=nodes["node_taints_soft"],
+    )
+    if round_masks is not None and "sp_penalty_node" in round_masks:
+        # ScheduleAnyway spread: emptier domains score higher — penalty is
+        # the count of matching pods already in the node's domain, weighted
+        # by the profile's topology_weight (weights[5]).
+        sc = sc - weights[5] * (blk["pod_sp_declares_soft"] @ round_masks["sp_penalty_node"])
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 

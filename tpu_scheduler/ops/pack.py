@@ -70,6 +70,8 @@ __all__ = [
     "build_selector_vocab",
     "build_taint_vocab",
     "build_affinity_vocab",
+    "build_soft_taint_vocab",
+    "build_pref_vocab",
     "round_up",
     "INT32_MAX",
 ]
@@ -114,9 +116,18 @@ class PackedCluster:
     pod_valid: np.ndarray  # [P]  bool
     pod_names: tuple[str, ...]  # full names of real pending pods
 
+    # Soft (scoring) terms — PreferNoSchedule taints and preferred node
+    # affinity (ops/score.py); zero-filled when the cluster has none.
+    node_taints_soft: np.ndarray  # [N,Ts] float32 — PreferNoSchedule bitmap
+    pod_ntol_soft: np.ndarray  # [P,Ts] float32 — 1 where NOT tolerated
+    node_pref: np.ndarray  # [N,A2] float32 — node satisfies pref-term
+    pod_pref_w: np.ndarray  # [P,A2] float32 — pod's weight for pref-term
+
     vocab: dict[tuple[str, str], int]
     taint_vocab: dict[tuple[str, str, str], int]
     aff_vocab: dict[tuple, int]  # NodeSelectorTerm.key() -> column
+    soft_taint_vocab: dict[tuple[str, str, str], int]
+    pref_vocab: dict[tuple, int]  # preferred-term key -> column
 
     # Anti-affinity/topology-spread tensors for this cycle (ops/constraints
     # .ConstraintSet) — attached per-cycle by the controller (the domain
@@ -157,7 +168,16 @@ class PackedCluster:
             "pod_has_aff": self.pod_has_aff,
             "pod_prio": self.pod_prio,
             "pod_valid": self.pod_valid,
+            "node_taints_soft": self.node_taints_soft,
+            "pod_ntol_soft": self.pod_ntol_soft,
+            "node_pref": self.node_pref,
+            "pod_pref_w": self.pod_pref_w,
         }
+
+    def has_soft_terms(self) -> bool:
+        """True when soft-scoring tensors carry content (the fused Pallas
+        kernel doesn't evaluate them, so backends route to the jnp path)."""
+        return bool(self.soft_taint_vocab) or bool(self.pref_vocab)
 
 
 def build_selector_vocab(pods: list[Pod]) -> dict[tuple[str, str], int]:
@@ -242,6 +262,63 @@ def build_taint_vocab(nodes) -> dict[tuple[str, str, str], int]:
     return vocab
 
 
+def build_soft_taint_vocab(nodes) -> dict[tuple[str, str, str], int]:
+    """Vocabulary of PreferNoSchedule taint triples — the soft (scoring)
+    twin of :func:`build_taint_vocab`."""
+    vocab: dict[tuple[str, str, str], int] = {}
+    for n in nodes:
+        if n.spec is not None and n.spec.taints:
+            for t in n.spec.taints:
+                if t.effect == "PreferNoSchedule":
+                    triple = (t.key, t.value, t.effect)
+                    if triple not in vocab:
+                        vocab[triple] = len(vocab)
+    return vocab
+
+
+def build_pref_vocab(pods: list[Pod]) -> dict[tuple, int]:
+    """Vocabulary of canonical preferred-affinity terms over pending pods."""
+    vocab: dict[tuple, int] = {}
+    for p in pods:
+        if p.spec is not None and p.spec.preferred_node_affinity:
+            for t in p.spec.preferred_node_affinity:
+                k = t.term.key()
+                if k not in vocab:
+                    vocab[k] = len(vocab)
+    return vocab
+
+
+def _pack_node_pref(nodes, pref_vocab: dict, n_pad: int, a_pad: int) -> np.ndarray:
+    """[N,A2] node-satisfies-preferred-term bitmap (full scalar operator
+    semantics, same evaluator as the required-affinity pack)."""
+    from ..core.predicates import node_selector_term_matches
+
+    node_pref = np.zeros((n_pad, a_pad), dtype=np.float32)
+    if not pref_vocab:
+        return node_pref
+    terms = [(idx, _term_from_key(key)) for key, idx in pref_vocab.items()]
+    for i, node in enumerate(nodes):
+        labels = node.metadata.labels
+        for j, term in terms:
+            if node_selector_term_matches(term, labels):
+                node_pref[i, j] = 1.0
+    return node_pref
+
+
+def _pack_pod_pref(pending: list[Pod], pref_vocab: dict, p_pad: int, a_pad: int) -> np.ndarray:
+    """[P,A2] per-pod weight of each preferred term (duplicate declarations
+    of the same canonical term sum their weights)."""
+    pod_pref_w = np.zeros((p_pad, a_pad), dtype=np.float32)
+    for i, pod in enumerate(pending):
+        terms = (pod.spec.preferred_node_affinity or []) if pod.spec is not None else []
+        for t in terms:
+            j = pref_vocab.get(t.term.key())
+            if j is None:
+                raise KeyError(f"preferred term {t.term.key()} missing from supplied pref_vocab")
+            pod_pref_w[i, j] += float(t.weight)
+    return pod_pref_w
+
+
 def _pack_ntol(pending: list[Pod], taint_vocab: dict, p_pad: int, t_pad: int) -> np.ndarray:
     """[P,T] 1.0 where the pod does NOT tolerate vocab taint t (padding
     rows/columns are 0 = vacuously tolerated)."""
@@ -317,6 +394,8 @@ def pack_snapshot(
     vocab: dict[tuple[str, str], int] | None = None,
     taint_vocab: dict[tuple[str, str, str], int] | None = None,
     aff_vocab: dict[tuple, int] | None = None,
+    soft_taint_vocab: dict[tuple[str, str, str], int] | None = None,
+    pref_vocab: dict[tuple, int] | None = None,
 ) -> PackedCluster:
     """Pack a snapshot into static-shape tensors.
 
@@ -340,11 +419,19 @@ def pack_snapshot(
     if aff_vocab is None:
         aff_vocab = build_affinity_vocab(pending)
     a_pad = round_up(len(aff_vocab), label_block)
+    if soft_taint_vocab is None:
+        soft_taint_vocab = build_soft_taint_vocab(nodes)
+    ts_pad = round_up(len(soft_taint_vocab), label_block)
+    if pref_vocab is None:
+        pref_vocab = build_pref_vocab(pending)
+    a2_pad = round_up(len(pref_vocab), label_block)
 
     alloc64, used64, _ = _alloc_and_used64(snapshot, n_pad)
     node_labels = np.zeros((n_pad, l_pad), dtype=np.float32)
     node_taints = np.zeros((n_pad, t_pad), dtype=np.float32)
+    node_taints_soft = np.zeros((n_pad, ts_pad), dtype=np.float32)
     node_aff = _pack_node_affinity(nodes, aff_vocab, n_pad, a_pad)
+    node_pref = _pack_node_pref(nodes, pref_vocab, n_pad, a2_pad)
     node_valid = np.zeros((n_pad,), dtype=bool)
     from ..core.predicates import HARD_TAINT_EFFECTS
 
@@ -363,6 +450,11 @@ def pack_snapshot(
                     if j is None:
                         raise KeyError(f"taint {(t.key, t.value, t.effect)} missing from supplied taint_vocab")
                     node_taints[i, j] = 1.0
+                elif t.effect == "PreferNoSchedule":
+                    j = soft_taint_vocab.get((t.key, t.value, t.effect))
+                    if j is None:
+                        raise KeyError(f"taint {(t.key, t.value, t.effect)} missing from supplied soft_taint_vocab")
+                    node_taints_soft[i, j] = 1.0
 
     node_alloc = _clamp_i32(np.stack([alloc64[:, CPU], alloc64[:, MEM] // 1024], axis=1))
     node_avail = _avail_i32(alloc64, used64)
@@ -370,6 +462,8 @@ def pack_snapshot(
     pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad)
     pod_ntol = _pack_ntol(pending, taint_vocab, p_pad, t_pad)
     pod_aff, pod_has_aff = _pack_affinity(pending, aff_vocab, p_pad, a_pad)
+    pod_ntol_soft = _pack_ntol(pending, soft_taint_vocab, p_pad, ts_pad)
+    pod_pref_w = _pack_pod_pref(pending, pref_vocab, p_pad, a2_pad)
 
     return PackedCluster(
         node_alloc=node_alloc,
@@ -382,9 +476,15 @@ def pack_snapshot(
         vocab=dict(vocab),
         taint_vocab=dict(taint_vocab),
         aff_vocab=dict(aff_vocab),
+        soft_taint_vocab=dict(soft_taint_vocab),
+        pref_vocab=dict(pref_vocab),
         pod_ntol=pod_ntol,
         pod_aff=pod_aff,
         pod_has_aff=pod_has_aff,
+        node_taints_soft=node_taints_soft,
+        pod_ntol_soft=pod_ntol_soft,
+        node_pref=node_pref,
+        pod_pref_w=pod_pref_w,
         **pod_tensors,
     )
 
@@ -456,11 +556,15 @@ def repack_incremental(packed: PackedCluster, snapshot: ClusterSnapshot, pod_blo
     pod_tensors = _pack_pods(pending, packed.vocab, p_pad, packed.pod_sel.shape[1])
     pod_ntol = _pack_ntol(pending, packed.taint_vocab, p_pad, packed.node_taints.shape[1])
     pod_aff, pod_has_aff = _pack_affinity(pending, packed.aff_vocab, p_pad, packed.node_aff.shape[1])
+    pod_ntol_soft = _pack_ntol(pending, packed.soft_taint_vocab, p_pad, packed.node_taints_soft.shape[1])
+    pod_pref_w = _pack_pod_pref(pending, packed.pref_vocab, p_pad, packed.node_pref.shape[1])
     return replace(
         packed,
         node_avail=_avail_i32(alloc64, used64),
         pod_ntol=pod_ntol,
         pod_aff=pod_aff,
         pod_has_aff=pod_has_aff,
+        pod_ntol_soft=pod_ntol_soft,
+        pod_pref_w=pod_pref_w,
         **pod_tensors,
     )

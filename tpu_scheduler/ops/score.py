@@ -29,13 +29,33 @@ from __future__ import annotations
 __all__ = ["score_block"]
 
 
-def score_block(xp, pod_req, node_alloc, node_avail, weights, pod_idx=None, node_idx=None):
+def score_block(
+    xp,
+    pod_req,
+    node_alloc,
+    node_avail,
+    weights,
+    pod_idx=None,
+    node_idx=None,
+    pod_pref_w=None,
+    node_pref=None,
+    pod_ntol_soft=None,
+    node_taints_soft=None,
+):
     """[B, N] combined priority score of a block of pods against all nodes.
 
     pod_req [B,2] int32; node_alloc, node_avail [N,2] int32;
-    weights [3] f32 — (least_requested_w, balanced_allocation_w, jitter);
-    pod_idx [B] / node_idx [N] uint32 — global indices for the jitter hash
-    (optional; jitter term is skipped when either is None).
+    weights [5] f32 — (least_requested_w, balanced_allocation_w, jitter,
+    preferred_affinity_w, soft_taint_w); pod_idx [B] / node_idx [N] uint32 —
+    global indices for the jitter hash (optional; jitter term is skipped
+    when either is None).
+
+    Soft terms (each optional-together, zero-width tensors are no-ops):
+      • preferred node affinity: +w₃ · Σ matching-term weights
+        (pod_pref_w [B,A2] · node_pref [N,A2], kube NodeAffinity scoring);
+      • PreferNoSchedule taints: −w₄ per untolerated soft taint
+        (pod_ntol_soft [B,Ts] · node_taints_soft [N,Ts], kube
+        TaintToleration scoring).
     """
     f32 = xp.float32
     used_after = (node_alloc - node_avail)[None, :, :] + pod_req[:, None, :]  # [B,N,2] int32
@@ -45,6 +65,10 @@ def score_block(xp, pod_req, node_alloc, node_avail, weights, pod_idx=None, node
     least_requested = ((f32(1.0) - frac[..., 0]) + (f32(1.0) - frac[..., 1])) * f32(50.0)
     balanced = (f32(1.0) - xp.abs(frac[..., 0] - frac[..., 1])) * f32(100.0)
     score = weights[0] * least_requested + weights[1] * balanced
+    if pod_pref_w is not None and node_pref is not None:
+        score = score + weights[3] * (pod_pref_w @ node_pref.T)
+    if pod_ntol_soft is not None and node_taints_soft is not None:
+        score = score - weights[4] * (pod_ntol_soft @ node_taints_soft.T)
     if pod_idx is not None and node_idx is not None:
         u32 = xp.uint32
         h = pod_idx.astype(u32)[:, None] * u32(2654435761) + node_idx.astype(u32)[None, :] * u32(2246822519)
