@@ -224,7 +224,10 @@ def test_untensorizable_many_valued_shared_key_raises():
 # --- synthetic-cluster sweep (the VERDICT acceptance shape) ------------------
 
 
-@pytest.mark.parametrize("seed", [0, 3, 11])
+# Seeds 4 and 15 are regression anchors: they caught the water-filling lo
+# deriving from uncertain (later-dropped) mass, which over-admitted a
+# skew-violating placement (fixed in constraint_filter's c0/c0_cert split).
+@pytest.mark.parametrize("seed", [0, 3, 4, 11, 15])
 def test_synth_constrained_cluster_parity_and_validity(seed):
     snap = synth_cluster(
         n_nodes=60,

@@ -16,12 +16,13 @@ from tpu_scheduler.ops.pallas_choose import build_node_info, choose_block_pallas
 from tpu_scheduler.testing import synth_cluster  # noqa: E402
 
 
-def _case(n_nodes, n_pending, seed, n_bound=None):
+def _case(n_nodes, n_pending, seed, n_bound=None, **soft):
     snap = synth_cluster(
         n_nodes=n_nodes,
         n_pending=n_pending,
         n_bound=n_nodes if n_bound is None else n_bound,
         seed=seed,
+        **soft,
     )
     packed = pack_snapshot(snap, pod_block=8, node_block=8)
     a = {k: jnp.asarray(v) for k, v in packed.device_arrays().items()}
@@ -40,6 +41,8 @@ def _both_paths(a, weights, pod_tile=8, node_tile=128):
         "pod_ntol": a["pod_ntol"],
         "pod_aff": a["pod_aff"],
         "pod_has_aff": a["pod_has_aff"],
+        "pod_pref_w": a["pod_pref_w"],
+        "pod_ntol_soft": a["pod_ntol_soft"],
         "active": a["pod_valid"],
         "ranks": ranks,
     }
@@ -51,12 +54,16 @@ def _both_paths(a, weights, pod_tile=8, node_tile=128):
         a["pod_ntol"],
         a["pod_aff"],
         a["pod_has_aff"],
+        a["pod_pref_w"],
+        a["pod_ntol_soft"],
         a["pod_valid"],
         ranks,
         build_node_info(a["node_avail"], a["node_alloc"], a["node_valid"]),
         a["node_labels"].T,
         a["node_taints"].T,
         a["node_aff"].T,
+        a["node_pref"].T,
+        a["node_taints_soft"].T,
         weights,
         pod_tile=pod_tile,
         node_tile=node_tile,
@@ -72,6 +79,18 @@ def test_pallas_choose_matches_jnp(seed, n_nodes, n_pending):
     jc, jh, pc, ph = _both_paths(a, weights)
     np.testing.assert_array_equal(jh, ph)
     # choice only defined where feasible
+    np.testing.assert_array_equal(jc[jh], pc[ph])
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_pallas_choose_matches_jnp_soft_terms(seed):
+    """Soft-scoring clusters (PreferNoSchedule taints + preferred affinity)
+    must flow through the kernel's soft matmuls bit-identically."""
+    a, weights = _case(
+        24, 40, seed, soft_taint_fraction=0.4, preferred_affinity_fraction=0.4
+    )
+    jc, jh, pc, ph = _both_paths(a, weights)
+    np.testing.assert_array_equal(jh, ph)
     np.testing.assert_array_equal(jc[jh], pc[ph])
 
 

@@ -44,6 +44,9 @@ class NativeBackend(SchedulingBackend):
         aff = packed.pod_aff[perm]
         has_aff = packed.pod_has_aff[perm]
         valid = packed.pod_valid[perm]
+        pref_w = packed.pod_pref_w[perm]
+        ntol_soft = packed.pod_ntol_soft[perm]
+        node_pref, node_taints_soft = packed.node_pref, packed.node_taints_soft
 
         cons = packed.constraints
         cmeta = cstate = cpods = None
@@ -76,7 +79,13 @@ class NativeBackend(SchedulingBackend):
                     blk = {k: v[lo:hi] for k, v in cpods.items()}
                     m = m & ~blocked_block(np, blk, round_masks)
                 pod_idx = np.arange(lo, hi, dtype=np.uint32)
-                sc = score_block(np, req[lo:hi], node_alloc, avail, weights, pod_idx, node_idx)
+                sc = score_block(
+                    np, req[lo:hi], node_alloc, avail, weights, pod_idx, node_idx,
+                    pod_pref_w=pref_w[lo:hi], node_pref=node_pref,
+                    pod_ntol_soft=ntol_soft[lo:hi], node_taints_soft=node_taints_soft,
+                )
+                if round_masks is not None:
+                    sc = sc - weights[5] * (cpods["pod_sps_declares"][lo:hi] @ round_masks["sp_penalty_node"])
                 sc = np.where(m, sc, -np.inf)
                 choice[lo:hi] = sc.argmax(axis=1).astype(np.int32)
                 has[lo:hi] = m.any(axis=1)

@@ -74,7 +74,14 @@ _CHOOSE_KEYS = (
 )
 # Constraint pod-side keys (present only when the cycle carries anti-affinity
 # or topology-spread tensors, ops/constraints.py).
-_CONSTRAINT_KEYS = ("pod_aa_carries", "pod_aa_matched", "pod_sp_declares", "pod_sp_matched")
+_CONSTRAINT_KEYS = (
+    "pod_aa_carries",
+    "pod_aa_matched",
+    "pod_sp_declares",
+    "pod_sp_matched",
+    "pod_sps_declares",
+    "pod_sps_matched",
+)
 
 
 def split_device_arrays(arrays: dict) -> tuple[dict, dict]:
@@ -112,7 +119,7 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
     if pallas_pack is not None:
         from .pallas_choose import choose_block_pallas
 
-        node_info, labels_t, taints_t, aff_t, interpret = pallas_pack
+        node_info, labels_t, taints_t, aff_t, pref_t, taints_soft_t, interpret = pallas_pack
         return choose_block_pallas(
             blk["pod_req"],
             blk["pod_sel"],
@@ -120,12 +127,16 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
             blk["pod_ntol"],
             blk["pod_aff"],
             blk["pod_has_aff"],
+            blk["pod_pref_w"],
+            blk["pod_ntol_soft"],
             blk["active"],
             blk["ranks"],
             node_info,
             labels_t,
             taints_t,
             aff_t,
+            pref_t,
+            taints_soft_t,
             weights,
             interpret=interpret,
         )
@@ -162,11 +173,11 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         pod_ntol_soft=blk["pod_ntol_soft"],
         node_taints_soft=nodes["node_taints_soft"],
     )
-    if round_masks is not None and "sp_penalty_node" in round_masks:
+    if round_masks is not None:
         # ScheduleAnyway spread: emptier domains score higher — penalty is
         # the count of matching pods already in the node's domain, weighted
         # by the profile's topology_weight (weights[5]).
-        sc = sc - weights[5] * (blk["pod_sp_declares_soft"] @ round_masks["sp_penalty_node"])
+        sc = sc - weights[5] * (blk["pod_sps_declares"] @ round_masks["sp_penalty_node"])
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
@@ -193,6 +204,8 @@ def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas
             nodes["node_labels"].T,
             nodes["node_taints"].T,
             nodes["node_aff"].T,
+            nodes["node_pref"].T,
+            nodes["node_taints_soft"].T,
             pallas_interpret,
         )
 

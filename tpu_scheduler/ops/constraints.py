@@ -35,7 +35,10 @@ by rank (the auction's priority order):
     computed (8-step fixpoint of q = max_skew + lo − counts with lo the
     rising min across the key's domains) and the cell keeps its quota's
     worth of lowest-rank claimants — mass spread workloads commit whole
-    waves per round instead of one pod per domain.
+    waves per round instead of one pod per domain.  The quota denominator
+    deliberately overcounts (all capacity-accepted matched mass) while the
+    water line lo counts only mass *certain* to commit this round; see the
+    inline soundness note in constraint_filter.
 Deferred pods stay active and retry next round against the committed state;
 the round-start choose mask already blocks saturated domains, so every kept
 set is violation-free and the loop strictly progresses.
@@ -128,26 +131,31 @@ class ConstraintSet:
     threads them through its while-loop carry.
     """
 
-    # Pod side [P, T] / [P, S] float32
+    # Pod side [P, T] / [P, S] / [P, Ss] float32
     pod_aa_carries: np.ndarray
     pod_aa_matched: np.ndarray
     pod_sp_declares: np.ndarray
     pod_sp_matched: np.ndarray
+    pod_sps_declares: np.ndarray  # soft (ScheduleAnyway) spread declarations
+    pod_sps_matched: np.ndarray
     # Node side
     node_dom_c: np.ndarray  # [N, D] float32 one-hot (one col per carried key)
     # Term metadata
     term_uses_dom: np.ndarray  # [T, D] float32 — domains of the term's key
     sp_uses_dom: np.ndarray  # [S, D] float32
     sp_skew: np.ndarray  # [S] float32
+    sps_uses_dom: np.ndarray  # [Ss, D] float32 — soft-spread constraint keys
     # Initial state (from placed pods)
     aa_dom_m: np.ndarray  # [T, D] 0/1 — domain holds a pod matched by term
     aa_dom_c: np.ndarray  # [T, D] 0/1 — domain holds a carrier of term
     aa_node_m: np.ndarray  # [T, N] 0/1 — fine-granularity (singleton) twin
     aa_node_c: np.ndarray  # [T, N] 0/1
     sp_counts: np.ndarray  # [S, D] float32 — matching placed pods per domain
+    sps_counts: np.ndarray  # [Ss, D] float32 — soft-spread matching counts
 
     n_terms: int
     n_spread: int
+    n_spread_soft: int
 
     def pod_arrays(self) -> dict:
         return {
@@ -155,6 +163,8 @@ class ConstraintSet:
             "pod_aa_matched": self.pod_aa_matched,
             "pod_sp_declares": self.pod_sp_declares,
             "pod_sp_matched": self.pod_sp_matched,
+            "pod_sps_declares": self.pod_sps_declares,
+            "pod_sps_matched": self.pod_sps_matched,
         }
 
     def meta_arrays(self) -> dict:
@@ -163,6 +173,7 @@ class ConstraintSet:
             "term_uses_dom": self.term_uses_dom,
             "sp_uses_dom": self.sp_uses_dom,
             "sp_skew": self.sp_skew,
+            "sps_uses_dom": self.sps_uses_dom,
         }
 
     def state_arrays(self) -> dict:
@@ -172,6 +183,7 @@ class ConstraintSet:
             "aa_node_m": self.aa_node_m,
             "aa_node_c": self.aa_node_c,
             "sp_counts": self.sp_counts,
+            "sps_counts": self.sps_counts,
         }
 
 
@@ -204,22 +216,30 @@ def pack_constraints(
     for q, _qn in placed_with_terms:
         for t in q.spec.anti_affinity:
             aa_vocab.setdefault(_aa_key(q.metadata.namespace, t), (q.metadata.namespace, t))
-    sp_vocab: dict[tuple, tuple] = {}
+    sp_vocab: dict[tuple, tuple] = {}  # hard (DoNotSchedule) — blocking
+    sps_vocab: dict[tuple, tuple] = {}  # soft (ScheduleAnyway) — scoring only
     for p in pending:
         if p.spec is not None and p.spec.topology_spread:
             for c in p.spec.topology_spread:
-                sp_vocab.setdefault(_sp_key(p.metadata.namespace, c), (p.metadata.namespace, c))
+                target = sp_vocab if c.is_hard else sps_vocab
+                target.setdefault(_sp_key(p.metadata.namespace, c), (p.metadata.namespace, c))
 
-    if not aa_vocab and not sp_vocab:
+    if not aa_vocab and not sp_vocab and not sps_vocab:
         return None
     if len(aa_vocab) > max_aa_terms:
         raise UntensorizableConstraints(f"{len(aa_vocab)} anti-affinity terms > budget {max_aa_terms}")
     if len(sp_vocab) > max_spread:
         raise UntensorizableConstraints(f"{len(sp_vocab)} spread constraints > budget {max_spread}")
+    if len(sps_vocab) > max_spread:
+        raise UntensorizableConstraints(f"{len(sps_vocab)} soft spread constraints > budget {max_spread}")
 
     # --- topology keys → coarse domains or fine (per-node) ----------------
-    keys = {k for (_ns, k, _sel) in aa_vocab} | {k for (_ns, k, _sk, _sel) in sp_vocab}
-    spread_keys = {k for (_ns, k, _sk, _sel) in sp_vocab}
+    keys = (
+        {k for (_ns, k, _sel) in aa_vocab}
+        | {k for (_ns, k, _sk, _sel) in sp_vocab}
+        | {k for (_ns, k, _sk, _sel) in sps_vocab}
+    )
+    spread_keys = {k for (_ns, k, _sk, _sel) in sp_vocab} | {k for (_ns, k, _sk, _sel) in sps_vocab}
     key_values: dict[str, dict[str, list[int]]] = {k: {} for k in keys}
     for i, n in enumerate(nodes):
         labels = n.metadata.labels or {}
@@ -248,6 +268,7 @@ def pack_constraints(
     d_pad = round_up(max(len(dom_vocab), 1), label_block)
     t_pad = round_up(max(len(aa_vocab), 1), label_block)
     s_pad = round_up(max(len(sp_vocab), 1), label_block)
+    ss_pad = round_up(max(len(sps_vocab), 1), label_block)
     n_pad = padded_nodes
 
     node_dom_c = np.zeros((n_pad, d_pad), dtype=np.float32)
@@ -257,6 +278,7 @@ def pack_constraints(
 
     aa_terms = list(aa_vocab.items())  # [(key, (ns, term))]
     sp_terms = list(sp_vocab.items())
+    sps_terms = list(sps_vocab.items())
 
     term_uses_dom = np.zeros((t_pad, d_pad), dtype=np.float32)
     for ti, (key, (_ns, term)) in enumerate(aa_terms):
@@ -269,14 +291,21 @@ def pack_constraints(
         sp_skew[si] = float(c.max_skew)
         for v in key_values.get(c.topology_key, ()):
             sp_uses_dom[si, dom_vocab[(c.topology_key, v)]] = 1.0
+    sps_uses_dom = np.zeros((ss_pad, d_pad), dtype=np.float32)
+    for si, (key, (_ns, c)) in enumerate(sps_terms):
+        for v in key_values.get(c.topology_key, ()):
+            sps_uses_dom[si, dom_vocab[(c.topology_key, v)]] = 1.0
 
     # --- pod-side bitmaps -------------------------------------------------
     pod_aa_carries = np.zeros((padded_pods, t_pad), dtype=np.float32)
     pod_aa_matched = np.zeros((padded_pods, t_pad), dtype=np.float32)
     pod_sp_declares = np.zeros((padded_pods, s_pad), dtype=np.float32)
     pod_sp_matched = np.zeros((padded_pods, s_pad), dtype=np.float32)
+    pod_sps_declares = np.zeros((padded_pods, ss_pad), dtype=np.float32)
+    pod_sps_matched = np.zeros((padded_pods, ss_pad), dtype=np.float32)
     aa_index = {key: i for i, (key, _) in enumerate(aa_terms)}
     sp_index = {key: i for i, (key, _) in enumerate(sp_terms)}
+    sps_index = {key: i for i, (key, _) in enumerate(sps_terms)}
     for pi, p in enumerate(pending):
         ns, labels = p.metadata.namespace, p.metadata.labels
         if p.spec is not None and p.spec.anti_affinity:
@@ -284,13 +313,19 @@ def pack_constraints(
                 pod_aa_carries[pi, aa_index[_aa_key(ns, t)]] = 1.0
         if p.spec is not None and p.spec.topology_spread:
             for c in p.spec.topology_spread:
-                pod_sp_declares[pi, sp_index[_sp_key(ns, c)]] = 1.0
+                if c.is_hard:
+                    pod_sp_declares[pi, sp_index[_sp_key(ns, c)]] = 1.0
+                else:
+                    pod_sps_declares[pi, sps_index[_sp_key(ns, c)]] = 1.0
         for ti, (_key, (t_ns, term)) in enumerate(aa_terms):
             if t_ns == ns and term_matches(term, labels):
                 pod_aa_matched[pi, ti] = 1.0
         for si, (_key, (c_ns, c)) in enumerate(sp_terms):
             if c_ns == ns and term_matches(c, labels):
                 pod_sp_matched[pi, si] = 1.0
+        for si, (_key, (c_ns, c)) in enumerate(sps_terms):
+            if c_ns == ns and term_matches(c, labels):
+                pod_sps_matched[pi, si] = 1.0
 
     # --- initial state from placed pods -----------------------------------
     aa_dom_m = np.zeros((t_pad, d_pad), dtype=np.float32)
@@ -298,6 +333,7 @@ def pack_constraints(
     aa_node_m = np.zeros((t_pad, n_pad), dtype=np.float32)
     aa_node_c = np.zeros((t_pad, n_pad), dtype=np.float32)
     sp_counts = np.zeros((s_pad, d_pad), dtype=np.float32)
+    sps_counts = np.zeros((ss_pad, d_pad), dtype=np.float32)
     node_index = {n.name: i for i, n in enumerate(nodes)}
 
     def _mark(arr_dom, arr_node, ti, term, qnode_name):
@@ -319,7 +355,7 @@ def pack_constraints(
             ns = q.metadata.namespace
             for t in q.spec.anti_affinity:
                 _mark(aa_dom_c, aa_node_c, aa_index[_aa_key(ns, t)], t, qnode.name)
-    if sp_terms:
+    if sp_terms or sps_terms:
         for q, qnode in snapshot.placed_pods():
             q_ns, q_labels = q.metadata.namespace, q.metadata.labels
             ni = node_index[qnode.name]
@@ -330,23 +366,34 @@ def pack_constraints(
                 v = nlabels.get(c.topology_key)
                 if v is not None and term_matches(c, q_labels):
                     sp_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
+            for si, (_key, (c_ns, c)) in enumerate(sps_terms):
+                if c_ns != q_ns:
+                    continue
+                v = nlabels.get(c.topology_key)
+                if v is not None and term_matches(c, q_labels):
+                    sps_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
 
     return ConstraintSet(
         pod_aa_carries=pod_aa_carries,
         pod_aa_matched=pod_aa_matched,
         pod_sp_declares=pod_sp_declares,
         pod_sp_matched=pod_sp_matched,
+        pod_sps_declares=pod_sps_declares,
+        pod_sps_matched=pod_sps_matched,
         node_dom_c=node_dom_c,
         term_uses_dom=term_uses_dom,
         sp_uses_dom=sp_uses_dom,
         sp_skew=sp_skew,
+        sps_uses_dom=sps_uses_dom,
         aa_dom_m=aa_dom_m,
         aa_dom_c=aa_dom_c,
         aa_node_m=aa_node_m,
         aa_node_c=aa_node_c,
         sp_counts=sp_counts,
+        sps_counts=sps_counts,
         n_terms=len(aa_terms),
         n_spread=len(sp_terms),
+        n_spread_soft=len(sps_terms),
     )
 
 
@@ -366,6 +413,12 @@ def round_blocked_masks(xp, state: dict, meta: dict) -> dict:
     blocks *carriers* of t.  aa_c_node[T,N]: holds a carrier — blocks
     *matched* pods.  sp_node[S,N]: placing a matching pod there would exceed
     ``max_skew + min(counts)`` — blocks *declarers* of s.
+
+    sp_penalty_node[Ss,N] (soft/ScheduleAnyway — scoring, never blocking):
+    the count of matching placed pods in the node's domain under soft
+    constraint s, the tensor twin of core/predicates.make_soft_spread_scorer;
+    ops/assign.py subtracts ``topology_weight ·
+    (pod_sps_declares @ sp_penalty_node)`` from the score.
     """
     ndc_t = meta["node_dom_c"].T
     aa_m_node = _clip01(xp, state["aa_dom_m"] @ ndc_t + state["aa_node_m"])
@@ -376,7 +429,13 @@ def round_blocked_masks(xp, state: dict, meta: dict) -> dict:
     lo = xp.where(lo >= RANK_INF, 0.0, lo)
     blockcell = uses * (counts >= (meta["sp_skew"] + lo)[:, None])
     sp_node = _clip01(xp, blockcell @ ndc_t)
-    return {"aa_m_node": aa_m_node, "aa_c_node": aa_c_node, "sp_node": sp_node}
+    sp_penalty_node = state["sps_counts"] @ ndc_t
+    return {
+        "aa_m_node": aa_m_node,
+        "aa_c_node": aa_c_node,
+        "sp_node": sp_node,
+        "sp_penalty_node": sp_penalty_node,
+    }
 
 
 def blocked_block(xp, blk: dict, masks: dict):
@@ -456,17 +515,45 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     dm = accf[:, None] * declares * matched * in_cell  # declaring+matching
     mo = accf[:, None] * (1.0 - declares) * matched  # matching-only (keyless→0 via matmul)
     dn = accf[:, None] * declares * (1.0 - matched) * in_cell  # declaring-only
+    # Two count bases, deliberately different (soundness, not sloppiness):
+    #   c0 — the quota DENOMINATOR — overcounts: every capacity-accepted
+    #     matched pod is in, even ones a later filter step drops.  Overcount
+    #     only shrinks quota (conservative), and it is *required* for
+    #     cross-constraint soundness: a pod kept by its own constraint's
+    #     quota may land in this constraint's domain, so its mass must be
+    #     assumed present at the declarer's turn in the witness order.
+    #   c0_cert — the water-line (lo) base — counts only mass CERTAIN to
+    #     place this round: round-start state plus post-anti-affinity
+    #     survivors that declare no spread constraint (nothing after this
+    #     filter can drop those).  Deriving lo from uncertain mass admitted
+    #     real violations: pods capacity-accepted into other domains but
+    #     deferred by their own skew quota inflated the min, opening quota
+    #     here (caught by the replay certificate at synth seed 4).
+    keep_f = keep.astype(xp.float32)
+    declares_n = declares.sum(axis=1)  # [P]
+    declares_any = xp.minimum(declares_n, 1.0)
+    certain = keep_f[:, None] * (1.0 - declares_any)[:, None] * matched
     c0 = state["sp_counts"] + (mo.T @ nd) * uses_sp  # [S, D]
+    c0_cert = state["sp_counts"] + (certain.T @ nd) * uses_sp
     dem = (dm.T @ nd) * uses_sp  # [S, D]
+    # A quota-kept claimant is certain iff nothing later can drop it: it
+    # survived anti-affinity and this is its only spread constraint.  Cells
+    # containing any uncertain claimant contribute no fill to the water line
+    # (an uncertain pod can hold a quota slot and then drop).
+    dm_cert = keep_f[:, None] * dm * (declares_n == 1.0).astype(xp.float32)[:, None]
+    dem_unc = dem - (dm_cert.T @ nd) * uses_sp  # [S, D] uncertain demand
 
     def _masked_lo(c):
         lo = xp.min(xp.where(uses_sp > 0, c, RANK_INF), axis=1)
         return xp.where(lo >= RANK_INF, 0.0, lo)
 
-    lo = _masked_lo(c0)
+    def _fills(q):
+        return xp.where(dem_unc == 0, xp.minimum(dem, q), 0.0)
+
+    lo = _masked_lo(c0_cert)
     for _ in range(8):  # water-filling fixpoint (lo is nondecreasing)
         q = xp.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp
-        lo = _masked_lo(c0 + xp.minimum(dem, q))
+        lo = _masked_lo(c0_cert + _fills(q))
     q_final = xp.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp  # [S, D]
 
     # Rank-prefix of each declaring+matching pod within its (s, domain) cell:
@@ -494,8 +581,8 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
 
     q_at = nd @ q_final.T  # [P, S] quota of own cell (0 where keyless)
     keep_dm = prefix < q_at
-    c_final = c0 + xp.minimum(dem, q_final)
-    lo_final = _masked_lo(c_final)
+    c_final = c0 + xp.minimum(dem, q_final)  # inflated (conservative) counts
+    lo_final = _masked_lo(c0_cert + _fills(q_final))  # certain water line
     c_at = nd @ c_final.T  # [P, S]
     keep_dn = (c_at + 1.0) <= (skew + lo_final)[None, :]
     bad_sp = ((dm > 0) & ~keep_dm) | ((dn > 0) & ~keep_dn)
@@ -525,10 +612,13 @@ def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict) -
     aa_node_c = _scatter_max1(xp, state["aa_node_c"].reshape(-1), gn, fine_c).reshape(t, n)
     sp_m = ps["pod_sp_matched"] * accf[:, None]  # [P, S]
     sp_counts = state["sp_counts"] + (sp_m.T @ nd) * meta["sp_uses_dom"]
+    sps_m = ps["pod_sps_matched"] * accf[:, None]  # [P, Ss]
+    sps_counts = state["sps_counts"] + (sps_m.T @ nd) * meta["sps_uses_dom"]
     return {
         "aa_dom_m": aa_dom_m,
         "aa_dom_c": aa_dom_c,
         "aa_node_m": aa_node_m,
         "aa_node_c": aa_node_c,
         "sp_counts": sp_counts,
+        "sps_counts": sps_counts,
     }

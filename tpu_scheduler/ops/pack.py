@@ -174,11 +174,6 @@ class PackedCluster:
             "pod_pref_w": self.pod_pref_w,
         }
 
-    def has_soft_terms(self) -> bool:
-        """True when soft-scoring tensors carry content (the fused Pallas
-        kernel doesn't evaluate them, so backends route to the jnp path)."""
-        return bool(self.soft_taint_vocab) or bool(self.pref_vocab)
-
 
 def build_selector_vocab(pods: list[Pod]) -> dict[tuple[str, str], int]:
     """Vocabulary of selector (key, value) pairs over the pending pods."""

@@ -59,19 +59,23 @@ def build_node_info(node_avail, node_alloc, node_valid):
 
 
 def _choose_kernel(
-    weights_ref,  # [1, 4] f32 SMEM  (w_lr, w_ba, w_jitter, pad)
+    weights_ref,  # [1, 8] f32 SMEM  (w_lr, w_ba, w_jitter, w_pref, w_soft_taint, w_topo, pad, pad)
     req_ref,  # [BP, 2] i32
     sel_ref,  # [BP, L] f32
     selc_ref,  # [BP, 1] f32
     ntol_ref,  # [BP, T] f32  (1 where vocab taint NOT tolerated)
     aff_ref,  # [BP, A] f32  (the pod's affinity-term bitmap)
     hasaff_ref,  # [BP, 1] f32  (1 if the pod declares node affinity)
+    prefw_ref,  # [BP, A2] f32  (pod's weight per preferred-affinity term)
+    ntols_ref,  # [BP, Ts] f32  (1 where soft vocab taint NOT tolerated)
     act_ref,  # [BP, 1] i32
     idx_ref,  # [BP, 1] u32  (priority ranks, jitter hash input)
     info_ref,  # [8, TN] i32  (node resources, see ROW_*)
     labels_ref,  # [L, TN] f32
     taints_ref,  # [T, TN] f32
     aff_t_ref,  # [A, TN] f32  (node satisfies affinity-term bitmap, transposed)
+    pref_t_ref,  # [A2, TN] f32  (node satisfies preferred-term bitmap, transposed)
+    taints_soft_t_ref,  # [Ts, TN] f32  (PreferNoSchedule bitmap, transposed)
     choice_ref,  # [BP, 1] i32 out
     has_ref,  # [BP, 1] i32 out
     best_ref,  # [BP, 1] f32 scratch
@@ -125,6 +129,14 @@ def _choose_kernel(
     balanced = (f32(1.0) - jnp.abs(frac_cpu - frac_mem)) * f32(100.0)
     score = weights_ref[0, 0] * least_requested + weights_ref[0, 1] * balanced
 
+    # Soft terms, same op order as ops/score.py: preferred node affinity
+    # (+w₃ · matching-term weights), then PreferNoSchedule taints (−w₄ per
+    # untolerated soft taint).  Both are exact small-int matmuls in f32.
+    pref = jnp.dot(prefw_ref[:], pref_t_ref[:], preferred_element_type=f32)  # [BP, TN]
+    score = score + weights_ref[0, 3] * pref
+    untol_soft = jnp.dot(ntols_ref[:], taints_soft_t_ref[:], preferred_element_type=f32)
+    score = score - weights_ref[0, 4] * untol_soft
+
     # Deterministic tie-break jitter — same uint32 hash as ops/score.py.
     u32 = jnp.uint32
     node_idx = (j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
@@ -156,13 +168,17 @@ def choose_block_pallas(
     ntol,  # [B, T] f32
     aff,  # [B, A] f32
     has_aff,  # [B] f32
+    pref_w,  # [B, A2] f32
+    ntol_soft,  # [B, Ts] f32
     act,  # [B] bool
     ranks,  # [B] u32
     node_info,  # [8, N] i32 (build_node_info)
     labels_t,  # [L, N] f32
     taints_t,  # [T, N] f32
     aff_t,  # [A, N] f32
-    weights,  # [3] f32
+    pref_t,  # [A2, N] f32
+    taints_soft_t,  # [Ts, N] f32
+    weights,  # [6] f32 (SchedulingProfile.weights())
     pod_tile: int = 256,
     node_tile: int = 512,
     interpret: bool = False,
@@ -176,6 +192,8 @@ def choose_block_pallas(
     l = sel.shape[1]
     t = ntol.shape[1]
     a_dim = aff.shape[1]
+    a2_dim = pref_w.shape[1]
+    ts_dim = ntol_soft.shape[1]
     bp = min(pod_tile, max(8, b))
     pb = -(-b // bp)
     nbt = -(-n // node_tile)
@@ -188,6 +206,8 @@ def choose_block_pallas(
         ntol = jnp.pad(ntol, ((0, b_pad - b), (0, 0)))
         aff = jnp.pad(aff, ((0, b_pad - b), (0, 0)))
         has_aff = jnp.pad(has_aff, ((0, b_pad - b),))
+        pref_w = jnp.pad(pref_w, ((0, b_pad - b), (0, 0)))
+        ntol_soft = jnp.pad(ntol_soft, ((0, b_pad - b), (0, 0)))
         act = jnp.pad(act, ((0, b_pad - b),))
         ranks = jnp.pad(ranks, ((0, b_pad - b),))
     if n_pad != n:
@@ -195,27 +215,33 @@ def choose_block_pallas(
         labels_t = jnp.pad(labels_t, ((0, 0), (0, n_pad - n)))
         taints_t = jnp.pad(taints_t, ((0, 0), (0, n_pad - n)))
         aff_t = jnp.pad(aff_t, ((0, 0), (0, n_pad - n)))
+        pref_t = jnp.pad(pref_t, ((0, 0), (0, n_pad - n)))
+        taints_soft_t = jnp.pad(taints_soft_t, ((0, 0), (0, n_pad - n)))
 
-    w = jnp.pad(weights.astype(jnp.float32), (0, 1)).reshape(1, 4)
+    w = jnp.pad(weights.astype(jnp.float32), (0, 8 - weights.shape[0])).reshape(1, 8)
 
     grid = (pb, nbt)
     choice, has = pl.pallas_call(
         _choose_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 4), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 8), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((bp, 2), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, l), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, t), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, a_dim), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, a2_dim), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, ts_dim), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((8, node_tile), lambda i, j: (0, j)),
             pl.BlockSpec((l, node_tile), lambda i, j: (0, j)),
             pl.BlockSpec((t, node_tile), lambda i, j: (0, j)),
             pl.BlockSpec((a_dim, node_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((a2_dim, node_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((ts_dim, node_tile), lambda i, j: (0, j)),
         ],
         out_specs=[
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
@@ -238,11 +264,15 @@ def choose_block_pallas(
         ntol,
         aff,
         has_aff.astype(jnp.float32).reshape(-1, 1),
+        pref_w,
+        ntol_soft,
         act.astype(jnp.int32).reshape(-1, 1),
         ranks.astype(jnp.uint32).reshape(-1, 1),
         node_info,
         labels_t,
         taints_t,
         aff_t,
+        pref_t,
+        taints_soft_t,
     )
     return choice[:b, 0], has[:b, 0].astype(bool)

@@ -74,7 +74,7 @@ def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32):
     # Node padding to the tp multiple (host-side twin of ShardedBackend.assign).
     n0 = a["node_avail"].shape[0]
     n_pad = round_up(n0, tp)
-    for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff"):
+    for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff", "node_pref", "node_taints_soft"):
         a[k] = np.pad(a[k], ((0, n_pad - n0), (0, 0)))
     a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - n0),))
 
@@ -84,7 +84,17 @@ def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32):
     perm = np.argsort(-a["pod_prio"], kind="stable")
     pods = {
         k: a[k][perm]
-        for k in ("pod_req", "pod_sel", "pod_sel_count", "pod_ntol", "pod_aff", "pod_has_aff", "pod_valid")
+        for k in (
+            "pod_req",
+            "pod_sel",
+            "pod_sel_count",
+            "pod_ntol",
+            "pod_aff",
+            "pod_has_aff",
+            "pod_pref_w",
+            "pod_ntol_soft",
+            "pod_valid",
+        )
     }
     extra = (-p_tot) % dp
     if extra:
@@ -98,12 +108,16 @@ def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32):
         a["node_taints"],
         a["node_aff"],
         a["node_valid"],
+        a["node_pref"],
+        a["node_taints_soft"],
         pods["pod_req"],
         pods["pod_sel"],
         pods["pod_sel_count"],
         pods["pod_ntol"],
         pods["pod_aff"],
         pods["pod_has_aff"],
+        pods["pod_pref_w"],
+        pods["pod_ntol_soft"],
         pods["pod_valid"],
         np.asarray(weights, dtype=np.float32),
     )

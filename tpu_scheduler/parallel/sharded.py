@@ -47,8 +47,8 @@ __all__ = ["sharded_assign_cycle", "ShardedBackend"]
 
 
 def _local_choose(
-    avail, active, req, sel, selc, ntol, aff, has_aff, node_alloc, node_labels, node_taints, node_aff, node_valid,
-    weights, pod_idx, node_idx,
+    avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels, node_taints,
+    node_aff, node_valid, node_pref, node_taints_soft, weights, pod_idx, node_idx,
 ):
     """Best local node per pod of this shard: (best_score, local idx, has).
 
@@ -57,7 +57,10 @@ def _local_choose(
     m = feasibility_block(
         jnp, req, sel, selc, active, avail, node_labels, node_valid, ntol, node_taints, aff, has_aff, node_aff
     )
-    sc = score_block(jnp, req, node_alloc, avail, weights, pod_idx, node_idx)
+    sc = score_block(
+        jnp, req, node_alloc, avail, weights, pod_idx, node_idx,
+        pod_pref_w=pref_w, node_pref=node_pref, pod_ntol_soft=ntol_soft, node_taints_soft=node_taints_soft,
+    )
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.max(sc, axis=1), jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
@@ -71,8 +74,8 @@ def _build_shard_map(mesh, max_rounds: int):
     tp = mesh.shape["tp"]
 
     def local_fn(
-        node_alloc, node_avail, node_labels, node_taints, node_aff, node_valid, req, sel, selc, ntol, aff, has_aff,
-        valid, w,
+        node_alloc, node_avail, node_labels, node_taints, node_aff, node_valid, node_pref, node_taints_soft,
+        req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, valid, w,
     ):
         p_local = req.shape[0]
         n_local = node_avail.shape[0]
@@ -93,8 +96,8 @@ def _build_shard_map(mesh, max_rounds: int):
 
             # 1. choose: local tile, then argmax across the tp axis.
             best_l, idx_l, _ = _local_choose(
-                avail, active, req, sel, selc, ntol, aff, has_aff, node_alloc, node_labels, node_taints, node_aff,
-                node_valid, w, g_pod_idx, g_node_idx,
+                avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels,
+                node_taints, node_aff, node_valid, node_pref, node_taints_soft, w, g_pod_idx, g_node_idx,
             )
             bests = lax.all_gather(best_l, "tp")  # [tp, p_local]
             idxs = lax.all_gather(idx_l + node_base, "tp")
@@ -164,12 +167,16 @@ IN_SPECS = (
     P("tp", None),  # node_taints
     P("tp", None),  # node_aff
     P("tp"),  # node_valid
+    P("tp", None),  # node_pref
+    P("tp", None),  # node_taints_soft
     P("dp", None),  # pod_req
     P("dp", None),  # pod_sel
     P("dp"),  # pod_sel_count
     P("dp", None),  # pod_ntol
     P("dp", None),  # pod_aff
     P("dp"),  # pod_has_aff
+    P("dp", None),  # pod_pref_w
+    P("dp", None),  # pod_ntol_soft
     P("dp"),  # pod_valid (already priority-permuted)
     P(),  # weights
 )
@@ -194,6 +201,8 @@ def _build_sharded_fn(mesh, max_rounds: int):
         ntol = a["pod_ntol"][perm]
         aff = a["pod_aff"][perm]
         has_aff = a["pod_has_aff"][perm]
+        pref_w = a["pod_pref_w"][perm]
+        ntol_soft = a["pod_ntol_soft"][perm]
         valid = a["pod_valid"][perm]
         extra = (-p_tot) % dp
         if extra:
@@ -203,6 +212,8 @@ def _build_sharded_fn(mesh, max_rounds: int):
             ntol = jnp.pad(ntol, ((0, extra), (0, 0)))
             aff = jnp.pad(aff, ((0, extra), (0, 0)))
             has_aff = jnp.pad(has_aff, ((0, extra),))
+            pref_w = jnp.pad(pref_w, ((0, extra), (0, 0)))
+            ntol_soft = jnp.pad(ntol_soft, ((0, extra), (0, 0)))
             valid = jnp.pad(valid, ((0, extra),))
         assigned_p, rounds, avail = sharded(
             a["node_alloc"],
@@ -211,12 +222,16 @@ def _build_sharded_fn(mesh, max_rounds: int):
             a["node_taints"],
             a["node_aff"],
             a["node_valid"],
+            a["node_pref"],
+            a["node_taints_soft"],
             req,
             sel,
             selc,
             ntol,
             aff,
             has_aff,
+            pref_w,
+            ntol_soft,
             valid,
             w,
         )
@@ -259,7 +274,7 @@ class ShardedBackend(SchedulingBackend):
             # Node padding to the tp multiple happens here; pod padding to the dp
             # multiple happens inside the jitted run, after the priority permute.
             n_pad = round_up(packed.padded_nodes, tp)
-            for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff"):
+            for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff", "node_pref", "node_taints_soft"):
                 a[k] = np.pad(a[k], ((0, n_pad - packed.padded_nodes), (0, 0)))
             a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - packed.padded_nodes),))
             assigned, rounds, _avail = sharded_assign_cycle(self.mesh, a, packed_weights(profile), profile.max_rounds)

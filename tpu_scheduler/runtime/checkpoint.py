@@ -31,7 +31,7 @@ from ..ops.pack import PackedCluster
 
 __all__ = ["save_scheduler", "restore_scheduler", "CHECKPOINT_VERSION"]
 
-CHECKPOINT_VERSION = 1
+CHECKPOINT_VERSION = 2  # v2: soft-term (PreferNoSchedule / preferred-affinity) tensors + vocabs
 
 _STATE_FILE = "state.json"
 _TENSORS_FILE = "node_tensors.npz"
@@ -53,9 +53,13 @@ def save_scheduler(scheduler, path: str) -> None:
     if packed is not None:
         state["vocab"] = [[k, v, i] for (k, v), i in packed.vocab.items()]
         state["taint_vocab"] = [[k, v, e, i] for (k, v, e), i in packed.taint_vocab.items()]
+        state["soft_taint_vocab"] = [[k, v, e, i] for (k, v, e), i in packed.soft_taint_vocab.items()]
         # affinity-term keys are tuples of (key, op, values-tuple) triples
         state["aff_vocab"] = [
             [[[k, op, list(vals)] for k, op, vals in key], i] for key, i in packed.aff_vocab.items()
+        ]
+        state["pref_vocab"] = [
+            [[[k, op, list(vals)] for k, op, vals in key], i] for key, i in packed.pref_vocab.items()
         ]
         state["node_names"] = list(packed.node_names)
         fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
@@ -68,6 +72,8 @@ def save_scheduler(scheduler, path: str) -> None:
                 node_taints=packed.node_taints,
                 node_aff=packed.node_aff,
                 node_valid=packed.node_valid,
+                node_taints_soft=packed.node_taints_soft,
+                node_pref=packed.node_pref,
             )
         os.replace(tmp, os.path.join(path, _TENSORS_FILE))
     fd, tmp = tempfile.mkstemp(dir=path, suffix=".json.tmp")
@@ -90,7 +96,10 @@ def restore_scheduler(scheduler, path: str) -> bool:
         return False
     with open(state_path) as f:
         state = json.load(f)
-    if state.get("version") != CHECKPOINT_VERSION:
+    # v1 checkpoints (pre-soft-terms) restore fine: the soft vocab fields
+    # default to empty below, and the tensor-consistency gate skips the v1
+    # cache (one full repack) rather than failing the restart.
+    if state.get("version") not in (1, CHECKPOINT_VERSION):
         raise ValueError(f"checkpoint version {state.get('version')} != {CHECKPOINT_VERSION}")
 
     scheduler._cycle_count = state.get("cycle_count", 0)
@@ -106,8 +115,12 @@ def restore_scheduler(scheduler, path: str) -> bool:
         with np.load(tensors_path) as z:
             vocab = {(k, v): i for k, v, i in state["vocab"]}
             taint_vocab = {(k, v, e): i for k, v, e, i in state.get("taint_vocab", [])}
+            soft_taint_vocab = {(k, v, e): i for k, v, e, i in state.get("soft_taint_vocab", [])}
             aff_vocab = {
                 tuple((k, op, tuple(vals)) for k, op, vals in key): i for key, i in state.get("aff_vocab", [])
+            }
+            pref_vocab = {
+                tuple((k, op, tuple(vals)) for k, op, vals in key): i for key, i in state.get("pref_vocab", [])
             }
             n_pad = z["node_alloc"].shape[0]
             consistent = (
@@ -118,6 +131,12 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 and "node_aff" in z
                 and z["node_aff"].shape[0] == n_pad
                 and len(aff_vocab) <= z["node_aff"].shape[1]
+                and "node_taints_soft" in z
+                and z["node_taints_soft"].shape[0] == n_pad
+                and len(soft_taint_vocab) <= z["node_taints_soft"].shape[1]
+                and "node_pref" in z
+                and z["node_pref"].shape[0] == n_pad
+                and len(pref_vocab) <= z["node_pref"].shape[1]
                 and z["node_valid"].shape == (n_pad,)
                 and len(vocab) <= z["node_labels"].shape[1]
                 and len(taint_vocab) <= z["node_taints"].shape[1]
@@ -136,6 +155,8 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 node_taints=z["node_taints"],
                 node_aff=z["node_aff"],
                 node_valid=z["node_valid"],
+                node_taints_soft=z["node_taints_soft"],
+                node_pref=z["node_pref"],
                 node_names=tuple(state.get("node_names", [])),
                 pod_req=np.zeros((p, 2), np.int32),
                 pod_sel=np.zeros((p, z["node_labels"].shape[1]), np.float32),
@@ -143,11 +164,15 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 pod_ntol=np.zeros((p, z["node_taints"].shape[1]), np.float32),
                 pod_aff=np.zeros((p, z["node_aff"].shape[1]), np.float32),
                 pod_has_aff=np.zeros((p,), np.float32),
+                pod_ntol_soft=np.zeros((p, z["node_taints_soft"].shape[1]), np.float32),
+                pod_pref_w=np.zeros((p, z["node_pref"].shape[1]), np.float32),
                 pod_prio=np.zeros((p,), np.int32),
                 pod_valid=np.zeros((p,), bool),
                 pod_names=(),
                 vocab=vocab,
                 taint_vocab=taint_vocab,
                 aff_vocab=aff_vocab,
+                soft_taint_vocab=soft_taint_vocab,
+                pref_vocab=pref_vocab,
             )
     return True

@@ -39,7 +39,10 @@ from ..core.predicates import (
     InvalidNodeReason,
     anti_affinity_ok,
     make_affinity_checker,
+    make_soft_spread_scorer,
     make_spread_checker,
+    preferred_affinity_score,
+    soft_taint_penalty,
     term_matches,
     topology_spread_ok,
 )
@@ -230,10 +233,21 @@ class Scheduler:
         return plain, constrained
 
     @staticmethod
-    def _scalar_score(pod: Pod, node: Node, snapshot: ClusterSnapshot, ledger: dict[str, PodResources], weights) -> float:
-        """LeastRequested + BalancedAllocation for one (pod, node) — the
-        scalar twin of ops/score.py (without the tie-break jitter; the
-        sequential phase breaks ties by node order instead)."""
+    def _scalar_score(
+        pod: Pod,
+        node: Node,
+        snapshot: ClusterSnapshot,
+        ledger: dict[str, PodResources],
+        weights,
+        soft_spread_penalty: float = 0.0,
+    ) -> float:
+        """LeastRequested + BalancedAllocation + soft terms for one
+        (pod, node) — the scalar twin of ops/score.py (without the tie-break
+        jitter; the sequential phase breaks ties by node order instead).
+
+        Soft terms mirror the tensor path weight-for-weight: preferred node
+        affinity (+w₃), PreferNoSchedule taints (−w₄), and the caller-supplied
+        ScheduleAnyway spread penalty (−w₅, from make_soft_spread_scorer)."""
         alloc = node_allocatable(node)
         used = node_used_resources(snapshot, node.name)
         assumed = ledger.get(node.name)
@@ -244,7 +258,11 @@ class Scheduler:
         fm = (used.memory + req.memory) / alloc.memory if alloc.memory > 0 else 1.0
         lr = ((1.0 - fc) + (1.0 - fm)) * 50.0
         ba = (1.0 - abs(fc - fm)) * 100.0
-        return float(weights[0]) * lr + float(weights[1]) * ba
+        score = float(weights[0]) * lr + float(weights[1]) * ba
+        score += float(weights[3]) * preferred_affinity_score(pod, node)
+        score -= float(weights[4]) * soft_taint_penalty(pod, node)
+        score -= float(weights[5]) * soft_spread_penalty
+        return score
 
     def _run_constrained_phase(
         self, snapshot: ClusterSnapshot, constrained: list[Pod], placed: list[tuple[Pod, Node]]
@@ -265,6 +283,7 @@ class Scheduler:
             # is then O(1) per candidate instead of re-scanning all placements.
             affinity_checker = make_affinity_checker(pod, snapshot, placed)
             spread_checker = make_spread_checker(pod, snapshot, placed)
+            soft_spread = make_soft_spread_scorer(pod, snapshot, placed)
             best: Node | None = None
             best_score = 0.0
             for node in snapshot.nodes:
@@ -273,7 +292,7 @@ class Scheduler:
                 )
                 if reason is not None:
                     continue
-                score = self._scalar_score(pod, node, snapshot, ledger, weights)
+                score = self._scalar_score(pod, node, snapshot, ledger, weights, soft_spread(node))
                 if best is None or score > best_score:
                     best, best_score = node, score
             if best is None:

@@ -19,6 +19,7 @@ from .api.objects import (
     PodAntiAffinityTerm,
     PodSpec,
     PodStatus,
+    PreferredSchedulingTerm,
     ResourceRequirements,
     Taint,
     Toleration,
@@ -65,6 +66,7 @@ def make_pod(
     topology_spread: list[TopologySpreadConstraint] | None = None,
     tolerations: list[Toleration] | None = None,
     node_affinity: list[NodeSelectorTerm] | None = None,
+    preferred_node_affinity: list[PreferredSchedulingTerm] | None = None,
 ) -> Pod:
     return Pod(
         metadata=ObjectMeta(name=name, namespace=namespace, labels=labels),
@@ -79,6 +81,7 @@ def make_pod(
             topology_spread=topology_spread,
             tolerations=tolerations,
             node_affinity=node_affinity,
+            preferred_node_affinity=preferred_node_affinity,
         ),
         status=PodStatus(phase=phase),
     )
@@ -96,6 +99,9 @@ def synth_cluster(
     tainted_fraction: float = 0.0,
     cordoned_fraction: float = 0.0,
     node_affinity_fraction: float = 0.0,
+    soft_taint_fraction: float = 0.0,
+    preferred_affinity_fraction: float = 0.0,
+    schedule_anyway_fraction: float = 0.0,
 ) -> ClusterSnapshot:
     """Generate a synthetic cluster snapshot.
 
@@ -112,6 +118,12 @@ def synth_cluster(
     cordoned (spec.unschedulable).  ``node_affinity_fraction`` of pending
     pods carry required node affinity exercising every operator (In/NotIn/
     Exists/DoesNotExist/Gt/Lt over zone/pool/slot labels, ORed terms).
+
+    Soft (scoring) terms: ``soft_taint_fraction`` of nodes carry a
+    PreferNoSchedule taint (half the pods tolerate it);
+    ``preferred_affinity_fraction`` of pending pods declare weighted
+    preferredDuringScheduling zone/pool terms; ``schedule_anyway_fraction``
+    declare a ScheduleAnyway (soft) zone topology-spread constraint.
     """
     rng = random.Random(seed)
     if n_nodes == 0:
@@ -127,6 +139,9 @@ def synth_cluster(
             "slot": str(i % 16),  # numeric label for Gt/Lt affinity
         }
         taints = [Taint(key="pool", value=pool, effect="NoSchedule")] if rng.random() < tainted_fraction else None
+        if soft_taint_fraction and rng.random() < soft_taint_fraction:
+            soft = Taint(key="degraded", value=_ZONES[i % len(_ZONES)], effect="PreferNoSchedule")
+            taints = (taints or []) + [soft]
         cordoned = rng.random() < cordoned_fraction
         nodes.append(
             make_node(f"node-{i}", cpu=cores, memory=f"{gib}Gi", labels=labels, taints=taints, unschedulable=cordoned)
@@ -158,6 +173,14 @@ def synth_cluster(
         spread = None
         if rng.random() < spread_fraction:
             spread = [TopologySpreadConstraint(topology_key="zone", max_skew=rng.choice([1, 2]), match_labels={"app": app})]
+        if schedule_anyway_fraction and rng.random() < schedule_anyway_fraction:
+            soft_c = TopologySpreadConstraint(
+                topology_key="zone",
+                max_skew=rng.choice([1, 2]),
+                match_labels={"app": app},
+                when_unsatisfiable="ScheduleAnyway",
+            )
+            spread = (spread or []) + [soft_c]
         node_aff = None
         if rng.random() < node_affinity_fraction:
             choice = rng.randrange(5)
@@ -191,6 +214,34 @@ def synth_cluster(
                 tols = [Toleration(operator="Exists")]
             else:
                 tols = [Toleration(key="pool", operator="Equal", value=rng.choice(_POOLS), effect="NoSchedule")]
+        if soft_taint_fraction and rng.random() < 0.5:
+            # Half the pods shrug off one zone's PreferNoSchedule degradation.
+            tols = (tols or []) + [
+                Toleration(key="degraded", operator="Equal", value=rng.choice(_ZONES), effect="PreferNoSchedule")
+            ]
+        pref_aff = None
+        if preferred_affinity_fraction and rng.random() < preferred_affinity_fraction:
+            pref_aff = [
+                PreferredSchedulingTerm(
+                    weight=rng.choice([1, 10, 50, 100]),
+                    term=NodeSelectorTerm(
+                        match_expressions=[
+                            LabelSelectorRequirement(key="zone", operator="In", values=[rng.choice(_ZONES)])
+                        ]
+                    ),
+                )
+            ]
+            if rng.random() < 0.3:  # second weighted term on the pool label
+                pref_aff.append(
+                    PreferredSchedulingTerm(
+                        weight=rng.choice([5, 25]),
+                        term=NodeSelectorTerm(
+                            match_expressions=[
+                                LabelSelectorRequirement(key="pool", operator="In", values=[rng.choice(_POOLS)])
+                            ]
+                        ),
+                    )
+                )
         pod = make_pod(
             f"pending-{i}",
             cpu=f"{rng.choice([100, 250, 500, 1000, 2000])}m",
@@ -202,6 +253,7 @@ def synth_cluster(
             topology_spread=spread,
             tolerations=tols,
             node_affinity=node_aff,
+            preferred_node_affinity=pref_aff,
         )
         if rng.random() < multi_container_fraction:
             pod.spec.containers.append(
