@@ -1,0 +1,28 @@
+# Development gate — the discipline the reference enforces via
+# .rustfmt.toml + .pre-commit-config.yaml (cargo check / clippy / fmt).
+# `make check` is the pre-commit bar: nothing ships with it red.
+
+PY ?= python
+
+.PHONY: check lint test native bench clean
+
+check: lint test
+
+lint:
+	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
+	$(PY) scripts/lint.py
+	@if command -v ruff >/dev/null 2>&1; then ruff check tpu_scheduler tests scripts; else echo "ruff not installed; stdlib gate only"; fi
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# C++ shim (optional; ops/native_ext.py gates on its presence)
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PY) bench.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+	rm -rf build dist *.egg-info

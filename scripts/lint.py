@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Zero-dependency lint gate — the error classes a round-2 regression shipped
+with (dead exports, stale imports) plus basic hygiene, implemented on the
+stdlib so the gate runs in the build image (which carries no linter).
+
+Checks (all hard failures):
+  F401  imported name never used in the module (``__init__.py`` re-exports
+        listed in ``__all__`` are exempt)
+  F822  ``__all__`` names a symbol the module does not define
+  DEAD  a non-underscore symbol in a module's ``__all__`` that no other file
+        in the package, tests, bench, or entry scripts references (the
+        round-2 'three dead soft scorers' class)
+  W291  trailing whitespace / W191 tabs in indentation
+  E999  syntax errors (via ast.parse)
+
+Usage: python scripts/lint.py [paths...]   (defaults to the package + tests)
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = ["tpu_scheduler", "tests", "bench.py", "__graft_entry__.py", "scripts"]
+
+
+def iter_py(paths: list[str]) -> list[pathlib.Path]:
+    out = []
+    for p in paths:
+        path = ROOT / p
+        if path.is_dir():
+            out.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            out.append(path)
+    return out
+
+
+class ImportUsage(ast.NodeVisitor):
+    """Collect imported names and every name/attribute usage."""
+
+    def __init__(self):
+        self.imports: dict[str, int] = {}  # bound name -> lineno
+        self.used: set[str] = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            self.imports[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return  # future imports act by existing, never by reference
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def module_all(tree: ast.Module) -> list[str]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" and isinstance(node.value, (ast.List, ast.Tuple)):
+                    return [e.value for e in node.value.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def top_level_defs(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    names.update(e.id for e in t.elts if isinstance(e, ast.Name))
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                if a.name != "*":
+                    names.add(a.asname or a.name.split(".")[0])
+    return names
+
+
+def main(argv: list[str]) -> int:
+    files = iter_py(argv or DEFAULT_PATHS)
+    errors: list[str] = []
+    sources: dict[pathlib.Path, str] = {}
+    trees: dict[pathlib.Path, ast.Module] = {}
+
+    for f in files:
+        text = f.read_text()
+        sources[f] = text
+        try:
+            trees[f] = ast.parse(text, filename=str(f))
+        except SyntaxError as e:
+            errors.append(f"{f.relative_to(ROOT)}:{e.lineno}: E999 syntax error: {e.msg}")
+            continue
+        for i, line in enumerate(text.splitlines(), 1):
+            if line != line.rstrip():
+                errors.append(f"{f.relative_to(ROOT)}:{i}: W291 trailing whitespace")
+            if line.startswith("\t"):
+                errors.append(f"{f.relative_to(ROOT)}:{i}: W191 tab in indentation")
+
+    # F401 / F822 per module
+    for f, tree in trees.items():
+        exported = set(module_all(tree))
+        usage = ImportUsage()
+        usage.visit(tree)
+        # Names referenced in string annotations / docstring doctests are out
+        # of scope; __init__ re-exports are legitimate when listed in __all__.
+        is_init = f.name == "__init__.py"
+        src = sources[f]
+        for name, lineno in usage.imports.items():
+            if name in usage.used or name == "_":
+                continue
+            if is_init or name in exported:
+                continue
+            # A conservative text check catches usage forms the AST visitor
+            # does not model (e.g. inside f-string format specs).
+            if len(re.findall(rf"\b{re.escape(name)}\b", src)) > 1:
+                continue
+            errors.append(f"{f.relative_to(ROOT)}:{lineno}: F401 '{name}' imported but unused")
+        defined = top_level_defs(tree)
+        for name in exported:
+            if name not in defined:
+                errors.append(f"{f.relative_to(ROOT)}:1: F822 undefined name '{name}' in __all__")
+
+    # DEAD: exported but referenced nowhere else in the repo
+    pkg_files = [f for f in files if f.suffix == ".py"]
+    all_text = {f: sources[f] for f in pkg_files if f in sources}
+    for f, tree in trees.items():
+        if "tpu_scheduler" not in str(f) or f.name == "__init__.py":
+            continue
+        for name in module_all(tree):
+            refs = 0
+            for g, text in all_text.items():
+                hits = len(re.findall(rf"\b{re.escape(name)}\b", text))
+                if g == f:
+                    # definition + __all__ entry account for 2 mentions
+                    refs += max(0, hits - 2)
+                else:
+                    refs += hits
+            if refs == 0:
+                errors.append(f"{f.relative_to(ROOT)}:1: DEAD export '{name}' is referenced nowhere")
+
+    for e in sorted(errors):
+        print(e)
+    print(f"lint: {len(files)} files, {len(errors)} errors")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

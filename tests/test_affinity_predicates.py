@@ -2,7 +2,6 @@
 (both directions, namespace scoping, singleton domains) and hard topology
 spread.  These define the semantics the batched backends must reproduce."""
 
-import pytest
 
 from tpu_scheduler.api.objects import PodAntiAffinityTerm, TopologySpreadConstraint
 from tpu_scheduler.core.predicates import (

@@ -7,7 +7,6 @@ scalar predicate chain (core/predicates.py) must show zero violations; and
 the native and TPU backends must agree binding-for-binding.
 """
 
-import numpy as np
 import pytest
 
 from dataclasses import replace

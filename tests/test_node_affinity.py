@@ -3,7 +3,6 @@ oracle), term-vocabulary tensorization, backend parity on affinity-heavy
 clusters, and end-to-end enforcement in every policy."""
 
 import numpy as np
-import pytest
 
 from tpu_scheduler.api.objects import (
     LabelSelectorRequirement as Req,

@@ -2,7 +2,6 @@
 blocks, repack_avail validation + incremental semantics, jax-free native path.
 """
 
-import numpy as np
 import pytest
 
 from tpu_scheduler import ClusterSnapshot

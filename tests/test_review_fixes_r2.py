@@ -3,7 +3,6 @@ capacity accounting, negative-priority jitter-rank parity, requeue cleanup,
 shim whitespace, synth/CLI guards."""
 
 import numpy as np
-import pytest
 
 from tpu_scheduler import ClusterSnapshot
 from tpu_scheduler.backends.native import NativeBackend

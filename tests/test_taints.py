@@ -3,7 +3,6 @@ tensorization (pack bitmaps), batched-backend parity on tainted clusters, and
 the control loop honoring both predicates end-to-end."""
 
 import numpy as np
-import pytest
 
 from tpu_scheduler.api.objects import Node, Pod, Taint, Toleration, node_to_dict, pod_to_dict
 from tpu_scheduler.backends.native import NativeBackend

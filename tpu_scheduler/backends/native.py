@@ -50,6 +50,7 @@ class NativeBackend(SchedulingBackend):
 
         cons = packed.constraints
         cmeta = cstate = cpods = None
+        soft_spread = cons is not None and cons.n_spread_soft > 0
         if cons is not None:
             from ..ops.constraints import blocked_block, constraint_commit, constraint_filter, round_blocked_masks
 
@@ -65,7 +66,7 @@ class NativeBackend(SchedulingBackend):
         rounds = 0
 
         while rounds < profile.max_rounds and active.any():
-            round_masks = round_blocked_masks(np, cstate, cmeta) if cons is not None else None
+            round_masks = round_blocked_masks(np, cstate, cmeta, soft_spread=soft_spread) if cons is not None else None
             choice = np.zeros((p,), dtype=np.int32)
             has = np.zeros((p,), dtype=bool)
             node_idx = np.arange(n, dtype=np.uint32)
@@ -83,9 +84,9 @@ class NativeBackend(SchedulingBackend):
                     np, req[lo:hi], node_alloc, avail, weights, pod_idx, node_idx,
                     pod_pref_w=pref_w[lo:hi], node_pref=node_pref,
                     pod_ntol_soft=ntol_soft[lo:hi], node_taints_soft=node_taints_soft,
+                    pod_sps_declares=cpods["pod_sps_declares"][lo:hi] if soft_spread else None,
+                    sp_penalty_node=round_masks["sp_penalty_node"] if soft_spread else None,
                 )
-                if round_masks is not None:
-                    sc = sc - weights[5] * (cpods["pod_sps_declares"][lo:hi] @ round_masks["sp_penalty_node"])
                 sc = np.where(m, sc, -np.inf)
                 choice[lo:hi] = sc.argmax(axis=1).astype(np.int32)
                 has[lo:hi] = m.any(axis=1)
@@ -111,7 +112,7 @@ class NativeBackend(SchedulingBackend):
 
             if cons is not None:
                 accepted = constraint_filter(np, accepted, choice, ranks, cpods, cstate, cmeta)
-                cstate = constraint_commit(np, accepted, choice, cpods, cstate, cmeta)
+                cstate = constraint_commit(np, accepted, choice, cpods, cstate, cmeta, soft_spread=soft_spread)
 
             assigned = np.where(accepted, choice, assigned)
             acc_round = np.where(accepted, rounds, acc_round)

@@ -67,6 +67,7 @@ class TpuBackend(SchedulingBackend):
             use_pallas=use_pallas,
             cmeta=cmeta,
             cstate=cstate,
+            soft_spread=cons is not None and cons.n_spread_soft > 0,
         )
         extras = {
             "acc_round": np.asarray(jax.device_get(acc_round)),

@@ -41,5 +41,8 @@ class BackendUnavailable(SchedulerError):
     falls back to the native path (see runtime.controller)."""
 
 
-class PackingError(SchedulerError):
-    """Snapshot → tensor packing failed (e.g. invalid quantity)."""
+class PackingError(SchedulerError, KeyError):
+    """Snapshot → tensor packing failed — a supplied vocabulary does not
+    cover the cluster (ops/pack.py).  Subclasses KeyError so callers holding
+    a cached vocab can treat it as the cache-miss it is (the controller's
+    incremental-pack fallback, runtime/controller.py)."""

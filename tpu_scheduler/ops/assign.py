@@ -160,6 +160,7 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         from .constraints import blocked_block
 
         m = m & ~blocked_block(jnp, blk, round_masks)
+    soft_sp = round_masks is not None and "sp_penalty_node" in round_masks
     sc = score_block(
         jnp,
         blk["pod_req"],
@@ -172,12 +173,9 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         node_pref=nodes["node_pref"],
         pod_ntol_soft=blk["pod_ntol_soft"],
         node_taints_soft=nodes["node_taints_soft"],
+        pod_sps_declares=blk["pod_sps_declares"] if soft_sp else None,
+        sp_penalty_node=round_masks["sp_penalty_node"] if soft_sp else None,
     )
-    if round_masks is not None:
-        # ScheduleAnyway spread: emptier domains score higher — penalty is
-        # the count of matching pods already in the node's domain, weighted
-        # by the profile's topology_weight (weights[5]).
-        sc = sc - weights[5] * (blk["pod_sps_declares"] @ round_masks["sp_penalty_node"])
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
@@ -236,7 +234,7 @@ def _pad0(v, extra):
     return jnp.pad(v, ((0, extra),) + ((0, 0),) * (v.ndim - 1))
 
 
-@partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret"))
+@partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread"))
 def assign_cycle(
     nodes: dict,
     pods: dict,
@@ -247,6 +245,7 @@ def assign_cycle(
     pallas_interpret: bool = False,
     cmeta: dict | None = None,
     cstate: dict | None = None,
+    soft_spread: bool = False,
 ):
     """Assign all pending pods to nodes in one on-device cycle.
 
@@ -315,7 +314,7 @@ def assign_cycle(
         if cmeta is not None:
             from .constraints import constraint_commit, constraint_filter, round_blocked_masks
 
-            round_masks = round_blocked_masks(jnp, cst, cmeta)
+            round_masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread)
         choice, has = _choose(avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks)
         cand = ps["active"] & has
         ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
@@ -338,7 +337,7 @@ def assign_cycle(
             # Within-round conflict resolution + domain-state commit
             # (deferred pods stay active and retry next round).
             accepted = constraint_filter(jnp, accepted, choice, ps["ranks"], ps, cst, cmeta)
-            cst = constraint_commit(jnp, accepted, choice, ps, cst, cmeta)
+            cst = constraint_commit(jnp, accepted, choice, ps, cst, cmeta, soft_spread=soft_spread)
 
         ps["assigned"] = jnp.where(accepted, choice, ps["assigned"])
         ps["acc_round"] = jnp.where(accepted, rounds, ps["acc_round"])

@@ -406,7 +406,7 @@ def _clip01(xp, a):
     return xp.minimum(a, 1.0)
 
 
-def round_blocked_masks(xp, state: dict, meta: dict) -> dict:
+def round_blocked_masks(xp, state: dict, meta: dict, soft_spread: bool = False) -> dict:
     """Per-round [·, N] blocked-node masks from the current domain state.
 
     aa_m_node[T,N]: node's domain (under term t's key) holds a matched pod —
@@ -414,11 +414,12 @@ def round_blocked_masks(xp, state: dict, meta: dict) -> dict:
     *matched* pods.  sp_node[S,N]: placing a matching pod there would exceed
     ``max_skew + min(counts)`` — blocks *declarers* of s.
 
-    sp_penalty_node[Ss,N] (soft/ScheduleAnyway — scoring, never blocking):
-    the count of matching placed pods in the node's domain under soft
-    constraint s, the tensor twin of core/predicates.make_soft_spread_scorer;
-    ops/assign.py subtracts ``topology_weight ·
-    (pod_sps_declares @ sp_penalty_node)`` from the score.
+    sp_penalty_node[Ss,N] (soft/ScheduleAnyway — scoring, never blocking;
+    built only with ``soft_spread=True``, a trace-time constant, so clusters
+    without ScheduleAnyway constraints skip the matmuls entirely): the count
+    of matching placed pods in the node's domain under soft constraint s,
+    the tensor twin of core/predicates.make_soft_spread_scorer; score_block
+    subtracts ``topology_weight · (pod_sps_declares @ sp_penalty_node)``.
     """
     ndc_t = meta["node_dom_c"].T
     aa_m_node = _clip01(xp, state["aa_dom_m"] @ ndc_t + state["aa_node_m"])
@@ -429,13 +430,10 @@ def round_blocked_masks(xp, state: dict, meta: dict) -> dict:
     lo = xp.where(lo >= RANK_INF, 0.0, lo)
     blockcell = uses * (counts >= (meta["sp_skew"] + lo)[:, None])
     sp_node = _clip01(xp, blockcell @ ndc_t)
-    sp_penalty_node = state["sps_counts"] @ ndc_t
-    return {
-        "aa_m_node": aa_m_node,
-        "aa_c_node": aa_c_node,
-        "sp_node": sp_node,
-        "sp_penalty_node": sp_penalty_node,
-    }
+    masks = {"aa_m_node": aa_m_node, "aa_c_node": aa_c_node, "sp_node": sp_node}
+    if soft_spread:
+        masks["sp_penalty_node"] = state["sps_counts"] @ ndc_t
+    return masks
 
 
 def blocked_block(xp, blk: dict, masks: dict):
@@ -589,7 +587,7 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     return keep & ~bad_sp.any(axis=1)
 
 
-def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict) -> dict:
+def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict, soft_spread: bool = False) -> dict:
     """Fold the round's final accepted placements into the domain state."""
     ndc = meta["node_dom_c"]
     d = ndc.shape[1]
@@ -612,8 +610,11 @@ def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict) -
     aa_node_c = _scatter_max1(xp, state["aa_node_c"].reshape(-1), gn, fine_c).reshape(t, n)
     sp_m = ps["pod_sp_matched"] * accf[:, None]  # [P, S]
     sp_counts = state["sp_counts"] + (sp_m.T @ nd) * meta["sp_uses_dom"]
-    sps_m = ps["pod_sps_matched"] * accf[:, None]  # [P, Ss]
-    sps_counts = state["sps_counts"] + (sps_m.T @ nd) * meta["sps_uses_dom"]
+    if soft_spread:
+        sps_m = ps["pod_sps_matched"] * accf[:, None]  # [P, Ss]
+        sps_counts = state["sps_counts"] + (sps_m.T @ nd) * meta["sps_uses_dom"]
+    else:
+        sps_counts = state["sps_counts"]
     return {
         "aa_dom_m": aa_dom_m,
         "aa_dom_c": aa_dom_c,

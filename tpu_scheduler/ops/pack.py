@@ -61,6 +61,7 @@ import numpy as np
 from ..api.objects import Pod, total_pod_resources
 from ..api.quantity import cpu_to_millis, memory_to_bytes
 from ..core.snapshot import ClusterSnapshot
+from ..errors import PackingError
 
 __all__ = [
     "PackedCluster",
@@ -237,7 +238,7 @@ def _pack_affinity(pending: list[Pod], aff_vocab: dict, p_pad: int, a_pad: int) 
         for term in terms:
             j = aff_vocab.get(term.key())
             if j is None:
-                raise KeyError(f"affinity term {term.key()} missing from supplied aff_vocab")
+                raise PackingError(f"affinity term {term.key()} missing from supplied aff_vocab")
             pod_aff[i, j] = 1.0
     return pod_aff, pod_has
 
@@ -309,7 +310,7 @@ def _pack_pod_pref(pending: list[Pod], pref_vocab: dict, p_pad: int, a_pad: int)
         for t in terms:
             j = pref_vocab.get(t.term.key())
             if j is None:
-                raise KeyError(f"preferred term {t.term.key()} missing from supplied pref_vocab")
+                raise PackingError(f"preferred term {t.term.key()} missing from supplied pref_vocab")
             pod_pref_w[i, j] += float(t.weight)
     return pod_pref_w
 
@@ -443,12 +444,12 @@ def pack_snapshot(
                 if t.effect in HARD_TAINT_EFFECTS:
                     j = taint_vocab.get((t.key, t.value, t.effect))
                     if j is None:
-                        raise KeyError(f"taint {(t.key, t.value, t.effect)} missing from supplied taint_vocab")
+                        raise PackingError(f"taint {(t.key, t.value, t.effect)} missing from supplied taint_vocab")
                     node_taints[i, j] = 1.0
                 elif t.effect == "PreferNoSchedule":
                     j = soft_taint_vocab.get((t.key, t.value, t.effect))
                     if j is None:
-                        raise KeyError(f"taint {(t.key, t.value, t.effect)} missing from supplied soft_taint_vocab")
+                        raise PackingError(f"taint {(t.key, t.value, t.effect)} missing from supplied soft_taint_vocab")
                     node_taints_soft[i, j] = 1.0
 
     node_alloc = _clamp_i32(np.stack([alloc64[:, CPU], alloc64[:, MEM] // 1024], axis=1))
@@ -507,7 +508,7 @@ def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int) -> dict:
                 for kv in pod.spec.node_selector.items():
                     j = vocab.get(kv)
                     if j is None:
-                        raise KeyError(f"selector pair {kv} missing from supplied vocab")
+                        raise PackingError(f"selector pair {kv} missing from supplied vocab")
                     pod_sel[i, j] = 1.0
                 pod_sel_count[i] = len(pod.spec.node_selector)
 

@@ -41,6 +41,8 @@ def score_block(
     node_pref=None,
     pod_ntol_soft=None,
     node_taints_soft=None,
+    pod_sps_declares=None,
+    sp_penalty_node=None,
 ):
     """[B, N] combined priority score of a block of pods against all nodes.
 
@@ -55,7 +57,11 @@ def score_block(
         (pod_pref_w [B,A2] · node_pref [N,A2], kube NodeAffinity scoring);
       • PreferNoSchedule taints: −w₄ per untolerated soft taint
         (pod_ntol_soft [B,Ts] · node_taints_soft [N,Ts], kube
-        TaintToleration scoring).
+        TaintToleration scoring);
+      • ScheduleAnyway topology spread: −w₅ per matching placed pod already
+        in the node's domain, per declared soft constraint
+        (pod_sps_declares [B,Ss] · sp_penalty_node [Ss,N],
+        ops/constraints.round_blocked_masks) — emptier domains score higher.
     """
     f32 = xp.float32
     used_after = (node_alloc - node_avail)[None, :, :] + pod_req[:, None, :]  # [B,N,2] int32
@@ -74,4 +80,6 @@ def score_block(
         h = pod_idx.astype(u32)[:, None] * u32(2654435761) + node_idx.astype(u32)[None, :] * u32(2246822519)
         h = (h ^ (h >> u32(15))) & u32(0xFFFF)
         score = score + weights[2] * (h.astype(f32) / f32(65536.0))
+    if pod_sps_declares is not None and sp_penalty_node is not None:
+        score = score - weights[5] * (pod_sps_declares @ sp_penalty_node)
     return score.astype(f32)

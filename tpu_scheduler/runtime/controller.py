@@ -47,7 +47,7 @@ from ..core.predicates import (
     topology_spread_ok,
 )
 from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
-from ..errors import BackendUnavailable, CreateBindingFailed, NoNodeFound
+from ..errors import BackendUnavailable, CreateBindingFailed, NoNodeFound, SchedulerError
 from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
 from ..ops.pack import pack_snapshot, repack_incremental
 from ..utils.metrics import CycleMetrics, MetricsRegistry
@@ -110,7 +110,10 @@ class Scheduler:
                 out.append(p)
         return out
 
-    def _requeue(self, pod_name: str, reason: str) -> None:
+    def _requeue(self, pod_name: str, reason: str | SchedulerError) -> None:
+        """Requeue a failed pod — the reference's error_policy
+        (``main.rs:122-125``): the reconcile error (errors.py mirrors
+        ``error.rs:3-15``) becomes a delayed retry, never a crash."""
         self.requeue_at[pod_name] = self.clock() + self.requeue_seconds
         self.metrics.inc("scheduler_requeues_total")
         logger.warning("reconcile failed on pod %s: %s; requeue in %.0fs", pod_name, reason, self.requeue_seconds)
@@ -296,7 +299,7 @@ class Scheduler:
                 if best is None or score > best_score:
                     best, best_score = node, score
             if best is None:
-                self._requeue(full_name(pod), "no-node-found")
+                self._requeue(full_name(pod), NoNodeFound("no feasible node this cycle"))
                 unschedulable += 1
                 continue
             if self._bind(pod.metadata.namespace or "default", pod.metadata.name, best.name):
@@ -367,7 +370,7 @@ class Scheduler:
                     if pod_obj is not None and node_obj is not None:
                         placed.append((pod_obj, node_obj))
             for pod_full in result.unschedulable:
-                self._requeue(pod_full, "no-node-found")
+                self._requeue(pod_full, NoNodeFound("no feasible node this cycle"))
         return bound, len(result.unschedulable), result.rounds
 
     def _run_batch_cycle(self, snapshot: ClusterSnapshot, trace: Trace) -> tuple[int, int, int]:
@@ -499,7 +502,7 @@ class Scheduler:
         for pod in pending:
             node = self._select_node_sample(pod, snapshot, ledger, placed)
             if node is None:
-                self._requeue(full_name(pod), "no-node-found")
+                self._requeue(full_name(pod), NoNodeFound("no feasible node this cycle"))
                 unschedulable += 1
                 continue
             if self._bind(pod.metadata.namespace or "default", pod.metadata.name, node.name):

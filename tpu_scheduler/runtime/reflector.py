@@ -20,7 +20,7 @@ import random
 import time
 import zlib
 
-from ..api.objects import Node, Pod
+from ..api.objects import Node
 from ..core.snapshot import ClusterSnapshot
 from .fake_api import ApiError, Watch, WatchEvent
 
