@@ -48,8 +48,37 @@ def main() -> int:
         diff = int((assigned != np.asarray(oracle)).sum())
         print(f"MULTIHOST_MISMATCH process={process_id} diff={diff}", flush=True)
         return 1
+
+    # Constrained cluster across hosts: anti-affinity + hard/soft spread via
+    # replicated domain state (parallel/sharded.py) over the same DCN mesh.
+    from dataclasses import replace
+
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import round_up
+    from tpu_scheduler.parallel.sharded import constraint_operands
+
+    csnap = synth_cluster(
+        n_nodes=16, n_pending=48, n_bound=16, seed=5,
+        anti_affinity_fraction=0.25, spread_fraction=0.25, schedule_anyway_fraction=0.2,
+    )
+    cpacked = pack_snapshot(csnap, pod_block=16, node_block=8)
+    cons = pack_constraints(csnap, csnap.pending_pods(), cpacked.padded_pods, cpacked.node_names, cpacked.padded_nodes)
+    assert cons is not None, "constrained multihost cluster packed no constraints"
+    n_pad = round_up(cpacked.padded_nodes, mesh.shape["tp"])
+    c = constraint_operands(cons, cpacked.padded_nodes, n_pad)
+    cassigned, crounds = sharded_assign_multihost(
+        mesh, cpacked.device_arrays(), profile.weights(), max_rounds=16,
+        constraints=c, soft_spread=cons.n_spread_soft > 0,
+    )
+    coracle, _, _ = NativeBackend().assign(replace(cpacked, constraints=cons), profile)
+    if not np.array_equal(cassigned, np.asarray(coracle)):
+        diff = int((cassigned != np.asarray(coracle)).sum())
+        print(f"MULTIHOST_CONSTRAINED_MISMATCH process={process_id} diff={diff}", flush=True)
+        return 1
+
     bound = int((assigned >= 0).sum())
-    print(f"MULTIHOST_OK process={process_id} bound={bound} rounds={rounds}", flush=True)
+    cbound = int((cassigned >= 0).sum())
+    print(f"MULTIHOST_OK process={process_id} bound={bound} rounds={rounds} cbound={cbound}", flush=True)
     return 0
 
 

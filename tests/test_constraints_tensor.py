@@ -271,10 +271,10 @@ def test_scheduler_uses_tensor_path_for_constrained_cluster():
         assert P.anti_affinity_ok(pod, node_by[node.name], others), full_name(pod)
 
 
-def test_sharded_backend_refuses_constraints_and_controller_falls_back():
-    """ShardedBackend doesn't evaluate constraint tensors yet — it must
-    refuse them (not silently bind violations), and the controller must
-    route the cycle through the exact host phase instead."""
+def test_sharded_backend_schedules_constraints_on_mesh():
+    """Constrained clusters ride the multi-chip path (replicated domain
+    state, parallel/sharded.py) — assignments must equal the native oracle,
+    with no host fallback in the controller."""
     from tpu_scheduler.parallel.sharded import ShardedBackend
 
     nodes = [make_node(f"n{i}", cpu="32", memory="64Gi", labels={"name": f"n{i}"}) for i in range(4)]
@@ -283,17 +283,45 @@ def test_sharded_backend_refuses_constraints_and_controller_falls_back():
     snap = ClusterSnapshot.build(nodes, pods)
     packed = _packed_with_constraints(snap)
     backend = ShardedBackend(tp=2)
-    with pytest.raises(UntensorizableConstraints):
-        backend.schedule(packed, DEFAULT_PROFILE)
+    rs = backend.schedule(packed, DEFAULT_PROFILE)
+    rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
+    assert rs.bindings == rn.bindings
+    assert len({n for _, n in rs.bindings}) == 3  # anti-affinity respected
 
     api = FakeApiServer()
     api.load(snap.nodes, snap.pods)
     sched = Scheduler(api, backend, policy="batch", requeue_seconds=0.0)
     sched.run(until_settled=True)
     counters = sched.metrics.snapshot()
-    assert counters.get("scheduler_constraint_host_fallbacks_total", 0) >= 1
+    assert counters.get("scheduler_constraint_host_fallbacks_total", 0) == 0
+    assert counters.get("scheduler_constraint_tensor_cycles_total", 0) >= 1
     bound_nodes = {p.spec.node_name for p in api.list_pods() if p.spec.node_name}
-    assert len(bound_nodes) == 3  # anti-affinity respected via host phase
+    assert len(bound_nodes) == 3
+
+
+@pytest.mark.parametrize("tp", [1, 2, 4])
+def test_sharded_constrained_synth_parity(tp):
+    """Mesh-path parity on a synthetic constrained cluster (AA + hard spread
+    + ScheduleAnyway + soft taints) across tp factorisations."""
+    from tpu_scheduler.parallel.mesh import make_mesh
+    from tpu_scheduler.parallel.sharded import ShardedBackend
+
+    snap = synth_cluster(
+        n_nodes=24,
+        n_pending=120,
+        n_bound=48,
+        seed=6,
+        anti_affinity_fraction=0.15,
+        spread_fraction=0.15,
+        schedule_anyway_fraction=0.15,
+        soft_taint_fraction=0.2,
+    )
+    packed = _packed_with_constraints(snap)
+    assert packed.constraints is not None
+    rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
+    rs = ShardedBackend(make_mesh(tp=tp)).schedule(packed, DEFAULT_PROFILE)
+    assert rs.bindings == rn.bindings
+    assert rs.rounds == rn.rounds
 
 
 def test_plain_cycles_unchanged_by_constraint_plumbing():

@@ -27,7 +27,18 @@ from .utils.tracing import configure_logging
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpu-scheduler", description=__doc__)
-    p.add_argument("--backend", choices=["native", "tpu"], default="tpu", help="scheduling backend (north-star flag)")
+    p.add_argument(
+        "--backend",
+        choices=["native", "tpu", "tpu-sharded"],
+        default="tpu",
+        help="scheduling backend (north-star flag); tpu-sharded runs the cycle over a dp×tp device mesh",
+    )
+    p.add_argument("--tp", type=int, default=None, help="tpu-sharded: tensor-parallel (nodes-axis) mesh width; dp gets the rest of the devices")
+    p.add_argument(
+        "--distributed",
+        action="store_true",
+        help="initialize jax.distributed at startup for multi-host meshes (reads SCHED_COORDINATOR / SCHED_NUM_PROCESSES / SCHED_PROCESS_ID, or auto-detects)",
+    )
     p.add_argument("--policy", choices=["batch", "sample"], default="batch", help="batched cycle vs reference-style per-pod random sampling")
     p.add_argument("--profile", choices=sorted(PROFILES), default="default", help="scoring profile")
     p.add_argument("--nodes", type=int, default=100, help="synthetic cluster: node count")
@@ -62,9 +73,21 @@ def main(argv: list[str] | None = None) -> int:
         snap = synth_cluster(n_nodes=args.nodes, n_pending=args.pods, n_bound=args.bound_pods, seed=args.seed)
         api.load(snap.nodes, snap.pods)
 
+    if args.distributed or args.backend == "tpu-sharded":
+        from .parallel.mesh import init_distributed
+
+        # No-op in single-process runs; multi-host coordination comes from
+        # the SCHED_* env (or cluster auto-detection with --distributed).
+        init_distributed(auto=args.distributed)
+
     if args.backend == "native":
         backend = NativeBackend()
         fallback = None
+    elif args.backend == "tpu-sharded":
+        from .parallel.sharded import ShardedBackend
+
+        backend = ShardedBackend(tp=args.tp)
+        fallback = None if args.no_fallback else NativeBackend()
     else:
         from .backends.tpu import TpuBackend
 

@@ -34,19 +34,19 @@ from functools import lru_cache
 import numpy as np
 
 from ..ops.pack import round_up
-from .sharded import IN_SPECS, _build_shard_map
+from .sharded import _N_PODKEYS, CONSTRAINT_KEYS, IN_SPECS, _build_shard_map
 
 __all__ = ["sharded_assign_multihost", "make_global_array"]
 
 
 @lru_cache(maxsize=64)
-def _jitted_shard_map(mesh, max_rounds: int):
+def _jitted_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False):
     """Cached jit of the shard_map program — without this every cycle would
     re-trace and re-compile (the single-process twin _build_sharded_fn is
     lru_cached for the same reason)."""
     import jax
 
-    return jax.jit(_build_shard_map(mesh, max_rounds))
+    return jax.jit(_build_shard_map(mesh, max_rounds, constrained, soft_spread))
 
 
 def make_global_array(mesh, spec, arr: np.ndarray):
@@ -58,12 +58,15 @@ def make_global_array(mesh, spec, arr: np.ndarray):
     return jax.make_array_from_callback(arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx])
 
 
-def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32):
+def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None, soft_spread: bool = False):
     """Run one scheduling cycle over a (possibly multi-host) mesh.
 
     ``arrays`` is the PackedCluster ``device_arrays()`` dict (numpy, same on
-    every process).  Returns (assigned [P] np.int32, rounds int) replicated
-    to every process.
+    every process); ``constraints`` the sharded.constraint_operands dict
+    (node axes already padded to this mesh's tp multiple) for constrained
+    cycles — the constraint tensors are replicated, exactly as in the
+    single-process path.  Returns (assigned [P] np.int32, rounds int)
+    replicated to every process.
     """
     import jax
     from jax.experimental import multihost_utils
@@ -96,10 +99,13 @@ def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32):
             "pod_valid",
         )
     }
+    cpods = {k: constraints[k][perm] for k in CONSTRAINT_KEYS[:_N_PODKEYS]} if constraints is not None else {}
     extra = (-p_tot) % dp
     if extra:
         for k, v in pods.items():
             pods[k] = np.pad(v, ((0, extra),) + ((0, 0),) * (v.ndim - 1))
+        for k, v in cpods.items():
+            cpods[k] = np.pad(v, ((0, extra), (0, 0)))
 
     operands = (
         a["node_alloc"],
@@ -121,9 +127,17 @@ def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32):
         pods["pod_valid"],
         np.asarray(weights, dtype=np.float32),
     )
-    global_ins = [make_global_array(mesh, spec, arr) for spec, arr in zip(IN_SPECS, operands)]
+    specs = IN_SPECS
+    if constraints is not None:
+        from jax.sharding import PartitionSpec as P
 
-    fn = _jitted_shard_map(mesh, max_rounds)
+        operands = operands + tuple(
+            cpods[k] if i < _N_PODKEYS else constraints[k] for i, k in enumerate(CONSTRAINT_KEYS)
+        )
+        specs = specs + (P(),) * len(CONSTRAINT_KEYS)
+    global_ins = [make_global_array(mesh, spec, arr) for spec, arr in zip(specs, operands)]
+
+    fn = _jitted_shard_map(mesh, max_rounds, constraints is not None, soft_spread)
     assigned_p, rounds, _avail = fn(*global_ins)
 
     assigned_full = np.asarray(multihost_utils.process_allgather(assigned_p, tiled=True))

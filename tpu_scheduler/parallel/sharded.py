@@ -18,6 +18,16 @@ Identical results to the single-device path, by construction:
              column computes identically, keeping replicated state in sync
              without extra traffic.
 
+Constrained cycles (anti-affinity / topology spread, ops/constraints.py)
+ride the same mesh: the constraint tensors are [T,D]/[S,D]/[T,N]-shaped — a
+rounding error next to the [P/dp × N/tp] choose tiles — so the domain state
+and pod bitmaps are REPLICATED on every device, the round-start blocked
+masks are computed redundantly (each device slices its node columns), and
+the within-round filter + state commit run identically on every device over
+the already-gathered global claims.  No collectives beyond the two the
+unconstrained path already pays; determinism keeps the replicas in lockstep
+(same inputs → same state), exactly like the replicated ``avail`` columns.
+
 Per-round traffic: O(P) int32s over dp + O(P) over tp — a few MB at 100k
 pods, ICI-trivial next to the [P/dp × N/tp] compute tiles.
 
@@ -43,30 +53,64 @@ from ..ops.score import score_block
 from ..backends.base import SchedulingBackend
 from .mesh import make_mesh
 
-__all__ = ["sharded_assign_cycle", "ShardedBackend"]
+__all__ = ["sharded_assign_cycle", "ShardedBackend", "IN_SPECS", "CONSTRAINT_KEYS", "constraint_operands"]
 
 
 def _local_choose(
     avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels, node_taints,
     node_aff, node_valid, node_pref, node_taints_soft, weights, pod_idx, node_idx,
+    blocked=None, sps_declares=None, sp_penalty=None,
 ):
     """Best local node per pod of this shard: (best_score, local idx, has).
 
     ``pod_idx``/``node_idx`` are *global* (rank-space) indices so the score
-    jitter hash matches the single-device path exactly."""
+    jitter hash matches the single-device path exactly.  ``blocked`` is the
+    constraint-blocked [p_local, n_local] mask (constrained cycles only);
+    ``sps_declares``/``sp_penalty`` the ScheduleAnyway scoring operands."""
     m = feasibility_block(
         jnp, req, sel, selc, active, avail, node_labels, node_valid, ntol, node_taints, aff, has_aff, node_aff
     )
+    if blocked is not None:
+        m = m & ~blocked
     sc = score_block(
         jnp, req, node_alloc, avail, weights, pod_idx, node_idx,
         pod_pref_w=pref_w, node_pref=node_pref, pod_ntol_soft=ntol_soft, node_taints_soft=node_taints_soft,
+        pod_sps_declares=sps_declares, sp_penalty_node=sp_penalty,
     )
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.max(sc, axis=1), jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
 
+# Flat operand order for the constrained extension (all REPLICATED — specs
+# P()): pod bitmaps in global rank order, then meta, then initial state.
+CONSTRAINT_KEYS = (
+    # pod side (ConstraintSet.pod_arrays, priority-permuted + dp-padded)
+    "pod_aa_carries",
+    "pod_aa_matched",
+    "pod_sp_declares",
+    "pod_sp_matched",
+    "pod_sps_declares",
+    "pod_sps_matched",
+    # meta (node_dom_c is [N,D] with N padded to the tp multiple)
+    "node_dom_c",
+    "term_uses_dom",
+    "sp_uses_dom",
+    "sp_skew",
+    "sps_uses_dom",
+    # initial state (aa_node_* are [T,N] with N padded to the tp multiple)
+    "aa_dom_m",
+    "aa_dom_c",
+    "aa_node_m",
+    "aa_node_c",
+    "sp_counts",
+    "sps_counts",
+)
+_N_PODKEYS = 6
+_N_METAKEYS = 5
+
+
 @lru_cache(maxsize=64)
-def _build_shard_map(mesh, max_rounds: int):
+def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False):
     """The shard_map'd per-device cycle fn (not yet jitted/wrapped) — shared
     by the single-process run wrapper below and the multi-host path
     (parallel/multihost.py), so both execute the identical program."""
@@ -75,7 +119,7 @@ def _build_shard_map(mesh, max_rounds: int):
 
     def local_fn(
         node_alloc, node_avail, node_labels, node_taints, node_aff, node_valid, node_pref, node_taints_soft,
-        req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, valid, w,
+        req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, valid, w, *cargs,
     ):
         p_local = req.shape[0]
         n_local = node_avail.shape[0]
@@ -87,17 +131,40 @@ def _build_shard_map(mesh, max_rounds: int):
         g_pod_idx = (dp_idx * p_local + jnp.arange(p_local)).astype(jnp.uint32)
         g_node_idx = (node_base + jnp.arange(n_local)).astype(jnp.uint32)
 
+        if constrained:
+            from ..ops.constraints import blocked_block, constraint_commit, constraint_filter, round_blocked_masks
+
+            named = dict(zip(CONSTRAINT_KEYS, cargs))
+            cpods = {k: named[k] for k in CONSTRAINT_KEYS[:_N_PODKEYS]}
+            cmeta = {k: named[k] for k in CONSTRAINT_KEYS[_N_PODKEYS : _N_PODKEYS + _N_METAKEYS]}
+            cst0 = {k: named[k] for k in CONSTRAINT_KEYS[_N_PODKEYS + _N_METAKEYS :]}
+            # This device's dp rows of the (replicated) pod bitmaps.
+            blk_l = {k: lax.dynamic_slice_in_dim(v, dp_idx * p_local, p_local) for k, v in cpods.items()}
+            g_ranks = jnp.arange(p_tot, dtype=jnp.uint32)
+        else:
+            cst0 = {}
+
         def cond(state):
-            _, _, _, go, rounds = state
+            _, _, _, go, rounds, _ = state
             return (rounds < max_rounds) & go
 
         def body(state):
-            avail, assigned, active, _, rounds = state
+            avail, assigned, active, _, rounds, cst = state
 
-            # 1. choose: local tile, then argmax across the tp axis.
+            # 1. choose: local tile (with the constraint-blocked columns of
+            # this shard when constrained), then argmax across the tp axis.
+            blocked_l = sps_dec_l = sp_pen_l = None
+            if constrained:
+                masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread)  # [·, n_tot]
+                lm = {k: lax.dynamic_slice_in_dim(v, node_base, n_local, axis=1) for k, v in masks.items()}
+                blocked_l = blocked_block(jnp, blk_l, lm)  # [p_local, n_local]
+                if soft_spread:
+                    sps_dec_l = blk_l["pod_sps_declares"]
+                    sp_pen_l = lm["sp_penalty_node"]
             best_l, idx_l, _ = _local_choose(
                 avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels,
                 node_taints, node_aff, node_valid, node_pref, node_taints_soft, w, g_pod_idx, g_node_idx,
+                blocked=blocked_l, sps_declares=sps_dec_l, sp_penalty=sp_pen_l,
             )
             bests = lax.all_gather(best_l, "tp")  # [tp, p_local]
             idxs = lax.all_gather(idx_l + node_base, "tp")
@@ -125,16 +192,29 @@ def _build_shard_map(mesh, max_rounds: int):
             acc_s = (within <= avail_ext[ch_s]).all(-1) & (ch_s < n_local)
             accepted_rng = jnp.zeros((p_tot,), bool).at[order].set(acc_s)
 
-            # 3. commit locally; flags across node shards are disjoint → psum.
-            dec = jnp.zeros((n_local + 1, 2), jnp.int32).at[ch_local].add(jnp.where(accepted_rng[:, None], claim, 0))
-            avail = avail - dec[:n_local]
+            # Flags across node shards are disjoint → psum replicates the
+            # global accepted set on every device.
             accepted = lax.psum(accepted_rng.astype(jnp.int32), "tp") > 0
+
+            # 3. constraints: filter + state commit run REPLICATED — every
+            # device holds the same global claims, bitmaps, and state, so
+            # every device computes the identical result (no collective).
+            if constrained:
+                gi = jnp.minimum(g_choice, n_tot - 1).astype(jnp.int32)  # clamp the non-claimant sentinel
+                accepted = constraint_filter(jnp, accepted, gi, g_ranks, cpods, cst, cmeta)
+                cst = constraint_commit(jnp, accepted, gi, cpods, cst, cmeta, soft_spread=soft_spread)
+
+            # 4. capacity commit from the FILTERED accepted set; each column
+            # scatter-subtracts its own nodes.
+            acc_here = accepted & in_range
+            dec = jnp.zeros((n_local + 1, 2), jnp.int32).at[ch_local].add(jnp.where(acc_here[:, None], claim, 0))
+            avail = avail - dec[:n_local]
             acc_local = lax.dynamic_slice(accepted, (dp_idx * p_local,), (p_local,))
 
             assigned = jnp.where(acc_local, choice, assigned)
             active = cand & ~acc_local
             n_active = lax.psum(active.sum(), "dp")
-            return avail, assigned, active, n_active > 0, rounds + 1
+            return avail, assigned, active, n_active > 0, rounds + 1, cst
 
         state0 = (
             node_avail,
@@ -142,14 +222,16 @@ def _build_shard_map(mesh, max_rounds: int):
             valid,
             lax.psum(valid.sum(), "dp") > 0,
             jnp.int32(0),
+            cst0,
         )
-        avail, assigned, _, _, rounds = lax.while_loop(cond, body, state0)
+        avail, assigned, _, _, rounds, _ = lax.while_loop(cond, body, state0)
         return assigned, rounds, avail
 
+    extra_specs = (P(),) * len(CONSTRAINT_KEYS) if constrained else ()
     return jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=IN_SPECS,
+        in_specs=IN_SPECS + extra_specs,
         out_specs=(P("dp"), P(), P("tp", None)),
         # The while-carry mixes tp-varying (avail) and dp-varying (assigned)
         # state that converges by construction; VMA inference can't see that.
@@ -159,7 +241,7 @@ def _build_shard_map(mesh, max_rounds: int):
 
 # shard_map input layout, shared with parallel/multihost.py: node tensors
 # over tp, pod tensors (pre-permuted to priority order) over dp, weights
-# replicated.
+# replicated; constrained cycles append CONSTRAINT_KEYS operands, all P().
 IN_SPECS = (
     P("tp", None),  # node_alloc
     P("tp", None),  # node_avail
@@ -182,39 +264,49 @@ IN_SPECS = (
 )
 
 
+def constraint_operands(cons, n_pad_from: int, n_pad_to: int) -> dict:
+    """Numpy constraint operands in CONSTRAINT_KEYS order (as a dict), with
+    the node axis padded from the pack's padding to the mesh's tp multiple.
+    Pod bitmaps are returned in PACK order — the caller permutes + pads them
+    alongside the pod tensors."""
+    extra = n_pad_to - n_pad_from
+    ops = {}
+    ops.update(cons.pod_arrays())
+    meta = cons.meta_arrays()
+    state = cons.state_arrays()
+    ops["node_dom_c"] = np.pad(meta["node_dom_c"], ((0, extra), (0, 0)))
+    for k in ("term_uses_dom", "sp_uses_dom", "sp_skew", "sps_uses_dom"):
+        ops[k] = meta[k]
+    for k in ("aa_dom_m", "aa_dom_c", "sp_counts", "sps_counts"):
+        ops[k] = state[k]
+    ops["aa_node_m"] = np.pad(state["aa_node_m"], ((0, 0), (0, extra)))
+    ops["aa_node_c"] = np.pad(state["aa_node_c"], ((0, 0), (0, extra)))
+    return ops
+
+
 @lru_cache(maxsize=64)
-def _build_sharded_fn(mesh, max_rounds: int):
+def _build_sharded_fn(mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False):
     """Jitted (mesh, max_rounds)-specialised cycle fn — cached so repeated
     cycles reuse the compiled executable (jit re-specialises per shape)."""
     dp = mesh.shape["dp"]
-    sharded = _build_shard_map(mesh, max_rounds)
+    sharded = _build_shard_map(mesh, max_rounds, constrained, soft_spread)
+    pod_keys = ("pod_req", "pod_sel", "pod_sel_count", "pod_ntol", "pod_aff", "pod_has_aff", "pod_pref_w", "pod_ntol_soft")
 
     @jax.jit
-    def run(a, w):
+    def run(a, c):
         p_tot = a["pod_req"].shape[0]
         # Permute BEFORE dp padding: ranks feed the score-jitter hash and
         # must equal the unpadded native backend's (see ops/assign.py).
         perm = jnp.argsort(-a["pod_prio"], stable=True)
-        req = a["pod_req"][perm]
-        sel = a["pod_sel"][perm]
-        selc = a["pod_sel_count"][perm]
-        ntol = a["pod_ntol"][perm]
-        aff = a["pod_aff"][perm]
-        has_aff = a["pod_has_aff"][perm]
-        pref_w = a["pod_pref_w"][perm]
-        ntol_soft = a["pod_ntol_soft"][perm]
-        valid = a["pod_valid"][perm]
+        pods = {k: a[k][perm] for k in pod_keys}
+        pods["pod_valid"] = a["pod_valid"][perm]
+        cpods = {k: c[k][perm] for k in CONSTRAINT_KEYS[:_N_PODKEYS]} if constrained else {}
         extra = (-p_tot) % dp
         if extra:
-            req = jnp.pad(req, ((0, extra), (0, 0)))
-            sel = jnp.pad(sel, ((0, extra), (0, 0)))
-            selc = jnp.pad(selc, ((0, extra),))
-            ntol = jnp.pad(ntol, ((0, extra), (0, 0)))
-            aff = jnp.pad(aff, ((0, extra), (0, 0)))
-            has_aff = jnp.pad(has_aff, ((0, extra),))
-            pref_w = jnp.pad(pref_w, ((0, extra), (0, 0)))
-            ntol_soft = jnp.pad(ntol_soft, ((0, extra), (0, 0)))
-            valid = jnp.pad(valid, ((0, extra),))
+            pad = lambda v: jnp.pad(v, ((0, extra),) + ((0, 0),) * (v.ndim - 1))  # noqa: E731
+            pods = {k: pad(v) for k, v in pods.items()}
+            cpods = {k: pad(v) for k, v in cpods.items()}
+        cargs = tuple(cpods[k] if i < _N_PODKEYS else c[k] for i, k in enumerate(CONSTRAINT_KEYS)) if constrained else ()
         assigned_p, rounds, avail = sharded(
             a["node_alloc"],
             a["node_avail"],
@@ -224,16 +316,17 @@ def _build_sharded_fn(mesh, max_rounds: int):
             a["node_valid"],
             a["node_pref"],
             a["node_taints_soft"],
-            req,
-            sel,
-            selc,
-            ntol,
-            aff,
-            has_aff,
-            pref_w,
-            ntol_soft,
-            valid,
-            w,
+            pods["pod_req"],
+            pods["pod_sel"],
+            pods["pod_sel_count"],
+            pods["pod_ntol"],
+            pods["pod_aff"],
+            pods["pod_has_aff"],
+            pods["pod_pref_w"],
+            pods["pod_ntol_soft"],
+            pods["pod_valid"],
+            a["weights"],
+            *cargs,
         )
         assigned = jnp.full((p_tot,), -1, jnp.int32).at[perm].set(assigned_p[:p_tot])
         return assigned, rounds, avail
@@ -241,18 +334,24 @@ def _build_sharded_fn(mesh, max_rounds: int):
     return run
 
 
-def sharded_assign_cycle(mesh, arrays: dict, weights, max_rounds: int = 32):
+def sharded_assign_cycle(mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None, soft_spread: bool = False):
     """Run one cycle over the mesh. ``arrays`` are the PackedCluster device
     arrays with N pre-padded to a tp multiple (pods pad internally, post-
-    permute).  Returns (assigned [P], rounds, avail [N_padded,2])."""
+    permute); ``constraints`` the :func:`constraint_operands` dict for
+    constrained cycles.  Returns (assigned [P], rounds, avail [N_padded,2])."""
     assert arrays["node_avail"].shape[0] % mesh.shape["tp"] == 0
-    return _build_sharded_fn(mesh, max_rounds)(arrays, weights)
+    a = dict(arrays)
+    a["weights"] = np.asarray(weights, dtype=np.float32)
+    run = _build_sharded_fn(mesh, max_rounds, constraints is not None, soft_spread)
+    return run(a, constraints if constraints is not None else {})
 
 
 class ShardedBackend(SchedulingBackend):
     """SchedulingBackend over a device mesh — DP×TP distribution of the
-    cycle.  Drop-in for TpuBackend; used by dryrun_multichip and the
-    multi-chip benches."""
+    cycle, including constrained (anti-affinity / topology-spread) cycles
+    via replicated domain state.  Drop-in for TpuBackend; used by
+    dryrun_multichip, the CLI ``--backend=tpu-sharded``, and the multi-chip
+    benches."""
 
     name = "tpu-sharded"
 
@@ -260,14 +359,6 @@ class ShardedBackend(SchedulingBackend):
         self.mesh = mesh if mesh is not None else make_mesh(tp=tp)
 
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
-        if packed.constraints is not None:
-            # The sharded cycle doesn't evaluate the anti-affinity/spread
-            # tensors yet; dropping them silently would bind violating
-            # placements.  Raising the tensor-budget signal routes the
-            # controller to its exact host-side constrained phase.
-            from ..ops.constraints import UntensorizableConstraints
-
-            raise UntensorizableConstraints("sharded backend does not evaluate constraint tensors yet")
         try:
             tp = self.mesh.shape["tp"]
             a = dict(packed.device_arrays())
@@ -277,7 +368,22 @@ class ShardedBackend(SchedulingBackend):
             for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff", "node_pref", "node_taints_soft"):
                 a[k] = np.pad(a[k], ((0, n_pad - packed.padded_nodes), (0, 0)))
             a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - packed.padded_nodes),))
-            assigned, rounds, _avail = sharded_assign_cycle(self.mesh, a, packed_weights(profile), profile.max_rounds)
+            cons = packed.constraints
+            c = constraint_operands(cons, packed.padded_nodes, n_pad) if cons is not None else None
+            soft_spread = cons is not None and cons.n_spread_soft > 0
+            if jax.process_count() > 1:
+                # Multi-controller runtime: host-local numpy can't feed a jit
+                # over non-addressable devices — route through the global-
+                # array path (parallel/multihost.py; same shard_map program).
+                from .multihost import sharded_assign_multihost
+
+                assigned, rounds = sharded_assign_multihost(
+                    self.mesh, a, profile.weights(), profile.max_rounds, constraints=c, soft_spread=soft_spread
+                )
+                return np.asarray(assigned), int(rounds)
+            assigned, rounds, _avail = sharded_assign_cycle(
+                self.mesh, a, profile.weights(), profile.max_rounds, constraints=c, soft_spread=soft_spread
+            )
             return np.asarray(jax.device_get(assigned)), int(rounds)
         except jax.errors.JaxRuntimeError as e:
             # Same contract as TpuBackend: device-runtime failures become the
@@ -286,7 +392,3 @@ class ShardedBackend(SchedulingBackend):
             from ..errors import BackendUnavailable
 
             raise BackendUnavailable(f"sharded backend runtime failure: {e}") from e
-
-
-def packed_weights(profile: SchedulingProfile):
-    return jnp.asarray(profile.weights())
