@@ -190,3 +190,102 @@ def test_client_reuses_connection_and_survives_drop(served):
     assert client._conn is first_conn  # keep-alive reused
     client._conn.close()  # simulate server-side drop
     assert {n.name for n in client.list_nodes()} == {"n1"}  # reconnects
+
+
+def test_http_watch_is_incremental_o_delta(served):
+    """The remote boundary performs ONE full list at startup, then only
+    ``?watch=true&resourceVersion=N`` delta requests per cycle — O(delta),
+    not O(cluster) (VERDICT r2 item 6; reference main.rs:135)."""
+    api, server, _ = served
+    api.load(
+        nodes=[make_node(f"n{i}", cpu="8", memory="32Gi") for i in range(6)],
+        pods=[make_pod(f"p{i}") for i in range(40)],
+    )
+    client = KubeApiClient(server.base_url)
+    sched = Scheduler(RemoteApiAdapter(client), NativeBackend(), requeue_seconds=0.0)
+    sched.run(until_settled=True)
+    assert sched.metrics.snapshot()["scheduler_bindings_total"] == 40
+    # Exactly one full list per kind, ever (the watch-start point).
+    assert client.request_counts[("GET", "/api/v1/pods")] == 1
+    assert client.request_counts[("GET", "/api/v1/nodes")] == 1
+
+    # Steady state: more cycles add zero list requests and O(1) watch polls.
+    watch_before = dict(client.request_counts)
+    for _ in range(5):
+        sched.run_cycle()
+    assert client.request_counts[("GET", "/api/v1/pods")] == 1
+    assert client.request_counts[("GET", "/api/v1/nodes")] == 1
+    assert client.request_counts[("GET", "/api/v1/pods?watch")] - watch_before[("GET", "/api/v1/pods?watch")] == 5
+    assert client.request_counts[("GET", "/api/v1/nodes?watch")] - watch_before[("GET", "/api/v1/nodes?watch")] == 5
+
+    # New work arrives: the watch delivers it incrementally (no relist).
+    for i in range(3):
+        api.create_pod(make_pod(f"late-{i}"))
+    m = sched.run_cycle()
+    assert m.bound == 3
+    assert client.request_counts[("GET", "/api/v1/pods")] == 1
+
+
+def test_http_watch_410_resync_relists_once(served):
+    """An evicted resourceVersion (bounded server history) produces one 410,
+    one relist, and a correct diff — the kube reflector resync contract."""
+    api, server, _ = served
+    api.load(nodes=[make_node("n1")], pods=[])
+    adapter = RemoteApiAdapter(KubeApiClient(server.base_url))
+    watch = adapter.watch_nodes()
+    first = watch.poll()
+    assert [e.type for e in first] == ["ADDED"]
+
+    # Evict history past the client's rv (what a full log-trim cycle does).
+    for i in range(8):
+        api.create_node(make_node(f"extra-{i}"))
+    del api._events_log[:-2]
+    events = watch.poll()  # rv now predates the retained history -> 410 -> relist
+    assert {e.type for e in events} == {"ADDED"}
+    assert len(events) == 8  # the 8 new nodes (n1 already seen)
+    # Subsequent polls resume incremental watching from the relist point.
+    assert watch.poll() == []
+    api.delete_node("extra-0")
+    assert [e.type for e in watch.poll()] == ["DELETED"]
+
+
+def test_http_watch_long_poll_returns_on_event(served):
+    """timeoutSeconds>0 long-polls server-side: the request parks until an
+    event arrives (no busy polling) and returns promptly with it."""
+    import threading
+    import time
+
+    api, server, _ = served
+    api.load(nodes=[make_node("n1")], pods=[])
+    client = KubeApiClient(server.base_url)
+    nodes, rv = client.list_nodes(with_rv=True)
+    assert len(nodes) == 1
+
+    results = {}
+
+    def poll():
+        t0 = time.monotonic()
+        events, new_rv = client.watch_nodes_since(rv, timeout_seconds=5.0)
+        results["events"] = events
+        results["latency"] = time.monotonic() - t0
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.2)
+    api.create_node(make_node("n2"))
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert [e.type for e in results["events"]] == ["ADDED"]
+    assert results["events"][0].object.name == "n2"
+    assert 0.1 < results["latency"] < 3.0  # woke on the event, not the timeout
+
+
+def test_http_watch_long_poll_outlives_client_socket_timeout(served):
+    """A long-poll longer than the client's default socket timeout must not
+    kill the connection: the watch request raises its own read timeout."""
+    api, server, _ = served
+    api.load(nodes=[make_node("n1")], pods=[])
+    client = KubeApiClient(server.base_url, timeout=0.5)
+    _, rv = client.list_nodes(with_rv=True)
+    events, new_rv = client.watch_nodes_since(rv, timeout_seconds=1.5)  # > socket timeout
+    assert events == [] and new_rv == rv  # timed out server-side, cleanly

@@ -89,25 +89,84 @@ class Watch:
 
 
 class FakeApiServer:
-    def __init__(self):
+    def __init__(self, watch_history: int = 1 << 18):
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
         self._pods: dict[tuple[str, str], Pod] = {}  # (namespace, name)
         self._rv = 0
         self._watches: dict[str, set[Watch]] = {"Node": set(), "Pod": set()}
+        # Bounded event history for resourceVersion-based incremental watch
+        # (the HTTP boundary's ``?watch=true&resourceVersion=N`` long-poll):
+        # (rv, kind, event, prev_object), rv strictly increasing.  A list
+        # (not a deque) so watch_since can bisect straight to the suffix
+        # after rv — O(log n + delta) per poll, not O(history).  A client
+        # whose rv has been evicted gets 410 Gone and relists — the kube
+        # watch-cache contract.
+        self._events_log: list[tuple[int, str, WatchEvent, Pod | Node | None]] = []
+        self._watch_history = watch_history
+        self._events_cv = threading.Condition(self._lock)
         # Fault injection: number of upcoming binding calls to fail with 500.
         self.fail_next_bindings = 0
         self.binding_count = 0
 
     # -- internals ---------------------------------------------------------
 
-    def _emit(self, kind: str, event: WatchEvent) -> None:
+    def _emit(self, kind: str, event: WatchEvent, prev: Pod | Node | None = None, rv: int | None = None) -> None:
+        if rv is None:
+            rv = event.object.metadata.resource_version or self._rv
+        self._events_log.append((rv, kind, event, prev))
+        if len(self._events_log) >= 2 * self._watch_history:
+            # Trim in halves — amortized O(1) per append.
+            del self._events_log[: len(self._events_log) - self._watch_history]
         for w in self._watches[kind]:
             w._offer(event)
+        self._events_cv.notify_all()
 
     def _bump(self, obj: Pod | Node) -> None:
         self._rv += 1
         obj.metadata.resource_version = self._rv
+
+    @property
+    def latest_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def watch_since(
+        self, kind: str, rv: int, field_selector: str | None = None, timeout: float = 0.0
+    ) -> tuple[list[WatchEvent], int]:
+        """Events of ``kind`` with resourceVersion > ``rv`` (the incremental
+        watch the reference's kube watcher provides, ``main.rs:135``).
+
+        Long-polls up to ``timeout`` seconds when nothing is pending.  An
+        object whose update leaves the field selector emits DELETED (kube
+        semantics).  Raises ``ApiError(410)`` when ``rv`` predates the
+        retained history — the client's cue to relist.
+        """
+        import bisect
+        import time as _time
+
+        match = _field_selector_fn(field_selector)
+        deadline = _time.monotonic() + timeout
+        with self._events_cv:
+            while True:
+                oldest = self._events_log[0][0] if self._events_log else self._rv + 1
+                if rv < oldest - 1:
+                    raise ApiError(410, f"resourceVersion {rv} too old (oldest retained {oldest - 1})")
+                start = bisect.bisect_right(self._events_log, rv, key=lambda e: e[0])
+                out: list[WatchEvent] = []
+                for erv, k, ev, prev in self._events_log[start:]:
+                    if k != kind:
+                        continue
+                    if match(ev.object):
+                        out.append(ev)
+                    elif prev is not None and match(prev):
+                        out.append(WatchEvent("DELETED", ev.object))
+                if out or timeout <= 0:
+                    return out, self._rv
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return [], self._rv
+                self._events_cv.wait(remaining)
 
     @staticmethod
     def _pod_key(pod: Pod) -> tuple[str, str]:
@@ -125,22 +184,31 @@ class FakeApiServer:
 
     def update_node(self, node: Node) -> None:
         with self._lock:
-            if node.name not in self._nodes:
+            prev = self._nodes.get(node.name)
+            if prev is None:
                 raise ApiError(404, f"node {node.name} not found")
             self._bump(node)
             self._nodes[node.name] = node
-            self._emit("Node", WatchEvent("MODIFIED", node))
+            self._emit("Node", WatchEvent("MODIFIED", node), prev=prev)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             node = self._nodes.pop(name, None)
             if node is None:
                 raise ApiError(404, f"node {name} not found")
-            self._emit("Node", WatchEvent("DELETED", node))
+            self._rv += 1  # deletion is an rv-advancing event (kube semantics)
+            self._emit("Node", WatchEvent("DELETED", node), rv=self._rv)
 
     def list_nodes(self) -> list[Node]:
         with self._lock:
             return list(self._nodes.values())
+
+    def list_nodes_with_rv(self) -> tuple[list[Node], int]:
+        """(nodes, resourceVersion) taken atomically — the watch-start token
+        a lister needs: events after this rv are exactly what the list
+        doesn't already reflect."""
+        with self._lock:
+            return list(self._nodes.values()), self._rv
 
     def watch_nodes(self, field_selector: str | None = None, send_initial: bool = True) -> Watch:
         with self._lock:
@@ -167,12 +235,19 @@ class FakeApiServer:
             pod = self._pods.pop((namespace, name), None)
             if pod is None:
                 raise ApiError(404, f"pod {namespace}/{name} not found")
-            self._emit("Pod", WatchEvent("DELETED", pod))
+            self._rv += 1  # deletion is an rv-advancing event (kube semantics)
+            self._emit("Pod", WatchEvent("DELETED", pod), rv=self._rv)
 
     def list_pods(self, field_selector: str | None = None) -> list[Pod]:
         match = _field_selector_fn(field_selector)
         with self._lock:
             return [p for p in self._pods.values() if match(p)]
+
+    def list_pods_with_rv(self, field_selector: str | None = None) -> tuple[list[Pod], int]:
+        """(pods, resourceVersion) taken atomically (see list_nodes_with_rv)."""
+        match = _field_selector_fn(field_selector)
+        with self._lock:
+            return [p for p in self._pods.values() if match(p)], self._rv
 
     def watch_pods(self, field_selector: str | None = None, send_initial: bool = True) -> Watch:
         with self._lock:
@@ -207,7 +282,7 @@ class FakeApiServer:
             bound = replace(pod, spec=new_spec, status=replace(pod.status, phase="Running"))
             self._bump(bound)
             self._pods[(namespace, pod_name)] = bound
-            self._emit("Pod", WatchEvent("MODIFIED", bound))
+            self._emit("Pod", WatchEvent("MODIFIED", bound), prev=pod)
 
     # -- bulk helpers for synthetic clusters -------------------------------
 

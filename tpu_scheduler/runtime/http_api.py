@@ -14,10 +14,11 @@ sides of that boundary for this framework:
     pointed at a real kube-apiserver (with a bearer token) it is the
     real-cluster edge adapter SURVEY.md §7 step 5 calls for.
   • ``RemoteApiAdapter`` — adapts the client to the poll-watch interface the
-    reflectors and controller expect (watch_nodes/watch_pods/create_binding),
-    emulating watches by list+diff relists — the "relist reflector" pattern;
-    the HTTP round-trip is the process boundary the reference crosses on
-    every watch reconnect (``main.rs:135-136``).
+    reflectors and controller expect (watch_nodes/watch_pods/create_binding)
+    via :class:`HttpWatch`: one initial list, then incremental
+    ``?watch=true&resourceVersion=N`` requests that carry only the delta —
+    O(delta) HTTP + parse per cycle, the reference's true watch stream
+    (``main.rs:135``), with 410-triggered relists as the resync path.
 
 Everything is exercised end-to-end over real sockets in
 tests/test_http_api.py: Scheduler → RemoteApiAdapter → HTTP → HttpApiServer
@@ -36,7 +37,7 @@ from ..api.objects import Node, ObjectReference, Pod, node_to_dict, pod_to_dict
 from ..errors import CreateBindingFailed
 from .fake_api import ApiError, FakeApiServer, WatchEvent
 
-__all__ = ["HttpApiServer", "KubeApiClient", "RemoteApiAdapter", "PollingWatch"]
+__all__ = ["HttpApiServer", "KubeApiClient", "RemoteApiAdapter", "HttpWatch", "PollingWatch"]
 
 
 class HttpApiServer:
@@ -66,10 +67,28 @@ class HttpApiServer:
             def _send_json(self, code: int, obj):
                 self._send(code, json.dumps(obj).encode())
 
+            def _send_watch(self, kind: str, to_dict, q, selector):
+                """``?watch=true&resourceVersion=N[&timeoutSeconds=T]`` — the
+                incremental boundary replacing full relists (reference
+                ``main.rs:135``).  Responds with newline-delimited watch
+                events plus a trailing BOOKMARK carrying the latest
+                resourceVersion (kube watch-bookmark shape); 410 when N
+                predates the retained history (client relists)."""
+                try:
+                    rv = int(q.get("resourceVersion", ["0"])[0])
+                    timeout = float(q.get("timeoutSeconds", ["0"])[0])
+                except ValueError as e:
+                    raise ApiError(400, f"malformed watch parameter: {e}") from e
+                events, new_rv = outer.api.watch_since(kind, rv, field_selector=selector, timeout=min(timeout, 30.0))
+                lines = [json.dumps({"type": e.type, "object": to_dict(e.object)}) for e in events]
+                lines.append(json.dumps({"type": "BOOKMARK", "object": {"metadata": {"resourceVersion": new_rv}}}))
+                self._send(200, "\n".join(lines).encode(), "application/json; stream=watch")
+
             def do_GET(self):
                 parsed = urlparse(self.path)
                 q = parse_qs(parsed.query)
                 selector = q.get("fieldSelector", [None])[0]
+                watching = q.get("watch", ["false"])[0] in ("true", "1")
                 try:
                     if parsed.path == "/healthz" or parsed.path == "/readyz":
                         self._send(200, b"ok", "text/plain")
@@ -78,12 +97,18 @@ class HttpApiServer:
                         self._send(200, text.encode(), "text/plain; version=0.0.4")
                     elif outer.api is None and parsed.path.startswith("/api/"):
                         self._send_json(503, {"message": "metrics-only server: no cluster state here"})
+                    elif parsed.path == "/api/v1/nodes" and watching:
+                        self._send_watch("Node", node_to_dict, q, selector)
+                    elif parsed.path == "/api/v1/pods" and watching:
+                        self._send_watch("Pod", pod_to_dict, q, selector)
                     elif parsed.path == "/api/v1/nodes":
-                        items = [node_to_dict(n) for n in outer.api.list_nodes()]
-                        self._send_json(200, {"kind": "NodeList", "items": items})
+                        nodes, rv = outer.api.list_nodes_with_rv()
+                        items = [node_to_dict(n) for n in nodes]
+                        self._send_json(200, {"kind": "NodeList", "metadata": {"resourceVersion": str(rv)}, "items": items})
                     elif parsed.path == "/api/v1/pods":
-                        items = [pod_to_dict(p) for p in outer.api.list_pods(field_selector=selector)]
-                        self._send_json(200, {"kind": "PodList", "items": items})
+                        pods, rv = outer.api.list_pods_with_rv(field_selector=selector)
+                        items = [pod_to_dict(p) for p in pods]
+                        self._send_json(200, {"kind": "PodList", "metadata": {"resourceVersion": str(rv)}, "items": items})
                     else:
                         self._send_json(404, {"message": f"not found: {parsed.path}"})
                 except ApiError as e:
@@ -161,11 +186,21 @@ class KubeApiClient:
             connection_factory = lambda: cls(self._host, self._port, timeout=self._timeout)  # noqa: E731
         self._connect = connection_factory
         self._conn = None  # persistent keep-alive connection
+        # GET accounting by (method, path-sans-query; watch polls keyed
+        # separately) — the O(delta) watch contract is testable only if the
+        # traffic is observable.  GET-only: binding POST paths embed pod
+        # names, which would grow the dict without bound in a daemon.
+        self.request_counts: dict[tuple[str, str], int] = {}
 
-    def _request(self, method: str, path: str, body=None) -> tuple[int, dict]:
+    def _request(self, method: str, path: str, body=None, read_timeout: float | None = None) -> tuple[int, bytes]:
         """One round-trip over a persistent connection (a binding-heavy cycle
         issues thousands of POSTs — per-request TCP/TLS handshakes would
-        dominate bind latency).  One reconnect on a dropped keep-alive."""
+        dominate bind latency).  One reconnect on a dropped keep-alive.
+        Returns the raw body; JSON decoding is the caller's (watch responses
+        are newline-delimited event streams, not single documents).
+        ``read_timeout`` overrides the socket timeout for this request —
+        a server-side long-poll must be allowed to park longer than the
+        default request timeout."""
         headers = {"Accept": "application/json"}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
@@ -173,6 +208,11 @@ class KubeApiClient:
         if body is not None:
             payload = json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
+        if method == "GET":
+            bare, _, query = path.partition("?")
+            if "watch=true" in query:
+                bare += "?watch"  # account watch polls separately from full lists
+            self.request_counts[(method, bare)] = self.request_counts.get((method, bare), 0) + 1
         # Only idempotent GETs are auto-retried: a POST whose connection
         # died after the request was sent may already have been processed
         # (a re-sent binding would then surface as a spurious 409).
@@ -180,16 +220,23 @@ class KubeApiClient:
         for attempt in retries:
             if self._conn is None:
                 self._conn = self._connect()
+            t = self._timeout if read_timeout is None else read_timeout
+            self._conn.timeout = t
+            if getattr(self._conn, "sock", None) is not None:
+                self._conn.sock.settimeout(t)
             try:
                 self._conn.request(method, path, body=payload, headers=headers)
                 resp = self._conn.getresponse()
-                data = resp.read()
-                return resp.status, (json.loads(data) if data else {})
+                return resp.status, resp.read()
             except (http.client.HTTPException, ConnectionError, BrokenPipeError, OSError):
                 self.close()
                 if attempt:
                     raise
         raise AssertionError("unreachable")
+
+    def _request_json(self, method: str, path: str, body=None) -> tuple[int, dict]:
+        code, data = self._request(method, path, body)
+        return code, (json.loads(data) if data else {})
 
     def close(self) -> None:
         if self._conn is not None:
@@ -198,22 +245,68 @@ class KubeApiClient:
             finally:
                 self._conn = None
 
-    def list_nodes(self) -> list[Node]:
-        code, body = self._request("GET", "/api/v1/nodes")
+    def list_nodes(self, with_rv: bool = False):
+        code, body = self._request_json("GET", "/api/v1/nodes")
         if code != 200:
             raise ApiError(code, body.get("message", "list nodes failed"))
-        return [Node.from_dict(d) for d in body.get("items", [])]
+        nodes = [Node.from_dict(d) for d in body.get("items", [])]
+        if with_rv:
+            return nodes, int(body.get("metadata", {}).get("resourceVersion", 0) or 0)
+        return nodes
 
-    def list_pods(self, field_selector: str | None = None) -> list[Pod]:
+    def list_pods(self, field_selector: str | None = None, with_rv: bool = False):
         path = "/api/v1/pods"
         if field_selector:
             from urllib.parse import quote
 
             path += f"?fieldSelector={quote(field_selector)}"
-        code, body = self._request("GET", path)
+        code, body = self._request_json("GET", path)
         if code != 200:
             raise ApiError(code, body.get("message", "list pods failed"))
-        return [Pod.from_dict(d) for d in body.get("items", [])]
+        pods = [Pod.from_dict(d) for d in body.get("items", [])]
+        if with_rv:
+            return pods, int(body.get("metadata", {}).get("resourceVersion", 0) or 0)
+        return pods
+
+    def _watch(self, path: str, from_dict, rv: int, field_selector: str | None, timeout_seconds: float):
+        """One incremental watch request: events after ``rv`` plus the new
+        resourceVersion (from the trailing BOOKMARK, falling back to the last
+        event's own rv for servers that don't send bookmarks)."""
+        from urllib.parse import quote
+
+        q = f"?watch=true&resourceVersion={rv}"
+        if timeout_seconds:
+            q += f"&timeoutSeconds={timeout_seconds:g}"
+        if field_selector:
+            q += f"&fieldSelector={quote(field_selector)}"
+        # The socket must outlive the server-side long-poll park.
+        read_timeout = timeout_seconds + max(5.0, self._timeout) if timeout_seconds else None
+        code, raw = self._request("GET", path + q, read_timeout=read_timeout)
+        if code != 200:
+            try:
+                msg = json.loads(raw).get("message", "watch failed")
+            except json.JSONDecodeError:
+                msg = "watch failed"
+            raise ApiError(code, msg)
+        events: list[WatchEvent] = []
+        new_rv = rv
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            doc = json.loads(line)
+            if doc.get("type") == "BOOKMARK":
+                new_rv = int(doc.get("object", {}).get("metadata", {}).get("resourceVersion", new_rv) or new_rv)
+                continue
+            obj = from_dict(doc.get("object", {}))
+            events.append(WatchEvent(doc.get("type", "MODIFIED"), obj))
+            new_rv = max(new_rv, obj.metadata.resource_version or 0)
+        return events, new_rv
+
+    def watch_nodes_since(self, rv: int, field_selector: str | None = None, timeout_seconds: float = 0.0):
+        return self._watch("/api/v1/nodes", Node.from_dict, rv, field_selector, timeout_seconds)
+
+    def watch_pods_since(self, rv: int, field_selector: str | None = None, timeout_seconds: float = 0.0):
+        return self._watch("/api/v1/pods", Pod.from_dict, rv, field_selector, timeout_seconds)
 
     def create_binding(self, namespace: str, pod_name: str, target: ObjectReference) -> None:
         # The Binding document the reference builds at main.rs:83-91.
@@ -223,7 +316,7 @@ class KubeApiClient:
             "metadata": {"name": pod_name, "namespace": namespace},
             "target": {"apiVersion": "v1", "kind": target.kind, "name": target.name},
         }
-        code, resp = self._request("POST", f"/api/v1/namespaces/{namespace}/pods/{pod_name}/binding", body)
+        code, resp = self._request_json("POST", f"/api/v1/namespaces/{namespace}/pods/{pod_name}/binding", body)
         if code == 500:
             raise CreateBindingFailed(resp.get("message", "binding failed"))
         if code not in (200, 201):
@@ -237,10 +330,72 @@ class KubeApiClient:
             return False
 
 
+class HttpWatch:
+    """Incremental watch over the HTTP boundary — the reference's true watch
+    stream (``main.rs:135``) rather than a relist emulation.
+
+    First poll: one full list (captured atomically with its resourceVersion)
+    diffed against any previously seen state — ADDED events on a fresh
+    start, the exact delta on a resync.  Every later poll: one
+    ``?watch=true&resourceVersion=N`` request returning only the events
+    since N — O(delta) HTTP + parse per cycle instead of O(cluster).  A 410
+    (rv evicted from the server's bounded history) falls back to one relist,
+    the kube reflector contract."""
+
+    def __init__(self, list_fn, watch_fn, key_fn, timeout_seconds: float = 0.0):
+        self._list = list_fn  # () -> (objects, resource_version)
+        self._watch = watch_fn  # (rv, timeout) -> (events, new_rv)
+        self._key = key_fn
+        self._timeout = timeout_seconds
+        self._rv: int | None = None
+        self._seen: dict = {}
+
+    def poll(self) -> list[WatchEvent]:
+        if self._rv is None:
+            return self._relist()
+        try:
+            events, new_rv = self._watch(self._rv, self._timeout)
+        except ApiError as e:
+            if e.code == 410:  # history gone — relist once, resume watching
+                self._rv = None
+                return self._relist()
+            raise
+        self._rv = new_rv
+        for ev in events:
+            key = self._key(ev.object)
+            if ev.type == "DELETED":
+                self._seen.pop(key, None)
+            else:
+                self._seen[key] = ev.object
+        return events
+
+    def _relist(self) -> list[WatchEvent]:
+        objs, rv = self._list()
+        fresh = {self._key(o): o for o in objs}
+        events: list[WatchEvent] = []
+        for key, obj in fresh.items():
+            if key not in self._seen:
+                events.append(WatchEvent("ADDED", obj))
+            elif PollingWatch._changed(self._seen[key], obj):
+                events.append(WatchEvent("MODIFIED", obj))
+        for key, obj in self._seen.items():
+            if key not in fresh:
+                events.append(WatchEvent("DELETED", obj))
+        self._seen = fresh
+        self._rv = rv
+        return events
+
+    def close(self) -> None:
+        self._seen = {}
+        self._rv = None
+
+
 class PollingWatch:
     """Emulate a watch stream by list+diff — each poll() relists and emits
     ADDED/MODIFIED/DELETED events vs the previously seen state (keyed by
-    resourceVersion when present, else object equality)."""
+    resourceVersion when present, else object equality).  Retained as the
+    degraded-mode adapter for servers without watch support; the primary
+    boundary is :class:`HttpWatch`."""
 
     def __init__(self, list_fn, key_fn):
         self._list = list_fn
@@ -282,21 +437,32 @@ class PollingWatch:
 
 class RemoteApiAdapter:
     """Duck-typed stand-in for FakeApiServer over a KubeApiClient — plugs the
-    HTTP boundary into ClusterReflector/Scheduler unchanged."""
+    HTTP boundary into ClusterReflector/Scheduler unchanged.
 
-    def __init__(self, client: KubeApiClient):
+    ``watch_timeout_seconds`` > 0 turns each steady-state watch request into
+    a server-side long-poll (the daemon's idle mode rides the server's
+    condition variable instead of busy-polling)."""
+
+    def __init__(self, client: KubeApiClient, watch_timeout_seconds: float = 0.0):
         self.client = client
+        self.watch_timeout_seconds = watch_timeout_seconds
 
     def watch_nodes(self, field_selector: str | None = None, send_initial: bool = True):
-        return PollingWatch(self.client.list_nodes, key_fn=lambda n: n.name)
+        return HttpWatch(
+            lambda: self.client.list_nodes(with_rv=True),
+            lambda rv, t: self.client.watch_nodes_since(rv, timeout_seconds=t),
+            key_fn=lambda n: n.name,
+            timeout_seconds=self.watch_timeout_seconds,
+        )
 
     def watch_pods(self, field_selector: str | None = None, send_initial: bool = True):
         sel = field_selector
-
-        def list_pods():
-            return self.client.list_pods(field_selector=sel)
-
-        return PollingWatch(list_pods, key_fn=lambda p: (p.metadata.namespace, p.metadata.name))
+        return HttpWatch(
+            lambda: self.client.list_pods(field_selector=sel, with_rv=True),
+            lambda rv, t: self.client.watch_pods_since(rv, field_selector=sel, timeout_seconds=t),
+            key_fn=lambda p: (p.metadata.namespace, p.metadata.name),
+            timeout_seconds=self.watch_timeout_seconds,
+        )
 
     def list_nodes(self):
         return self.client.list_nodes()
