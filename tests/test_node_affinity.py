@@ -176,9 +176,11 @@ def test_sample_policy_respects_affinity():
             assert p.spec.node_name == "good"
 
 
-def test_new_affinity_term_forces_full_repack():
-    """The incremental-pack gate must notice a pending pod whose affinity
-    term is not in the cached vocabulary."""
+def test_new_affinity_term_extends_vocab_incrementally():
+    """A pending pod whose affinity term is not in the cached vocabulary
+    GROWS the cached node tensors (ops/pack.extend_node_vocabs) and stays on
+    the incremental path — while still scheduling correctly against the new
+    term."""
     api = FakeApiServer()
     api.load(nodes=[make_node("n", labels={"zone": "a"})], pods=[make_pod("p0")])
     sched = Scheduler(api, NativeBackend(), policy="batch")
@@ -187,4 +189,6 @@ def test_new_affinity_term_forces_full_repack():
     api.create_pod(make_pod("p1", node_affinity=[term(Req("zone", "In", ["a"]))]))
     m = sched.run_cycle()
     assert m.bound == 1
-    assert sched.metrics.counters["scheduler_full_packs_total"] == 2
+    assert sched.metrics.counters["scheduler_full_packs_total"] == 1  # still only the first
+    assert sched.metrics.counters["scheduler_vocab_extensions_total"] == 1
+    assert sched.metrics.counters["scheduler_incremental_packs_total"] >= 1

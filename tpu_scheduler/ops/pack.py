@@ -68,6 +68,7 @@ __all__ = [
     "pack_snapshot",
     "repack_avail",
     "repack_incremental",
+    "extend_node_vocabs",
     "build_selector_vocab",
     "build_taint_vocab",
     "build_affinity_vocab",
@@ -535,6 +536,122 @@ def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedClus
     return replace(packed, node_avail=_avail_i32(alloc64, used64))
 
 
+def _grow_columns(arr: np.ndarray, total: int, label_block: int) -> np.ndarray:
+    """Copy ``arr`` with its column count grown to cover ``total`` entries
+    (padded to the block multiple).  Always copies — cached tensors may be
+    aliased by checkpoints or in-flight device transfers."""
+    width = arr.shape[1]
+    if total > width:
+        return np.pad(arr, ((0, 0), (0, round_up(total, label_block) - width)))
+    return arr.copy()
+
+
+def extend_node_vocabs(packed: PackedCluster, snapshot: ClusterSnapshot, label_block: int = 8) -> PackedCluster:
+    """Grow the cached node-side tensors to cover vocabulary entries newly
+    introduced by the pending pods — the in-place alternative to a full
+    repack when the node set is stable but a new deployment brings a
+    selector pair, affinity term, or preferred term the cache has never
+    seen (VERDICT r2 item 8).
+
+    Only the *new* columns are evaluated against the nodes — O(N · new)
+    host work instead of the full pack's O(N · (L + A + A2)).  Taint vocabs
+    are node-driven: a taint change bumps the node's resourceVersion, which
+    changes the node-set signature and forces a full pack anyway, so they
+    are not extended here.  Column order of existing entries is preserved,
+    so score/feasibility semantics are bit-identical to a fresh pack.
+    """
+    fresh_names = tuple(n.name for n in snapshot.nodes)
+    if fresh_names != packed.node_names:
+        raise ValueError("extend_node_vocabs requires an identical node set/order; run a full pack_snapshot instead")
+    pending = snapshot.pending_pods()
+    nodes = list(snapshot.nodes)
+
+    # One pass over the pending pods: collect entries the cache lacks (new_*)
+    # and the distinct entries actually in use (live_*, for the compaction
+    # valve below).  Membership goes against the cached dicts directly — the
+    # steady state allocates only these small live/new sets, never copies of
+    # the (possibly large) vocabularies.
+    new_sel: dict[tuple[str, str], None] = {}
+    new_aff: dict[tuple, None] = {}
+    new_pref: dict[tuple, None] = {}
+    live_sel: set = set()
+    live_aff: set = set()
+    live_pref: set = set()
+    for p in pending:
+        if p.spec is None:
+            continue
+        if p.spec.node_selector:
+            for kv in p.spec.node_selector.items():
+                live_sel.add(kv)
+                if kv not in packed.vocab:
+                    new_sel[kv] = None
+        for term in p.spec.node_affinity or []:
+            k = term.key()
+            live_aff.add(k)
+            if k not in packed.aff_vocab:
+                new_aff[k] = None
+        for t in p.spec.preferred_node_affinity or []:
+            k = t.term.key()
+            live_pref.add(k)
+            if k not in packed.pref_vocab:
+                new_pref[k] = None
+    if not (new_sel or new_aff or new_pref):
+        return packed
+
+    # Compaction valve: growth is monotone (dead deployments leave columns
+    # behind), so once dead columns dominate the live entries, refuse —
+    # the caller's full-pack fallback rebuilds minimal vocabularies from the
+    # current pending set, shrinking the tensors.
+    for vocab, live, new in (
+        (packed.vocab, live_sel, new_sel),
+        (packed.aff_vocab, live_aff, new_aff),
+        (packed.pref_vocab, live_pref, new_pref),
+    ):
+        if len(vocab) + len(new) > max(16, 2 * len(live)):
+            raise ValueError(
+                f"vocabulary bloat: {len(vocab)} cached + {len(new)} new entries vs {len(live)} live; "
+                "full repack compacts the dead columns"
+            )
+
+    out = {}
+    if new_sel:
+        vocab = dict(packed.vocab)
+        node_labels = _grow_columns(packed.node_labels, len(vocab) + len(new_sel), label_block)
+        for kv in new_sel:
+            vocab[kv] = len(vocab)
+        for ni, node in enumerate(nodes):
+            labels = node.metadata.labels
+            if labels:
+                for k, v in new_sel:
+                    if labels.get(k) == v:
+                        node_labels[ni, vocab[(k, v)]] = 1.0
+        out["vocab"] = vocab
+        out["node_labels"] = node_labels
+    if new_aff or new_pref:
+        from ..core.predicates import node_selector_term_matches
+
+        for keys, vocab_name, tensor_name in (
+            (new_aff, "aff_vocab", "node_aff"),
+            (new_pref, "pref_vocab", "node_pref"),
+        ):
+            if not keys:
+                continue
+            vocab = dict(getattr(packed, vocab_name))
+            tensor = _grow_columns(getattr(packed, tensor_name), len(vocab) + len(keys), label_block)
+            terms = []
+            for key in keys:
+                vocab[key] = len(vocab)
+                terms.append((vocab[key], _term_from_key(key)))
+            for ni, node in enumerate(nodes):
+                labels = node.metadata.labels
+                for j, term in terms:
+                    if node_selector_term_matches(term, labels):
+                        tensor[ni, j] = 1.0
+            out[vocab_name] = vocab
+            out[tensor_name] = tensor
+    return replace(packed, **out)
+
+
 def repack_incremental(packed: PackedCluster, snapshot: ClusterSnapshot, pod_block: int = 128) -> PackedCluster:
     """Between-cycles repack: reuse the node-side tensors (labels, alloc,
     vocab — stable while the node set is stable) and rebuild only what a
@@ -549,7 +666,9 @@ def repack_incremental(packed: PackedCluster, snapshot: ClusterSnapshot, pod_blo
     alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes)
     pending = snapshot.pending_pods()
     p_pad = max(packed.padded_pods, round_up(len(pending), pod_block))
-    pod_tensors = _pack_pods(pending, packed.vocab, p_pad, packed.pod_sel.shape[1])
+    # Pod tensor widths come from the NODE side: extend_node_vocabs may have
+    # grown label columns since the cached pod tensors were built.
+    pod_tensors = _pack_pods(pending, packed.vocab, p_pad, packed.node_labels.shape[1])
     pod_ntol = _pack_ntol(pending, packed.taint_vocab, p_pad, packed.node_taints.shape[1])
     pod_aff, pod_has_aff = _pack_affinity(pending, packed.aff_vocab, p_pad, packed.node_aff.shape[1])
     pod_ntol_soft = _pack_ntol(pending, packed.soft_taint_vocab, p_pad, packed.node_taints_soft.shape[1])

@@ -49,7 +49,7 @@ from ..core.predicates import (
 from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
 from ..errors import BackendUnavailable, CreateBindingFailed, NoNodeFound, SchedulerError
 from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
-from ..ops.pack import pack_snapshot, repack_incremental
+from ..ops.pack import extend_node_vocabs, pack_snapshot, repack_incremental
 from ..utils.metrics import CycleMetrics, MetricsRegistry
 from ..utils.tracing import Trace, span
 from .fake_api import ApiError, FakeApiServer
@@ -151,28 +151,18 @@ class Scheduler:
     # -- batch policy ------------------------------------------------------
 
     def _pack(self, snapshot: ClusterSnapshot):
-        """Full pack, or incremental avail-refresh when the node set and the
-        selector vocabulary are stable (the device-resident tensor path)."""
+        """Full pack, or incremental refresh when the node set is stable
+        (the device-resident tensor path).  New pod-driven vocabulary
+        entries (a fresh deployment's selector pair / affinity term) GROW
+        the cached node tensors in place (ops/pack.extend_node_vocabs)
+        instead of abandoning the incremental path."""
         sig = self.reflector.node_set_signature()
-        pending = snapshot.pending_pods()
-        if (
-            self._packed is not None
-            and sig == self._node_sig
-            and all(
-                kv in self._packed.vocab
-                for p in pending
-                if p.spec is not None and p.spec.node_selector
-                for kv in p.spec.node_selector.items()
-            )
-            and all(
-                term.key() in self._packed.aff_vocab
-                for p in pending
-                if p.spec is not None and p.spec.node_affinity
-                for term in p.spec.node_affinity
-            )
-        ):
+        if self._packed is not None and sig == self._node_sig:
             try:
-                packed = repack_incremental(self._packed, snapshot, pod_block=self.pod_block)
+                extended = extend_node_vocabs(self._packed, snapshot)
+                if extended is not self._packed:
+                    self.metrics.inc("scheduler_vocab_extensions_total")
+                packed = repack_incremental(extended, snapshot, pod_block=self.pod_block)
                 self.metrics.inc("scheduler_incremental_packs_total")
             except (ValueError, KeyError):
                 # The cached node tensors don't match the live node order
