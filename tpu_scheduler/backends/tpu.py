@@ -12,7 +12,7 @@ import numpy as np
 
 from ..errors import BackendUnavailable
 from ..models.profiles import SchedulingProfile
-from ..ops.assign import assign_cycle, split_device_arrays
+from ..ops.assign import assign_cycle_epochs, split_device_arrays
 from ..ops.pack import PackedCluster
 from .base import SchedulingBackend
 
@@ -58,7 +58,10 @@ class TpuBackend(SchedulingBackend):
             pods.update({k: jax.device_put(v, self.device) for k, v in cons.pod_arrays().items()})
             cmeta = {k: jax.device_put(v, self.device) for k, v in cons.meta_arrays().items()}
             cstate = {k: jax.device_put(v, self.device) for k, v in cons.state_arrays().items()}
-        assigned, rounds, _avail, acc_round, rank_of = assign_cycle(
+        # The epoch driver: identical math to assign_cycle, with the pod
+        # arrays re-sliced along a halving chain as actives decay, so the
+        # per-round accept cost tracks the live pod count (ops/assign.py).
+        assigned, rounds, _avail, acc_round, rank_of = assign_cycle_epochs(
             nodes,
             pods,
             weights,

@@ -56,7 +56,7 @@ from .masks import feasibility_block
 from .pack import INT32_MAX
 from .score import score_block
 
-__all__ = ["assign_cycle", "split_device_arrays", "INT32_MAX"]
+__all__ = ["assign_cycle", "assign_cycle_epochs", "split_device_arrays", "INT32_MAX"]
 
 # Pod-side keys the choose step consumes (sliced per block); the rest of the
 # pod state (assigned, active bookkeeping) never enters the score math.
@@ -234,6 +234,88 @@ def _pad0(v, extra):
     return jnp.pad(v, ((0, extra),) + ((0, 0),) * (v.ndim - 1))
 
 
+def _compact(ps):
+    """Stable active-first packing — relative (priority) order preserved."""
+    order = jnp.argsort(~ps["active"], stable=True)
+    return {k: v[order] for k, v in ps.items()}
+
+
+def _prepare_pods(pods, block: int):
+    """Shared cycle setup — permute to priority order, pad to a block
+    multiple, init the auction bookkeeping, compact actives to the front.
+    ONE implementation for assign_cycle and the epoch driver: the two are
+    interchangeable by construction, so their setup must be too.
+
+    Priority order (priority desc, FIFO index asc); stable sort keeps FIFO.
+    The permutation happens BEFORE any block padding: rank positions feed
+    the score-jitter hash and must equal the native backend's (which never
+    pads) for binding parity — padding first would shift ranks whenever a
+    pod has negative priority.  Padding rows sit at ranks ≥ p_out
+    (inactive), leaving real ranks intact.
+    """
+    p_out = pods["pod_req"].shape[0]
+    perm = jnp.argsort(-pods["pod_prio"], stable=True)
+    ps = {k: v[perm] for k, v in pods.items() if k != "pod_prio"}
+    p = p_out
+    if block < p and p % block != 0:
+        extra = block - p % block
+        ps = {k: _pad0(v, extra) for k, v in ps.items()}
+        p = p + extra
+    ps["ranks"] = jnp.arange(p, dtype=jnp.uint32)
+    ps["assigned"] = jnp.full((p,), -1, jnp.int32)
+    ps["acc_round"] = jnp.full((p,), -1, jnp.int32)  # round each pod was accepted in
+    ps["active"] = ps.pop("pod_valid")
+    return perm, _compact(ps)
+
+
+def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread):
+    """One auction round as a while_loop body (shared by the monolithic
+    assign_cycle and the size-shrinking epoch driver)."""
+    n = nodes["node_avail"].shape[0]
+
+    def body(state):
+        avail, ps, n_active, rounds, cst = state
+        p = ps["pod_req"].shape[0]
+        round_masks = None
+        if cmeta is not None:
+            from .constraints import constraint_commit, constraint_filter, round_blocked_masks
+
+            round_masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread)
+        choice, has = _choose(avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks)
+        cand = ps["active"] & has
+        ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
+        claim = jnp.where(cand[:, None], ps["pod_req"], 0)
+
+        # Group claimants per node; the stable sort preserves the compacted
+        # (= priority) order among each node's claimants.
+        order = jnp.argsort(ch, stable=True)
+        ch_s = ch[order]
+        claim_s = claim[order]
+        is_start = jnp.concatenate([jnp.ones((1,), bool), ch_s[1:] != ch_s[:-1]])[:, None]
+        _, within = lax.associative_scan(_seg_scan_op, (is_start, claim_s))
+
+        avail_ext = jnp.concatenate([avail, jnp.zeros((1, 2), avail.dtype)], axis=0)
+        fits_prefix = (within <= avail_ext[ch_s]).all(-1)
+        acc_s = fits_prefix & (ch_s < n)
+        accepted = jnp.zeros((p,), bool).at[order].set(acc_s)
+
+        if cmeta is not None:
+            # Within-round conflict resolution + domain-state commit
+            # (deferred pods stay active and retry next round).
+            accepted = constraint_filter(jnp, accepted, choice, ps["ranks"], ps, cst, cmeta)
+            cst = constraint_commit(jnp, accepted, choice, ps, cst, cmeta, soft_spread=soft_spread)
+
+        ps["assigned"] = jnp.where(accepted, choice, ps["assigned"])
+        ps["acc_round"] = jnp.where(accepted, rounds, ps["acc_round"])
+        dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], ps["pod_req"], 0))
+        avail = avail - dec[:n]
+        ps["active"] = cand & ~accepted
+        ps = _compact(ps)
+        return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1, cst
+
+    return body
+
+
 @partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread"))
 def assign_cycle(
     nodes: dict,
@@ -270,83 +352,14 @@ def assign_cycle(
 
     p_out = pods["pod_req"].shape[0]
     n = nodes["node_avail"].shape[0]
-
-    # Priority order (priority desc, FIFO index asc); stable sort keeps FIFO.
-    # The permutation happens BEFORE any block padding: rank positions feed
-    # the score-jitter hash and must equal the native backend's (which never
-    # pads) for binding parity — padding first would shift ranks whenever a
-    # pod has negative priority.
-    perm = jnp.argsort(-pods["pod_prio"], stable=True)
-    ps = {k: v[perm] for k, v in pods.items() if k != "pod_prio"}
-
-    # Pad the pod axis to a block multiple so the blockwise choose path is
-    # always exact — otherwise a remainder would silently materialise the
-    # full [P,N] score matrix and blow HBM at target scale (100k × 10k).
-    # Padding rows sit at ranks ≥ p_out (inactive), leaving real ranks intact.
-    p = p_out
-    if block < p and p % block != 0:
-        extra = block - p % block
-        ps = {k: _pad0(v, extra) for k, v in ps.items()}
-        p = p + extra
-
-    # Compaction state: pod arrays are kept active-first; ``ranks`` maps each
-    # slot back to its original priority rank (for the jitter hash and the
-    # final unpermute).  The initial order (rank order, actives scattered) is
-    # handled by compacting once before the loop.
-    ps["ranks"] = jnp.arange(p, dtype=jnp.uint32)
-    ps["assigned"] = jnp.full((p,), -1, jnp.int32)
-    ps["acc_round"] = jnp.full((p,), -1, jnp.int32)  # round each pod was accepted in
-    ps["active"] = ps.pop("pod_valid")
-
-    def compact(ps):
-        order = jnp.argsort(~ps["active"], stable=True)
-        return {k: v[order] for k, v in ps.items()}
-
-    ps = compact(ps)
+    perm, ps = _prepare_pods(pods, block)
+    p = ps["pod_req"].shape[0]
 
     def cond(state):
         _, _, n_active, rounds, _ = state
         return (rounds < max_rounds) & (n_active > 0)
 
-    def body(state):
-        avail, ps, n_active, rounds, cst = state
-        round_masks = None
-        if cmeta is not None:
-            from .constraints import constraint_commit, constraint_filter, round_blocked_masks
-
-            round_masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread)
-        choice, has = _choose(avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks)
-        cand = ps["active"] & has
-        ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
-        claim = jnp.where(cand[:, None], ps["pod_req"], 0)
-
-        # Group claimants per node; the stable sort preserves the compacted
-        # (= priority) order among each node's claimants.
-        order = jnp.argsort(ch, stable=True)
-        ch_s = ch[order]
-        claim_s = claim[order]
-        is_start = jnp.concatenate([jnp.ones((1,), bool), ch_s[1:] != ch_s[:-1]])[:, None]
-        _, within = lax.associative_scan(_seg_scan_op, (is_start, claim_s))
-
-        avail_ext = jnp.concatenate([avail, jnp.zeros((1, 2), avail.dtype)], axis=0)
-        fits_prefix = (within <= avail_ext[ch_s]).all(-1)
-        acc_s = fits_prefix & (ch_s < n)
-        accepted = jnp.zeros((p,), bool).at[order].set(acc_s)
-
-        if cmeta is not None:
-            # Within-round conflict resolution + domain-state commit
-            # (deferred pods stay active and retry next round).
-            accepted = constraint_filter(jnp, accepted, choice, ps["ranks"], ps, cst, cmeta)
-            cst = constraint_commit(jnp, accepted, choice, ps, cst, cmeta, soft_spread=soft_spread)
-
-        ps["assigned"] = jnp.where(accepted, choice, ps["assigned"])
-        ps["acc_round"] = jnp.where(accepted, rounds, ps["acc_round"])
-        dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], ps["pod_req"], 0))
-        avail = avail - dec[:n]
-        ps["active"] = cand & ~accepted
-        ps = compact(ps)
-        return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1, cst
-
+    body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread)
     state0 = (nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32), jnp.int32(0), cstate)
     avail, ps, _, rounds, _ = lax.while_loop(cond, body, state0)
 
@@ -355,6 +368,121 @@ def assign_cycle(
     assigned_rank = jnp.zeros((p,), jnp.int32).at[ps["ranks"]].set(ps["assigned"])
     out = jnp.full((p_out,), -1, jnp.int32).at[perm].set(assigned_rank[:p_out])
     acc_round_rank = jnp.zeros((p,), jnp.int32).at[ps["ranks"]].set(ps["acc_round"])
+    acc_round = jnp.full((p_out,), -1, jnp.int32).at[perm].set(acc_round_rank[:p_out])
+    rank_of = jnp.zeros((p_out,), jnp.int32).at[perm].set(jnp.arange(p_out, dtype=jnp.int32))
+    return out, rounds, avail, acc_round, rank_of
+
+
+# Epoch-size floor: below this the accept phase is negligible and further
+# halvings would only multiply compiled variants.
+_MIN_EPOCH_SIZE = 256
+
+
+@partial(jax.jit, static_argnames=("block",))
+def _epoch_prelude(nodes, pods, block: int):
+    """Jitted wrapper of the shared cycle setup, returning the state the
+    epoch loop drives (plus the permutation for the final unpermute)."""
+    perm, ps = _prepare_pods(pods, block)
+    return perm, nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread", "floor"))
+def _assign_epoch(
+    nodes, ps, avail, n_active, rounds, cst, weights, cmeta,
+    max_rounds: int, block: int, use_pallas: bool, pallas_interpret: bool, soft_spread: bool, floor: bool,
+):
+    """Run auction rounds until done — or, when not at the ``floor`` size,
+    until the active count falls to half the (static) pod-array size, so the
+    host driver can halve the arrays and re-enter at a cheaper size.
+
+    ``cmeta`` is a traced pytree operand; its None-vs-dict structure is part
+    of the jit cache key, which is what lets the body builder branch on it
+    at trace time (same contract as assign_cycle)."""
+    p = ps["pod_req"].shape[0]
+    body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread)
+
+    def cond(state):
+        _, _, n_active, rounds, _ = state
+        go = (rounds < max_rounds) & (n_active > 0)
+        if not floor:
+            go = go & (2 * n_active > p)
+        return go
+
+    return lax.while_loop(cond, body, (avail, ps, n_active, rounds, cst))
+
+
+def assign_cycle_epochs(
+    nodes: dict,
+    pods: dict,
+    weights,
+    max_rounds: int = 32,
+    block: int = 4096,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    cmeta: dict | None = None,
+    cstate: dict | None = None,
+    soft_spread: bool = False,
+):
+    """assign_cycle with host-driven SIZE SHRINKING — the backend's driver.
+
+    Identical round-by-round math to :func:`assign_cycle` (same body fn),
+    but the pod arrays are re-sliced to half along a fixed halving chain
+    whenever the active count drops below half the current size: the accept
+    phase's per-round sort/scan/scatter cost then tracks the live pod count
+    instead of staying O(P_padded · log P) for all ~32 rounds.  Compaction
+    keeps actives in a prefix, so slicing drops only finished rows (their
+    results are folded into rank-space buffers first).  Each size on the
+    chain compiles once and is cached by jit; one host sync per epoch
+    (≤ log2(P/block) + 1 epochs).
+
+    NOT jittable (host control flow) — jittable contexts (dryrun, graft
+    entry) use :func:`assign_cycle`.
+    """
+    if cmeta is not None:
+        use_pallas = False
+
+    p_out = pods["pod_req"].shape[0]
+    perm, avail, ps, n_active_dev = _epoch_prelude(nodes, pods, block)
+    p_pad = ps["pod_req"].shape[0]
+    n_active = int(n_active_dev)
+    rounds = jnp.int32(0)
+    cst = cstate
+    assigned_rank = jnp.full((p_pad,), -1, jnp.int32)
+    acc_round_rank = jnp.full((p_pad,), -1, jnp.int32)
+
+    p_cur = p_pad
+    rounds_i = 0
+    while rounds_i < max_rounds and n_active > 0:
+        floor = p_cur <= _MIN_EPOCH_SIZE
+        avail, ps, n_active_dev, rounds, cst = _assign_epoch(
+            nodes, ps, avail, n_active_dev, rounds, cst, weights, cmeta,
+            max_rounds, block, use_pallas, pallas_interpret, soft_spread, floor,
+        )
+        n_active = int(n_active_dev)  # host sync — once per epoch, not per round
+        rounds_i = int(rounds)
+        if floor:
+            break
+        # Halving chain: sizes above ``block`` stay block multiples (the
+        # blockwise choose requires it); below, the single-block choose path
+        # takes any size, so the chain continues down to _MIN_EPOCH_SIZE —
+        # late rounds then touch hundreds of rows, not a full block.
+        new_size = p_cur
+        while new_size > _MIN_EPOCH_SIZE and n_active * 2 <= new_size:
+            half = new_size // 2
+            if half > block:
+                half = ((half + block - 1) // block) * block
+            new_size = max(_MIN_EPOCH_SIZE, half)
+        if new_size < p_cur:
+            # Fold the rows about to be dropped (all finished — actives sit
+            # in the compacted prefix) into the rank-space result buffers.
+            assigned_rank = assigned_rank.at[ps["ranks"]].set(ps["assigned"])
+            acc_round_rank = acc_round_rank.at[ps["ranks"]].set(ps["acc_round"])
+            ps = {k: v[:new_size] for k, v in ps.items()}
+            p_cur = new_size
+
+    assigned_rank = assigned_rank.at[ps["ranks"]].set(ps["assigned"])
+    acc_round_rank = acc_round_rank.at[ps["ranks"]].set(ps["acc_round"])
+    out = jnp.full((p_out,), -1, jnp.int32).at[perm].set(assigned_rank[:p_out])
     acc_round = jnp.full((p_out,), -1, jnp.int32).at[perm].set(acc_round_rank[:p_out])
     rank_of = jnp.zeros((p_out,), jnp.int32).at[perm].set(jnp.arange(p_out, dtype=jnp.int32))
     return out, rounds, avail, acc_round, rank_of

@@ -34,7 +34,7 @@ from functools import lru_cache
 import numpy as np
 
 from ..ops.pack import round_up
-from .sharded import _N_PODKEYS, CONSTRAINT_KEYS, IN_SPECS, _build_shard_map
+from .sharded import _N_PODKEYS, CONSTRAINT_KEYS, IN_SPECS, POD_KEYS, _build_shard_map
 
 __all__ = ["sharded_assign_multihost", "make_global_array"]
 
@@ -85,20 +85,7 @@ def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32, 
     # then pad pods to the dp multiple.
     p_tot = a["pod_req"].shape[0]
     perm = np.argsort(-a["pod_prio"], kind="stable")
-    pods = {
-        k: a[k][perm]
-        for k in (
-            "pod_req",
-            "pod_sel",
-            "pod_sel_count",
-            "pod_ntol",
-            "pod_aff",
-            "pod_has_aff",
-            "pod_pref_w",
-            "pod_ntol_soft",
-            "pod_valid",
-        )
-    }
+    pods = {k: a[k][perm] for k in POD_KEYS}
     cpods = {k: constraints[k][perm] for k in CONSTRAINT_KEYS[:_N_PODKEYS]} if constraints is not None else {}
     extra = (-p_tot) % dp
     if extra:
@@ -116,15 +103,7 @@ def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32, 
         a["node_valid"],
         a["node_pref"],
         a["node_taints_soft"],
-        pods["pod_req"],
-        pods["pod_sel"],
-        pods["pod_sel_count"],
-        pods["pod_ntol"],
-        pods["pod_aff"],
-        pods["pod_has_aff"],
-        pods["pod_pref_w"],
-        pods["pod_ntol_soft"],
-        pods["pod_valid"],
+        *(pods[k] for k in POD_KEYS),
         np.asarray(weights, dtype=np.float32),
     )
     specs = IN_SPECS

@@ -81,6 +81,20 @@ def _local_choose(
     return jnp.max(sc, axis=1), jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
 
+# Plain pod operand order — must match IN_SPECS positionally; shared by the
+# single-process run wrapper and multihost.py so the three stay in lockstep.
+POD_KEYS = (
+    "pod_req",
+    "pod_sel",
+    "pod_sel_count",
+    "pod_ntol",
+    "pod_aff",
+    "pod_has_aff",
+    "pod_pref_w",
+    "pod_ntol_soft",
+    "pod_valid",
+)
+
 # Flat operand order for the constrained extension (all REPLICATED — specs
 # P()): pod bitmaps in global rank order, then meta, then initial state.
 CONSTRAINT_KEYS = (
@@ -290,7 +304,6 @@ def _build_sharded_fn(mesh, max_rounds: int, constrained: bool = False, soft_spr
     cycles reuse the compiled executable (jit re-specialises per shape)."""
     dp = mesh.shape["dp"]
     sharded = _build_shard_map(mesh, max_rounds, constrained, soft_spread)
-    pod_keys = ("pod_req", "pod_sel", "pod_sel_count", "pod_ntol", "pod_aff", "pod_has_aff", "pod_pref_w", "pod_ntol_soft")
 
     @jax.jit
     def run(a, c):
@@ -298,8 +311,7 @@ def _build_sharded_fn(mesh, max_rounds: int, constrained: bool = False, soft_spr
         # Permute BEFORE dp padding: ranks feed the score-jitter hash and
         # must equal the unpadded native backend's (see ops/assign.py).
         perm = jnp.argsort(-a["pod_prio"], stable=True)
-        pods = {k: a[k][perm] for k in pod_keys}
-        pods["pod_valid"] = a["pod_valid"][perm]
+        pods = {k: a[k][perm] for k in POD_KEYS}
         cpods = {k: c[k][perm] for k in CONSTRAINT_KEYS[:_N_PODKEYS]} if constrained else {}
         extra = (-p_tot) % dp
         if extra:
@@ -307,24 +319,16 @@ def _build_sharded_fn(mesh, max_rounds: int, constrained: bool = False, soft_spr
             pods = {k: pad(v) for k, v in pods.items()}
             cpods = {k: pad(v) for k, v in cpods.items()}
         cargs = tuple(cpods[k] if i < _N_PODKEYS else c[k] for i, k in enumerate(CONSTRAINT_KEYS)) if constrained else ()
+        node_args = tuple(
+            a[k]
+            for k in (
+                "node_alloc", "node_avail", "node_labels", "node_taints", "node_aff", "node_valid",
+                "node_pref", "node_taints_soft",
+            )
+        )
         assigned_p, rounds, avail = sharded(
-            a["node_alloc"],
-            a["node_avail"],
-            a["node_labels"],
-            a["node_taints"],
-            a["node_aff"],
-            a["node_valid"],
-            a["node_pref"],
-            a["node_taints_soft"],
-            pods["pod_req"],
-            pods["pod_sel"],
-            pods["pod_sel_count"],
-            pods["pod_ntol"],
-            pods["pod_aff"],
-            pods["pod_has_aff"],
-            pods["pod_pref_w"],
-            pods["pod_ntol_soft"],
-            pods["pod_valid"],
+            *node_args,
+            *(pods[k] for k in POD_KEYS),
             a["weights"],
             *cargs,
         )
