@@ -1,0 +1,175 @@
+"""Expert-parallel routing (parallel/routing.py; SURVEY.md §2b EP): pods
+pinned to node pools schedule as independent per-pool shards, the residual
+against post-pool capacity — validity and capacity exactly preserved, choice
+parity deliberately relaxed (per-shard rank spaces)."""
+
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.backends.tpu import TpuBackend
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+from tpu_scheduler.parallel.routing import partition_snapshot
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+def _pooled_cluster(n_nodes=24, n_pending=120, seed=0, pin_fraction=1.0):
+    """synth_cluster-style cluster where pin_fraction of pending pods pin the
+    'pool' node label (the routable class)."""
+    import random
+
+    rng = random.Random(seed)
+    pools = ["cpu", "gpu", "mem"]
+    nodes = [
+        make_node(f"n{i}", cpu="16", memory="64Gi", labels={"pool": pools[i % 3], "zone": f"z{i % 4}"})
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for i in range(n_pending):
+        sel = {"pool": rng.choice(pools)} if rng.random() < pin_fraction else None
+        pods.append(make_pod(f"p{i}", cpu="500m", memory="1Gi", node_selector=sel, priority=rng.randrange(5)))
+    return ClusterSnapshot.build(nodes, pods)
+
+
+def test_partition_splits_by_pinned_selector():
+    snap = _pooled_cluster(pin_fraction=0.7, seed=3)
+    part = partition_snapshot(snap, "pool")
+    assert part is not None
+    assert set(part.pools) == {"cpu", "gpu", "mem"}
+    total = part.routed_pods + len(part.residual_pending)
+    assert total == len(snap.pending_pods())
+    for v, sub in part.pools.items():
+        assert all((n.metadata.labels or {}).get("pool") == v for n in sub.nodes)
+        assert all(p.spec.node_selector.get("pool") == v for p in sub.pending_pods())
+
+
+def test_partition_none_when_nothing_routable():
+    snap = _pooled_cluster(pin_fraction=0.0)
+    assert partition_snapshot(snap, "pool") is None
+    snap2 = synth_cluster(n_nodes=8, n_pending=16, seed=1)
+    assert partition_snapshot(snap2, "no-such-label") is None
+
+
+def test_pod_pinning_unknown_pool_goes_residual_and_requeues():
+    nodes = [make_node("a", labels={"pool": "cpu"})]
+    pods = [make_pod("ghost", node_selector={"pool": "tpu"}), make_pod("ok", node_selector={"pool": "cpu"})]
+    snap = ClusterSnapshot.build(nodes, pods)
+    part = partition_snapshot(snap, "pool")
+    assert part is None  # only one live pool -> routing declines, plain path
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, NativeBackend(), profile=DEFAULT_PROFILE.with_(pool_key="pool"), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 1 and m.unschedulable == 1
+
+
+def test_routed_cycle_binds_everything_validly():
+    """Fully-pinned cluster through the controller's routed path: every pod
+    binds inside its pool, scalar-chain valid, same bound count as the
+    unrouted oracle run."""
+    snap = _pooled_cluster(pin_fraction=1.0, seed=5)
+    profile = DEFAULT_PROFILE.with_(pool_key="pool")
+
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, TpuBackend(), profile=profile, requeue_seconds=0.0)
+    sched.run(until_settled=True)
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_routed_cycles_total", 0) >= 1
+    assert counters["scheduler_routed_pods_total"] == 120
+
+    # Oracle: same cluster, no routing.
+    api2 = FakeApiServer()
+    api2.load(_pooled_cluster(pin_fraction=1.0, seed=5).nodes, _pooled_cluster(pin_fraction=1.0, seed=5).pods)
+    sched2 = Scheduler(api2, TpuBackend(), requeue_seconds=0.0)
+    sched2.run(until_settled=True)
+    assert counters["scheduler_bindings_total"] == sched2.metrics.snapshot()["scheduler_bindings_total"]
+
+    node_by = {n.name: n for n in snap.nodes}
+    final = ClusterSnapshot.build(api.list_nodes(), api.list_pods())
+    for pod, node in final.placed_pods():
+        assert (node_by[node.name].metadata.labels or {}).get("pool") == pod.spec.node_selector["pool"]
+    # capacity: no node oversubscribed under the exact scalar arithmetic
+    for n in final.nodes:
+        from tpu_scheduler.core.snapshot import node_allocatable, node_used_resources
+
+        used = node_used_resources(final, n.name)
+        alloc = node_allocatable(n)
+        assert used.cpu <= alloc.cpu and used.memory <= alloc.memory
+
+
+def test_routed_cycle_residual_sees_pool_capacity():
+    """A residual pod must see pool placements as consumed capacity: pools
+    saturate, the unpinned pod lands on the only node with room."""
+    nodes = [
+        make_node("cpu-0", cpu="1", memory="2Gi", labels={"pool": "cpu"}),
+        make_node("gpu-0", cpu="1", memory="2Gi", labels={"pool": "gpu"}),
+        make_node("spare", cpu="8", memory="32Gi"),  # keyless: residual-only
+    ]
+    pods = [
+        make_pod("c0", cpu="1", memory="1Gi", node_selector={"pool": "cpu"}),
+        make_pod("g0", cpu="1", memory="1Gi", node_selector={"pool": "gpu"}),
+        make_pod("free", cpu="1", memory="1Gi"),  # residual
+    ]
+    snap = ClusterSnapshot.build(nodes, pods)
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, NativeBackend(), profile=DEFAULT_PROFILE.with_(pool_key="pool"), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 3
+    placed = {p.metadata.name: p.spec.node_name for p in api.list_pods() if p.spec.node_name}
+    assert placed["c0"] == "cpu-0" and placed["g0"] == "gpu-0"
+    assert placed["free"] == "spare"  # pools were full after their shards
+
+
+def test_routed_shards_spread_over_devices():
+    """With several devices, pool shards round-robin across them — the EP
+    dispatch (each shard's solve runs on its own chip)."""
+    backend = TpuBackend()
+    shards = {backend.shard_for(i).device.id for i in range(3)}
+    assert len(shards) == 3  # conftest provides 8 virtual devices
+
+
+def test_constrained_cluster_bypasses_routing():
+    """Anti-affinity spans pools — the routed path must decline, the
+    constraint tensor path takes over."""
+    from tpu_scheduler.api.objects import PodAntiAffinityTerm
+
+    nodes = [make_node(f"n{i}", cpu="16", memory="64Gi", labels={"pool": ["a", "b"][i % 2], "name": f"n{i}"}) for i in range(4)]
+    term = [PodAntiAffinityTerm(match_labels={"app": "db"}, topology_key="name")]
+    pods = [
+        make_pod(f"db-{i}", labels={"app": "db"}, anti_affinity=term, node_selector={"pool": ["a", "b"][i % 2]})
+        for i in range(3)
+    ]
+    api = FakeApiServer()
+    api.load(nodes, pods)
+    sched = Scheduler(api, NativeBackend(), profile=DEFAULT_PROFILE.with_(pool_key="pool"), requeue_seconds=0.0)
+    sched.run(until_settled=True)
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_routed_cycles_total", 0) == 0
+    assert counters.get("scheduler_constraint_tensor_cycles_total", 0) >= 1
+    assert len({p.spec.node_name for p in api.list_pods() if p.spec.node_name}) == 3
+
+
+def test_cli_pool_key_routes(capsys):
+    import json
+
+    from tpu_scheduler.cli import main
+    import tpu_scheduler.cli as cli_mod
+
+    orig = cli_mod.synth_cluster
+
+    def pooled(**kw):
+        snap = _pooled_cluster(n_nodes=12, n_pending=60, seed=2, pin_fraction=0.8)
+        return snap
+
+    cli_mod.synth_cluster = pooled
+    try:
+        rc = main(["--backend", "native", "--pool-key", "pool", "--cycles", "3"])
+    finally:
+        cli_mod.synth_cluster = orig
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["counters"].get("scheduler_routed_cycles_total", 0) >= 1
+    assert summary["bound_total"] == 60

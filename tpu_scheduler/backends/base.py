@@ -41,6 +41,19 @@ class SchedulingBackend(abc.ABC):
         per-pod diagnostics (acceptance round, priority rank) into
         ``CycleResult.stats``."""
 
+    # Whether a routed cycle may solve shards from concurrent threads.
+    # Mesh backends whose assign issues cross-host collectives must say
+    # False: a multi-controller runtime requires identical collective launch
+    # order on every process, which a thread pool cannot guarantee.
+    supports_concurrent_shards: bool = True
+
+    def shard_for(self, index: int) -> "SchedulingBackend":
+        """Backend instance for the ``index``-th parallel shard of a routed
+        cycle (parallel/routing.py).  Default: this backend (serialized on
+        one device); device-owning backends override to spread shards over
+        the device set — the expert-parallel dispatch."""
+        return self
+
     def schedule(self, packed: PackedCluster, profile: SchedulingProfile = DEFAULT_PROFILE) -> CycleResult:
         result = self.assign(packed, profile)
         assigned_padded, rounds = result[0], result[1]

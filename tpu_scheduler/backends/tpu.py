@@ -8,6 +8,8 @@ is named for its design target.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..errors import BackendUnavailable
@@ -45,6 +47,11 @@ class TpuBackend(SchedulingBackend):
         # BackendUnavailable→native fallback on the flagship platform.
         self._pallas_proven = False
         self._pallas_strikes = 0
+        # Serializes the first-use proving attempt: concurrent routed-shard
+        # threads must not double-count strikes on one transient fault (the
+        # guard tolerates exactly one) or race the unproven kernel.
+        self._guard_lock = threading.Lock()
+        self._shards: dict = {}  # device id -> shard backend (see shard_for)
 
     def _assign_once(self, packed: PackedCluster, profile: SchedulingProfile, use_pallas: bool):
         jax = self._jax
@@ -85,6 +92,22 @@ class TpuBackend(SchedulingBackend):
         # a proving run for the first-use guard below.
         pallas_eligible = self.use_pallas and packed.constraints is None
         if pallas_eligible and not self._pallas_proven:
+            with self._guard_lock:
+                return self._assign_proving(packed, profile)
+        try:
+            return self._assign_once(packed, profile, use_pallas=pallas_eligible and self.use_pallas)
+        except jax.errors.JaxRuntimeError as e:
+            # Device-runtime failure (OOM, device lost, …) — the recovery
+            # scenario the native fallback exists for (SURVEY.md §5).  Python
+            # programming errors deliberately propagate instead.
+            raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
+
+    def _assign_proving(self, packed: PackedCluster, profile: SchedulingProfile):
+        """First-use pallas attempt under the guard lock (a second thread
+        re-checks the flags it may have just changed)."""
+        jax = self._jax
+        pallas_eligible = self.use_pallas
+        if pallas_eligible and not self._pallas_proven:
             try:
                 result = self._assign_once(packed, profile, use_pallas=True)
                 self._pallas_proven = True
@@ -123,6 +146,20 @@ class TpuBackend(SchedulingBackend):
             # scenario the native fallback exists for (SURVEY.md §5).  Python
             # programming errors deliberately propagate instead.
             raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
+
+    def shard_for(self, index: int) -> "TpuBackend":
+        """Per-pool shard backend (parallel/routing.py): round-robin the pool
+        shards over the visible device set so their solves overlap — the EP
+        dispatch.  On one device every shard is this backend."""
+        devices = self._jax.devices()
+        if len(devices) <= 1:
+            return self
+        dev = devices[index % len(devices)]
+        if dev == self.device:
+            return self
+        if dev.id not in self._shards:
+            self._shards[dev.id] = TpuBackend(device=dev, use_pallas=self.use_pallas)
+        return self._shards[dev.id]
 
 
 def make_backend(name: str, **kw) -> SchedulingBackend:

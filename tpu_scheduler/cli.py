@@ -41,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--policy", choices=["batch", "sample"], default="batch", help="batched cycle vs reference-style per-pod random sampling")
     p.add_argument("--profile", choices=sorted(PROFILES), default="default", help="scoring profile")
+    p.add_argument(
+        "--pool-key",
+        default=None,
+        help="node label partitioning the cluster into per-pool scheduling shards (expert-parallel routing; pods pinning the label route to their pool's shard)",
+    )
     p.add_argument("--nodes", type=int, default=100, help="synthetic cluster: node count")
     p.add_argument("--pods", type=int, default=1000, help="synthetic cluster: pending pods")
     p.add_argument("--bound-pods", type=int, default=0, help="synthetic cluster: pre-bound pods")
@@ -94,10 +99,13 @@ def main(argv: list[str] | None = None) -> int:
         backend = TpuBackend()
         fallback = None if args.no_fallback else NativeBackend()
 
+    profile = PROFILES[args.profile]
+    if args.pool_key:
+        profile = profile.with_(pool_key=args.pool_key)
     sched = Scheduler(
         api,
         backend,
-        profile=PROFILES[args.profile],
+        profile=profile,
         policy=args.policy,
         attempts=args.attempts,
         requeue_seconds=args.requeue_seconds,

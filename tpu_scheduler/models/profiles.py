@@ -38,6 +38,9 @@ class SchedulingProfile:
     preferred_affinity_weight: float = 1.0
     soft_taint_weight: float = 10.0
     topology_weight: float = 1.0
+    # Expert-parallel routing (parallel/routing.py): node label whose values
+    # partition the cluster into per-pool scheduling shards; None = off.
+    pool_key: str | None = None
 
     def weights(self) -> np.ndarray:
         return np.array(

@@ -358,6 +358,10 @@ class ShardedBackend(SchedulingBackend):
     benches."""
 
     name = "tpu-sharded"
+    # One mesh program at a time: concurrent shard solves would interleave
+    # collective launches, which deadlocks multi-controller runtimes (and
+    # buys nothing on a single mesh — the devices are shared anyway).
+    supports_concurrent_shards = False
 
     def __init__(self, mesh=None, tp: int | None = None):
         self.mesh = mesh if mesh is not None else make_mesh(tp=tp)

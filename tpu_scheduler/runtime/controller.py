@@ -51,7 +51,7 @@ from ..errors import BackendUnavailable, CreateBindingFailed, NoNodeFound, Sched
 from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
 from ..ops.pack import extend_node_vocabs, pack_snapshot, repack_incremental
 from ..utils.metrics import CycleMetrics, MetricsRegistry
-from ..utils.tracing import Trace, span
+from ..utils.tracing import Trace, current_trace, span
 from .fake_api import ApiError, FakeApiServer
 from .reflector import ClusterReflector
 
@@ -306,6 +306,93 @@ class Scheduler:
         spec = replace(pod.spec, node_name=node.name) if pod.spec is not None else PodSpec(node_name=node.name)
         return replace(pod, spec=spec)
 
+    def _solve_with_fallback(self, packed, backend: SchedulingBackend | None = None):
+        """backend.schedule with the BackendUnavailable→fallback contract."""
+        backend = backend or self.backend
+        try:
+            return backend.schedule(packed, self.profile)
+        except BackendUnavailable as e:
+            # Only the explicit unavailability signal triggers fallback;
+            # programming errors in a backend must surface, not be
+            # silently absorbed as degraded-mode cycles forever.
+            if self.fallback_backend is None:
+                raise
+            logger.error("backend %s failed (%s); falling back to %s", backend.name, e, self.fallback_backend.name)
+            self.metrics.inc("scheduler_backend_fallbacks_total")
+            return self.fallback_backend.schedule(packed, self.profile)
+
+    def _bind_result(self, batch_snapshot: ClusterSnapshot, result, placed: list[tuple[Pod, Node]]) -> tuple[int, int]:
+        """POST a cycle result's bindings; requeue its unschedulables."""
+        bound = 0
+        node_by_name = {n.name: n for n in batch_snapshot.nodes}
+        pod_by_full = {full_name(p): p for p in batch_snapshot.pending_pods()}
+        for pod_full, node_name in result.bindings:
+            namespace, _, name = pod_full.rpartition("/")
+            if self._bind(namespace or "default", name, node_name):
+                bound += 1
+                pod_obj, node_obj = pod_by_full.get(pod_full), node_by_name.get(node_name)
+                if pod_obj is not None and node_obj is not None:
+                    placed.append((pod_obj, node_obj))
+        for pod_full in result.unschedulable:
+            self._requeue(pod_full, NoNodeFound("no feasible node this cycle"))
+        return bound, len(result.unschedulable)
+
+    def _run_routed_cycle(self, snapshot: ClusterSnapshot, part, placed: list[tuple[Pod, Node]]) -> tuple[int, int, int]:
+        """Expert-parallel cycle (parallel/routing.py): per-pool shards pack
+        and solve CONCURRENTLY (each shard on its own device when the
+        backend has several — JAX async dispatch overlaps the solves), then
+        bind deterministically in pool order; the residual runs as a normal
+        batch against post-pool capacity via the placed overlay."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        pools = sorted(part.pools.items())
+        self.metrics.inc("scheduler_routed_cycles_total")
+        self.metrics.inc("scheduler_routed_pods_total", part.routed_pods)
+        # Shard backends resolved on the main thread (shard_for mutates a
+        # per-device cache); solves then fan out over worker threads —
+        # unless the backend forbids it (mesh backends: collective launch
+        # order must be identical on every process of a multi-controller
+        # runtime, which a thread pool cannot guarantee).
+        shard_backends = [self.backend.shard_for(i) for i in range(len(pools))]
+        workers = min(8, len(pools)) if self.backend.supports_concurrent_shards else 1
+
+        def solve(item):
+            i, (value, pool_snap) = item
+            t0 = time.perf_counter()
+            packed = pack_snapshot(pool_snap, pod_block=self.pod_block, node_block=self.node_block)
+            pack_dt = time.perf_counter() - t0
+            result = self._solve_with_fallback(packed, shard_backends[i])
+            return value, pool_snap, result, pack_dt
+
+        # The solve span is the fan-out wall clock; per-pool pack time
+        # (overlapped inside it) is recorded into the pack span separately
+        # so CycleMetrics attribution stays meaningful on routed cycles.
+        with span("solve"):
+            with ThreadPoolExecutor(max_workers=workers) as ex:
+                results = list(ex.map(solve, enumerate(pools)))
+        tr = current_trace()
+        if tr is not None:
+            tr.record("pack", sum(pack_dt for _, _, _, pack_dt in results))
+        bound = unsched = rounds = 0
+        with span("bind"):
+            for _value, pool_snap, result, _pack_dt in results:
+                b, u = self._bind_result(pool_snap, result, placed)
+                bound += b
+                unsched += u
+                rounds = max(rounds, result.rounds)
+        if part.residual_pending:
+            pending_ids = {id(p) for p in snapshot.pending_pods()}
+            base_pods = [p for p in snapshot.pods if id(p) not in pending_ids]
+            residual_snapshot = ClusterSnapshot.build(
+                snapshot.nodes,
+                base_pods + [self._bound_clone(q, qn) for q, qn in placed] + part.residual_pending,
+            )
+            b, u, r = self._schedule_batch(residual_snapshot, placed)
+            bound += b
+            unsched += u
+            rounds += r
+        return bound, unsched, rounds
+
     def _schedule_batch(
         self, batch_snapshot: ClusterSnapshot, placed: list[tuple[Pod, Node]], with_constraints: bool = False
     ) -> tuple[int, int, int]:
@@ -337,37 +424,25 @@ class Scheduler:
                     packed = replace(packed, constraints=cons)
                     self.metrics.inc("scheduler_constraint_tensor_cycles_total")
         with span("solve"):
-            try:
-                result = self.backend.schedule(packed, self.profile)
-            except BackendUnavailable as e:
-                # Only the explicit unavailability signal triggers fallback;
-                # programming errors in a backend must surface, not be
-                # silently absorbed as degraded-mode cycles forever.
-                if self.fallback_backend is None:
-                    raise
-                logger.error("backend %s failed (%s); falling back to %s", self.backend.name, e, self.fallback_backend.name)
-                self.metrics.inc("scheduler_backend_fallbacks_total")
-                result = self.fallback_backend.schedule(packed, self.profile)
-        bound = 0
-        node_by_name = {n.name: n for n in batch_snapshot.nodes}
-        pod_by_full = {full_name(p): p for p in batch_snapshot.pending_pods()}
+            result = self._solve_with_fallback(packed)
         with span("bind"):
-            for pod_full, node_name in result.bindings:
-                namespace, _, name = pod_full.rpartition("/")
-                if self._bind(namespace or "default", name, node_name):
-                    bound += 1
-                    pod_obj, node_obj = pod_by_full.get(pod_full), node_by_name.get(node_name)
-                    if pod_obj is not None and node_obj is not None:
-                        placed.append((pod_obj, node_obj))
-            for pod_full in result.unschedulable:
-                self._requeue(pod_full, NoNodeFound("no feasible node this cycle"))
-        return bound, len(result.unschedulable), result.rounds
+            bound, unsched = self._bind_result(batch_snapshot, result, placed)
+        return bound, unsched, result.rounds
 
     def _run_batch_cycle(self, snapshot: ClusterSnapshot, trace: Trace) -> tuple[int, int, int]:
         pending = snapshot.pending_pods()
         _, constrained = self._split_affinity_pending(snapshot, pending)
         placed: list[tuple[Pod, Node]] = []
         if not constrained:
+            # Expert-parallel routing: pods pinned to node pools schedule as
+            # independent per-pool shards (parallel/routing.py); constrained
+            # cycles bypass it (domain state spans pools).
+            if self.profile.pool_key:
+                from ..parallel.routing import partition_snapshot
+
+                part = partition_snapshot(snapshot, self.profile.pool_key)
+                if part is not None:
+                    return self._run_routed_cycle(snapshot, part, placed)
             # Fast path — one tensor cycle over every pending pod (and the
             # incremental device-resident pack stays hot).
             return self._schedule_batch(snapshot, placed)

@@ -5,6 +5,7 @@ numbers (BASELINE.md); the reference exposes no metrics at all (SURVEY.md §5).
 from __future__ import annotations
 
 import json
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -36,14 +37,19 @@ class CycleMetrics:
 
 @dataclass
 class MetricsRegistry:
-    """Process counters (Prometheus-style names, in-memory registry)."""
+    """Process counters (Prometheus-style names, in-memory registry).
+    ``inc`` is locked: the routed cycle's pool shards (and backend
+    fallbacks inside them) increment from worker threads, and the /metrics
+    HTTP server reads concurrently."""
 
     counters: dict[str, int] = field(default_factory=dict)
     cycles: list[CycleMetrics] = field(default_factory=list)
     started_at: float = field(default_factory=time.time)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def inc(self, name: str, value: int = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + value
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + value
 
     def observe_cycle(self, m: CycleMetrics) -> None:
         self.cycles.append(m)
