@@ -1,0 +1,133 @@
+"""Pipelined binding (runtime/controller.py; SURVEY.md §2b PP): the binding
+POSTs of cycle k run on a worker thread while cycle k+1 syncs/packs/solves,
+with an assumed-bindings cache making in-flight placements visible as
+consumed capacity."""
+
+import threading
+import time
+
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+class SlowBindApi(FakeApiServer):
+    """FakeApiServer whose binding POSTs take ``delay`` seconds — models the
+    API-server round-trip the pipeline hides."""
+
+    def __init__(self, delay: float = 0.0):
+        super().__init__()
+        self.delay = delay
+        self.bind_thread_ids: set[int] = set()
+
+    def create_binding(self, namespace, pod_name, target):
+        self.bind_thread_ids.add(threading.get_ident())
+        if self.delay:
+            time.sleep(self.delay)
+        super().create_binding(namespace, pod_name, target)
+
+
+def test_pipelined_run_binds_everything():
+    snap = synth_cluster(n_nodes=20, n_pending=200, n_bound=20, seed=1, selector_fraction=0.3)
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, NativeBackend(), pipeline=True, requeue_seconds=0.0)
+    sched.run(until_settled=True)
+    assert sched._bind_inflight is None and sched._assumed == {}
+    assert sched.metrics.snapshot()["scheduler_bindings_total"] == 200
+    assert all(p.spec.node_name is not None for p in api.list_pods())
+
+
+def test_binds_run_off_main_thread_and_overlap():
+    """The POSTs execute on a worker thread; a second wave of pods solves
+    while the first wave's binds are still in flight — and capacity stays
+    consistent via the assumed overlay."""
+    api = SlowBindApi(delay=0.002)
+    api.load(
+        nodes=[make_node(f"n{i}", cpu="2", memory="8Gi") for i in range(4)],
+        pods=[make_pod(f"a{i}", cpu="1", memory="1Gi") for i in range(8)],  # exactly fills the nodes
+    )
+    sched = Scheduler(api, NativeBackend(), pipeline=True, requeue_seconds=0.0)
+    m1 = sched.run_cycle()
+    assert m1.bound == 8  # dispatched
+    assert sched._bind_inflight is not None  # in flight
+    # Second wave arrives while wave 1 binds: the cluster is FULL under the
+    # assumed overlay, so nothing may double-book.
+    for i in range(4):
+        api.create_pod(make_pod(f"b{i}", cpu="1", memory="1Gi"))
+    m2 = sched.run_cycle()
+    assert m2.bound == 0 and m2.unschedulable == 4
+    sched.run(until_settled=True, max_cycles=4)
+    assert threading.get_ident() not in api.bind_thread_ids  # never the test (main) thread
+    bound = [p for p in api.list_pods() if p.spec.node_name]
+    assert len(bound) == 8  # wave 1 all landed; wave 2 correctly refused
+
+
+def test_async_bind_failures_requeue_and_recover():
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="8", memory="32Gi")],
+        pods=[make_pod(f"p{i}") for i in range(5)],
+    )
+    api.fail_next_bindings = 2  # first two POSTs 500
+    sched = Scheduler(api, NativeBackend(), pipeline=True, requeue_seconds=0.0)
+    sched.run(until_settled=True)
+    counters = sched.metrics.snapshot()
+    assert counters["scheduler_async_bind_failures_total"] == 2
+    assert counters["scheduler_bindings_total"] == 5  # all recovered on retry
+    assert all(p.spec.node_name is not None for p in api.list_pods())
+    assert sched._assumed == {}
+
+
+def test_pipeline_cycle_wall_excludes_bind_latency():
+    """The point of the pipeline: with slow POSTs, the scheduling cycle's
+    wall clock no longer pays for them (bind time is attributed at drain)."""
+    n_pods = 50
+    api_slow = SlowBindApi(delay=0.004)
+    api_slow.load(nodes=[make_node("n1", cpu="64", memory="256Gi")], pods=[make_pod(f"p{i}") for i in range(n_pods)])
+    piped = Scheduler(api_slow, NativeBackend(), pipeline=True, requeue_seconds=0.0)
+    m = piped.run_cycle()
+    assert m.bound == n_pods
+    assert m.wall_seconds < n_pods * 0.004  # didn't wait for ~0.2s of POSTs
+    piped.run(until_settled=True, max_cycles=4)
+
+    api_sync = SlowBindApi(delay=0.004)
+    api_sync.load(nodes=[make_node("n1", cpu="64", memory="256Gi")], pods=[make_pod(f"p{i}") for i in range(n_pods)])
+    sync = Scheduler(api_sync, NativeBackend(), requeue_seconds=0.0)
+    ms = sync.run_cycle()
+    assert ms.wall_seconds >= n_pods * 0.004  # the synchronous cycle pays
+
+
+def test_cli_pipeline_flag(capsys):
+    import json
+
+    from tpu_scheduler.cli import main
+
+    rc = main(["--backend", "native", "--pipeline", "--nodes", "8", "--pods", "40", "--cycles", "6"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["counters"]["scheduler_bindings_total"] == 40
+
+
+def test_pipelined_scheduler_over_http_sockets():
+    """The pipeline's worker thread and the main thread's watch polls share
+    one KubeApiClient — per-thread connections keep them from corrupting
+    each other (regression: http.client is not thread-safe)."""
+    from tpu_scheduler.runtime.http_api import HttpApiServer, KubeApiClient, RemoteApiAdapter
+
+    api = FakeApiServer()
+    server = HttpApiServer(api).start()
+    try:
+        api.load(
+            nodes=[make_node(f"n{i}", cpu="16", memory="64Gi") for i in range(6)],
+            pods=[make_pod(f"p{i}") for i in range(120)],
+        )
+        adapter = RemoteApiAdapter(KubeApiClient(server.base_url))
+        sched = Scheduler(adapter, NativeBackend(), pipeline=True, requeue_seconds=0.0)
+        sched.run(until_settled=True, max_cycles=10)
+        assert sched.metrics.snapshot()["scheduler_bindings_total"] == 120
+        assert all(p.spec.node_name is not None for p in api.list_pods())
+    finally:
+        server.stop()

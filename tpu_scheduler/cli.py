@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0, help="synthetic cluster seed")
     p.add_argument("--cycles", type=int, default=None, help="max scheduling cycles (default: run until settled)")
     p.add_argument("--daemon", action="store_true", help="serve forever: never exit on settle, idle between cycles (reference main.rs:146-149)")
+    p.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="overlap binding POSTs with the next cycle's pack+solve via an assumed-bindings cache (host<->device pipelining; plain unconstrained cycles — routed/constrained cycles bind synchronously)",
+    )
     p.add_argument("--interval", type=float, default=1.0, help="daemon mode: idle sleep between settled cycles (seconds)")
     p.add_argument("--attempts", type=int, default=ATTEMPTS, help="sample policy: candidates per pod (reference ATTEMPTS)")
     p.add_argument("--requeue-seconds", type=float, default=REQUEUE_SECONDS, help="failed-pod requeue delay")
@@ -110,6 +115,7 @@ def main(argv: list[str] | None = None) -> int:
         attempts=args.attempts,
         requeue_seconds=args.requeue_seconds,
         fallback_backend=fallback,
+        pipeline=args.pipeline,
     )
 
     if args.checkpoint_dir:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import http.client
 import logging
 import random
+import threading
 import time
 
 from dataclasses import replace
@@ -77,6 +78,7 @@ class Scheduler:
         rng: random.Random | None = None,
         pod_block: int = 128,
         node_block: int = 128,
+        pipeline: bool = False,
     ):
         if policy not in ("batch", "sample"):
             raise ValueError(f"unknown policy {policy!r} (expected 'batch' or 'sample')")
@@ -91,6 +93,7 @@ class Scheduler:
         self.rng = rng or random.Random()
         self.pod_block = pod_block
         self.node_block = node_block
+        self.pipeline = pipeline
         self.reflector = ClusterReflector(api, clock=clock)
         self.metrics = MetricsRegistry()
         self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
@@ -98,6 +101,22 @@ class Scheduler:
         self._packed = None
         self._node_sig = None
         self._watch_errors_folded = 0
+        # Pipelined binding (SURVEY.md §2b PP): the binding POSTs of cycle k
+        # run on a worker thread while cycle k+1 syncs/packs/solves.  The
+        # assumed cache (pod full name -> node) makes in-flight bindings
+        # visible to the next cycle as consumed capacity — kube-scheduler's
+        # assume-cache, here closing the host↔device pipeline bubble.
+        self._assumed: dict[str, str] = {}
+        # One long-lived worker (lazily started) so its thread-local API
+        # connection stays keep-alive across bind batches; at most one batch
+        # in flight: (outcomes list, done event).
+        self._bind_queue = None
+        self._bind_inflight: tuple[list, threading.Event] | None = None
+        if pipeline and profile.pool_key:
+            logger.warning(
+                "--pipeline applies to plain unconstrained cycles; routed (--pool-key) and "
+                "constrained cycles bind synchronously"
+            )
 
     # -- eligibility -------------------------------------------------------
 
@@ -337,6 +356,110 @@ class Scheduler:
             self._requeue(pod_full, NoNodeFound("no feasible node this cycle"))
         return bound, len(result.unschedulable)
 
+    # -- pipelined binding (SURVEY.md §2b PP) -------------------------------
+
+    def _schedule_batch_pipelined(self, batch_snapshot: ClusterSnapshot) -> tuple[int, int, int]:
+        """Pack + solve, then hand the binding POSTs to a worker thread and
+        return — the next cycle overlaps its sync/pack/solve with this
+        cycle's host I/O.  ``bound`` counts DISPATCHED bindings; failures
+        surface next cycle via the outcome drain (requeue) exactly as a
+        synchronous bind's failures would."""
+        with span("pack"):
+            packed = self._pack(batch_snapshot)
+        with span("solve"):
+            result = self._solve_with_fallback(packed)
+        self._dispatch_binds(result)
+        for pod_full in result.unschedulable:
+            self._requeue(pod_full, NoNodeFound("no feasible node this cycle"))
+        return len(result.bindings), len(result.unschedulable), result.rounds
+
+    def _bind_worker_loop(self) -> None:
+        while True:
+            job = self._bind_queue.get()
+            if job is None:
+                return
+            bindings, outcomes, done = job
+            t0 = time.perf_counter()
+            for pod_full, node_name in bindings:
+                namespace, _, name = pod_full.rpartition("/")
+                try:
+                    self.api.create_binding(namespace or "default", name, ObjectReference(name=node_name))
+                    outcomes.append((pod_full, None))
+                except Exception as e:  # noqa: BLE001 — categorized on the main-thread drain
+                    outcomes.append((pod_full, e))
+            outcomes.append(("__bind_seconds__", time.perf_counter() - t0))
+            done.set()
+
+    def _dispatch_binds(self, result) -> None:
+        """Assume every binding, then hand the batch to the bind worker (at
+        most one batch in flight — joined before the next dispatch).  The
+        worker is one long-lived thread, so its thread-local API connection
+        stays keep-alive across batches (no per-cycle TCP/TLS handshake)."""
+        self._join_binds()
+        if self._bind_queue is None:
+            import queue
+
+            self._bind_queue = queue.Queue()
+            threading.Thread(target=self._bind_worker_loop, daemon=True).start()
+        bindings = list(result.bindings)
+        for pod_full, node_name in bindings:
+            self._assumed[pod_full] = node_name
+        outcomes: list = []
+        done = threading.Event()
+        self._bind_inflight = (outcomes, done)
+        self._bind_queue.put((bindings, outcomes, done))
+
+    def _join_binds(self) -> None:
+        """Wait for the in-flight bind batch (if any) and fold its outcomes
+        into scheduler state — the same error taxonomy as the synchronous
+        ``_bind`` (409 skip, failure requeue), applied on the main thread."""
+        if self._bind_inflight is None:
+            return
+        outcomes, done = self._bind_inflight
+        done.wait()
+        self._bind_inflight = None
+        for pod_full, err in outcomes:
+            if pod_full == "__bind_seconds__":
+                tr = current_trace()
+                if tr is not None:
+                    tr.record("bind", err)  # the overlapped POST time, attributed at drain
+                continue
+            if err is None:
+                self.metrics.inc("scheduler_bindings_total")
+                self.requeue_at.pop(pod_full, None)
+                continue
+            self._assumed.pop(pod_full, None)
+            if isinstance(err, ApiError) and err.code == 409:
+                logger.info("pod %s already bound; skipping", pod_full)
+            elif isinstance(err, (CreateBindingFailed, ApiError, OSError, http.client.HTTPException)):
+                self.metrics.inc("scheduler_async_bind_failures_total")
+                self._requeue(pod_full, f"async-bind-failed: {type(err).__name__}: {err}")
+            else:
+                raise err  # programming error — surface, never absorb
+
+    def _prune_and_overlay_assumed(self, snapshot: ClusterSnapshot) -> ClusterSnapshot:
+        """Drop assumptions the watch has confirmed (or whose pod vanished),
+        then overlay the rest: an assumed pod appears bound to its node so
+        the cycle consumes its capacity and never re-schedules it."""
+        if not self._assumed:
+            return snapshot
+        by_full = {full_name(p): p for p in snapshot.pods}
+        for pod_full in list(self._assumed):
+            p = by_full.get(pod_full)
+            if p is None or is_pod_bound(p):
+                del self._assumed[pod_full]
+        if not self._assumed:
+            return snapshot
+        node_by = {n.name: n for n in snapshot.nodes}
+        pods = []
+        for p in snapshot.pods:
+            target = self._assumed.get(full_name(p))
+            if target is not None and not is_pod_bound(p) and target in node_by:
+                pods.append(self._bound_clone(p, node_by[target]))
+            else:
+                pods.append(p)
+        return ClusterSnapshot.build(snapshot.nodes, pods)
+
     def _run_routed_cycle(self, snapshot: ClusterSnapshot, part, placed: list[tuple[Pod, Node]]) -> tuple[int, int, int]:
         """Expert-parallel cycle (parallel/routing.py): per-pool shards pack
         and solve CONCURRENTLY (each shard on its own device when the
@@ -443,6 +566,10 @@ class Scheduler:
                 part = partition_snapshot(snapshot, self.profile.pool_key)
                 if part is not None:
                     return self._run_routed_cycle(snapshot, part, placed)
+            if self.pipeline:
+                # PP: hand the binds to a worker thread; the next cycle's
+                # sync/pack/solve overlaps this cycle's host I/O.
+                return self._schedule_batch_pipelined(snapshot)
             # Fast path — one tensor cycle over every pending pod (and the
             # incremental device-resident pack stays hot).
             return self._schedule_batch(snapshot, placed)
@@ -593,6 +720,13 @@ class Scheduler:
                     self.metrics.inc("scheduler_watch_errors_total", err_delta)
                     self._watch_errors_folded = self.reflector.errors_seen
                 snapshot = self.reflector.snapshot()
+            if self.pipeline:
+                # Fold a FINISHED bind batch (never block — blocking here
+                # would serialize the pipeline); then hide confirmed /
+                # overlay in-flight assumptions onto the snapshot.
+                if self._bind_inflight is not None and self._bind_inflight[1].is_set():
+                    self._join_binds()
+                snapshot = self._prune_and_overlay_assumed(snapshot)
             pending_all = snapshot.pending_pods()
             pending = self._eligible(pending_all)
             # Prune requeue backoffs for pods that no longer exist / are no
@@ -666,6 +800,7 @@ class Scheduler:
         ran = 0
         settle_timeout = 60.0
         unhealthy_idle = 0.0
+        flush_tries = 0
         while max_cycles is None or ran < max_cycles:
             if stop_event is not None and stop_event.is_set():
                 break
@@ -681,6 +816,14 @@ class Scheduler:
                     else:
                         sleep(daemon_interval)
             elif until_settled and m.bound == 0:
+                if self.pipeline and (self._bind_inflight is not None or self._assumed) and flush_tries < 8:
+                    # In-flight/unconfirmed binds: fold their outcomes and
+                    # run another cycle so failures requeue before settling
+                    # (bounded tries — an unconfirmable assumption must not
+                    # spin the loop forever).
+                    self._join_binds()
+                    flush_tries += 1
+                    continue
                 if self.reflector.healthy:
                     break
                 # Sleep out the backoff window instead of spinning no-op
@@ -695,4 +838,7 @@ class Scheduler:
                 sleep(wait)
             else:
                 unhealthy_idle = 0.0
+                flush_tries = 0
+        if self.pipeline:
+            self._join_binds()  # never leave a bind batch in flight on exit
         return out

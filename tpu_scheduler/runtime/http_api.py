@@ -185,12 +185,23 @@ class KubeApiClient:
             cls = http.client.HTTPSConnection if parsed.scheme == "https" else http.client.HTTPConnection
             connection_factory = lambda: cls(self._host, self._port, timeout=self._timeout)  # noqa: E731
         self._connect = connection_factory
-        self._conn = None  # persistent keep-alive connection
+        # Per-THREAD keep-alive connections: http.client connections are not
+        # thread-safe, and the pipelined controller posts bindings from a
+        # worker thread while the main thread polls watches concurrently.
+        self._local = threading.local()
         # GET accounting by (method, path-sans-query; watch polls keyed
         # separately) — the O(delta) watch contract is testable only if the
         # traffic is observable.  GET-only: binding POST paths embed pod
         # names, which would grow the dict without bound in a daemon.
         self.request_counts: dict[tuple[str, str], int] = {}
+
+    @property
+    def _conn(self):
+        return getattr(self._local, "conn", None)
+
+    @_conn.setter
+    def _conn(self, value):
+        self._local.conn = value
 
     def _request(self, method: str, path: str, body=None, read_timeout: float | None = None) -> tuple[int, bytes]:
         """One round-trip over a persistent connection (a binding-heavy cycle

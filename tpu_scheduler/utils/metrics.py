@@ -60,7 +60,8 @@ class MetricsRegistry:
         self.inc("scheduler_pods_unschedulable_total", m.unschedulable)
 
     def snapshot(self) -> dict:
-        out = dict(self.counters)
+        with self._lock:  # /metrics reader vs worker-thread inc (dict-resize race)
+            out = dict(self.counters)
         if self.cycles:
             last = self.cycles[-1]
             out["scheduler_last_cycle_seconds"] = last.wall_seconds
