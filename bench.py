@@ -230,6 +230,14 @@ def main() -> int:
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument("--block", type=int, default=8192)
     ap.add_argument("--max-rounds", type=int, default=64)
+    from tpu_scheduler.models.profiles import PROFILES  # numpy-only import; safe before device init
+
+    ap.add_argument(
+        "--profile",
+        default="throughput",
+        choices=sorted(PROFILES),
+        help="scoring profile (models/profiles.py); the flagship bench runs the mass-admission 'throughput' profile",
+    )
     ap.add_argument("--target-seconds", type=float, default=1.0)
     ap.add_argument("--no-sharded-row", action="store_true")
     ap.add_argument("--force-cpu", action="store_true", help="testing: skip the TPU entirely")
@@ -238,10 +246,9 @@ def main() -> int:
     jax, devices, platform = init_devices(force_cpu=args.force_cpu)
 
     from tpu_scheduler.backends.tpu import TpuBackend
-    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
 
     backend = TpuBackend()
-    profile = DEFAULT_PROFILE.with_(pod_block=args.block, max_rounds=args.max_rounds)
+    profile = PROFILES[args.profile].with_(pod_block=args.block, max_rounds=args.max_rounds)
     n_bound = args.bound if args.bound is not None else 2 * args.nodes
 
     # Downscale ladder: a partial number beats none (VERDICT r1 #1).

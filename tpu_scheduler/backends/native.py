@@ -86,6 +86,7 @@ class NativeBackend(SchedulingBackend):
                     pod_ntol_soft=ntol_soft[lo:hi], node_taints_soft=node_taints_soft,
                     pod_sps_declares=cpods["pod_sps_declares"][lo:hi] if soft_spread else None,
                     sp_penalty_node=round_masks["sp_penalty_node"] if soft_spread else None,
+                    salt=rounds,
                 )
                 sc = np.where(m, sc, -np.inf)
                 choice[lo:hi] = sc.argmax(axis=1).astype(np.int32)

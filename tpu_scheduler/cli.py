@@ -154,6 +154,7 @@ def main(argv: list[str] | None = None) -> int:
             save_scheduler(sched, args.checkpoint_dir)
         if http_server is not None:
             http_server.stop()
+        sched.close()  # drain in-flight pipelined binds, stop the worker
 
     for m in metrics:
         print(m.to_json())

@@ -67,4 +67,13 @@ PROFILES: dict[str, SchedulingProfile] = {
     "most-requested": SchedulingProfile(name="most-requested", least_requested_weight=-1.0),
     # Pure spread on balanced allocation.
     "balanced-only": SchedulingProfile(name="balanced-only", least_requested_weight=0.0),
+    # Mass-admission flavour — the flagship benchmark profile: a wider
+    # tie-break jitter spreads each auction round's claims across many more
+    # near-tied nodes, cutting rounds ~3x (measured 21 -> 7 at 20k x 2k) at
+    # the cost of ±8 points of scoring noise on the ~200-point
+    # LeastRequested+Balanced scale.  Validity and capacity are exact
+    # regardless (jitter only reorders feasible choices); soft terms
+    # (PreferNoSchedule at 10/violation, weighted preferred affinity) still
+    # dominate the noise.
+    "throughput": SchedulingProfile(name="throughput", spread_jitter=8.0),
 }

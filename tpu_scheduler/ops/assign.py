@@ -107,7 +107,7 @@ def _seg_scan_op(x, y):
     return fx | fy, jnp.where(fy, vy, _sat_add(vx, vy))
 
 
-def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None):
+def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None, salt=None):
     """[B] best feasible node (+feasibility flag) for one block of pods.
 
     ``blk`` is the pod-side dict sliced to one block.  With ``pallas_pack``
@@ -138,6 +138,7 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
             pref_t,
             taints_soft_t,
             weights,
+            salt=salt,
             interpret=interpret,
         )
     node_idx = jnp.arange(avail.shape[0], dtype=jnp.uint32)
@@ -175,12 +176,15 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         node_taints_soft=nodes["node_taints_soft"],
         pod_sps_declares=blk["pod_sps_declares"] if soft_sp else None,
         sp_penalty_node=round_masks["sp_penalty_node"] if soft_sp else None,
+        salt=salt,
     )
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
 
-def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas_interpret=False, round_masks=None):
+def _choose(
+    avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas_interpret=False, round_masks=None, salt=None
+):
     """Per-pod best feasible node vs current capacity, blockwise over pods.
 
     Never materialises the full [P,N] score matrix: peak live memory is one
@@ -209,7 +213,7 @@ def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas
 
     choose_keys = _CHOOSE_KEYS + (_CONSTRAINT_KEYS if round_masks is not None else ())
     if block >= p:
-        return _choose_block(avail, nodes, weights, {k: ps[k] for k in choose_keys}, pallas_pack, round_masks)
+        return _choose_block(avail, nodes, weights, {k: ps[k] for k in choose_keys}, pallas_pack, round_masks, salt)
 
     nb_occupied = (n_active + block - 1) // block  # traced; caller pads p % block == 0
 
@@ -221,7 +225,7 @@ def _choose(avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas
         i, choice, has = s
         lo = i * block
         blk = {k: lax.dynamic_slice_in_dim(ps[k], lo, block) for k in choose_keys}
-        bc, bh = _choose_block(avail, nodes, weights, blk, pallas_pack, round_masks)
+        bc, bh = _choose_block(avail, nodes, weights, blk, pallas_pack, round_masks, salt)
         choice = lax.dynamic_update_slice_in_dim(choice, bc, lo, axis=0)
         has = lax.dynamic_update_slice_in_dim(has, bh, lo, axis=0)
         return i + 1, choice, has
@@ -281,7 +285,9 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
             from .constraints import constraint_commit, constraint_filter, round_blocked_masks
 
             round_masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread)
-        choice, has = _choose(avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks)
+        choice, has = _choose(
+            avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks, salt=rounds
+        )
         cand = ps["active"] & has
         ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
         claim = jnp.where(cand[:, None], ps["pod_req"], 0)

@@ -59,7 +59,7 @@ def build_node_info(node_avail, node_alloc, node_valid):
 
 
 def _choose_kernel(
-    weights_ref,  # [1, 8] f32 SMEM  (w_lr, w_ba, w_jitter, w_pref, w_soft_taint, w_topo, pad, pad)
+    weights_ref,  # [1, 8] f32 SMEM  (w_lr, w_ba, w_jitter, w_pref, w_soft_taint, w_topo, round_salt, pad)
     req_ref,  # [BP, 2] i32
     sel_ref,  # [BP, L] f32
     selc_ref,  # [BP, 1] f32
@@ -137,10 +137,13 @@ def _choose_kernel(
     untol_soft = jnp.dot(ntols_ref[:], taints_soft_t_ref[:], preferred_element_type=f32)
     score = score - weights_ref[0, 4] * untol_soft
 
-    # Deterministic tie-break jitter — same uint32 hash as ops/score.py.
+    # Deterministic tie-break jitter — same uint32 hash as ops/score.py,
+    # including the auction-round salt (rides the spare SMEM weights slot;
+    # rounds < 2^24, so the f32 round-trip is exact).
     u32 = jnp.uint32
     node_idx = (j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
-    h = idx_ref[:].astype(u32) * u32(2654435761) + node_idx * u32(2246822519)
+    salt = weights_ref[0, 6].astype(jnp.int32).astype(u32)
+    h = idx_ref[:].astype(u32) * u32(2654435761) + node_idx * u32(2246822519) + salt * u32(3266489917)
     h = (h ^ (h >> u32(15))) & u32(0xFFFF)
     # Mosaic lacks a direct uint32→f32 cast; h < 2^16 so int32 is exact.
     score = score + weights_ref[0, 2] * (h.astype(jnp.int32).astype(f32) / f32(65536.0))
@@ -179,6 +182,7 @@ def choose_block_pallas(
     pref_t,  # [A2, N] f32
     taints_soft_t,  # [Ts, N] f32
     weights,  # [6] f32 (SchedulingProfile.weights())
+    salt=None,  # auction round (int32 scalar) — jitter re-roll per round
     pod_tile: int = 256,
     node_tile: int = 512,
     interpret: bool = False,
@@ -219,6 +223,8 @@ def choose_block_pallas(
         taints_soft_t = jnp.pad(taints_soft_t, ((0, 0), (0, n_pad - n)))
 
     w = jnp.pad(weights.astype(jnp.float32), (0, 8 - weights.shape[0])).reshape(1, 8)
+    if salt is not None:
+        w = w.at[0, 6].set(jnp.asarray(salt).astype(jnp.float32))
 
     grid = (pb, nbt)
     choice, has = pl.pallas_call(

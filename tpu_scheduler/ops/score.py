@@ -43,6 +43,7 @@ def score_block(
     node_taints_soft=None,
     pod_sps_declares=None,
     sp_penalty_node=None,
+    salt=None,
 ):
     """[B, N] combined priority score of a block of pods against all nodes.
 
@@ -78,6 +79,12 @@ def score_block(
     if pod_idx is not None and node_idx is not None:
         u32 = xp.uint32
         h = pod_idx.astype(u32)[:, None] * u32(2654435761) + node_idx.astype(u32)[None, :] * u32(2246822519)
+        if salt is not None:
+            # Auction-round salt: deferred pods re-roll their tie-break each
+            # round instead of re-herding onto the same near-tied nodes —
+            # spreads retries, cutting rounds.  Same wraparound semantics in
+            # NumPy and XLA (uint32), so cross-backend parity is preserved.
+            h = h + xp.asarray(salt).astype(u32) * u32(3266489917)
         h = (h ^ (h >> u32(15))) & u32(0xFFFF)
         score = score + weights[2] * (h.astype(f32) / f32(65536.0))
     if pod_sps_declares is not None and sp_penalty_node is not None:

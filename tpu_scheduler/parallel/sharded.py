@@ -59,7 +59,7 @@ __all__ = ["sharded_assign_cycle", "ShardedBackend", "IN_SPECS", "CONSTRAINT_KEY
 def _local_choose(
     avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels, node_taints,
     node_aff, node_valid, node_pref, node_taints_soft, weights, pod_idx, node_idx,
-    blocked=None, sps_declares=None, sp_penalty=None,
+    blocked=None, sps_declares=None, sp_penalty=None, salt=None,
 ):
     """Best local node per pod of this shard: (best_score, local idx, has).
 
@@ -75,7 +75,7 @@ def _local_choose(
     sc = score_block(
         jnp, req, node_alloc, avail, weights, pod_idx, node_idx,
         pod_pref_w=pref_w, node_pref=node_pref, pod_ntol_soft=ntol_soft, node_taints_soft=node_taints_soft,
-        pod_sps_declares=sps_declares, sp_penalty_node=sp_penalty,
+        pod_sps_declares=sps_declares, sp_penalty_node=sp_penalty, salt=salt,
     )
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.max(sc, axis=1), jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
@@ -178,7 +178,7 @@ def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spre
             best_l, idx_l, _ = _local_choose(
                 avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels,
                 node_taints, node_aff, node_valid, node_pref, node_taints_soft, w, g_pod_idx, g_node_idx,
-                blocked=blocked_l, sps_declares=sps_dec_l, sp_penalty=sp_pen_l,
+                blocked=blocked_l, sps_declares=sps_dec_l, sp_penalty=sp_pen_l, salt=rounds,
             )
             bests = lax.all_gather(best_l, "tp")  # [tp, p_local]
             idxs = lax.all_gather(idx_l + node_base, "tp")

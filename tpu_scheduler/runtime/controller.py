@@ -418,6 +418,7 @@ class Scheduler:
         outcomes, done = self._bind_inflight
         done.wait()
         self._bind_inflight = None
+        unexpected: Exception | None = None
         for pod_full, err in outcomes:
             if pod_full == "__bind_seconds__":
                 tr = current_trace()
@@ -429,13 +430,19 @@ class Scheduler:
                 self.requeue_at.pop(pod_full, None)
                 continue
             self._assumed.pop(pod_full, None)
+            # The dispatching cycle optimistically counted this pod bound
+            # (observe_cycle); correct the series so pods_bound_total stays
+            # the confirmed count, not dispatch attempts.
+            self.metrics.inc("scheduler_pods_bound_total", -1)
             if isinstance(err, ApiError) and err.code == 409:
                 logger.info("pod %s already bound; skipping", pod_full)
             elif isinstance(err, (CreateBindingFailed, ApiError, OSError, http.client.HTTPException)):
                 self.metrics.inc("scheduler_async_bind_failures_total")
                 self._requeue(pod_full, f"async-bind-failed: {type(err).__name__}: {err}")
-            else:
-                raise err  # programming error — surface, never absorb
+            elif unexpected is None:
+                unexpected = err  # surface AFTER the whole batch is folded
+        if unexpected is not None:
+            raise unexpected  # programming error — surface, never absorb
 
     def _prune_and_overlay_assumed(self, snapshot: ClusterSnapshot) -> ClusterSnapshot:
         """Drop assumptions the watch has confirmed (or whose pod vanished),
@@ -801,6 +808,13 @@ class Scheduler:
         settle_timeout = 60.0
         unhealthy_idle = 0.0
         flush_tries = 0
+        try:
+            return self._run_loop(out, ran, max_cycles, until_settled, daemon_interval, stop_event, sleep, settle_timeout, unhealthy_idle, flush_tries)
+        finally:
+            if self.pipeline:
+                self._join_binds()  # even on an unhealthy-watch raise, never leave binds in flight
+
+    def _run_loop(self, out, ran, max_cycles, until_settled, daemon_interval, stop_event, sleep, settle_timeout, unhealthy_idle, flush_tries):
         while max_cycles is None or ran < max_cycles:
             if stop_event is not None and stop_event.is_set():
                 break
@@ -839,6 +853,13 @@ class Scheduler:
             else:
                 unhealthy_idle = 0.0
                 flush_tries = 0
-        if self.pipeline:
-            self._join_binds()  # never leave a bind batch in flight on exit
         return out
+
+    def close(self) -> None:
+        """Release pipeline resources: drain the in-flight bind batch and
+        stop the bind worker (its thread-local API connection dies with it).
+        Idempotent; a Scheduler without pipeline mode has nothing to do."""
+        self._join_binds()
+        if self._bind_queue is not None:
+            self._bind_queue.put(None)  # worker-loop shutdown sentinel
+            self._bind_queue = None
