@@ -209,3 +209,85 @@ def test_vocab_bloat_triggers_compacting_full_pack():
     assert counters["scheduler_full_packs_total"] >= 2  # the valve fired
     assert counters["scheduler_vocab_extensions_total"] >= 10  # but growth was incremental first
     assert len(sched._packed.vocab) < 24  # compacted below the all-time total
+
+
+def test_repack_incremental_row_reuse_matches_fresh_pack():
+    """The O(delta) row-reuse path must produce tensors identical to a
+    from-scratch pack: same-object pods gather their cached rows, replaced
+    objects and new pods re-derive."""
+    import numpy as np
+
+    from dataclasses import replace as dc_replace
+
+    from tpu_scheduler.core.snapshot import ClusterSnapshot
+    from tpu_scheduler.ops.pack import repack_incremental
+
+    snap = synth_cluster(
+        n_nodes=12, n_pending=60, n_bound=12, seed=8,
+        selector_fraction=0.4, tainted_fraction=0.3, node_affinity_fraction=0.3,
+        soft_taint_fraction=0.3, preferred_affinity_fraction=0.3,
+    )
+    packed = pack_snapshot(snap)
+    pending = snap.pending_pods()
+    # Mutate the pending set: drop 10, replace 5 objects (spec change), add 5.
+    kept = pending[10:]
+    replaced = [dc_replace(kept[i], spec=dc_replace(kept[i].spec, priority=9)) for i in range(5)]
+    survivors = replaced + kept[5:]
+    from tpu_scheduler.testing import make_pod
+
+    added = [make_pod(f"fresh-{i}", cpu="250m", memory="512Mi", node_selector={"zone": "zone-a"}) for i in range(5)]
+    others = [p for p in snap.pods if p not in pending]
+    snap2 = ClusterSnapshot.build(snap.nodes, others + survivors + added)
+
+    # Count how many pods actually take the fresh Python path — the reuse
+    # path must fire for the unchanged survivors, or the O(delta) feature
+    # has silently regressed to O(P).
+    import tpu_scheduler.ops.pack as pack_mod
+
+    fresh_counts: list[int] = []
+    orig_pack_pods = pack_mod._pack_pods
+
+    def counting_pack_pods(pending_arg, *a, **kw):
+        fresh_counts.append(len(pending_arg))
+        return orig_pack_pods(pending_arg, *a, **kw)
+
+    pack_mod._pack_pods = counting_pack_pods
+    try:
+        inc = repack_incremental(packed, snap2)
+    finally:
+        pack_mod._pack_pods = orig_pack_pods
+    assert fresh_counts == [10]  # 5 replaced + 5 added; the 45 unchanged rows were gathered
+    fresh = pack_snapshot(
+        snap2,
+        vocab=packed.vocab,
+        taint_vocab=packed.taint_vocab,
+        aff_vocab=packed.aff_vocab,
+        soft_taint_vocab=packed.soft_taint_vocab,
+        pref_vocab=packed.pref_vocab,
+    )
+    assert inc.pod_names == fresh.pod_names
+    for field in (
+        "pod_req", "pod_sel", "pod_sel_count", "pod_prio", "pod_valid",
+        "pod_ntol", "pod_aff", "pod_has_aff", "pod_ntol_soft", "pod_pref_w", "node_avail",
+    ):
+        a, b = getattr(inc, field), getattr(fresh, field)
+        m = min(a.shape[0], b.shape[0])
+        np.testing.assert_array_equal(a[:m], b[:m], err_msg=field)
+
+
+def test_res_memo_reuses_and_refreshes():
+    from tpu_scheduler.api.objects import total_pod_resources
+    from tpu_scheduler.ops.pack import _alloc_and_used64
+
+    snap = synth_cluster(n_nodes=4, n_pending=0, n_bound=12, seed=1)
+    memo: dict = {}
+    a1, u1, _ = _alloc_and_used64(snap, 4, memo)
+    assert len(memo) == 12
+    a2, u2, _ = _alloc_and_used64(snap, 4, memo)  # all hits
+    import numpy as np
+
+    np.testing.assert_array_equal(u1, u2)
+    # memo agrees with the direct summation
+    for pod in snap.pods:
+        hit = memo[id(pod)]
+        assert hit[0] is pod and hit[1] == total_pod_resources(pod)

@@ -137,6 +137,14 @@ class PackedCluster:
     # unconstrained cycles.
     constraints: object | None = None
 
+    # The pod OBJECTS behind the rows (same order as pod_names) — the
+    # identity keys of the O(delta) row-reuse path in repack_incremental:
+    # an unchanged object means unchanged spec (the API layer replaces
+    # objects on modification), so its packed row can be gathered from the
+    # previous cycle instead of re-derived in Python.  Host-only bookkeeping
+    # (never shipped to device, never checkpointed).
+    pod_objs: tuple = ()
+
     @property
     def num_nodes(self) -> int:
         return len(self.node_names)
@@ -351,7 +359,9 @@ def _pack_ntol(pending: list[Pod], taint_vocab: dict, p_pad: int, t_pad: int) ->
     return ntol
 
 
-def _alloc_and_used64(snapshot: ClusterSnapshot, n_pad: int) -> tuple[np.ndarray, np.ndarray, dict[str, int]]:
+def _alloc_and_used64(
+    snapshot: ClusterSnapshot, n_pad: int, res_memo: dict | None = None
+) -> tuple[np.ndarray, np.ndarray, dict[str, int]]:
     """Exact int64 (allocatable, bound-usage) per node — shared by pack and
     the incremental avail refresh."""
     alloc64 = np.zeros((n_pad, 2), dtype=np.int64)
@@ -366,12 +376,24 @@ def _alloc_and_used64(snapshot: ClusterSnapshot, n_pad: int) -> tuple[np.ndarray
             if "memory" in alloc:
                 alloc64[i, MEM] = memory_to_bytes(alloc["memory"])
     # Bound-pod usage, summed exactly in int64 bytes before the KiB floor.
+    # ``res_memo`` (id(pod) -> (pod, PodResources), object-identity keyed
+    # with the reference held so an id can never alias) amortizes the
+    # request summation across cycles: bound pods dominate the cluster and
+    # their objects only change on watch events.
     for pod in snapshot.pods:
         if pod.spec is not None and pod.spec.node_name is not None:
             i = node_index.get(pod.spec.node_name)
             if i is None:
                 continue  # bound to an unknown node; consumes nothing we track
-            res = total_pod_resources(pod)
+            if res_memo is not None:
+                hit = res_memo.get(id(pod))
+                if hit is not None and hit[0] is pod:
+                    res = hit[1]
+                else:
+                    res = total_pod_resources(pod)
+                    res_memo[id(pod)] = (pod, res)
+            else:
+                res = total_pod_resources(pod)
             used64[i, CPU] += res.cpu
             used64[i, MEM] += res.memory
     return alloc64, used64, node_index
@@ -393,6 +415,7 @@ def pack_snapshot(
     aff_vocab: dict[tuple, int] | None = None,
     soft_taint_vocab: dict[tuple[str, str, str], int] | None = None,
     pref_vocab: dict[tuple, int] | None = None,
+    res_memo: dict | None = None,
 ) -> PackedCluster:
     """Pack a snapshot into static-shape tensors.
 
@@ -423,7 +446,7 @@ def pack_snapshot(
         pref_vocab = build_pref_vocab(pending)
     a2_pad = round_up(len(pref_vocab), label_block)
 
-    alloc64, used64, _ = _alloc_and_used64(snapshot, n_pad)
+    alloc64, used64, _ = _alloc_and_used64(snapshot, n_pad, res_memo)
     node_labels = np.zeros((n_pad, l_pad), dtype=np.float32)
     node_taints = np.zeros((n_pad, t_pad), dtype=np.float32)
     node_taints_soft = np.zeros((n_pad, ts_pad), dtype=np.float32)
@@ -520,6 +543,7 @@ def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int) -> dict:
         pod_prio=pod_prio,
         pod_valid=pod_valid,
         pod_names=tuple(pod_names),
+        pod_objs=tuple(pending),
     )
 
 
@@ -652,34 +676,109 @@ def extend_node_vocabs(packed: PackedCluster, snapshot: ClusterSnapshot, label_b
     return replace(packed, **out)
 
 
-def repack_incremental(packed: PackedCluster, snapshot: ClusterSnapshot, pod_block: int = 128) -> PackedCluster:
+def repack_incremental(
+    packed: PackedCluster, snapshot: ClusterSnapshot, pod_block: int = 128, res_memo: dict | None = None
+) -> PackedCluster:
     """Between-cycles repack: reuse the node-side tensors (labels, alloc,
     vocab — stable while the node set is stable) and rebuild only what a
     cycle changes — the pending-pod tensors and remaining capacity.
 
+    The pod side is O(delta): a pending pod whose OBJECT is unchanged since
+    the cached pack (same identity — the API layer replaces objects on every
+    modification) has its rows gathered from the cached tensors with one
+    vectorized scatter; only new/changed pods run the Python packing body.
+    Reused rows are automatically correct under grown vocab columns
+    (extend_node_vocabs preserves existing column indices, and an unchanged
+    pod's entries all predate the growth, so its new columns are zero).
+
     Caller guarantees: identical node set/order (validated) and that
     ``packed.vocab`` covers every pending selector pair (KeyError otherwise).
     """
-    fresh_names = tuple(n.name for n in snapshot.nodes)
-    if fresh_names != packed.node_names:
+    from ..api.objects import full_name
+
+    fresh_nodes = tuple(n.name for n in snapshot.nodes)
+    if fresh_nodes != packed.node_names:
         raise ValueError("repack_incremental requires an identical node set/order; run a full pack_snapshot instead")
-    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes)
+    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_memo)
     pending = snapshot.pending_pods()
     p_pad = max(packed.padded_pods, round_up(len(pending), pod_block))
     # Pod tensor widths come from the NODE side: extend_node_vocabs may have
     # grown label columns since the cached pod tensors were built.
-    pod_tensors = _pack_pods(pending, packed.vocab, p_pad, packed.node_labels.shape[1])
-    pod_ntol = _pack_ntol(pending, packed.taint_vocab, p_pad, packed.node_taints.shape[1])
-    pod_aff, pod_has_aff = _pack_affinity(pending, packed.aff_vocab, p_pad, packed.node_aff.shape[1])
-    pod_ntol_soft = _pack_ntol(pending, packed.soft_taint_vocab, p_pad, packed.node_taints_soft.shape[1])
-    pod_pref_w = _pack_pod_pref(pending, packed.pref_vocab, p_pad, packed.node_pref.shape[1])
+    l_w = packed.node_labels.shape[1]
+    t_w = packed.node_taints.shape[1]
+    a_w = packed.node_aff.shape[1]
+    ts_w = packed.node_taints_soft.shape[1]
+    a2_w = packed.node_pref.shape[1]
+
+    prev_row = {name: j for j, name in enumerate(packed.pod_names)} if packed.pod_objs else {}
+    reuse_src: list[int] = []
+    reuse_dst: list[int] = []
+    fresh_idx: list[int] = []
+    names: list[str] = []
+    for i, pod in enumerate(pending):
+        nm = full_name(pod)
+        names.append(nm)
+        j = prev_row.get(nm)
+        if j is not None and packed.pod_objs[j] is pod:
+            reuse_src.append(j)
+            reuse_dst.append(i)
+        else:
+            fresh_idx.append(i)
+
+    pod_req = np.zeros((p_pad, 2), dtype=np.int32)
+    pod_sel = np.zeros((p_pad, l_w), dtype=np.float32)
+    pod_sel_count = np.zeros((p_pad,), dtype=np.float32)
+    pod_prio = np.zeros((p_pad,), dtype=np.int32)
+    pod_valid = np.zeros((p_pad,), dtype=bool)
+    pod_ntol = np.zeros((p_pad, t_w), dtype=np.float32)
+    pod_aff = np.zeros((p_pad, a_w), dtype=np.float32)
+    pod_has_aff = np.zeros((p_pad,), dtype=np.float32)
+    pod_ntol_soft = np.zeros((p_pad, ts_w), dtype=np.float32)
+    pod_pref_w = np.zeros((p_pad, a2_w), dtype=np.float32)
+    pod_valid[: len(pending)] = True
+
+    if reuse_src:
+        src = np.asarray(reuse_src, dtype=np.intp)
+        dst = np.asarray(reuse_dst, dtype=np.intp)
+        pod_req[dst] = packed.pod_req[src]
+        pod_sel[dst, : packed.pod_sel.shape[1]] = packed.pod_sel[src]
+        pod_sel_count[dst] = packed.pod_sel_count[src]
+        pod_prio[dst] = packed.pod_prio[src]
+        pod_ntol[dst, : packed.pod_ntol.shape[1]] = packed.pod_ntol[src]
+        pod_aff[dst, : packed.pod_aff.shape[1]] = packed.pod_aff[src]
+        pod_has_aff[dst] = packed.pod_has_aff[src]
+        pod_ntol_soft[dst, : packed.pod_ntol_soft.shape[1]] = packed.pod_ntol_soft[src]
+        pod_pref_w[dst, : packed.pod_pref_w.shape[1]] = packed.pod_pref_w[src]
+
+    if fresh_idx:
+        fp = [pending[i] for i in fresh_idx]
+        fi = np.asarray(fresh_idx, dtype=np.intp)
+        n_f = len(fp)
+        sub = _pack_pods(fp, packed.vocab, n_f, l_w)
+        pod_req[fi] = sub["pod_req"]
+        pod_sel[fi] = sub["pod_sel"]
+        pod_sel_count[fi] = sub["pod_sel_count"]
+        pod_prio[fi] = sub["pod_prio"]
+        pod_ntol[fi] = _pack_ntol(fp, packed.taint_vocab, n_f, t_w)
+        f_aff, f_has = _pack_affinity(fp, packed.aff_vocab, n_f, a_w)
+        pod_aff[fi] = f_aff
+        pod_has_aff[fi] = f_has
+        pod_ntol_soft[fi] = _pack_ntol(fp, packed.soft_taint_vocab, n_f, ts_w)
+        pod_pref_w[fi] = _pack_pod_pref(fp, packed.pref_vocab, n_f, a2_w)
+
     return replace(
         packed,
         node_avail=_avail_i32(alloc64, used64),
+        pod_req=pod_req,
+        pod_sel=pod_sel,
+        pod_sel_count=pod_sel_count,
+        pod_prio=pod_prio,
+        pod_valid=pod_valid,
+        pod_names=tuple(names),
+        pod_objs=tuple(pending),
         pod_ntol=pod_ntol,
         pod_aff=pod_aff,
         pod_has_aff=pod_has_aff,
         pod_ntol_soft=pod_ntol_soft,
         pod_pref_w=pod_pref_w,
-        **pod_tensors,
     )

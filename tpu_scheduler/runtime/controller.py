@@ -101,6 +101,9 @@ class Scheduler:
         self._packed = None
         self._node_sig = None
         self._watch_errors_folded = 0
+        # id(pod) -> (pod, PodResources): amortizes the bound-usage request
+        # summation across cycles (objects change only on watch events).
+        self._res_memo: dict[int, tuple] = {}
         # Pipelined binding (SURVEY.md §2b PP): the binding POSTs of cycle k
         # run on a worker thread while cycle k+1 syncs/packs/solves.  The
         # assumed cache (pod full name -> node) makes in-flight bindings
@@ -176,12 +179,15 @@ class Scheduler:
         the cached node tensors in place (ops/pack.extend_node_vocabs)
         instead of abandoning the incremental path."""
         sig = self.reflector.node_set_signature()
+        if len(self._res_memo) > 4 * max(1, len(snapshot.pods)):
+            live = {id(p) for p in snapshot.pods}
+            self._res_memo = {k: v for k, v in self._res_memo.items() if k in live}
         if self._packed is not None and sig == self._node_sig:
             try:
                 extended = extend_node_vocabs(self._packed, snapshot)
                 if extended is not self._packed:
                     self.metrics.inc("scheduler_vocab_extensions_total")
-                packed = repack_incremental(extended, snapshot, pod_block=self.pod_block)
+                packed = repack_incremental(extended, snapshot, pod_block=self.pod_block, res_memo=self._res_memo)
                 self.metrics.inc("scheduler_incremental_packs_total")
             except (ValueError, KeyError):
                 # The cached node tensors don't match the live node order
@@ -189,11 +195,15 @@ class Scheduler:
                 # relisted in a different order: the signature is sorted, the
                 # pack is order-sensitive).  Degrade to a full pack — never
                 # crash the cycle on a stale cache.
-                packed = pack_snapshot(snapshot, pod_block=self.pod_block, node_block=self.node_block)
+                packed = pack_snapshot(
+                    snapshot, pod_block=self.pod_block, node_block=self.node_block, res_memo=self._res_memo
+                )
                 self._node_sig = sig
                 self.metrics.inc("scheduler_full_packs_total")
         else:
-            packed = pack_snapshot(snapshot, pod_block=self.pod_block, node_block=self.node_block)
+            packed = pack_snapshot(
+                snapshot, pod_block=self.pod_block, node_block=self.node_block, res_memo=self._res_memo
+            )
             self._node_sig = sig
             self.metrics.inc("scheduler_full_packs_total")
         self._packed = packed
