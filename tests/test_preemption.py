@@ -1,0 +1,243 @@
+"""Priority preemption (runtime/controller.py; kube PostFilter — absent in
+the reference): resource-starved high-priority pods evict strictly-lower-
+priority victims with minimal disruption."""
+
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+PREEMPT = DEFAULT_PROFILE.with_(preemption=True)
+
+
+def _full_node(name="n1", cpu="4", memory="16Gi", **kw):
+    return make_node(name, cpu=cpu, memory=memory, **kw)
+
+
+def test_high_priority_pod_evicts_lowest_victims():
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node()],
+        pods=[
+            make_pod("low-a", cpu="2", memory="4Gi", node_name="n1", phase="Running", priority=1),
+            make_pod("low-b", cpu="2", memory="4Gi", node_name="n1", phase="Running", priority=2),
+            make_pod("vip", cpu="2", memory="4Gi", priority=10),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 1 and m.unschedulable == 0
+    pods = {p.metadata.name: p for p in api.list_pods()}
+    assert pods["vip"].spec.node_name == "n1"
+    assert "low-a" not in pods  # the LOWEST priority victim went first
+    assert "low-b" in pods  # one eviction sufficed — minimal disruption
+    c = sched.metrics.snapshot()
+    assert c["scheduler_preemptions_total"] == 1
+    assert c["scheduler_preemption_victims_total"] == 1
+
+
+def test_no_preemption_of_equal_or_higher_priority():
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node()],
+        pods=[
+            make_pod("same", cpu="4", memory="8Gi", node_name="n1", phase="Running", priority=5),
+            make_pod("wanter", cpu="2", memory="4Gi", priority=5),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 0 and m.unschedulable == 1
+    assert "default/same" not in [None]  # victim survives
+    assert {p.metadata.name for p in api.list_pods()} == {"same", "wanter"}
+
+
+def test_selector_mismatch_never_preempts():
+    """Eviction cannot fix a non-resource predicate: a pod whose selector
+    matches no node stays unschedulable even with victims available."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node(labels={"zone": "a"})],
+        pods=[
+            make_pod("victim", cpu="4", memory="8Gi", node_name="n1", phase="Running", priority=0),
+            make_pod("misfit", cpu="1", memory="1Gi", priority=10, node_selector={"zone": "b"}),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 0 and m.unschedulable == 1
+    assert {p.metadata.name for p in api.list_pods()} == {"victim", "misfit"}
+
+
+def test_preemption_prefers_lowest_max_victim_priority():
+    """Two feasible nodes: prefer the one whose required victims have the
+    lower maximum priority (kube minimal-disruption)."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node("a"), _full_node("b")],
+        pods=[
+            make_pod("a-vic", cpu="4", memory="8Gi", node_name="a", phase="Running", priority=7),
+            make_pod("b-vic", cpu="4", memory="8Gi", node_name="b", phase="Running", priority=2),
+            make_pod("vip", cpu="2", memory="4Gi", priority=9),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0)
+    sched.run_cycle()
+    pods = {p.metadata.name: p for p in api.list_pods()}
+    assert pods["vip"].spec.node_name == "b"
+    assert "b-vic" not in pods and "a-vic" in pods
+
+
+def test_preemption_off_by_default():
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node()],
+        pods=[
+            make_pod("low", cpu="4", memory="8Gi", node_name="n1", phase="Running", priority=0),
+            make_pod("vip", cpu="2", memory="4Gi", priority=10),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 0 and m.unschedulable == 1
+    assert {p.metadata.name for p in api.list_pods()} == {"low", "vip"}
+
+
+def test_multiple_preemptors_account_shared_capacity():
+    """Two preemptors in one cycle: the second sees the first's placement
+    and the freed pool honestly (no double-spend of evicted capacity)."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node(cpu="4", memory="16Gi")],
+        pods=[
+            make_pod("v1", cpu="2", memory="4Gi", node_name="n1", phase="Running", priority=0),
+            make_pod("v2", cpu="2", memory="4Gi", node_name="n1", phase="Running", priority=0),
+            make_pod("hi-a", cpu="2", memory="4Gi", priority=8),
+            make_pod("hi-b", cpu="2", memory="4Gi", priority=9),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 2 and m.unschedulable == 0
+    pods = {p.metadata.name: p for p in api.list_pods()}
+    assert pods["hi-a"].spec.node_name == "n1" and pods["hi-b"].spec.node_name == "n1"
+    assert "v1" not in pods and "v2" not in pods
+    # capacity exact: 2 + 2 cores on a 4-core node, nothing oversubscribed
+    assert sched.metrics.snapshot()["scheduler_preemption_victims_total"] == 2
+
+
+def test_preemption_over_http_boundary(tmp_path):
+    """The eviction DELETE flows through the REST boundary end-to-end."""
+    from tpu_scheduler.runtime.http_api import HttpApiServer, KubeApiClient, RemoteApiAdapter
+
+    api = FakeApiServer()
+    server = HttpApiServer(api).start()
+    try:
+        api.load(
+            nodes=[_full_node()],
+            pods=[
+                make_pod("low", cpu="4", memory="8Gi", node_name="n1", phase="Running", priority=0),
+                make_pod("vip", cpu="2", memory="4Gi", priority=10),
+            ],
+        )
+        adapter = RemoteApiAdapter(KubeApiClient(server.base_url))
+        sched = Scheduler(adapter, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0)
+        m = sched.run_cycle()
+        assert m.bound == 1
+        pods = {p.metadata.name: p for p in api.list_pods()}
+        assert pods["vip"].spec.node_name == "n1" and "low" not in pods
+    finally:
+        server.stop()
+
+
+def test_cli_preemption_flag(capsys):
+    import json
+
+    from tpu_scheduler.cli import main
+    import tpu_scheduler.cli as cli_mod
+    from tpu_scheduler.core.snapshot import ClusterSnapshot
+
+    orig = cli_mod.synth_cluster
+
+    def contended(**kw):
+        nodes = [_full_node()]
+        pods = [
+            make_pod("low", cpu="4", memory="8Gi", node_name="n1", phase="Running", priority=0),
+            make_pod("vip", cpu="2", memory="4Gi", priority=10),
+        ]
+        return ClusterSnapshot.build(nodes, pods)
+
+    cli_mod.synth_cluster = contended
+    try:
+        rc = main(["--backend", "native", "--preemption", "--cycles", "2", "--requeue-seconds", "0"])
+    finally:
+        cli_mod.synth_cluster = orig
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["counters"]["scheduler_preemptions_total"] == 1
+
+
+def test_preemption_sees_same_cycle_placements():
+    """Regression (review repro): the pass must count capacity bound earlier
+    in the SAME cycle — two 3-core equal-priority pods on a 4-core node must
+    not both land (and a zero-eviction 'preemption' must not be counted)."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node(cpu="4", memory="16Gi")],
+        pods=[
+            make_pod("a", cpu="3", memory="4Gi", priority=5),
+            make_pod("b", cpu="3", memory="4Gi", priority=5),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 1 and m.unschedulable == 1
+    assert sched.metrics.snapshot().get("scheduler_preemptions_total", 0) == 0
+    bound = [p for p in api.list_pods() if p.spec.node_name]
+    assert len(bound) == 1  # 6/4 cores never happens
+
+
+def test_preemption_sees_pipelined_dispatches():
+    """Same invariant under --pipeline, where main-pass binds are only
+    dispatched when the preemption pass runs."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node(cpu="4", memory="16Gi")],
+        pods=[
+            make_pod("a", cpu="3", memory="4Gi", priority=5),
+            make_pod("b", cpu="3", memory="4Gi", priority=5),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0, pipeline=True)
+    m = sched.run_cycle()
+    sched.run(until_settled=True, max_cycles=3)
+    bound = [p for p in api.list_pods() if p.spec.node_name]
+    assert len(bound) == 1
+    assert sched.metrics.snapshot().get("scheduler_preemptions_total", 0) == 0
+
+
+def test_preemptor_bind_failure_clears_backoff():
+    """Victims already evicted + bind 500: the preemptor must stay eligible
+    for the next cycle (approximated nominatedNodeName reservation)."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[_full_node()],
+        pods=[
+            make_pod("low", cpu="4", memory="8Gi", node_name="n1", phase="Running", priority=0),
+            make_pod("vip", cpu="2", memory="4Gi", priority=10),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=300.0)
+    api.fail_next_bindings = 1  # the main pass never binds (node full); the preemption bind fails
+    m = sched.run_cycle()
+    assert m.bound == 0
+    c = sched.metrics.snapshot()
+    assert c.get("scheduler_preemption_bind_failures_total", 0) == 1
+    assert "default/vip" not in sched.requeue_at  # eligible immediately
+    m2 = sched.run_cycle()  # freed capacity is there; vip binds without more evictions
+    assert m2.bound == 1
+    pods = {p.metadata.name: p for p in api.list_pods()}
+    assert pods["vip"].spec.node_name == "n1" and "low" not in pods
+    assert c.get("scheduler_preemption_victims_total", 0) == 1

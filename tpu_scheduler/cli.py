@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--policy", choices=["batch", "sample"], default="batch", help="batched cycle vs reference-style per-pod random sampling")
     p.add_argument("--profile", choices=sorted(PROFILES), default="default", help="scoring profile")
     p.add_argument(
+        "--preemption",
+        action="store_true",
+        help="evict strictly-lower-priority pods when a cycle leaves higher-priority pods resource-starved (kube PostFilter)",
+    )
+    p.add_argument(
         "--pool-key",
         default=None,
         help="node label partitioning the cluster into per-pool scheduling shards (expert-parallel routing; pods pinning the label route to their pool's shard)",
@@ -107,6 +112,8 @@ def main(argv: list[str] | None = None) -> int:
     profile = PROFILES[args.profile]
     if args.pool_key:
         profile = profile.with_(pool_key=args.pool_key)
+    if args.preemption:
+        profile = profile.with_(preemption=True)
     sched = Scheduler(
         api,
         backend,

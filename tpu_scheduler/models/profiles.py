@@ -41,6 +41,11 @@ class SchedulingProfile:
     # Expert-parallel routing (parallel/routing.py): node label whose values
     # partition the cluster into per-pool scheduling shards; None = off.
     pool_key: str | None = None
+    # Priority preemption (runtime/controller.py): pods the cycle could not
+    # place for lack of RESOURCES may evict strictly-lower-priority victims
+    # (kube PostFilter semantics).  Off by default: the synthetic cluster
+    # has no controllers to recreate evicted pods.
+    preemption: bool = False
 
     def weights(self) -> np.ndarray:
         return np.array(

@@ -64,6 +64,12 @@ ATTEMPTS = 5  # reference main.rs:49
 REQUEUE_SECONDS = 300.0  # reference main.rs:124
 
 
+def _pod_priority(p: Pod) -> int:
+    """Pod priority with the unset default — ONE definition for every sort
+    key and preemption comparison in this module."""
+    return p.spec.priority if p.spec is not None else 0
+
+
 class Scheduler:
     def __init__(
         self,
@@ -115,6 +121,10 @@ class Scheduler:
         # in flight: (outcomes list, done event).
         self._bind_queue = None
         self._bind_inflight: tuple[list, threading.Event] | None = None
+        self._cycle_unschedulable: list[str] = []  # this cycle's no-node pods
+        # This cycle's successful (or dispatched) placements — the capacity
+        # the preemption pass must see on top of the pre-cycle snapshot.
+        self._cycle_placed: list[tuple[Pod, Node]] = []
         if pipeline and profile.pool_key:
             logger.warning(
                 "--pipeline applies to plain unconstrained cycles; routed (--pool-key) and "
@@ -139,6 +149,12 @@ class Scheduler:
         self.requeue_at[pod_name] = self.clock() + self.requeue_seconds
         self.metrics.inc("scheduler_requeues_total")
         logger.warning("reconcile failed on pod %s: %s; requeue in %.0fs", pod_name, reason, self.requeue_seconds)
+
+    def _mark_unschedulable(self, pod_full: str) -> None:
+        """Requeue a pod the cycle could not place, and remember it for the
+        end-of-cycle preemption pass (profile.preemption)."""
+        self._cycle_unschedulable.append(pod_full)
+        self._requeue(pod_full, NoNodeFound("no feasible node this cycle"))
 
     # -- binding (main.rs:83-115) -----------------------------------------
 
@@ -299,7 +315,7 @@ class Scheduler:
         weights = self.profile.weights()
         bound = 0
         unschedulable = 0
-        order = sorted(constrained, key=lambda p: -(p.spec.priority if p.spec is not None else 0))
+        order = sorted(constrained, key=lambda p: -_pod_priority(p))
         for pod in order:
             # Precompute the pod's affinity/spread state once — the node loop
             # is then O(1) per candidate instead of re-scanning all placements.
@@ -318,7 +334,7 @@ class Scheduler:
                 if best is None or score > best_score:
                     best, best_score = node, score
             if best is None:
-                self._requeue(full_name(pod), NoNodeFound("no feasible node this cycle"))
+                self._mark_unschedulable(full_name(pod))
                 unschedulable += 1
                 continue
             if self._bind(pod.metadata.namespace or "default", pod.metadata.name, best.name):
@@ -326,6 +342,7 @@ class Scheduler:
                 committed = ledger.setdefault(best.name, PodResources())
                 committed += total_pod_resources(pod)
                 placed.append((pod, best))
+                self._cycle_placed.append((pod, best))
         return bound, unschedulable
 
     @staticmethod
@@ -362,8 +379,9 @@ class Scheduler:
                 pod_obj, node_obj = pod_by_full.get(pod_full), node_by_name.get(node_name)
                 if pod_obj is not None and node_obj is not None:
                     placed.append((pod_obj, node_obj))
+                    self._cycle_placed.append((pod_obj, node_obj))
         for pod_full in result.unschedulable:
-            self._requeue(pod_full, NoNodeFound("no feasible node this cycle"))
+            self._mark_unschedulable(pod_full)
         return bound, len(result.unschedulable)
 
     # -- pipelined binding (SURVEY.md §2b PP) -------------------------------
@@ -379,8 +397,16 @@ class Scheduler:
         with span("solve"):
             result = self._solve_with_fallback(packed)
         self._dispatch_binds(result)
+        # Dispatched placements count as this cycle's capacity (the
+        # preemption pass and the next cycle's assumed overlay both see it).
+        node_by_name = {n.name: n for n in batch_snapshot.nodes}
+        pod_by_full = {full_name(p): p for p in batch_snapshot.pending_pods()}
+        for pod_full, node_name in result.bindings:
+            pod_obj, node_obj = pod_by_full.get(pod_full), node_by_name.get(node_name)
+            if pod_obj is not None and node_obj is not None:
+                self._cycle_placed.append((pod_obj, node_obj))
         for pod_full in result.unschedulable:
-            self._requeue(pod_full, NoNodeFound("no feasible node this cycle"))
+            self._mark_unschedulable(pod_full)
         return len(result.bindings), len(result.unschedulable), result.rounds
 
     def _bind_worker_loop(self) -> None:
@@ -613,10 +639,9 @@ class Scheduler:
         # all earlier placements as consumed capacity.
         constrained_ids = {id(p) for p in constrained}
         pending_ids = {id(p) for p in pending}
-        priority_of = lambda p: p.spec.priority if p.spec is not None else 0  # noqa: E731
-        order = sorted(pending, key=lambda p: -priority_of(p))
+        order = sorted(pending, key=lambda p: -_pod_priority(p))
         segments: list[tuple[bool, list[Pod]]] = []
-        for _, level in groupby(order, key=priority_of):
+        for _, level in groupby(order, key=_pod_priority):
             for pod in sorted(level, key=lambda p: id(p) in constrained_ids):  # plain first within a level
                 is_constrained = id(pod) in constrained_ids
                 if segments and segments[-1][0] == is_constrained:
@@ -640,6 +665,110 @@ class Scheduler:
             unschedulable += u
             rounds += r
         return bound, unschedulable, rounds
+
+    # -- preemption (kube PostFilter; absent in the reference) -------------
+
+    def _attempt_preemption(self, snapshot: ClusterSnapshot) -> tuple[int, int]:
+        """Evict strictly-lower-priority victims so this cycle's
+        resource-starved pods can bind (kube preemption semantics,
+        simplified to immediate deletion — the synthetic cluster has no
+        kubelet grace period to await).
+
+        Per preemptor (priority desc): candidate nodes must pass every
+        NON-resource predicate as-is (eviction cannot fix a selector, taint,
+        or affinity mismatch — and no credit is taken for constraint room an
+        eviction might open: conservative); on each, victims are taken
+        lowest-priority-first until the preemptor fits; the chosen node
+        minimizes (highest victim priority, victim count) — kube's
+        minimal-disruption heuristics.  Returns (pods bound, victims
+        evicted)."""
+        by_full = {full_name(p): p for p in snapshot.pending_pods()}
+        pods_on: dict[str, list[Pod]] = {}
+        for q, qn in snapshot.placed_pods():
+            pods_on.setdefault(qn.name, []).append(q)
+        for lst in pods_on.values():
+            lst.sort(key=_pod_priority)
+        # Seed with THIS cycle's placements (bound or dispatched) — the
+        # snapshot predates them, and ignoring them would let the pass bind
+        # onto capacity the main pass already consumed (oversubscription).
+        extra_used: dict[str, PodResources] = {}
+        placed_overlay: list[tuple[Pod, Node]] = list(self._cycle_placed)
+        for q, qn in self._cycle_placed:
+            u = extra_used.setdefault(qn.name, PodResources())
+            u += total_pod_resources(q)
+        freed: dict[str, PodResources] = {}  # victims evicted this pass
+        bound = victims_total = 0
+
+        order = sorted(
+            (by_full[n] for n in self._cycle_unschedulable if n in by_full), key=lambda p: -_pod_priority(p)
+        )
+        for pod in order:
+            prio = _pod_priority(pod)
+            req = total_pod_resources(pod)
+            best = best_key = None
+            for node in snapshot.nodes:
+                if any(not pred(pod, node, snapshot) for _, pred in NODE_LOCAL_PREDICATES):
+                    continue
+                if not anti_affinity_ok(pod, node, snapshot, extra_placed=placed_overlay):
+                    continue
+                if not topology_spread_ok(pod, node, snapshot, extra_placed=placed_overlay):
+                    continue
+                avail = node_allocatable(node)
+                avail -= node_used_resources(snapshot, node.name)
+                if node.name in extra_used:
+                    avail -= extra_used[node.name]
+                if node.name in freed:
+                    avail += freed[node.name]
+                need_cpu, need_mem = req.cpu - avail.cpu, req.memory - avail.memory
+                victims: list[Pod] = []
+                got = PodResources()
+                for q in pods_on.get(node.name, []):  # priority ascending
+                    if got.cpu >= need_cpu and got.memory >= need_mem:
+                        break
+                    if _pod_priority(q) >= prio:
+                        break  # sorted: everything after is also ineligible
+                    victims.append(q)
+                    got += total_pod_resources(q)
+                if got.cpu >= need_cpu and got.memory >= need_mem:
+                    key = (_pod_priority(victims[-1]) if victims else -(2**31), len(victims))
+                    if best_key is None or key < best_key:
+                        best, best_key = (node, victims), key
+            if best is None:
+                continue
+            node, victims = best
+            evict_failed = False
+            for q in victims:
+                try:
+                    self.api.delete_pod(q.metadata.namespace or "default", q.metadata.name)
+                except ApiError as e:
+                    logger.warning("preemption eviction of %s failed: %s", full_name(q), e)
+                    evict_failed = True
+                    break
+                pods_on[node.name].remove(q)
+                f = freed.setdefault(node.name, PodResources())
+                f += total_pod_resources(q)
+                victims_total += 1
+                self.metrics.inc("scheduler_preemption_victims_total")
+            if evict_failed:
+                continue  # freed capacity stays accounted; preemptor retries next cycle
+            if self._bind(pod.metadata.namespace or "default", pod.metadata.name, node.name):
+                bound += 1
+                self.metrics.inc("scheduler_preemptions_total")
+                placed_overlay.append((pod, node))
+                self._cycle_placed.append((pod, node))
+                u = extra_used.setdefault(node.name, PodResources())
+                u += req
+            elif victims:
+                # Victims are already gone but the bind failed: clear the
+                # backoff so the preemptor contends for the freed capacity
+                # in the very next cycle (its priority wins the auction) —
+                # the nominatedNodeName reservation, approximated.
+                self.requeue_at.pop(full_name(pod), None)
+                self.metrics.inc("scheduler_preemption_bind_failures_total")
+                logger.warning(
+                    "preemptor %s failed to bind after %d evictions; retrying next cycle", full_name(pod), len(victims)
+                )
+        return bound, victims_total
 
     # -- sample policy (reference main.rs:49-71) ---------------------------
 
@@ -711,7 +840,7 @@ class Scheduler:
         for pod in pending:
             node = self._select_node_sample(pod, snapshot, ledger, placed)
             if node is None:
-                self._requeue(full_name(pod), NoNodeFound("no feasible node this cycle"))
+                self._mark_unschedulable(full_name(pod))
                 unschedulable += 1
                 continue
             if self._bind(pod.metadata.namespace or "default", pod.metadata.name, node.name):
@@ -719,12 +848,15 @@ class Scheduler:
                 committed = ledger.setdefault(node.name, PodResources())
                 committed += total_pod_resources(pod)
                 placed.append((pod, node))
+                self._cycle_placed.append((pod, node))
         return bound, unschedulable
 
     # -- the loop ----------------------------------------------------------
 
     def run_cycle(self) -> CycleMetrics:
         t0 = time.perf_counter()
+        self._cycle_unschedulable = []
+        self._cycle_placed = []
         trace = Trace()
         with trace:
             with span("sync"):
@@ -768,6 +900,11 @@ class Scheduler:
                 else:
                     bound, unsched = self._run_sample_cycle(cycle_snapshot, pending)
                     rounds = self.attempts
+                if self.profile.preemption and self._cycle_unschedulable:
+                    with span("preempt"):
+                        p_bound, _victims = self._attempt_preemption(cycle_snapshot)
+                    bound += p_bound
+                    unsched -= p_bound
             else:
                 bound, unsched, rounds = 0, 0, 0
 

@@ -114,6 +114,24 @@ class HttpApiServer:
                 except ApiError as e:
                     self._send_json(e.code, {"message": str(e)})
 
+            def do_DELETE(self):
+                # /api/v1/namespaces/{ns}/pods/{name} — the eviction path
+                # preemption drives (kube's eviction subresource, simplified
+                # to an immediate delete: the fake cluster has no kubelet
+                # grace period to model).
+                parts = urlparse(self.path).path.strip("/").split("/")
+                if outer.api is None:
+                    self._send_json(503, {"message": "metrics-only server: no cluster state here"})
+                    return
+                if len(parts) == 6 and parts[:3] == ["api", "v1", "namespaces"] and parts[4] == "pods":
+                    try:
+                        outer.api.delete_pod(parts[3], parts[5])
+                        self._send_json(200, {"kind": "Status", "status": "Success"})
+                    except ApiError as e:
+                        self._send_json(e.code, {"message": str(e)})
+                else:
+                    self._send_json(404, {"message": f"not found: {self.path}"})
+
             def do_POST(self):
                 parsed = urlparse(self.path)
                 parts = parsed.path.strip("/").split("/")
@@ -333,6 +351,12 @@ class KubeApiClient:
         if code not in (200, 201):
             raise ApiError(code, resp.get("message", "binding rejected"))
 
+    def delete_pod(self, namespace: str, name: str) -> None:
+        """Evict a pod (preemption path)."""
+        code, resp = self._request_json("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+        if code != 200:
+            raise ApiError(code, resp.get("message", "delete failed"))
+
     def healthz(self) -> bool:
         try:
             code, _ = self._request("GET", "/healthz")
@@ -483,3 +507,6 @@ class RemoteApiAdapter:
 
     def create_binding(self, namespace: str, pod_name: str, target: ObjectReference) -> None:
         self.client.create_binding(namespace, pod_name, target)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.client.delete_pod(namespace, name)
