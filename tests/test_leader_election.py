@@ -1,0 +1,129 @@
+"""Lease-based leader election (SURVEY.md §5 — the reference has none):
+only the lease holder schedules; standbys keep warm caches and take over
+within the lease TTL of the leader vanishing, or immediately on clean
+hand-off."""
+
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _cluster(api, pods=6):
+    api.load(
+        nodes=[make_node(f"n{i}", cpu="8", memory="32Gi") for i in range(2)],
+        pods=[make_pod(f"p{i}") for i in range(pods)],
+    )
+
+
+def test_only_leader_schedules():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    _cluster(api)
+    s1 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s1", clock=clock)
+    s2 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s2", clock=clock)
+    m1 = s1.run_cycle()  # acquires the lease, schedules
+    m2 = s2.run_cycle()  # standby: lease held
+    assert s1.is_leader and not s2.is_leader
+    assert m1.bound == 6 and m2.bound == 0
+    assert s1.metrics.snapshot()["scheduler_leadership_acquisitions_total"] == 1
+    assert "scheduler_leadership_acquisitions_total" not in s2.metrics.snapshot()
+
+
+def test_standby_takes_over_after_lease_expiry():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    _cluster(api, pods=2)
+    s1 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s1", clock=clock, lease_duration=15.0)
+    s2 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s2", clock=clock, lease_duration=15.0)
+    s1.run_cycle()
+    assert s1.is_leader
+    # Leader dies silently (stops renewing); lease not yet expired.
+    clock.t += 10.0
+    api.create_pod(make_pod("late-1"))
+    m = s2.run_cycle()
+    assert not s2.is_leader and m.bound == 0
+    # Past the TTL the standby wins the CAS and schedules the backlog.
+    clock.t += 6.0
+    m = s2.run_cycle()
+    assert s2.is_leader and m.bound == 1
+
+
+def test_clean_handoff_on_close():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    _cluster(api, pods=2)
+    s1 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s1", clock=clock)
+    s2 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s2", clock=clock)
+    s1.run_cycle()
+    s1.close()  # releases the lease — no TTL wait
+    api.create_pod(make_pod("late-1"))
+    m = s2.run_cycle()
+    assert s2.is_leader and m.bound == 1
+
+
+def test_leader_renews_by_scheduling():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    _cluster(api, pods=2)
+    s1 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s1", clock=clock, lease_duration=15.0)
+    s2 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s2", clock=clock, lease_duration=15.0)
+    for _ in range(4):  # each cycle renews; 4 x 10s > TTL but never lapses
+        s1.run_cycle()
+        clock.t += 10.0
+        s2.run_cycle()
+    assert s1.is_leader and not s2.is_leader
+
+
+def test_lease_failure_fails_safe():
+    """If the lease endpoint is unreachable, the scheduler must STAND BY —
+    an ex-leader that cannot prove leadership never schedules."""
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    _cluster(api, pods=2)
+
+    s1 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s1", clock=clock)
+    s1.run_cycle()
+    assert s1.is_leader
+
+    from tpu_scheduler.runtime.fake_api import ApiError
+
+    orig = api.acquire_lease
+    api.acquire_lease = lambda *a, **k: (_ for _ in ()).throw(ApiError(503, "lease backend down"))
+    try:
+        api.create_pod(make_pod("late-1"))
+        m = s1.run_cycle()
+    finally:
+        api.acquire_lease = orig
+    assert not s1.is_leader and m.bound == 0
+
+
+def test_leader_election_over_http():
+    from tpu_scheduler.runtime.http_api import HttpApiServer, KubeApiClient, RemoteApiAdapter
+
+    api = FakeApiServer()
+    server = HttpApiServer(api).start()
+    try:
+        _cluster(api, pods=4)
+        a1 = RemoteApiAdapter(KubeApiClient(server.base_url))
+        a2 = RemoteApiAdapter(KubeApiClient(server.base_url))
+        s1 = Scheduler(a1, NativeBackend(), leader_elect=True, identity="s1")
+        s2 = Scheduler(a2, NativeBackend(), leader_elect=True, identity="s2")
+        m1 = s1.run_cycle()
+        m2 = s2.run_cycle()
+        assert s1.is_leader and not s2.is_leader
+        assert m1.bound == 4 and m2.bound == 0
+        s1.close()  # release over HTTP
+        api.create_pod(make_pod("late-1"))
+        m = s2.run_cycle()
+        assert s2.is_leader and m.bound == 1
+    finally:
+        server.stop()

@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--policy", choices=["batch", "sample"], default="batch", help="batched cycle vs reference-style per-pod random sampling")
     p.add_argument("--profile", choices=sorted(PROFILES), default="default", help="scoring profile")
+    p.add_argument("--leader-elect", action="store_true", help="lease-based leader election: only the lease holder schedules; standbys keep caches warm and take over on leader loss")
+    p.add_argument("--lease-name", default="tpu-scheduler", help="leader-election lease name")
+    p.add_argument("--lease-duration", type=float, default=15.0, help="leader-election lease TTL (seconds)")
+    p.add_argument("--identity", default=None, help="leader-election holder identity (default: derived from pid)")
     p.add_argument(
         "--preemption",
         action="store_true",
@@ -123,6 +127,10 @@ def main(argv: list[str] | None = None) -> int:
         requeue_seconds=args.requeue_seconds,
         fallback_backend=fallback,
         pipeline=args.pipeline,
+        leader_elect=args.leader_elect,
+        identity=args.identity,
+        lease_name=args.lease_name,
+        lease_duration=args.lease_duration,
     )
 
     if args.checkpoint_dir:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import http.client
 import logging
+import os
 import random
 import threading
 import time
@@ -85,6 +86,10 @@ class Scheduler:
         pod_block: int = 128,
         node_block: int = 128,
         pipeline: bool = False,
+        leader_elect: bool = False,
+        identity: str | None = None,
+        lease_name: str = "tpu-scheduler",
+        lease_duration: float = 15.0,
     ):
         if policy not in ("batch", "sample"):
             raise ValueError(f"unknown policy {policy!r} (expected 'batch' or 'sample')")
@@ -122,6 +127,15 @@ class Scheduler:
         self._bind_queue = None
         self._bind_inflight: tuple[list, threading.Event] | None = None
         self._cycle_unschedulable: list[str] = []  # this cycle's no-node pods
+        # Leader election (SURVEY.md §5 — the reference has none): only the
+        # lease holder schedules; standbys keep their reflector caches warm
+        # and take over within lease_duration of the leader vanishing.
+        self.leader_elect = leader_elect
+        self.identity = identity or f"sched-{os.getpid()}-{id(self):x}"
+        self.lease_name = lease_name
+        self.lease_duration = lease_duration
+        self.is_leader = not leader_elect
+        self._renew_stop: threading.Event | None = None
         # This cycle's successful (or dispatched) placements — the capacity
         # the preemption pass must see on top of the pre-cycle snapshot.
         self._cycle_placed: list[tuple[Pod, Node]] = []
@@ -876,13 +890,36 @@ class Scheduler:
                 if self._bind_inflight is not None and self._bind_inflight[1].is_set():
                     self._join_binds()
                 snapshot = self._prune_and_overlay_assumed(snapshot)
-            pending_all = snapshot.pending_pods()
-            pending = self._eligible(pending_all)
-            # Prune requeue backoffs for pods that no longer exist / are no
-            # longer pending (deleted, or bound out-of-band).
-            pending_names = {full_name(p) for p in pending_all}
-            for gone in [k for k in self.requeue_at if k not in pending_names]:
-                del self.requeue_at[gone]
+            if self.leader_elect:
+                was = self.is_leader
+                try:
+                    self.is_leader = self.api.acquire_lease(self.lease_name, self.identity, self.lease_duration)
+                except (ApiError, OSError, http.client.HTTPException) as e:
+                    # Can't reach the lease: fail SAFE — never schedule
+                    # without proof of leadership (a partitioned ex-leader
+                    # double-scheduling is the failure this exists to stop).
+                    logger.warning("lease acquire failed (%s); standing by", e)
+                    self.is_leader = False
+                if self.is_leader and not was:
+                    self.metrics.inc("scheduler_leadership_acquisitions_total")
+                    logger.info("acquired leadership lease %s as %s", self.lease_name, self.identity)
+                if self.is_leader:
+                    self._ensure_renewal_thread()
+            if self.leader_elect and not self.is_leader:
+                # Standby: the reflector cache above stays warm (fast
+                # takeover); scheduling is the leader's alone.  Local state
+                # (requeue backoffs) is NOT pruned on standby cycles — a
+                # transient lease failure must not wipe the backoff ledger.
+                pending_all = []
+                pending = []
+            else:
+                pending_all = snapshot.pending_pods()
+                pending = self._eligible(pending_all)
+                # Prune requeue backoffs for pods that no longer exist / are
+                # no longer pending (deleted, or bound out-of-band).
+                pending_names = {full_name(p) for p in pending_all}
+                for gone in [k for k in self.requeue_at if k not in pending_names]:
+                    del self.requeue_at[gone]
             if pending:
                 # Schedule only eligible pods; bound pods — including
                 # bound-but-still-Pending ones (kubelet lag) — count capacity.
@@ -977,6 +1014,11 @@ class Scheduler:
                     else:
                         sleep(daemon_interval)
             elif until_settled and m.bound == 0:
+                if self.leader_elect and not self.is_leader:
+                    # A standby is never "settled" — it is waiting for
+                    # leadership; idle a renewal interval and try again.
+                    sleep(min(1.0, self.lease_duration / 3.0))
+                    continue
                 if self.pipeline and (self._bind_inflight is not None or self._assumed) and flush_tries < 8:
                     # In-flight/unconfirmed binds: fold their outcomes and
                     # run another cycle so failures requeue before settling
@@ -1002,11 +1044,42 @@ class Scheduler:
                 flush_tries = 0
         return out
 
+    def _ensure_renewal_thread(self) -> None:
+        """Kube-style background lease renewal at TTL/3: a cycle longer than
+        the lease (pack+solve on a big cluster) must not let the lease lapse
+        mid-cycle — the standby would win the CAS while this leader is still
+        binding (split brain).  Renewal failure drops leadership so the next
+        cycle stands down."""
+        if self._renew_stop is not None:
+            return
+        self._renew_stop = threading.Event()
+
+        def renew():
+            while not self._renew_stop.wait(self.lease_duration / 3.0):
+                if not self.is_leader:
+                    continue
+                try:
+                    if not self.api.acquire_lease(self.lease_name, self.identity, self.lease_duration):
+                        self.is_leader = False
+                except (ApiError, OSError, http.client.HTTPException):
+                    self.is_leader = False
+
+        threading.Thread(target=renew, daemon=True).start()
+
     def close(self) -> None:
-        """Release pipeline resources: drain the in-flight bind batch and
-        stop the bind worker (its thread-local API connection dies with it).
-        Idempotent; a Scheduler without pipeline mode has nothing to do."""
+        """Release pipeline resources (drain the in-flight bind batch, stop
+        the bind worker) and hand off leadership (standbys take over
+        immediately instead of waiting out the lease).  Idempotent."""
         self._join_binds()
+        if self._renew_stop is not None:
+            self._renew_stop.set()
+            self._renew_stop = None
         if self._bind_queue is not None:
             self._bind_queue.put(None)  # worker-loop shutdown sentinel
             self._bind_queue = None
+        if self.leader_elect and self.is_leader:
+            try:
+                self.api.release_lease(self.lease_name, self.identity)
+            except (ApiError, OSError, http.client.HTTPException):
+                pass  # the lease expires on its own
+            self.is_leader = False

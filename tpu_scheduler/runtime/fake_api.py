@@ -20,6 +20,7 @@ Capabilities (matching what the reference consumes from kube):
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, replace
 from typing import Callable, Iterable
@@ -89,7 +90,8 @@ class Watch:
 
 
 class FakeApiServer:
-    def __init__(self, watch_history: int = 1 << 18):
+    def __init__(self, watch_history: int = 1 << 18, clock=None):
+        self._clock = clock or time.monotonic
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
         self._pods: dict[tuple[str, str], Pod] = {}  # (namespace, name)
@@ -105,6 +107,11 @@ class FakeApiServer:
         self._events_log: list[tuple[int, str, WatchEvent, Pod | Node | None]] = []
         self._watch_history = watch_history
         self._events_cv = threading.Condition(self._lock)
+        # Leader-election leases (coordination.k8s.io Lease, simplified to a
+        # compare-and-swap acquire RPC): name -> {holder, expires}.  The
+        # SERVER's clock arbitrates — competing schedulers on different
+        # machines cannot agree on anything else.
+        self._leases: dict[str, dict] = {}
         # Fault injection: number of upcoming binding calls to fail with 500.
         self.fail_next_bindings = 0
         self.binding_count = 0
@@ -143,10 +150,9 @@ class FakeApiServer:
         retained history — the client's cue to relist.
         """
         import bisect
-        import time as _time
 
         match = _field_selector_fn(field_selector)
-        deadline = _time.monotonic() + timeout
+        deadline = time.monotonic() + timeout
         with self._events_cv:
             while True:
                 oldest = self._events_log[0][0] if self._events_log else self._rv + 1
@@ -163,7 +169,7 @@ class FakeApiServer:
                         out.append(WatchEvent("DELETED", ev.object))
                 if out or timeout <= 0:
                     return out, self._rv
-                remaining = deadline - _time.monotonic()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return [], self._rv
                 self._events_cv.wait(remaining)
@@ -283,6 +289,33 @@ class FakeApiServer:
             self._bump(bound)
             self._pods[(namespace, pod_name)] = bound
             self._emit("Pod", WatchEvent("MODIFIED", bound), prev=pod)
+
+    # -- leader election (coordination.k8s.io Lease, simplified) -----------
+
+    def acquire_lease(self, name: str, holder: str, duration_seconds: float) -> bool:
+        """Atomically acquire or renew a lease: succeeds when unheld,
+        expired, or already held by ``holder``.  Returns True on success —
+        the holder is leader until ``duration_seconds`` from now unless it
+        renews first (kube leader-election semantics)."""
+        with self._lock:
+            now = self._clock()
+            lease = self._leases.get(name)
+            if lease is None or lease["holder"] == holder or now >= lease["expires"]:
+                self._leases[name] = {"holder": holder, "expires": now + duration_seconds}
+                return True
+            return False
+
+    def release_lease(self, name: str, holder: str) -> None:
+        """Voluntary hand-off (clean shutdown): only the holder may release."""
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is not None and lease["holder"] == holder:
+                del self._leases[name]
+
+    def get_lease(self, name: str) -> dict | None:
+        with self._lock:
+            lease = self._leases.get(name)
+            return dict(lease) if lease is not None else None
 
     # -- bulk helpers for synthetic clusters -------------------------------
 

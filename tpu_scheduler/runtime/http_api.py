@@ -144,6 +144,33 @@ class HttpApiServer:
                 if outer.api is None:
                     self._send_json(503, {"message": "metrics-only server: no cluster state here"})
                     return
+                # /apis/coordination.k8s.io/v1/leases/{name}/acquire|release —
+                # leader election (simplified Lease CAS; server clock rules).
+                if len(parts) == 5 and parts[:3] == ["apis", "coordination.k8s.io", "v1"] and parts[3] == "leases":
+                    self._send_json(404, {"message": "lease verbs are /leases/{name}/(acquire|release)"})
+                    return
+                if len(parts) == 6 and parts[:3] == ["apis", "coordination.k8s.io", "v1"] and parts[3] == "leases":
+                    name, verb = parts[4], parts[5]
+                    holder = body.get("holderIdentity", "")
+                    if verb == "acquire":
+                        try:
+                            duration = float(body.get("leaseDurationSeconds", 15))
+                        except (TypeError, ValueError):
+                            duration = -1.0
+                        if duration <= 0:
+                            self._send_json(400, {"message": "leaseDurationSeconds must be a positive number"})
+                            return
+                        ok = outer.api.acquire_lease(name, holder, duration)
+                        if ok:
+                            self._send_json(200, {"kind": "Lease", "acquired": True})
+                        else:
+                            self._send_json(409, {"message": f"lease {name} held", "acquired": False})
+                    elif verb == "release":
+                        outer.api.release_lease(name, holder)
+                        self._send_json(200, {"kind": "Status", "status": "Success"})
+                    else:
+                        self._send_json(404, {"message": f"unknown lease verb {verb!r}"})
+                    return
                 # /api/v1/namespaces/{ns}/pods/{name}/binding  (main.rs:94-109)
                 if (
                     len(parts) == 7
@@ -357,6 +384,22 @@ class KubeApiClient:
         if code != 200:
             raise ApiError(code, resp.get("message", "delete failed"))
 
+    def acquire_lease(self, name: str, holder: str, duration_seconds: float) -> bool:
+        body = {"holderIdentity": holder, "leaseDurationSeconds": duration_seconds}
+        code, resp = self._request_json("POST", f"/apis/coordination.k8s.io/v1/leases/{name}/acquire", body)
+        if code == 200:
+            return True
+        if code == 409:
+            return False
+        raise ApiError(code, resp.get("message", "lease acquire failed"))
+
+    def release_lease(self, name: str, holder: str) -> None:
+        code, resp = self._request_json(
+            "POST", f"/apis/coordination.k8s.io/v1/leases/{name}/release", {"holderIdentity": holder}
+        )
+        if code != 200:
+            raise ApiError(code, resp.get("message", "lease release failed"))
+
     def healthz(self) -> bool:
         try:
             code, _ = self._request("GET", "/healthz")
@@ -510,3 +553,9 @@ class RemoteApiAdapter:
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self.client.delete_pod(namespace, name)
+
+    def acquire_lease(self, name: str, holder: str, duration_seconds: float) -> bool:
+        return self.client.acquire_lease(name, holder, duration_seconds)
+
+    def release_lease(self, name: str, holder: str) -> None:
+        self.client.release_lease(name, holder)
