@@ -1,0 +1,158 @@
+"""Gang (coscheduling) admission: pods sharing spec.gang bind all-or-nothing
+within a cycle — the TPU training-job shape (runtime/controller.py
+_solve_gang_aware)."""
+
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+
+def test_complete_gang_binds():
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node(f"n{i}", cpu="8", memory="32Gi") for i in range(2)],
+        pods=[make_pod(f"w{i}", cpu="1", memory="1Gi", gang="job-1") for i in range(4)],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 4 and m.unschedulable == 0
+    assert sched.metrics.snapshot()["scheduler_gangs_admitted_total"] == 1
+
+
+def test_partial_gang_binds_nothing():
+    """Capacity for 3 of 4 members: the whole gang must stay pending."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="3", memory="32Gi")],
+        pods=[make_pod(f"w{i}", cpu="1", memory="1Gi", gang="job-1") for i in range(4)],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 0 and m.unschedulable == 4
+    assert all(p.spec.node_name is None for p in api.list_pods())
+    assert sched.metrics.snapshot()["scheduler_gang_rejections_total"] == 1
+
+
+def test_gang_admits_when_capacity_arrives():
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="3", memory="32Gi")],
+        pods=[make_pod(f"w{i}", cpu="1", memory="1Gi", gang="job-1") for i in range(4)],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    sched.run_cycle()
+    api.create_node(make_node("n2", cpu="3", memory="32Gi"))
+    m = sched.run_cycle()
+    assert m.bound == 4
+    assert all(p.spec.node_name is not None for p in api.list_pods())
+
+
+def test_gang_does_not_block_independent_pods():
+    """An incomplete gang requeues whole; unrelated pods in the same cycle
+    still bind (and the capacity the gang momentarily held is reclaimed by
+    the next cycle)."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="4", memory="32Gi")],
+        pods=[make_pod(f"w{i}", cpu="2", memory="1Gi", gang="job-1", priority=5) for i in range(3)]
+        + [make_pod("solo", cpu="1", memory="1Gi")],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    # gang needs 6 cores, node has 4 -> gang requeues whole; solo binds
+    # (this cycle or next — the auction may have ceded its capacity view).
+    sched.run(until_settled=True, max_cycles=4)
+    placed = {p.metadata.name for p in api.list_pods() if p.spec.node_name}
+    assert placed == {"solo"}
+    assert m.unschedulable >= 1
+
+
+def test_pipelined_gang_filtering():
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="3", memory="32Gi")],
+        pods=[make_pod(f"w{i}", cpu="1", memory="1Gi", gang="job-1") for i in range(4)],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, pipeline=True)
+    sched.run(until_settled=True, max_cycles=4)
+    assert all(p.spec.node_name is None for p in api.list_pods())
+    assert sched._assumed == {}  # nothing dispatched for the rejected gang
+
+
+def test_synth_gangs_schedule_atomically():
+    snap = synth_cluster(n_nodes=16, n_pending=80, n_bound=16, seed=4, gang_fraction=0.3)
+    gangs: dict[str, int] = {}
+    for p in snap.pending_pods():
+        if p.spec.gang:
+            gangs[p.spec.gang] = gangs.get(p.spec.gang, 0) + 1
+    assert gangs and max(gangs.values()) >= 2
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    sched.run(until_settled=True, max_cycles=6)
+    # Atomicity invariant: every gang is fully placed or fully pending.
+    placed = {p.metadata.name for p in api.list_pods() if p.spec.node_name}
+    for g, size in gangs.items():
+        members = [p.metadata.name for p in snap.pending_pods() if p.spec.gang == g]
+        n_placed = sum(1 for m in members if m in placed)
+        assert n_placed in (0, size), (g, n_placed, size)
+
+
+def test_gang_split_across_pools_requeues_whole():
+    """Cycle-wide membership: a gang whose members pin DIFFERENT pools can
+    never look complete to any one pool shard — both halves requeue (no
+    partial placement), exactly the atomicity contract."""
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+
+    api = FakeApiServer()
+    api.load(
+        nodes=[
+            make_node("a1", cpu="8", memory="32Gi", labels={"pool": "a"}),
+            make_node("b1", cpu="8", memory="32Gi", labels={"pool": "b"}),
+        ],
+        pods=[
+            make_pod("g-a", cpu="1", memory="1Gi", gang="split", node_selector={"pool": "a"}),
+            make_pod("g-b", cpu="1", memory="1Gi", gang="split", node_selector={"pool": "b"}),
+            make_pod("solo-a", cpu="1", memory="1Gi", node_selector={"pool": "a"}),
+            make_pod("solo-b", cpu="1", memory="1Gi", node_selector={"pool": "b"}),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=DEFAULT_PROFILE.with_(pool_key="pool"), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    placed = {p.metadata.name for p in api.list_pods() if p.spec.node_name}
+    assert placed == {"solo-a", "solo-b"}  # the split gang placed NOTHING
+    assert m.unschedulable == 2
+
+
+def test_gang_member_never_preempts_individually():
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="4", memory="16Gi")],
+        pods=[
+            make_pod("victim", cpu="4", memory="4Gi", node_name="n1", phase="Running", priority=0),
+            make_pod("g-1", cpu="2", memory="1Gi", gang="j", priority=9),
+            make_pod("g-2", cpu="64", memory="1Gi", gang="j", priority=9),  # can never fit
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=DEFAULT_PROFILE.with_(preemption=True), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 0
+    pods = {p.metadata.name for p in api.list_pods()}
+    assert "victim" in pods  # nothing was evicted for half a gang
+    assert sched.metrics.snapshot().get("scheduler_preemptions_total", 0) == 0
+
+
+def test_sample_policy_refuses_gang_pods():
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="8", memory="32Gi")],
+        pods=[make_pod("g-1", cpu="1", memory="1Gi", gang="j"), make_pod("solo", cpu="1", memory="1Gi")],
+    )
+    sched = Scheduler(api, NativeBackend(), policy="sample", requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 1 and m.unschedulable == 1
+    placed = {p.metadata.name for p in api.list_pods() if p.spec.node_name}
+    assert placed == {"solo"}

@@ -232,6 +232,12 @@ class PodSpec:
     tolerations: list[Toleration] | None = None
     node_affinity: list[NodeSelectorTerm] | None = None  # required terms, ORed
     preferred_node_affinity: list[PreferredSchedulingTerm] | None = None  # soft, weighted
+    # Gang (coscheduling) group: pods sharing a gang name bind all-or-
+    # nothing within a cycle — the TPU-workload shape (a training job's
+    # workers are useless until every one of them places).  Kube expresses
+    # this via the scheduling-sigs PodGroup CRD; here it is a first-class
+    # spec field, serialized as the pod-group label.
+    gang: str | None = None
 
 
 @dataclass
@@ -345,6 +351,7 @@ class Pod:
                 tolerations=tolerations,
                 node_affinity=node_aff,
                 preferred_node_affinity=pref_aff,
+                gang=(meta.get("labels") or {}).get("pod-group.scheduling.sigs.k8s.io") or spec_d.get("schedulingGang"),
             )
         status = PodStatus(phase=d.get("status", {}).get("phase", "Pending"))
         obj_meta = ObjectMeta(
@@ -412,6 +419,8 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
         spec["nodeName"] = pod.spec.node_name
     if pod.spec.priority:
         spec["priority"] = pod.spec.priority
+    if pod.spec.gang:
+        spec["schedulingGang"] = pod.spec.gang
     if pod.spec.tolerations:
         spec["tolerations"] = [
             {

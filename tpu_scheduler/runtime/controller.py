@@ -127,6 +127,7 @@ class Scheduler:
         self._bind_queue = None
         self._bind_inflight: tuple[list, threading.Event] | None = None
         self._cycle_unschedulable: list[str] = []  # this cycle's no-node pods
+        self._cycle_gangs: dict[str, set[str]] = {}  # gang -> CYCLE-wide member names
         # Leader election (SURVEY.md §5 — the reference has none): only the
         # lease holder schedules; standbys keep their reflector caches warm
         # and take over within lease_duration of the leader vanishing.
@@ -366,6 +367,71 @@ class Scheduler:
         spec = replace(pod.spec, node_name=node.name) if pod.spec is not None else PodSpec(node_name=node.name)
         return replace(pod, spec=spec)
 
+    def _solve_gang_aware(self, packed, batch_snapshot: ClusterSnapshot, backend: SchedulingBackend | None = None):
+        """Solve with all-or-nothing gang admission (coscheduling — the
+        TPU-workload shape: a training job's workers are useless until every
+        one places).  A gang whose CYCLE-WIDE members are not all bound by
+        this result is rejected whole; its local pods are masked out and the
+        cycle RE-SOLVES so the capacity the gang briefly held reallocates to
+        other pods in the same cycle (no gang-starves-the-cluster livelock).
+        Rejected members surface as unschedulable (requeue; the gang retries
+        whole).
+
+        Membership comes from the FULL cycle (``self._cycle_gangs``, set in
+        run_cycle), not this batch: a gang split across scheduling scopes
+        (mixed priority segments, per-pool shards, the host constrained
+        fallback) can never look complete to any one scope, so every scope
+        rejects its share and the gang requeues whole — atomicity holds
+        regardless of how the cycle was decomposed."""
+        members = self._cycle_gangs
+        result = self._solve_with_fallback(packed, backend)
+        if not members:
+            return result
+        from ..backends.base import CycleResult
+
+        local_names = {full_name(p) for p in batch_snapshot.pending_pods()}
+        rejected_gangs: set[str] = set()
+        rejected_pods: set[str] = set()
+
+        def incomplete_now():
+            bound_names = {pf for pf, _ in result.bindings}
+            return {g for g, ms in members.items() if g not in rejected_gangs and ms & local_names and not ms <= bound_names}
+
+        for _ in range(4):  # each iteration rejects ≥1 gang; gangs are few
+            incomplete = incomplete_now()
+            if not incomplete:
+                break
+            for g in sorted(incomplete):
+                logger.info("gang %s incomplete; rejecting %d members whole and re-solving", g, len(members[g]))
+                rejected_gangs.add(g)
+                rejected_pods |= members[g] & local_names
+            name_to_row = {nm: i for i, nm in enumerate(packed.pod_names)}
+            pod_valid = packed.pod_valid.copy()
+            for nm in rejected_pods:
+                row = name_to_row.get(nm)
+                if row is not None:
+                    pod_valid[row] = False
+            result = self._solve_with_fallback(replace(packed, pod_valid=pod_valid), backend)
+        # Iteration budget exhausted with gangs still incomplete: reject them
+        # WITHOUT another solve — atomicity is unconditional, the reclaimed
+        # capacity just waits for the next cycle.
+        for g in sorted(incomplete_now()):
+            rejected_gangs.add(g)
+            rejected_pods |= members[g] & local_names
+        for g in sorted(g for g, ms in members.items() if ms & local_names and g not in rejected_gangs):
+            self.metrics.inc("scheduler_gangs_admitted_total")
+        for _g in sorted(rejected_gangs):
+            self.metrics.inc("scheduler_gang_rejections_total")
+        if not rejected_gangs:
+            return result
+        return CycleResult(
+            assigned=result.assigned,  # per-row view of the final solve; bindings below are authoritative
+            bindings=[(pf, n) for pf, n in result.bindings if pf not in rejected_pods],
+            unschedulable=sorted(set(result.unschedulable) | rejected_pods),
+            rounds=result.rounds,
+            stats=result.stats,
+        )
+
     def _solve_with_fallback(self, packed, backend: SchedulingBackend | None = None):
         """backend.schedule with the BackendUnavailable→fallback contract."""
         backend = backend or self.backend
@@ -409,7 +475,7 @@ class Scheduler:
         with span("pack"):
             packed = self._pack(batch_snapshot)
         with span("solve"):
-            result = self._solve_with_fallback(packed)
+            result = self._solve_gang_aware(packed, batch_snapshot)
         self._dispatch_binds(result)
         # Dispatched placements count as this cycle's capacity (the
         # preemption pass and the next cycle's assumed overlay both see it).
@@ -541,7 +607,7 @@ class Scheduler:
             t0 = time.perf_counter()
             packed = pack_snapshot(pool_snap, pod_block=self.pod_block, node_block=self.node_block)
             pack_dt = time.perf_counter() - t0
-            result = self._solve_with_fallback(packed, shard_backends[i])
+            result = self._solve_gang_aware(packed, pool_snap, shard_backends[i])
             return value, pool_snap, result, pack_dt
 
         # The solve span is the fan-out wall clock; per-pool pack time
@@ -604,7 +670,7 @@ class Scheduler:
                     packed = replace(packed, constraints=cons)
                     self.metrics.inc("scheduler_constraint_tensor_cycles_total")
         with span("solve"):
-            result = self._solve_with_fallback(packed)
+            result = self._solve_gang_aware(packed, batch_snapshot)
         with span("bind"):
             bound, unsched = self._bind_result(batch_snapshot, result, placed)
         return bound, unsched, result.rounds
@@ -713,8 +779,16 @@ class Scheduler:
         freed: dict[str, PodResources] = {}  # victims evicted this pass
         bound = victims_total = 0
 
+        # Gang members never preempt individually: evicting victims to host
+        # part of a gang that may never fully place is pure disruption —
+        # all-or-nothing admission stays with the gang-aware solve.
         order = sorted(
-            (by_full[n] for n in self._cycle_unschedulable if n in by_full), key=lambda p: -_pod_priority(p)
+            (
+                by_full[n]
+                for n in self._cycle_unschedulable
+                if n in by_full and not (by_full[n].spec is not None and by_full[n].spec.gang)
+            ),
+            key=lambda p: -_pod_priority(p),
         )
         for pod in order:
             prio = _pod_priority(pod)
@@ -852,6 +926,12 @@ class Scheduler:
         bound = 0
         unschedulable = 0
         for pod in pending:
+            if pod.spec is not None and pod.spec.gang:
+                # The per-pod sample policy cannot express all-or-nothing
+                # admission; refusing beats silently binding half a gang.
+                self._requeue(full_name(pod), "gang pods require the batch policy")
+                unschedulable += 1
+                continue
             node = self._select_node_sample(pod, snapshot, ledger, placed)
             if node is None:
                 self._mark_unschedulable(full_name(pod))
@@ -932,6 +1012,10 @@ class Scheduler:
                         if p.status.phase != "Pending" or is_pod_bound(p) or full_name(p) in eligible_names
                     ],
                 )
+                self._cycle_gangs = {}
+                for p in cycle_snapshot.pending_pods():
+                    if p.spec is not None and p.spec.gang:
+                        self._cycle_gangs.setdefault(p.spec.gang, set()).add(full_name(p))
                 if self.policy == "batch":
                     bound, unsched, rounds = self._run_batch_cycle(cycle_snapshot, trace)
                 else:

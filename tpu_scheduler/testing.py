@@ -67,6 +67,7 @@ def make_pod(
     tolerations: list[Toleration] | None = None,
     node_affinity: list[NodeSelectorTerm] | None = None,
     preferred_node_affinity: list[PreferredSchedulingTerm] | None = None,
+    gang: str | None = None,
 ) -> Pod:
     return Pod(
         metadata=ObjectMeta(name=name, namespace=namespace, labels=labels),
@@ -82,6 +83,7 @@ def make_pod(
             tolerations=tolerations,
             node_affinity=node_affinity,
             preferred_node_affinity=preferred_node_affinity,
+            gang=gang,
         ),
         status=PodStatus(phase=phase),
     )
@@ -102,6 +104,7 @@ def synth_cluster(
     soft_taint_fraction: float = 0.0,
     preferred_affinity_fraction: float = 0.0,
     schedule_anyway_fraction: float = 0.0,
+    gang_fraction: float = 0.0,
 ) -> ClusterSnapshot:
     """Generate a synthetic cluster snapshot.
 
@@ -124,6 +127,9 @@ def synth_cluster(
     ``preferred_affinity_fraction`` of pending pods declare weighted
     preferredDuringScheduling zone/pool terms; ``schedule_anyway_fraction``
     declare a ScheduleAnyway (soft) zone topology-spread constraint.
+
+    ``gang_fraction`` of pending pods join all-or-nothing gangs of 2-4
+    consecutive pods (coscheduling; the TPU training-job shape).
     """
     rng = random.Random(seed)
     if n_nodes == 0:
@@ -159,7 +165,15 @@ def synth_cluster(
                 phase="Running",
             )
         )
+    gang_name = None
+    gang_left = 0
     for i in range(n_pending):
+        gang = None
+        if gang_left > 0:
+            gang, gang_left = gang_name, gang_left - 1
+        elif gang_fraction and rng.random() < gang_fraction:
+            gang_name = f"gang-{i}"
+            gang, gang_left = gang_name, rng.randrange(1, 4)  # 2-4 members total
         selector = None
         if rng.random() < selector_fraction:
             if rng.random() < 0.5:
@@ -254,6 +268,7 @@ def synth_cluster(
             tolerations=tols,
             node_affinity=node_aff,
             preferred_node_affinity=pref_aff,
+            gang=gang,
         )
         if rng.random() < multi_container_fraction:
             pod.spec.containers.append(
