@@ -156,3 +156,64 @@ def test_sample_policy_refuses_gang_pods():
     assert m.bound == 1 and m.unschedulable == 1
     placed = {p.metadata.name for p in api.list_pods() if p.spec.node_name}
     assert placed == {"solo"}
+
+
+def test_gang_member_in_backoff_blocks_the_rest():
+    """A gang member still in requeue backoff makes the gang incomplete for
+    everyone — the eligible members must NOT bind alone (review repro)."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="2", memory="32Gi")],
+        pods=[make_pod(f"w{i}", cpu="1", memory="1Gi", gang="j") for i in range(3)],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=60.0)
+    sched.run_cycle()  # capacity for 2 of 3 -> whole gang rejected, 60s backoff
+    assert all(p.spec.node_name is None for p in api.list_pods())
+    api.create_pod(make_pod("w3", cpu="1", memory="1Gi", gang="j"))  # 4th member arrives
+    m = sched.run_cycle()  # w3 eligible, w0-w2 in backoff: gang still incomplete
+    assert m.bound == 0
+    assert all(p.spec.node_name is None for p in api.list_pods())
+
+
+def test_gang_refused_by_host_constrained_fallback():
+    """UntensorizableConstraints -> host sequential phase: gang pods are
+    refused there (atomicity cannot be expressed), the whole gang requeues."""
+    from tpu_scheduler.api.objects import PodAntiAffinityTerm
+
+    # 130 distinct AA terms exceed MAX_AA_TERMS=128 -> host fallback.
+    nodes = [make_node(f"n{i}", cpu="64", memory="256Gi", labels={"name": f"n{i}"}) for i in range(4)]
+    pods = []
+    for i in range(130):
+        term = [PodAntiAffinityTerm(match_labels={"app": f"a{i}"}, topology_key="name")]
+        pods.append(make_pod(f"c{i}", cpu="100m", memory="64Mi", labels={"app": f"a{i}"}, anti_affinity=term))
+    pods.append(make_pod("g-ok", cpu="100m", memory="64Mi", gang="j"))
+    pods.append(make_pod("g-big", cpu="999", memory="64Mi", gang="j"))  # can never fit
+    api = FakeApiServer()
+    api.load(nodes, pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    sched.run(until_settled=True, max_cycles=4)
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_constraint_host_fallbacks_total", 0) >= 1
+    placed = {p.metadata.name for p in api.list_pods() if p.spec.node_name}
+    assert "g-ok" not in placed and "g-big" not in placed  # atomicity held
+
+
+def test_split_gang_rejection_counted_once_per_cycle():
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+
+    api = FakeApiServer()
+    api.load(
+        nodes=[
+            make_node("a1", cpu="8", memory="32Gi", labels={"pool": "a"}),
+            make_node("b1", cpu="8", memory="32Gi", labels={"pool": "b"}),
+        ],
+        pods=[
+            make_pod("g-a", cpu="1", memory="1Gi", gang="split", node_selector={"pool": "a"}),
+            make_pod("g-b", cpu="64", memory="1Gi", gang="split", node_selector={"pool": "b"}),  # never fits
+            make_pod("x-a", cpu="1", memory="1Gi", node_selector={"pool": "a"}),
+            make_pod("x-b", cpu="1", memory="1Gi", node_selector={"pool": "b"}),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), profile=DEFAULT_PROFILE.with_(pool_key="pool"), requeue_seconds=60.0)
+    sched.run_cycle()
+    assert sched.metrics.snapshot()["scheduler_gang_rejections_total"] == 1  # one gang, one count

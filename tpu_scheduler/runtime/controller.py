@@ -332,6 +332,13 @@ class Scheduler:
         unschedulable = 0
         order = sorted(constrained, key=lambda p: -_pod_priority(p))
         for pod in order:
+            if pod.spec is not None and pod.spec.gang:
+                # The sequential host phase cannot express all-or-nothing
+                # admission (same as the sample policy): refuse — the gang's
+                # other scopes see it incomplete and the whole gang requeues.
+                self._requeue(full_name(pod), "gang pods not supported in the host constrained fallback")
+                unschedulable += 1
+                continue
             # Precompute the pod's affinity/spread state once — the node loop
             # is then O(1) per candidate instead of re-scanning all placements.
             affinity_checker = make_affinity_checker(pod, snapshot, placed)
@@ -418,10 +425,11 @@ class Scheduler:
         for g in sorted(incomplete_now()):
             rejected_gangs.add(g)
             rejected_pods |= members[g] & local_names
-        for g in sorted(g for g, ms in members.items() if ms & local_names and g not in rejected_gangs):
-            self.metrics.inc("scheduler_gangs_admitted_total")
-        for _g in sorted(rejected_gangs):
-            self.metrics.inc("scheduler_gang_rejections_total")
+        # Metrics are counted once per gang per cycle in run_cycle, from
+        # bind outcomes — not here (a split gang passes through several
+        # scopes; an admitted gang can still lose a member to a bind error:
+        # admission-time atomicity does not survive per-member 409s, the
+        # same window kube coscheduling has).
         if not rejected_gangs:
             return result
         return CycleResult(
@@ -1012,8 +1020,12 @@ class Scheduler:
                         if p.status.phase != "Pending" or is_pod_bound(p) or full_name(p) in eligible_names
                     ],
                 )
+                # Gang membership over ALL pending pods — including ones in
+                # requeue backoff (excluded from cycle_snapshot): a gang
+                # with any ineligible member must never look complete to the
+                # eligible subset.
                 self._cycle_gangs = {}
-                for p in cycle_snapshot.pending_pods():
+                for p in pending_all:
                     if p.spec is not None and p.spec.gang:
                         self._cycle_gangs.setdefault(p.spec.gang, set()).add(full_name(p))
                 if self.policy == "batch":
@@ -1026,6 +1038,19 @@ class Scheduler:
                         p_bound, _victims = self._attempt_preemption(cycle_snapshot)
                     bound += p_bound
                     unsched -= p_bound
+                if self._cycle_gangs:
+                    # Gang metrics counted ONCE per gang per cycle, from
+                    # actual bind outcomes (dispatched, in pipeline mode) —
+                    # not per scheduling scope (a split gang would otherwise
+                    # multi-count) and not at admission (a per-member bind
+                    # failure would overcount admissions).
+                    placed_names = {full_name(p) for p, _ in self._cycle_placed}
+                    eligible_names = {full_name(p) for p in pending}
+                    for g, ms in sorted(self._cycle_gangs.items()):
+                        if ms <= placed_names:
+                            self.metrics.inc("scheduler_gangs_admitted_total")
+                        elif ms & eligible_names:
+                            self.metrics.inc("scheduler_gang_rejections_total")
             else:
                 bound, unsched, rounds = 0, 0, 0
 
