@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Microbenchmark: one full-P choose (the auction's hot op) on the real chip,
+jnp vs Pallas at several tile sizes.  Ground truth for kernel tuning."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from tpu_scheduler.models.profiles import PROFILES
+from tpu_scheduler.ops.assign import split_device_arrays, _choose
+from tpu_scheduler.ops.pack import pack_snapshot
+from tpu_scheduler.testing import synth_cluster
+
+P, N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000, int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+
+snap = synth_cluster(n_nodes=N, n_pending=P, n_bound=2 * N, seed=0)
+packed = pack_snapshot(snap, pod_block=8192, node_block=128)
+nodes, pods = split_device_arrays(packed.device_arrays())
+prof = PROFILES["throughput"]
+weights = jnp.asarray(prof.weights(), jnp.float32)
+
+p = pods["pod_req"].shape[0]
+ps = {k: v for k, v in pods.items() if k != "pod_prio"}
+ps["ranks"] = jnp.arange(p, dtype=jnp.uint32)
+ps["active"] = ps.pop("pod_valid")
+avail = nodes["node_avail"]
+n_active = jnp.int32(P)
+
+BLOCK = 8192
+
+
+def timeit(name, fn):
+    r = fn()
+    jax.block_until_ready(r)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = fn()
+        jax.block_until_ready(r)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    pairs = p * avail.shape[0]
+    print(f"{name}: {dt*1e3:.1f} ms  ({pairs/dt/1e9:.2f} Gpair/s)", flush=True)
+    return r
+
+
+@jax.jit
+def jnp_choose(avail, ps, n_active):
+    return _choose(avail, ps, n_active, nodes, weights, BLOCK, use_pallas=False)
+
+
+c_j, h_j = timeit("jnp   block=8192", lambda: jnp_choose(avail, ps, n_active))
+
+from tpu_scheduler.ops import pallas_choose as pc
+
+for pt, nt in [(256, 512), (256, 2048), (512, 1024), (1024, 1024), (128, 4096), (512, 2048), (1024, 2048), (256, 8192)]:
+    def pall(pt=pt, nt=nt):
+        @jax.jit
+        def f(avail, ps, n_active):
+            info = pc.build_node_info(avail, nodes["node_alloc"], nodes["node_valid"])
+            lt, tt = nodes["node_labels"].T, nodes["node_taints"].T
+            at, prt, tst = nodes["node_aff"].T, nodes["node_pref"].T, nodes["node_taints_soft"].T
+            outc = jnp.zeros((p,), jnp.int32)
+            outh = jnp.zeros((p,), bool)
+            for lo in range(0, p, BLOCK):
+                blk = {k: ps[k][lo : lo + BLOCK] for k in ps}
+                c, h = pc.choose_block_pallas(
+                    blk["pod_req"], blk["pod_sel"], blk["pod_sel_count"], blk["pod_ntol"],
+                    blk["pod_aff"], blk["pod_has_aff"], blk["pod_pref_w"], blk["pod_ntol_soft"],
+                    blk["active"], blk["ranks"], info, lt, tt, at, prt, tst, weights,
+                    salt=jnp.int32(0), pod_tile=pt, node_tile=nt,
+                )
+                outc = outc.at[lo : lo + BLOCK].set(c)
+                outh = outh.at[lo : lo + BLOCK].set(h)
+            return outc, outh
+        return f
+
+    try:
+        f = pall()
+        c_p, h_p = timeit(f"pallas pt={pt:4d} nt={nt:4d}", lambda: f(avail, ps, n_active))
+    except Exception as e:  # noqa: BLE001
+        print(f"pallas pt={pt} nt={nt}: FAILED {type(e).__name__}: {str(e)[:200]}", flush=True)

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Bisect the choose kernel's per-pair cost: time stripped-down Pallas
+variants (mask only, +matmuls, +score, +hash, +argmax) at the north-star
+shape to find what eats the cycles."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+P, N = 106_496, 10_240
+L = 8
+BP, TN = 256, 2048
+
+key = jax.random.PRNGKey(0)
+req = jax.random.randint(key, (P, 2), 1, 1000, jnp.int32)
+sel = (jax.random.uniform(key, (P, L)) < 0.2).astype(jnp.float32)
+selc = sel.sum(-1, keepdims=True)
+ranks = jnp.arange(P, dtype=jnp.uint32).reshape(-1, 1)
+info = jnp.concatenate([jax.random.randint(key, (4, N), 500, 100000, jnp.int32), jnp.ones((1, N), jnp.int32), jnp.zeros((3, N), jnp.int32)], 0)
+labels_t = (jax.random.uniform(key, (L, N)) < 0.5).astype(jnp.float32)
+
+
+def make(variant):
+    def kern(req_ref, sel_ref, selc_ref, ranks_ref, info_ref, labels_ref, out_ref, best_ref, bestidx_ref):
+        j = pl.program_id(1)
+        nb = pl.num_programs(1)
+        tn = info_ref.shape[1]
+        f32 = jnp.float32
+
+        @pl.when(j == 0)
+        def _():
+            best_ref[:] = jnp.full_like(best_ref, float("-inf"))
+            bestidx_ref[:] = jnp.zeros_like(bestidx_ref)
+
+        avail = info_ref[0:2, :]
+        alloc = info_ref[2:4, :]
+        req_cpu = req_ref[:, 0:1]
+        req_mem = req_ref[:, 1:2]
+        fit = (req_cpu <= avail[0:1, :]) & (req_mem <= avail[1:2, :])
+        sc = fit.astype(f32)
+        if variant >= 1:  # + selector matmul
+            counts = jnp.dot(sel_ref[:], labels_ref[:], preferred_element_type=f32)
+            sc = sc + jnp.where(counts == selc_ref[:], f32(1.0), f32(0.0))
+        if variant >= 2:  # + least-requested/balanced score (divisions)
+            used_cpu = (alloc[0:1, :] - avail[0:1, :]) + req_cpu
+            used_mem = (alloc[1:2, :] - avail[1:2, :]) + req_mem
+            denom_cpu = jnp.maximum(alloc[0:1, :], 1).astype(f32)
+            denom_mem = jnp.maximum(alloc[1:2, :], 1).astype(f32)
+            frac_cpu = used_cpu.astype(f32) / denom_cpu
+            frac_mem = used_mem.astype(f32) / denom_mem
+            sc = sc + ((f32(1.0) - frac_cpu) + (f32(1.0) - frac_mem)) * f32(50.0)
+            sc = sc + (f32(1.0) - jnp.abs(frac_cpu - frac_mem)) * f32(100.0)
+        if variant >= 3:  # + jitter hash
+            u32 = jnp.uint32
+            node_idx = (j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
+            h = ranks_ref[:].astype(u32) * u32(2654435761) + node_idx * u32(2246822519)
+            h = (h ^ (h >> u32(15))) & u32(0xFFFF)
+            sc = sc + h.astype(jnp.int32).astype(f32) / f32(65536.0)
+        # running argmax across node tiles
+        tile_best = jnp.max(sc, axis=1, keepdims=True)
+        tile_arg = jnp.argmax(sc, axis=1).reshape(-1, 1).astype(jnp.int32) + j * tn
+        improve = tile_best > best_ref[:]
+        bestidx_ref[:] = jnp.where(improve, tile_arg, bestidx_ref[:])
+        best_ref[:] = jnp.where(improve, tile_best, best_ref[:])
+
+        @pl.when(j == nb - 1)
+        def _():
+            out_ref[:] = bestidx_ref[:]
+
+    @jax.jit
+    def run():
+        return pl.pallas_call(
+            kern,
+            grid=(P // BP, N // TN),
+            in_specs=[
+                pl.BlockSpec((BP, 2), lambda i, j: (i, 0)),
+                pl.BlockSpec((BP, L), lambda i, j: (i, 0)),
+                pl.BlockSpec((BP, 1), lambda i, j: (i, 0)),
+                pl.BlockSpec((BP, 1), lambda i, j: (i, 0)),
+                pl.BlockSpec((8, TN), lambda i, j: (0, j)),
+                pl.BlockSpec((L, TN), lambda i, j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((BP, 1), lambda i, j: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((P, 1), jnp.int32),
+            scratch_shapes=[pltpu.VMEM((BP, 1), jnp.float32), pltpu.VMEM((BP, 1), jnp.int32)],
+        )(req, sel, selc, ranks, info, labels_t)
+
+    return run
+
+
+names = ["fit+argmax", "+sel matmul", "+score divs", "+hash"]
+for v in range(4):
+    run = make(v)
+    r = run()
+    jax.block_until_ready(r)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    print(f"variant {v} ({names[v]:12s}): {dt*1e3:6.1f} ms  ({P*N/dt/1e9:.2f} Gpair/s)", flush=True)

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Decisive layout experiment: the choose kernel with pod features packed
+into ONE wide [P, 64] f32 operand (+ one [P, 8] i32), passed as jit
+ARGUMENTS.  If this runs ~50ms where the narrow-operand version runs
+~260ms, the narrow-array relayout is confirmed as the bottleneck."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+P, N = 106_496, 10_240
+BP, TN = 256, 2048
+F = 64  # wide f32 feature width; cols: sel 0:8, ntol 8:16, aff 16:24, prefw 24:32, ntols 32:40, selc 40, hasaff 41
+
+key = jax.random.PRNGKey(0)
+pod_f32 = jnp.zeros((P, F), jnp.float32)
+sel = (jax.random.uniform(key, (P, 8)) < 0.2).astype(jnp.float32)
+pod_f32 = pod_f32.at[:, 0:8].set(sel).at[:, 40].set(sel.sum(-1))
+pod_i32 = jnp.zeros((P, 8), jnp.int32)
+pod_i32 = pod_i32.at[:, 0:2].set(jax.random.randint(key, (P, 2), 1, 1000, jnp.int32))
+pod_i32 = pod_i32.at[:, 2].set(1).at[:, 3].set(jnp.arange(P, dtype=jnp.int32))
+
+info = jnp.concatenate(
+    [jax.random.randint(key, (4, N), 500, 100000, jnp.int32), jnp.ones((1, N), jnp.int32), jnp.zeros((3, N), jnp.int32)], 0
+)
+# Banded node matrix: rows 0:8 labels (others zero) -> dot(pod_f32, band) == sel @ labels
+node_f32 = jnp.zeros((F, N), jnp.float32)
+node_f32 = node_f32.at[0:8, :].set((jax.random.uniform(key, (8, N)) < 0.5).astype(jnp.float32))
+
+
+def kern(req_ref, feat_ref, info_ref, nodef_ref, out_ref, best_ref, bestidx_ref):
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    tn = info_ref.shape[1]
+    f32 = jnp.float32
+
+    @pl.when(j == 0)
+    def _():
+        best_ref[:] = jnp.full_like(best_ref, float("-inf"))
+        bestidx_ref[:] = jnp.zeros_like(bestidx_ref)
+
+    avail = info_ref[0:2, :]
+    alloc = info_ref[2:4, :]
+    req_cpu = req_ref[:, 0:1]
+    req_mem = req_ref[:, 1:2]
+    act = req_ref[:, 2:3]
+    ranks = req_ref[:, 3:4]
+    fit = (req_cpu <= avail[0:1, :]) & (req_mem <= avail[1:2, :])
+    counts = jnp.dot(feat_ref[:], nodef_ref[:], preferred_element_type=f32)
+    selc = feat_ref[:, 40:41]
+    sel_ok = counts == selc
+    mask = fit & sel_ok & (act > 0)
+
+    used_cpu = (alloc[0:1, :] - avail[0:1, :]) + req_cpu
+    used_mem = (alloc[1:2, :] - avail[1:2, :]) + req_mem
+    denom_cpu = jnp.maximum(alloc[0:1, :], 1).astype(f32)
+    denom_mem = jnp.maximum(alloc[1:2, :], 1).astype(f32)
+    frac_cpu = used_cpu.astype(f32) / denom_cpu
+    frac_mem = used_mem.astype(f32) / denom_mem
+    sc = ((f32(1.0) - frac_cpu) + (f32(1.0) - frac_mem)) * f32(50.0)
+    sc = sc + (f32(1.0) - jnp.abs(frac_cpu - frac_mem)) * f32(100.0)
+    u32 = jnp.uint32
+    node_idx = (j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
+    h = ranks.astype(u32) * u32(2654435761) + node_idx * u32(2246822519)
+    h = (h ^ (h >> u32(15))) & u32(0xFFFF)
+    sc = sc + h.astype(jnp.int32).astype(f32) / f32(65536.0)
+    sc = jnp.where(mask, sc, float("-inf"))
+
+    tile_best = jnp.max(sc, axis=1, keepdims=True)
+    tile_arg = jnp.argmax(sc, axis=1).reshape(-1, 1).astype(jnp.int32) + j * tn
+    improve = tile_best > best_ref[:]
+    bestidx_ref[:] = jnp.where(improve, tile_arg, bestidx_ref[:])
+    best_ref[:] = jnp.where(improve, tile_best, best_ref[:])
+
+    @pl.when(j == nb - 1)
+    def _():
+        out_ref[:] = bestidx_ref[:]
+
+
+@jax.jit
+def run(pod_i32, pod_f32, info, node_f32):
+    return pl.pallas_call(
+        kern,
+        grid=(P // BP, N // TN),
+        in_specs=[
+            pl.BlockSpec((BP, 8), lambda i, j: (i, 0)),
+            pl.BlockSpec((BP, F), lambda i, j: (i, 0)),
+            pl.BlockSpec((8, TN), lambda i, j: (0, j)),
+            pl.BlockSpec((F, TN), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((BP, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((P, 1), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((BP, 1), jnp.float32), pltpu.VMEM((BP, 1), jnp.int32)],
+    )(pod_i32, pod_f32, info, node_f32)
+
+
+r = run(pod_i32, pod_f32, info, node_f32)
+jax.block_until_ready(r)
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(pod_i32, pod_f32, info, node_f32))
+    ts.append(time.perf_counter() - t0)
+dt = min(ts)
+print(f"wide-operand kernel (arguments): {dt*1e3:.1f} ms  ({P*N/dt/1e9:.2f} Gpair/s)")

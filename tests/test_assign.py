@@ -188,7 +188,8 @@ def test_epoch_driver_matches_monolithic_constrained():
     assert cons is not None
     packed = replace(packed, constraints=cons)
     rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
-    rt = TpuBackend().schedule(packed, DEFAULT_PROFILE)  # epoch driver inside
-    assert rn.bindings == rt.bindings
-    assert rn.rounds == rt.rounds
-    assert (rn.stats["acc_round"] == rt.stats["acc_round"]).all()
+    for driver in ("monolithic", "epochs"):
+        rt = TpuBackend().schedule(packed, DEFAULT_PROFILE.with_(driver=driver))
+        assert rn.bindings == rt.bindings, driver
+        assert rn.rounds == rt.rounds, driver
+        assert (rn.stats["acc_round"] == rt.stats["acc_round"]).all(), driver

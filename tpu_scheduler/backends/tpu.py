@@ -14,7 +14,7 @@ import numpy as np
 
 from ..errors import BackendUnavailable
 from ..models.profiles import SchedulingProfile
-from ..ops.assign import assign_cycle_epochs, split_device_arrays
+from ..ops.assign import assign_cycle, assign_cycle_epochs, split_device_arrays
 from ..ops.pack import PackedCluster
 from .base import SchedulingBackend
 
@@ -65,10 +65,13 @@ class TpuBackend(SchedulingBackend):
             pods.update({k: jax.device_put(v, self.device) for k, v in cons.pod_arrays().items()})
             cmeta = {k: jax.device_put(v, self.device) for k, v in cons.meta_arrays().items()}
             cstate = {k: jax.device_put(v, self.device) for k, v in cons.state_arrays().items()}
-        # The epoch driver: identical math to assign_cycle, with the pod
-        # arrays re-sliced along a halving chain as actives decay, so the
-        # per-round accept cost tracks the live pod count (ops/assign.py).
-        assigned, rounds, _avail, acc_round, rank_of = assign_cycle_epochs(
+        # Driver choice (profiles.py `driver`): monolithic keeps the whole
+        # auction in one jit program — one host sync per cycle, no jit-
+        # boundary relayouts — which on the real (tunnelled) chip beats the
+        # epoch driver's smaller per-round sorts by ~4x.  Both drivers are
+        # bit-identical in results (tests/test_assign.py).
+        drive = assign_cycle if profile.driver == "monolithic" else assign_cycle_epochs
+        assigned, rounds, _avail, acc_round, rank_of = drive(
             nodes,
             pods,
             weights,
