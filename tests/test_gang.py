@@ -217,3 +217,32 @@ def test_split_gang_rejection_counted_once_per_cycle():
     sched = Scheduler(api, NativeBackend(), profile=DEFAULT_PROFILE.with_(pool_key="pool"), requeue_seconds=60.0)
     sched.run_cycle()
     assert sched.metrics.snapshot()["scheduler_gang_rejections_total"] == 1  # one gang, one count
+
+
+def test_desynchronized_backoffs_do_not_livelock_the_gang():
+    """Review repro: gang members whose requeue deadlines are desynchronized
+    (a member arrived mid-backoff) must not ping-pong eligibility forever.
+    On gang rejection the whole gang's deadlines are aligned, so the gang
+    becomes eligible as a unit and binds once capacity allows."""
+    now = [0.0]
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="8", memory="32Gi")],
+        pods=[make_pod(f"w{i}", cpu="1", memory="1Gi", gang="j") for i in range(2)],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=60.0, clock=lambda: now[0])
+    # Make the 2-member gang unplaceable first: a blocker eats the node.
+    api.create_pod(make_pod("blocker", cpu="8", memory="1Gi", priority=100))
+    sched.run_cycle()  # blocker binds; gang rejected -> w0/w1 deadline 60
+    assert {p.metadata.name for p in api.list_pods() if p.spec.node_name} == {"blocker"}
+    api.delete_pod("default", "blocker")  # capacity frees up
+    now[0] = 30.0
+    api.create_pod(make_pod("w2", cpu="1", memory="1Gi", gang="j"))  # 3rd member, mid-backoff
+    bound_names = set()
+    for _ in range(40):  # cycle every 10s — shorter than the 60s backoff
+        now[0] += 10.0
+        sched.run_cycle()
+        bound_names = {p.metadata.name for p in api.list_pods() if p.spec.node_name}
+        if bound_names == {"w0", "w1", "w2"}:
+            break
+    assert bound_names == {"w0", "w1", "w2"}, f"gang livelocked; bound={bound_names}"

@@ -1045,12 +1045,25 @@ class Scheduler:
                     # multi-count) and not at admission (a per-member bind
                     # failure would overcount admissions).
                     placed_names = {full_name(p) for p, _ in self._cycle_placed}
-                    eligible_names = {full_name(p) for p in pending}
                     for g, ms in sorted(self._cycle_gangs.items()):
                         if ms <= placed_names:
                             self.metrics.inc("scheduler_gangs_admitted_total")
                         elif ms & eligible_names:
                             self.metrics.inc("scheduler_gang_rejections_total")
+                            # Align the gang's retry deadlines.  Per-member
+                            # backoff resets desynchronize the gang: each
+                            # cycle the eligible subset is rejected (gang
+                            # incomplete) and re-deadlined while the rest
+                            # still wait, so eligibility ping-pongs between
+                            # subsets forever and the gang never binds even
+                            # when capacity exists.  One shared deadline
+                            # (the max — every member's backoff is
+                            # respected) makes the gang eligible as a unit.
+                            deadlines = [self.requeue_at[m] for m in ms if m in self.requeue_at]
+                            if deadlines:
+                                align = max(deadlines)
+                                for m in ms & self.requeue_at.keys():
+                                    self.requeue_at[m] = align
             else:
                 bound, unsched, rounds = 0, 0, 0
 
