@@ -245,6 +245,12 @@ def main() -> int:
 
     jax, devices, platform = init_devices(force_cpu=args.force_cpu)
 
+    from tpu_scheduler.utils.compile_cache import enable_compilation_cache
+
+    cache_dir = enable_compilation_cache()
+    if cache_dir:
+        log(f"compilation cache: {cache_dir}")
+
     from tpu_scheduler.backends.tpu import TpuBackend
 
     backend = TpuBackend()

@@ -59,14 +59,13 @@ class SchedulingBackend(abc.ABC):
         assigned_padded, rounds = result[0], result[1]
         extras = result[2] if len(result) > 2 else {}
         assigned = np.asarray(assigned_padded)[: packed.num_pods]
-        bindings = []
-        unschedulable = []
-        for i, pod_name in enumerate(packed.pod_names):
-            j = int(assigned[i])
-            if j >= 0:
-                bindings.append((pod_name, packed.node_names[j]))
-            else:
-                unschedulable.append(pod_name)
+        # Vectorized binding construction: at 100k pods a Python loop with
+        # per-element int() casts costs ~0.2 s — a third of the whole cycle.
+        pod_arr = np.asarray(packed.pod_names, dtype=object)
+        node_arr = np.asarray(packed.node_names, dtype=object)
+        placed = np.flatnonzero(assigned >= 0)
+        bindings = list(zip(pod_arr[placed].tolist(), node_arr[assigned[placed]].tolist()))
+        unschedulable = pod_arr[np.flatnonzero(assigned < 0)].tolist()
         stats = {"backend": self.name}
         for k, v in extras.items():
             stats[k] = np.asarray(v)[: packed.num_pods]

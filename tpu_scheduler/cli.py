@@ -83,6 +83,11 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     configure_logging(args.log_level)
 
+    if args.backend in ("tpu", "tpu-sharded"):
+        from .utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+
     if args.api_server:
         from .runtime.http_api import KubeApiClient, RemoteApiAdapter
 

@@ -87,11 +87,10 @@ PROFILES: dict[str, SchedulingProfile] = {
     "balanced-only": SchedulingProfile(name="balanced-only", least_requested_weight=0.0),
     # Mass-admission flavour — the flagship benchmark profile: a wider
     # tie-break jitter spreads each auction round's claims across many more
-    # near-tied nodes, cutting rounds ~3x (measured 21 -> 7 at 20k x 2k) at
-    # the cost of ±8 points of scoring noise on the ~200-point
+    # near-tied nodes, cutting rounds (measured 13 -> 9 at 100k x 10k going
+    # 8 -> 32) at the cost of ±32 points of scoring noise on the ~200-point
     # LeastRequested+Balanced scale.  Validity and capacity are exact
-    # regardless (jitter only reorders feasible choices); soft terms
-    # (PreferNoSchedule at 10/violation, weighted preferred affinity) still
-    # dominate the noise.
-    "throughput": SchedulingProfile(name="throughput", spread_jitter=8.0),
+    # regardless (jitter only reorders feasible choices); for score-faithful
+    # placement use the default profile (jitter 0.5).
+    "throughput": SchedulingProfile(name="throughput", spread_jitter=32.0),
 }
