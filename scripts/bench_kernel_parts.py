@@ -2,7 +2,6 @@
 """Bisect the choose kernel's per-pair cost: time stripped-down Pallas
 variants (mask only, +matmuls, +score, +hash, +argmax) at the north-star
 shape to find what eats the cycles."""
-import functools
 import os
 import sys
 import time

@@ -3,7 +3,6 @@
 into ONE wide [P, 64] f32 operand (+ one [P, 8] i32), passed as jit
 ARGUMENTS.  If this runs ~50ms where the narrow-operand version runs
 ~260ms, the narrow-array relayout is confirmed as the bottleneck."""
-import functools
 import os
 import sys
 import time
