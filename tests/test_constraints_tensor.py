@@ -56,8 +56,10 @@ def _replay_validity(snap, packed, result) -> int:
             progressed = False
             remaining = []
             for rank, pod, node in group:
-                if P.anti_affinity_ok(pod, node, snap, extra_placed=placed) and P.topology_spread_ok(
-                    pod, node, snap, extra_placed=placed
+                if (
+                    P.anti_affinity_ok(pod, node, snap, extra_placed=placed)
+                    and P.pod_affinity_ok(pod, node, snap, extra_placed=placed)
+                    and P.topology_spread_ok(pod, node, snap, extra_placed=placed)
                 ):
                     placed.append((pod, node))
                     progressed = True

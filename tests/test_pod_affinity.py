@@ -1,0 +1,226 @@
+"""Positive inter-pod affinity (requiredDuringScheduling co-location) — the
+twin of anti-affinity, absent in the reference (its chain stops at resources
++ nodeSelector, src/predicates.rs:63-77) and in kube expressed via
+affinity.podAffinity.
+
+Semantics under test: a declarer may land only in a topology domain holding
+a pod matched by EVERY declared term; a term matching no placed pod anywhere
+is waived iff the pod matches its own term (bootstrap), else the pod is
+unschedulable; within an auction round only the first accepted match may use
+the waiver (later waived declarers defer one round and then follow it).
+"""
+
+import tpu_scheduler.core.predicates as P
+from tpu_scheduler.api.objects import PodAffinityTerm
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod, synth_cluster
+
+from test_constraints_tensor import _replay_validity, _schedule_both
+
+ZONE_NODES = [
+    make_node(f"n{i}", cpu="8", memory="32Gi", labels={"zone": f"z{i % 3}", "name": f"n{i}"}) for i in range(6)
+]
+CACHE_TERM = [PodAffinityTerm(match_labels={"app": "cache"}, topology_key="zone")]
+
+
+# --- scalar semantics --------------------------------------------------------
+
+
+def test_scalar_requires_matching_domain():
+    snap = ClusterSnapshot.build(
+        ZONE_NODES,
+        [make_pod("cache-0", labels={"app": "cache"}, node_name="n1", phase="Running")],  # zone z1
+    )
+    web = make_pod("web-0", labels={"app": "web"}, pod_affinity=CACHE_TERM)
+    for n in snap.nodes:
+        ok = P.pod_affinity_ok(web, n, snap)
+        assert ok == (n.metadata.labels["zone"] == "z1"), n.name
+
+
+def test_scalar_bootstrap_waiver_needs_self_match():
+    snap = ClusterSnapshot.build(ZONE_NODES, [])
+    selfish = make_pod("cache-0", labels={"app": "cache"}, pod_affinity=CACHE_TERM)
+    stranger = make_pod("web-0", labels={"app": "web"}, pod_affinity=CACHE_TERM)
+    assert all(P.pod_affinity_ok(selfish, n, snap) for n in snap.nodes)  # waived
+    assert not any(P.pod_affinity_ok(stranger, n, snap) for n in snap.nodes)  # unmatchable
+
+
+def test_scalar_namespace_scoped():
+    snap = ClusterSnapshot.build(
+        ZONE_NODES,
+        [make_pod("cache-0", namespace="other", labels={"app": "cache"}, node_name="n1", phase="Running")],
+    )
+    web = make_pod("web-0", namespace="default", labels={"app": "web"}, pod_affinity=CACHE_TERM)
+    assert not any(P.pod_affinity_ok(web, n, snap) for n in snap.nodes)
+
+
+def test_scalar_multiple_terms_anded():
+    snap = ClusterSnapshot.build(
+        ZONE_NODES,
+        [
+            make_pod("cache-0", labels={"app": "cache"}, node_name="n1", phase="Running"),  # z1
+            make_pod("db-0", labels={"app": "db"}, node_name="n4", phase="Running"),  # z1
+            make_pod("db-1", labels={"app": "db"}, node_name="n2", phase="Running"),  # z2
+        ],
+    )
+    both = make_pod(
+        "web-0",
+        labels={"app": "web"},
+        pod_affinity=[
+            PodAffinityTerm(match_labels={"app": "cache"}, topology_key="zone"),
+            PodAffinityTerm(match_labels={"app": "db"}, topology_key="zone"),
+        ],
+    )
+    for n in snap.nodes:
+        assert P.pod_affinity_ok(both, n, snap) == (n.metadata.labels["zone"] == "z1"), n.name
+
+
+# --- tensor path (native xp engine + TPU backend parity) ---------------------
+
+
+def test_declarers_follow_placed_match():
+    """Pods affine to a placed cache pod all land in its zone."""
+    snap = ClusterSnapshot.build(
+        ZONE_NODES,
+        [make_pod("cache-0", labels={"app": "cache"}, node_name="n2", phase="Running")]  # zone z2
+        + [make_pod(f"web-{i}", labels={"app": "web"}, pod_affinity=CACHE_TERM) for i in range(4)],
+    )
+    packed, r = _schedule_both(snap)
+    assert len(r.bindings) == 4
+    node_zone = {n.name: n.metadata.labels["zone"] for n in snap.nodes}
+    assert all(node_zone[nn] == "z2" for _, nn in r.bindings)
+    assert _replay_validity(snap, packed, r) == 0
+
+
+def test_bootstrap_group_colocates():
+    """A self-affine group with no placed match: the first member places by
+    the waiver, the rest follow into the same zone — never split."""
+    pods = [
+        make_pod(f"grp-{i}", labels={"app": "cache"}, pod_affinity=CACHE_TERM, priority=10 - i) for i in range(5)
+    ]
+    snap = ClusterSnapshot.build(ZONE_NODES, pods)
+    packed, r = _schedule_both(snap)
+    assert len(r.bindings) == 5
+    node_zone = {n.name: n.metadata.labels["zone"] for n in snap.nodes}
+    assert len({node_zone[nn] for _, nn in r.bindings}) == 1, "group split across zones"
+    assert _replay_validity(snap, packed, r) == 0
+
+
+def test_unmatchable_declarer_is_unschedulable():
+    snap = ClusterSnapshot.build(
+        ZONE_NODES,
+        [make_pod("web-0", labels={"app": "web"}, pod_affinity=CACHE_TERM)],
+    )
+    packed, r = _schedule_both(snap)
+    assert r.bindings == []
+    assert r.unschedulable == ["default/web-0"]
+
+
+def test_unconstrained_match_activates_term_for_declarer():
+    """A plain pod whose labels match the term (but declares nothing) pins
+    the declarer to wherever it lands — within one cycle."""
+    pods = [
+        make_pod("cache-0", labels={"app": "cache"}, priority=10),  # plain, highest priority
+        make_pod("web-0", labels={"app": "web"}, pod_affinity=CACHE_TERM, priority=1),
+    ]
+    snap = ClusterSnapshot.build(ZONE_NODES, pods)
+    packed, r = _schedule_both(snap)
+    assert len(r.bindings) == 2
+    node_zone = {n.name: n.metadata.labels["zone"] for n in snap.nodes}
+    zones = {p: node_zone[nn] for p, nn in r.bindings}
+    assert zones["default/web-0"] == zones["default/cache-0"]
+    assert _replay_validity(snap, packed, r) == 0
+
+
+def test_keyless_node_is_singleton_domain_for_affinity():
+    """Fine granularity: affinity on the per-node 'name' key means strict
+    co-location on the SAME node."""
+    nodes = [make_node(f"n{i}", cpu="8", memory="32Gi", labels={"name": f"n{i}"}) for i in range(4)]
+    term = [PodAffinityTerm(match_labels={"app": "cache"}, topology_key="name")]
+    snap = ClusterSnapshot.build(
+        nodes,
+        [make_pod("cache-0", labels={"app": "cache"}, node_name="n3", phase="Running")]
+        + [make_pod(f"web-{i}", labels={"app": "web"}, pod_affinity=term) for i in range(3)],
+    )
+    packed, r = _schedule_both(snap)
+    assert len(r.bindings) == 3
+    assert all(nn == "n3" for _, nn in r.bindings)
+    assert _replay_validity(snap, packed, r) == 0
+
+
+def test_synth_pod_affinity_parity_and_validity():
+    for seed in (0, 3, 11):
+        snap = synth_cluster(
+            n_nodes=24,
+            n_pending=150,
+            n_bound=24,
+            seed=seed,
+            pod_affinity_fraction=0.3,
+            anti_affinity_fraction=0.1,
+            spread_fraction=0.1,
+        )
+        packed, r = _schedule_both(snap)
+        assert _replay_validity(snap, packed, r) == 0, f"seed {seed}"
+
+
+def test_scheduler_end_to_end_with_pod_affinity():
+    """Controller path: PA pods are classified constrained, ride the tensor
+    path, and bind co-located."""
+    api = FakeApiServer()
+    snap = ClusterSnapshot.build(
+        ZONE_NODES,
+        [make_pod("cache-0", labels={"app": "cache"}, node_name="n0", phase="Running")]  # z0
+        + [make_pod(f"web-{i}", labels={"app": "web"}, pod_affinity=CACHE_TERM) for i in range(3)],
+    )
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 3
+    node_zone = {n.metadata.name: n.metadata.labels["zone"] for n in api.list_nodes()}
+    for p in api.list_pods():
+        if p.metadata.name.startswith("web-"):
+            assert node_zone[p.spec.node_name] == "z0"
+
+
+def test_round_trip_serialization():
+    from tpu_scheduler.api.objects import Pod, pod_to_dict
+
+    pod = make_pod("web-0", labels={"app": "web"}, pod_affinity=CACHE_TERM)
+    d = pod_to_dict(pod)
+    back = Pod.from_dict(d)
+    assert back.spec.pod_affinity is not None
+    t = back.spec.pod_affinity[0]
+    assert t.match_labels == {"app": "cache"} and t.topology_key == "zone"
+
+
+def test_preemption_respects_pod_affinity():
+    """Review repro: a preemptor with required podAffinity must not evict
+    victims on a node outside its co-location domain — eviction frees
+    capacity but can never conjure a match."""
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+
+    nodes = [
+        make_node("a1", cpu="2", memory="4Gi", labels={"zone": "z1"}),
+        make_node("b1", cpu="2", memory="4Gi", labels={"zone": "z2"}),
+    ]
+    pods = [
+        # the match lives in z1; z1's node is full with a HIGH-priority pod
+        make_pod("cache-0", labels={"app": "cache"}, node_name="a1", phase="Running"),
+        make_pod("hog-z1", cpu="1900m", labels={"app": "hog"}, node_name="a1", phase="Running", priority=100),
+        # z2 is full with a cheap low-priority victim
+        make_pod("victim-z2", cpu="1900m", labels={"app": "v"}, node_name="b1", phase="Running", priority=0),
+        # preemptor: must co-locate with cache (z1), priority high
+        make_pod("web-0", cpu="1500m", labels={"app": "web"}, pod_affinity=CACHE_TERM, priority=50),
+    ]
+    api = FakeApiServer()
+    api.load(nodes, pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, profile=DEFAULT_PROFILE.with_(preemption=True))
+    m = sched.run_cycle()
+    assert m.bound == 0
+    # the z2 victim must NOT have been evicted for a pod that can't live there
+    assert {p.metadata.name for p in api.list_pods()} >= {"victim-z2"}
+    web = next(p for p in api.list_pods() if p.metadata.name == "web-0")
+    assert web.spec.node_name is None

@@ -25,6 +25,7 @@ __all__ = [
     "Container",
     "LabelSelectorRequirement",
     "PodAntiAffinityTerm",
+    "PodAffinityTerm",
     "TopologySpreadConstraint",
     "NodeSelectorTerm",
     "PodSpec",
@@ -121,6 +122,16 @@ class PodAntiAffinityTerm:
     match_labels: dict[str, str] | None = None
     topology_key: str = "kubernetes.io/hostname"
     match_expressions: list[LabelSelectorRequirement] | None = None
+
+
+# Positive inter-pod affinity reuses the same term structure (as Kubernetes'
+# PodAffinityTerm does for both lists): the pod may land ONLY in a topology
+# domain that already holds a pod matched by the selector (every term must be
+# satisfied — terms AND).  Bootstrap rule (kube InterPodAffinity): a term no
+# existing pod matches anywhere is waived iff the incoming pod matches its
+# own term — so the first pod of a self-affine group can place; without
+# self-match the pod is unschedulable until a match appears.
+PodAffinityTerm = PodAntiAffinityTerm
 
 
 @dataclass
@@ -228,6 +239,7 @@ class PodSpec:
     # config 5) — the reference has neither (it stops at resources +
     # nodeSelector, src/predicates.rs:63-77).
     anti_affinity: list[PodAntiAffinityTerm] | None = None
+    pod_affinity: list[PodAntiAffinityTerm] | None = None  # positive co-location twin
     topology_spread: list[TopologySpreadConstraint] | None = None
     tolerations: list[Toleration] | None = None
     node_affinity: list[NodeSelectorTerm] | None = None  # required terms, ORed
@@ -302,6 +314,22 @@ class Pod:
                     )
                     for t in terms
                 ]
+            pod_aff = None
+            aff_terms = (
+                ((spec_d.get("affinity") or {}).get("podAffinity") or {}).get(
+                    "requiredDuringSchedulingIgnoredDuringExecution"
+                )
+                or []
+            )
+            if aff_terms:
+                pod_aff = [
+                    PodAntiAffinityTerm(
+                        match_labels=(t.get("labelSelector") or {}).get("matchLabels"),
+                        topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
+                        match_expressions=parse_expressions(t.get("labelSelector")),
+                    )
+                    for t in aff_terms
+                ]
             spread = None
             constraints = spec_d.get("topologySpreadConstraints") or []
             if constraints:  # hard (DoNotSchedule) and soft (ScheduleAnyway) alike
@@ -347,6 +375,7 @@ class Pod:
                 node_name=spec_d.get("nodeName"),
                 priority=spec_d.get("priority", 0),
                 anti_affinity=anti,
+                pod_affinity=pod_aff,
                 topology_spread=spread,
                 tolerations=tolerations,
                 node_affinity=node_aff,
@@ -441,6 +470,15 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
                 term["labelSelector"] = sel
             terms.append(term)
         affinity["podAntiAffinity"] = {"requiredDuringSchedulingIgnoredDuringExecution": terms}
+    if pod.spec.pod_affinity:
+        terms = []
+        for t in pod.spec.pod_affinity:
+            term = {"topologyKey": t.topology_key}
+            sel = _selector_to_dict(t.match_labels, t.match_expressions)
+            if sel:
+                term["labelSelector"] = sel
+            terms.append(term)
+        affinity["podAffinity"] = {"requiredDuringSchedulingIgnoredDuringExecution": terms}
     if pod.spec.node_affinity or pod.spec.preferred_node_affinity:
         node_affinity: dict[str, Any] = {}
         if pod.spec.node_affinity:

@@ -120,7 +120,14 @@ class NativeBackend(SchedulingBackend):
             dec = np.zeros((n + 1, 2), dtype=np.int64)
             np.add.at(dec, ch, np.where(accepted[:, None], req, 0).astype(np.int64))
             avail = (avail.astype(np.int64) - dec[:n]).astype(np.int32)
+            was_active = active
             active = cand & ~accepted
+            if cons is not None:
+                # Positive-affinity declarers blocked everywhere stay active
+                # while the round placed anyone — a same-round placement can
+                # activate their term (mirrors ops/assign.py exactly).
+                pa_hope = (cpods["pod_pa_declares"].sum(axis=1) > 0) & accepted.any()
+                active = active | (was_active & ~has & pa_hope)
             rounds += 1
 
         out = np.full((p,), -1, dtype=np.int32)

@@ -29,7 +29,9 @@ __all__ = [
     "node_selector_term_matches",
     "HARD_TAINT_EFFECTS",
     "anti_affinity_ok",
+    "pod_affinity_ok",
     "topology_spread_ok",
+    "make_pod_affinity_checker",
     "labels_match_selector",
     "selector_matches",
     "term_matches",
@@ -56,6 +58,7 @@ class InvalidNodeReason(enum.Enum):
     NODE_UNSCHEDULABLE = "NodeUnschedulable"
     TAINT_NOT_TOLERATED = "TaintNotTolerated"
     ANTI_AFFINITY_VIOLATION = "AntiAffinityViolation"
+    POD_AFFINITY_UNSATISFIED = "PodAffinityUnsatisfied"
     TOPOLOGY_SPREAD_VIOLATION = "TopologySpreadViolation"
 
 
@@ -277,6 +280,63 @@ def anti_affinity_ok(
     return make_affinity_checker(pod, snapshot, extra_placed)(node)
 
 
+def make_pod_affinity_checker(
+    pod: Pod,
+    snapshot: ClusterSnapshot,
+    extra_placed: Sequence[tuple[Pod, Node]] = (),
+) -> Callable[[Node], bool]:
+    """Positive inter-pod affinity (requiredDuringScheduling co-location):
+    for EVERY declared term, the candidate node's topology domain must hold
+    a placed pod (same namespace) matched by the term's selector.
+
+    Bootstrap rule, matching kube-scheduler's InterPodAffinity filter: a term
+    that matches *no* placed pod anywhere is waived iff the incoming pod
+    matches its own term — the first pod of a self-affine group can place;
+    a non-self-matching pod with an unmatchable term fails everywhere.
+
+    Unlike anti-affinity there is no symmetric direction: a placed pod's
+    affinity terms do not constrain newcomers.  ``extra_placed`` overlays
+    same-cycle commitments (the sequential host path), which also activate
+    waived terms for later pods in the same cycle.
+    """
+    my_terms = (pod.spec.pod_affinity or []) if pod.spec is not None else []
+    if not my_terms:
+        return lambda node: True
+    my_ns = pod.metadata.namespace
+    # Per term: the set of domains holding a match, or None = waived.
+    term_domains: list[set[tuple[str, str]] | None] = []
+    for t in my_terms:
+        doms: set[tuple[str, str]] = set()
+        for q, qnode in chain(snapshot.placed_pods(), extra_placed):
+            if q.metadata.namespace == my_ns and term_matches(t, q.metadata.labels):
+                doms.add(node_topology_domain(qnode, t.topology_key))
+        if doms:
+            term_domains.append(doms)
+        elif term_matches(t, pod.metadata.labels):
+            term_domains.append(None)  # waived: self-match bootstrap
+        else:
+            return lambda node: False  # unmatchable, no self-match
+
+    def check(node: Node) -> bool:
+        for t, doms in zip(my_terms, term_domains):
+            if doms is not None and node_topology_domain(node, t.topology_key) not in doms:
+                return False
+        return True
+
+    return check
+
+
+def pod_affinity_ok(
+    pod: Pod,
+    node: Node,
+    snapshot: ClusterSnapshot,
+    extra_placed: Sequence[tuple[Pod, Node]] = (),
+) -> bool:
+    """Positive inter-pod affinity predicate — one-shot form of
+    :func:`make_pod_affinity_checker` (see it for semantics)."""
+    return make_pod_affinity_checker(pod, snapshot, extra_placed)(node)
+
+
 def make_spread_checker(
     pod: Pod,
     snapshot: ClusterSnapshot,
@@ -417,6 +477,7 @@ PREDICATE_CHAIN: list[tuple[InvalidNodeReason, Callable[[Pod, Node, ClusterSnaps
     (InvalidNodeReason.NOT_ENOUGH_RESOURCES, pod_fits_resources),
     *NODE_LOCAL_PREDICATES,
     (InvalidNodeReason.ANTI_AFFINITY_VIOLATION, anti_affinity_ok),
+    (InvalidNodeReason.POD_AFFINITY_UNSATISFIED, pod_affinity_ok),
     (InvalidNodeReason.TOPOLOGY_SPREAD_VIOLATION, topology_spread_ok),
 ]
 

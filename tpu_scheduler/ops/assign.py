@@ -77,6 +77,8 @@ _CHOOSE_KEYS = (
 _CONSTRAINT_KEYS = (
     "pod_aa_carries",
     "pod_aa_matched",
+    "pod_pa_declares",
+    "pod_pa_matched",
     "pod_sp_declares",
     "pod_sp_matched",
     "pod_sps_declares",
@@ -315,7 +317,17 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
         ps["acc_round"] = jnp.where(accepted, rounds, ps["acc_round"])
         dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], ps["pod_req"], 0))
         avail = avail - dec[:n]
+        was_active = ps["active"]
         ps["active"] = cand & ~accepted
+        if cmeta is not None:
+            # Positive affinity breaks the "feasibility only shrinks" rule
+            # the no-feasible-node drop-out relies on: a pod placed THIS
+            # round can activate a declarer's term and open nodes for it.
+            # Keep blocked-everywhere PA declarers active while the round
+            # placed anyone (state changed → re-evaluate); a round that
+            # places nobody freezes the state, so stragglers drop then.
+            pa_hope = (ps["pod_pa_declares"].sum(axis=1) > 0) & accepted.any()
+            ps["active"] = ps["active"] | (was_active & ~has & pa_hope)
         ps = _compact(ps)
         return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1, cst
 

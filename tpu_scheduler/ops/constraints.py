@@ -1,4 +1,5 @@
-"""Tensorized inter-pod anti-affinity + topology spread (BASELINE config 5).
+"""Tensorized inter-pod anti-affinity, POSITIVE pod affinity + topology
+spread (BASELINE config 5).
 
 The scalar predicates (core/predicates.py: anti_affinity_ok /
 topology_spread_ok) are pods×pods×nodes relations — the memory wall SURVEY.md
@@ -9,6 +10,14 @@ domain-granular:
 
   AA term vocab T:  distinct (namespace, topology_key, selector) terms among
                     pending + placed pods.
+  PA term vocab Ta: positive (requiredDuringScheduling podAffinity) terms
+                    among PENDING pods only — affinity constrains just the
+                    declarer, so placed pods' terms need no columns.  The
+                    blocked mask is the inverted matched-domain mask, gated
+                    by the bootstrap waiver (a term matching nothing
+                    anywhere is waived for self-matching declarers); the
+                    within-round filter keeps only the first accepted match
+                    per waived term (see constraint_filter).
   Spread vocab S:   distinct (namespace, key, max_skew, selector) constraints
                     among pending pods.
   Coarse domains D: (key, value) pairs over the referenced topology keys —
@@ -131,9 +140,11 @@ class ConstraintSet:
     threads them through its while-loop carry.
     """
 
-    # Pod side [P, T] / [P, S] / [P, Ss] float32
+    # Pod side [P, T] / [P, Ta] / [P, S] / [P, Ss] float32
     pod_aa_carries: np.ndarray
     pod_aa_matched: np.ndarray
+    pod_pa_declares: np.ndarray  # positive affinity: the pod declares term
+    pod_pa_matched: np.ndarray  # the pod's labels satisfy the term's selector
     pod_sp_declares: np.ndarray
     pod_sp_matched: np.ndarray
     pod_sps_declares: np.ndarray  # soft (ScheduleAnyway) spread declarations
@@ -142,6 +153,7 @@ class ConstraintSet:
     node_dom_c: np.ndarray  # [N, D] float32 one-hot (one col per carried key)
     # Term metadata
     term_uses_dom: np.ndarray  # [T, D] float32 — domains of the term's key
+    pa_uses_dom: np.ndarray  # [Ta, D] float32 — positive-affinity term keys
     sp_uses_dom: np.ndarray  # [S, D] float32
     sp_skew: np.ndarray  # [S] float32
     sps_uses_dom: np.ndarray  # [Ss, D] float32 — soft-spread constraint keys
@@ -150,10 +162,13 @@ class ConstraintSet:
     aa_dom_c: np.ndarray  # [T, D] 0/1 — domain holds a carrier of term
     aa_node_m: np.ndarray  # [T, N] 0/1 — fine-granularity (singleton) twin
     aa_node_c: np.ndarray  # [T, N] 0/1
+    pa_dom_m: np.ndarray  # [Ta, D] 0/1 — domain holds a pod matched by PA term
+    pa_node_m: np.ndarray  # [Ta, N] 0/1 — fine-granularity twin
     sp_counts: np.ndarray  # [S, D] float32 — matching placed pods per domain
     sps_counts: np.ndarray  # [Ss, D] float32 — soft-spread matching counts
 
     n_terms: int
+    n_pa_terms: int
     n_spread: int
     n_spread_soft: int
 
@@ -161,6 +176,8 @@ class ConstraintSet:
         return {
             "pod_aa_carries": self.pod_aa_carries,
             "pod_aa_matched": self.pod_aa_matched,
+            "pod_pa_declares": self.pod_pa_declares,
+            "pod_pa_matched": self.pod_pa_matched,
             "pod_sp_declares": self.pod_sp_declares,
             "pod_sp_matched": self.pod_sp_matched,
             "pod_sps_declares": self.pod_sps_declares,
@@ -171,6 +188,7 @@ class ConstraintSet:
         return {
             "node_dom_c": self.node_dom_c,
             "term_uses_dom": self.term_uses_dom,
+            "pa_uses_dom": self.pa_uses_dom,
             "sp_uses_dom": self.sp_uses_dom,
             "sp_skew": self.sp_skew,
             "sps_uses_dom": self.sps_uses_dom,
@@ -182,6 +200,8 @@ class ConstraintSet:
             "aa_dom_c": self.aa_dom_c,
             "aa_node_m": self.aa_node_m,
             "aa_node_c": self.aa_node_c,
+            "pa_dom_m": self.pa_dom_m,
+            "pa_node_m": self.pa_node_m,
             "sp_counts": self.sp_counts,
             "sps_counts": self.sps_counts,
         }
@@ -216,6 +236,13 @@ def pack_constraints(
     for q, _qn in placed_with_terms:
         for t in q.spec.anti_affinity:
             aa_vocab.setdefault(_aa_key(q.metadata.namespace, t), (q.metadata.namespace, t))
+    # Positive affinity: only PENDING pods' terms constrain anyone (no
+    # symmetric direction — a placed pod's affinity is already satisfied).
+    pa_vocab: dict[tuple, tuple] = {}
+    for p in pending:
+        if p.spec is not None and p.spec.pod_affinity:
+            for t in p.spec.pod_affinity:
+                pa_vocab.setdefault(_aa_key(p.metadata.namespace, t), (p.metadata.namespace, t))
     sp_vocab: dict[tuple, tuple] = {}  # hard (DoNotSchedule) — blocking
     sps_vocab: dict[tuple, tuple] = {}  # soft (ScheduleAnyway) — scoring only
     for p in pending:
@@ -224,10 +251,12 @@ def pack_constraints(
                 target = sp_vocab if c.is_hard else sps_vocab
                 target.setdefault(_sp_key(p.metadata.namespace, c), (p.metadata.namespace, c))
 
-    if not aa_vocab and not sp_vocab and not sps_vocab:
+    if not aa_vocab and not pa_vocab and not sp_vocab and not sps_vocab:
         return None
     if len(aa_vocab) > max_aa_terms:
         raise UntensorizableConstraints(f"{len(aa_vocab)} anti-affinity terms > budget {max_aa_terms}")
+    if len(pa_vocab) > max_aa_terms:
+        raise UntensorizableConstraints(f"{len(pa_vocab)} pod-affinity terms > budget {max_aa_terms}")
     if len(sp_vocab) > max_spread:
         raise UntensorizableConstraints(f"{len(sp_vocab)} spread constraints > budget {max_spread}")
     if len(sps_vocab) > max_spread:
@@ -236,6 +265,7 @@ def pack_constraints(
     # --- topology keys → coarse domains or fine (per-node) ----------------
     keys = (
         {k for (_ns, k, _sel) in aa_vocab}
+        | {k for (_ns, k, _sel) in pa_vocab}
         | {k for (_ns, k, _sk, _sel) in sp_vocab}
         | {k for (_ns, k, _sk, _sel) in sps_vocab}
     )
@@ -267,6 +297,7 @@ def pack_constraints(
 
     d_pad = round_up(max(len(dom_vocab), 1), label_block)
     t_pad = round_up(max(len(aa_vocab), 1), label_block)
+    ta_pad = round_up(max(len(pa_vocab), 1), label_block)
     s_pad = round_up(max(len(sp_vocab), 1), label_block)
     ss_pad = round_up(max(len(sps_vocab), 1), label_block)
     n_pad = padded_nodes
@@ -277,6 +308,7 @@ def pack_constraints(
             node_dom_c[i, j] = 1.0
 
     aa_terms = list(aa_vocab.items())  # [(key, (ns, term))]
+    pa_terms = list(pa_vocab.items())
     sp_terms = list(sp_vocab.items())
     sps_terms = list(sps_vocab.items())
 
@@ -285,6 +317,11 @@ def pack_constraints(
         if term.topology_key not in fine_keys:
             for v in key_values.get(term.topology_key, ()):  # noqa: B007
                 term_uses_dom[ti, dom_vocab[(term.topology_key, v)]] = 1.0
+    pa_uses_dom = np.zeros((ta_pad, d_pad), dtype=np.float32)
+    for ti, (key, (_ns, term)) in enumerate(pa_terms):
+        if term.topology_key not in fine_keys:
+            for v in key_values.get(term.topology_key, ()):  # noqa: B007
+                pa_uses_dom[ti, dom_vocab[(term.topology_key, v)]] = 1.0
     sp_uses_dom = np.zeros((s_pad, d_pad), dtype=np.float32)
     sp_skew = np.zeros((s_pad,), dtype=np.float32)
     for si, (key, (_ns, c)) in enumerate(sp_terms):
@@ -299,11 +336,14 @@ def pack_constraints(
     # --- pod-side bitmaps -------------------------------------------------
     pod_aa_carries = np.zeros((padded_pods, t_pad), dtype=np.float32)
     pod_aa_matched = np.zeros((padded_pods, t_pad), dtype=np.float32)
+    pod_pa_declares = np.zeros((padded_pods, ta_pad), dtype=np.float32)
+    pod_pa_matched = np.zeros((padded_pods, ta_pad), dtype=np.float32)
     pod_sp_declares = np.zeros((padded_pods, s_pad), dtype=np.float32)
     pod_sp_matched = np.zeros((padded_pods, s_pad), dtype=np.float32)
     pod_sps_declares = np.zeros((padded_pods, ss_pad), dtype=np.float32)
     pod_sps_matched = np.zeros((padded_pods, ss_pad), dtype=np.float32)
     aa_index = {key: i for i, (key, _) in enumerate(aa_terms)}
+    pa_index = {key: i for i, (key, _) in enumerate(pa_terms)}
     sp_index = {key: i for i, (key, _) in enumerate(sp_terms)}
     sps_index = {key: i for i, (key, _) in enumerate(sps_terms)}
     for pi, p in enumerate(pending):
@@ -311,6 +351,9 @@ def pack_constraints(
         if p.spec is not None and p.spec.anti_affinity:
             for t in p.spec.anti_affinity:
                 pod_aa_carries[pi, aa_index[_aa_key(ns, t)]] = 1.0
+        if p.spec is not None and p.spec.pod_affinity:
+            for t in p.spec.pod_affinity:
+                pod_pa_declares[pi, pa_index[_aa_key(ns, t)]] = 1.0
         if p.spec is not None and p.spec.topology_spread:
             for c in p.spec.topology_spread:
                 if c.is_hard:
@@ -320,6 +363,9 @@ def pack_constraints(
         for ti, (_key, (t_ns, term)) in enumerate(aa_terms):
             if t_ns == ns and term_matches(term, labels):
                 pod_aa_matched[pi, ti] = 1.0
+        for ti, (_key, (t_ns, term)) in enumerate(pa_terms):
+            if t_ns == ns and term_matches(term, labels):
+                pod_pa_matched[pi, ti] = 1.0
         for si, (_key, (c_ns, c)) in enumerate(sp_terms):
             if c_ns == ns and term_matches(c, labels):
                 pod_sp_matched[pi, si] = 1.0
@@ -332,6 +378,8 @@ def pack_constraints(
     aa_dom_c = np.zeros((t_pad, d_pad), dtype=np.float32)
     aa_node_m = np.zeros((t_pad, n_pad), dtype=np.float32)
     aa_node_c = np.zeros((t_pad, n_pad), dtype=np.float32)
+    pa_dom_m = np.zeros((ta_pad, d_pad), dtype=np.float32)
+    pa_node_m = np.zeros((ta_pad, n_pad), dtype=np.float32)
     sp_counts = np.zeros((s_pad, d_pad), dtype=np.float32)
     sps_counts = np.zeros((ss_pad, d_pad), dtype=np.float32)
     node_index = {n.name: i for i, n in enumerate(nodes)}
@@ -345,12 +393,15 @@ def pack_constraints(
         else:
             arr_node[ti, ni] = 1.0
 
-    if aa_terms:
+    if aa_terms or pa_terms:
         for q, qnode in snapshot.placed_pods():
             q_ns, q_labels = q.metadata.namespace, q.metadata.labels
             for ti, (_key, (t_ns, term)) in enumerate(aa_terms):
                 if t_ns == q_ns and term_matches(term, q_labels):
                     _mark(aa_dom_m, aa_node_m, ti, term, qnode.name)
+            for ti, (_key, (t_ns, term)) in enumerate(pa_terms):
+                if t_ns == q_ns and term_matches(term, q_labels):
+                    _mark(pa_dom_m, pa_node_m, ti, term, qnode.name)
         for q, qnode in placed_with_terms:
             ns = q.metadata.namespace
             for t in q.spec.anti_affinity:
@@ -376,12 +427,15 @@ def pack_constraints(
     return ConstraintSet(
         pod_aa_carries=pod_aa_carries,
         pod_aa_matched=pod_aa_matched,
+        pod_pa_declares=pod_pa_declares,
+        pod_pa_matched=pod_pa_matched,
         pod_sp_declares=pod_sp_declares,
         pod_sp_matched=pod_sp_matched,
         pod_sps_declares=pod_sps_declares,
         pod_sps_matched=pod_sps_matched,
         node_dom_c=node_dom_c,
         term_uses_dom=term_uses_dom,
+        pa_uses_dom=pa_uses_dom,
         sp_uses_dom=sp_uses_dom,
         sp_skew=sp_skew,
         sps_uses_dom=sps_uses_dom,
@@ -389,9 +443,12 @@ def pack_constraints(
         aa_dom_c=aa_dom_c,
         aa_node_m=aa_node_m,
         aa_node_c=aa_node_c,
+        pa_dom_m=pa_dom_m,
+        pa_node_m=pa_node_m,
         sp_counts=sp_counts,
         sps_counts=sps_counts,
         n_terms=len(aa_terms),
+        n_pa_terms=len(pa_terms),
         n_spread=len(sp_terms),
         n_spread_soft=len(sps_terms),
     )
@@ -424,23 +481,45 @@ def round_blocked_masks(xp, state: dict, meta: dict, soft_spread: bool = False) 
     ndc_t = meta["node_dom_c"].T
     aa_m_node = _clip01(xp, state["aa_dom_m"] @ ndc_t + state["aa_node_m"])
     aa_c_node = _clip01(xp, state["aa_dom_c"] @ ndc_t + state["aa_node_c"])
+    # Positive affinity: a declarer is blocked wherever its term has NO match
+    # in the node's domain — the inverted twin of aa_m_node — except while
+    # the term is globally inactive (no match anywhere) AND the pod matches
+    # its own term (the bootstrap waiver; blocked_block applies the pod-side
+    # gate from pa_inactive).
+    pa_m_node = _clip01(xp, state["pa_dom_m"] @ ndc_t + state["pa_node_m"])
+    pa_unmatched_node = 1.0 - pa_m_node
+    pa_inactive = (state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0  # [Ta]
     uses = meta["sp_uses_dom"]
     counts = state["sp_counts"]
     lo = xp.min(xp.where(uses > 0, counts, RANK_INF), axis=1)
     lo = xp.where(lo >= RANK_INF, 0.0, lo)
     blockcell = uses * (counts >= (meta["sp_skew"] + lo)[:, None])
     sp_node = _clip01(xp, blockcell @ ndc_t)
-    masks = {"aa_m_node": aa_m_node, "aa_c_node": aa_c_node, "sp_node": sp_node}
+    masks = {
+        "aa_m_node": aa_m_node,
+        "aa_c_node": aa_c_node,
+        "sp_node": sp_node,
+        "pa_unmatched_node": pa_unmatched_node,
+        "pa_inactive": pa_inactive.astype(xp.float32),
+    }
     if soft_spread:
         masks["sp_penalty_node"] = state["sps_counts"] @ ndc_t
     return masks
 
 
 def blocked_block(xp, blk: dict, masks: dict):
-    """[B, N] constraint-blocked mask for one pod block (three matmuls)."""
+    """[B, N] constraint-blocked mask for one pod block (four matmuls)."""
     b = blk["pod_aa_carries"] @ masks["aa_m_node"]
     b = b + blk["pod_aa_matched"] @ masks["aa_c_node"]
     b = b + blk["pod_sp_declares"] @ masks["sp_node"]
+    # Positive affinity with the bootstrap waiver: a declared term that is
+    # globally inactive AND self-matched drops out of the pod's requirement
+    # set for this round; every remaining declared term blocks its unmatched
+    # nodes (terms AND — any unmet term blocks).  A non-self-matching pod
+    # with an inactive term keeps it → unmatched everywhere → unschedulable
+    # this round, exactly the scalar checker's "unmatchable" rule.
+    gated = blk["pod_pa_declares"] * (1.0 - blk["pod_pa_matched"] * masks["pa_inactive"][None, :])
+    b = b + gated @ masks["pa_unmatched_node"]
     return b > 0
 
 
@@ -503,6 +582,24 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     min_m_at = min_matched[g]
     bad_aa = ((matc > 0) & (rank_f[:, None] > min_c_at)) | ((carr > 0) & (rank_f[:, None] > min_m_at))
     keep = accepted & ~bad_aa.any(axis=1)
+
+    # ---- positive affinity bootstrap (within-round) -----------------------
+    # A term inactive at round start was waived for self-matching declarers
+    # (blocked_block let them choose freely).  Sequentially, only the FIRST
+    # accepted pod matching the term may rely on the waiver: any earlier-rank
+    # accepted match re-activates the term before a later pod's turn in the
+    # witness order, and the later pod's free placement would then violate
+    # it.  Keep the min-rank accepted match; defer other waived declarers
+    # one round (the term is then active and the round-start mask routes
+    # them to its domain).  Over-inclusive min (it counts matches a later
+    # filter may drop) only defers more — never admits a violation.
+    pa_inactive_f = ((state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0).astype(xp.float32)
+    keep_pa_f = keep.astype(xp.float32)
+    pa_m_acc = ps["pod_pa_matched"] * keep_pa_f[:, None]  # [P, Ta]
+    min_match_rank = xp.min(xp.where(pa_m_acc > 0, rank_f[:, None], RANK_INF), axis=0)  # [Ta]
+    waived = ps["pod_pa_declares"] * ps["pod_pa_matched"] * pa_inactive_f[None, :]  # [P, Ta]
+    bad_pa = (waived > 0) & keep[:, None] & (rank_f[:, None] > min_match_rank[None, :])
+    keep = keep & ~bad_pa.any(axis=1)
 
     # ---- topology spread (vectorized over S) ------------------------------
     uses_sp = meta["sp_uses_dom"]  # [S, D]
@@ -608,6 +705,16 @@ def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict, s
     gn = (xp.arange(t, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
     aa_node_m = _scatter_max1(xp, state["aa_node_m"].reshape(-1), gn, fine_m).reshape(t, n)
     aa_node_c = _scatter_max1(xp, state["aa_node_c"].reshape(-1), gn, fine_c).reshape(t, n)
+    # Positive affinity: every accepted pod matching a PA term activates its
+    # landing domain (declaring or not — matches are matches).
+    uses_pa = meta["pa_uses_dom"]
+    ta = uses_pa.shape[0]
+    matc_pa = ps["pod_pa_matched"] * accf[:, None]  # [P, Ta]
+    pa_dom_m = _clip01(xp, state["pa_dom_m"] + (matc_pa.T @ nd) * uses_pa)
+    has_c_pa = nd @ uses_pa.T  # [P, Ta]
+    fine_pa = (matc_pa * (has_c_pa == 0)).T.reshape(-1)
+    gn_pa = (xp.arange(ta, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
+    pa_node_m = _scatter_max1(xp, state["pa_node_m"].reshape(-1), gn_pa, fine_pa).reshape(ta, n)
     sp_m = ps["pod_sp_matched"] * accf[:, None]  # [P, S]
     sp_counts = state["sp_counts"] + (sp_m.T @ nd) * meta["sp_uses_dom"]
     if soft_spread:
@@ -620,6 +727,8 @@ def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict, s
         "aa_dom_c": aa_dom_c,
         "aa_node_m": aa_node_m,
         "aa_node_c": aa_node_c,
+        "pa_dom_m": pa_dom_m,
+        "pa_node_m": pa_node_m,
         "sp_counts": sp_counts,
         "sps_counts": sps_counts,
     }

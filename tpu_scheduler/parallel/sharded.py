@@ -101,6 +101,8 @@ CONSTRAINT_KEYS = (
     # pod side (ConstraintSet.pod_arrays, priority-permuted + dp-padded)
     "pod_aa_carries",
     "pod_aa_matched",
+    "pod_pa_declares",
+    "pod_pa_matched",
     "pod_sp_declares",
     "pod_sp_matched",
     "pod_sps_declares",
@@ -108,19 +110,22 @@ CONSTRAINT_KEYS = (
     # meta (node_dom_c is [N,D] with N padded to the tp multiple)
     "node_dom_c",
     "term_uses_dom",
+    "pa_uses_dom",
     "sp_uses_dom",
     "sp_skew",
     "sps_uses_dom",
-    # initial state (aa_node_* are [T,N] with N padded to the tp multiple)
+    # initial state (aa_node_* / pa_node_m are [·,N] padded to the tp multiple)
     "aa_dom_m",
     "aa_dom_c",
     "aa_node_m",
     "aa_node_c",
+    "pa_dom_m",
+    "pa_node_m",
     "sp_counts",
     "sps_counts",
 )
-_N_PODKEYS = 6
-_N_METAKEYS = 5
+_N_PODKEYS = 8
+_N_METAKEYS = 6
 
 
 @lru_cache(maxsize=64)
@@ -170,7 +175,12 @@ def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spre
             blocked_l = sps_dec_l = sp_pen_l = None
             if constrained:
                 masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread)  # [·, n_tot]
-                lm = {k: lax.dynamic_slice_in_dim(v, node_base, n_local, axis=1) for k, v in masks.items()}
+                # Node-axis masks slice to this shard's columns; pa_inactive
+                # is per-TERM ([Ta], no node axis) and stays whole.
+                lm = {
+                    k: (v if k == "pa_inactive" else lax.dynamic_slice_in_dim(v, node_base, n_local, axis=1))
+                    for k, v in masks.items()
+                }
                 blocked_l = blocked_block(jnp, blk_l, lm)  # [p_local, n_local]
                 if soft_spread:
                     sps_dec_l = blk_l["pod_sps_declares"]
@@ -226,7 +236,15 @@ def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spre
             acc_local = lax.dynamic_slice(accepted, (dp_idx * p_local,), (p_local,))
 
             assigned = jnp.where(acc_local, choice, assigned)
-            active = cand & ~acc_local
+            was_active = active  # round-start actives (not yet rebound)
+            new_active = cand & ~acc_local
+            if constrained:
+                # PA declarers blocked everywhere stay active while the round
+                # placed anyone (see ops/assign.py) — `accepted` is global
+                # and replicated, so every device computes the same flag.
+                pa_hope = (blk_l["pod_pa_declares"].sum(axis=1) > 0) & accepted.any()
+                new_active = new_active | (was_active & ~has & pa_hope)
+            active = new_active
             n_active = lax.psum(active.sum(), "dp")
             return avail, assigned, active, n_active > 0, rounds + 1, cst
 
@@ -289,12 +307,13 @@ def constraint_operands(cons, n_pad_from: int, n_pad_to: int) -> dict:
     meta = cons.meta_arrays()
     state = cons.state_arrays()
     ops["node_dom_c"] = np.pad(meta["node_dom_c"], ((0, extra), (0, 0)))
-    for k in ("term_uses_dom", "sp_uses_dom", "sp_skew", "sps_uses_dom"):
+    for k in ("term_uses_dom", "pa_uses_dom", "sp_uses_dom", "sp_skew", "sps_uses_dom"):
         ops[k] = meta[k]
-    for k in ("aa_dom_m", "aa_dom_c", "sp_counts", "sps_counts"):
+    for k in ("aa_dom_m", "aa_dom_c", "pa_dom_m", "sp_counts", "sps_counts"):
         ops[k] = state[k]
     ops["aa_node_m"] = np.pad(state["aa_node_m"], ((0, 0), (0, extra)))
     ops["aa_node_c"] = np.pad(state["aa_node_c"], ((0, 0), (0, extra)))
+    ops["pa_node_m"] = np.pad(state["pa_node_m"], ((0, 0), (0, extra)))
     return ops
 
 

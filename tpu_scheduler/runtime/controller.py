@@ -41,8 +41,10 @@ from ..core.predicates import (
     InvalidNodeReason,
     anti_affinity_ok,
     make_affinity_checker,
+    make_pod_affinity_checker,
     make_soft_spread_scorer,
     make_spread_checker,
+    pod_affinity_ok,
     preferred_affinity_score,
     soft_taint_penalty,
     term_matches,
@@ -274,7 +276,7 @@ class Scheduler:
         plain: list[Pod] = []
         constrained: list[Pod] = []
         for p in pending:
-            if p.spec is not None and (p.spec.anti_affinity or p.spec.topology_spread):
+            if p.spec is not None and (p.spec.anti_affinity or p.spec.pod_affinity or p.spec.topology_spread):
                 constrained.append(p)
                 continue
             ns = p.metadata.namespace
@@ -342,13 +344,16 @@ class Scheduler:
             # Precompute the pod's affinity/spread state once — the node loop
             # is then O(1) per candidate instead of re-scanning all placements.
             affinity_checker = make_affinity_checker(pod, snapshot, placed)
+            pod_affinity_checker = make_pod_affinity_checker(pod, snapshot, placed)
             spread_checker = make_spread_checker(pod, snapshot, placed)
             soft_spread = make_soft_spread_scorer(pod, snapshot, placed)
             best: Node | None = None
             best_score = 0.0
             for node in snapshot.nodes:
                 reason = self._check_with_ledger(
-                    pod, node, snapshot, ledger, placed, affinity_checker=affinity_checker, spread_checker=spread_checker
+                    pod, node, snapshot, ledger, placed,
+                    affinity_checker=affinity_checker, spread_checker=spread_checker,
+                    pod_affinity_checker=pod_affinity_checker,
                 )
                 if reason is not None:
                     continue
@@ -807,6 +812,11 @@ class Scheduler:
                     continue
                 if not anti_affinity_ok(pod, node, snapshot, extra_placed=placed_overlay):
                     continue
+                # Positive affinity gates candidates too: eviction frees
+                # capacity but can never conjure a co-location match, so a
+                # node outside the pod's required domain is never a target.
+                if not pod_affinity_ok(pod, node, snapshot, extra_placed=placed_overlay):
+                    continue
                 if not topology_spread_ok(pod, node, snapshot, extra_placed=placed_overlay):
                     continue
                 avail = node_allocatable(node)
@@ -895,6 +905,7 @@ class Scheduler:
         placed: list[tuple[Pod, Node]],
         affinity_checker=None,
         spread_checker=None,
+        pod_affinity_checker=None,
     ) -> InvalidNodeReason | None:
         """Full predicate chain vs snapshot + this-cycle commitments: the
         assumed-resources ledger (closing the reference's TOCTOU race) and
@@ -921,6 +932,13 @@ class Scheduler:
         )
         if not affinity_fine:
             return InvalidNodeReason.ANTI_AFFINITY_VIOLATION
+        pa_fine = (
+            pod_affinity_checker(node)
+            if pod_affinity_checker is not None
+            else pod_affinity_ok(pod, node, snapshot, extra_placed=placed)
+        )
+        if not pa_fine:
+            return InvalidNodeReason.POD_AFFINITY_UNSATISFIED
         spread_fine = (
             spread_checker(node) if spread_checker is not None else topology_spread_ok(pod, node, snapshot, extra_placed=placed)
         )

@@ -63,6 +63,7 @@ def make_pod(
     priority: int = 0,
     labels: dict[str, str] | None = None,
     anti_affinity: list[PodAntiAffinityTerm] | None = None,
+    pod_affinity: list[PodAntiAffinityTerm] | None = None,
     topology_spread: list[TopologySpreadConstraint] | None = None,
     tolerations: list[Toleration] | None = None,
     node_affinity: list[NodeSelectorTerm] | None = None,
@@ -79,6 +80,7 @@ def make_pod(
             node_name=node_name,
             priority=priority,
             anti_affinity=anti_affinity,
+            pod_affinity=pod_affinity,
             topology_spread=topology_spread,
             tolerations=tolerations,
             node_affinity=node_affinity,
@@ -105,6 +107,7 @@ def synth_cluster(
     preferred_affinity_fraction: float = 0.0,
     schedule_anyway_fraction: float = 0.0,
     gang_fraction: float = 0.0,
+    pod_affinity_fraction: float = 0.0,
 ) -> ClusterSnapshot:
     """Generate a synthetic cluster snapshot.
 
@@ -130,6 +133,11 @@ def synth_cluster(
 
     ``gang_fraction`` of pending pods join all-or-nothing gangs of 2-4
     consecutive pods (coscheduling; the TPU training-job shape).
+
+    ``pod_affinity_fraction`` of pending pods declare POSITIVE inter-pod
+    affinity: self-affine co-location groups (the term matches the pod's own
+    ``pa-group`` label over the zone key), so the first member exercises the
+    bootstrap waiver and later members must follow it into its zone.
     """
     rng = random.Random(seed)
     if n_nodes == 0:
@@ -184,6 +192,11 @@ def synth_cluster(
         anti = None
         if rng.random() < anti_affinity_fraction:
             anti = [PodAntiAffinityTerm(match_labels={"app": app}, topology_key="name")]
+        pod_aff = None
+        pa_label = None
+        if pod_affinity_fraction and rng.random() < pod_affinity_fraction:
+            pa_label = f"pa-group-{rng.randrange(0, 8)}"
+            pod_aff = [PodAntiAffinityTerm(match_labels={"pa": pa_label}, topology_key="zone")]
         spread = None
         if rng.random() < spread_fraction:
             spread = [TopologySpreadConstraint(topology_key="zone", max_skew=rng.choice([1, 2]), match_labels={"app": app})]
@@ -262,8 +275,9 @@ def synth_cluster(
             memory=f"{rng.choice([128, 256, 512, 1024, 4096])}Mi",
             node_selector=selector,
             priority=rng.randrange(0, 10),
-            labels={"app": app},
+            labels={"app": app, **({"pa": pa_label} if pa_label else {})},
             anti_affinity=anti,
+            pod_affinity=pod_aff,
             topology_spread=spread,
             tolerations=tols,
             node_affinity=node_aff,
