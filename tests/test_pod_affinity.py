@@ -224,3 +224,26 @@ def test_preemption_respects_pod_affinity():
     assert {p.metadata.name for p in api.list_pods()} >= {"victim-z2"}
     web = next(p for p in api.list_pods() if p.metadata.name == "web-0")
     assert web.spec.node_name is None
+
+
+def test_preemption_never_evicts_the_affinity_match():
+    """Review repro: the only pod matching the preemptor's required
+    podAffinity is also the cheapest victim on the target node — evicting it
+    would leave the preemptor in a domain with zero matches.  kube's
+    selectVictimsOnNode re-filter (victims removed) must disqualify the node."""
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+
+    nodes = [make_node("a1", cpu="2", memory="4Gi", labels={"zone": "z1"})]
+    pods = [
+        make_pod("cache-0", cpu="1900m", labels={"app": "cache"}, node_name="a1", phase="Running", priority=0),
+        make_pod("web-0", cpu="1500m", labels={"app": "web"}, pod_affinity=CACHE_TERM, priority=50),
+    ]
+    api = FakeApiServer()
+    api.load(nodes, pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, profile=DEFAULT_PROFILE.with_(preemption=True))
+    m = sched.run_cycle()
+    assert m.bound == 0
+    names = {p.metadata.name for p in api.list_pods()}
+    assert "cache-0" in names, "the affinity match was evicted to host its own dependent"
+    web = next(p for p in api.list_pods() if p.metadata.name == "web-0")
+    assert web.spec.node_name is None

@@ -26,6 +26,7 @@ __all__ = [
     "LabelSelectorRequirement",
     "PodAntiAffinityTerm",
     "PodAffinityTerm",
+    "WeightedPodAffinityTerm",
     "TopologySpreadConstraint",
     "NodeSelectorTerm",
     "PodSpec",
@@ -135,6 +136,20 @@ PodAffinityTerm = PodAntiAffinityTerm
 
 
 @dataclass
+class WeightedPodAffinityTerm:
+    """One ``preferredDuringSchedulingIgnoredDuringExecution`` entry of
+    podAffinity / podAntiAffinity: a soft preference — every placed pod in a
+    candidate node's topology domain that matches ``term`` adds (affinity) or
+    subtracts (anti-affinity) ``weight`` (1-100, kube semantics) score
+    points.  Deviation from full Kubernetes, by design: only the incoming
+    pod's own preferred terms score; placed pods' preferred terms are not
+    applied symmetrically."""
+
+    weight: int
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
 class TopologySpreadConstraint:
     """Topology-spread constraint (config 5).
 
@@ -240,6 +255,8 @@ class PodSpec:
     # nodeSelector, src/predicates.rs:63-77).
     anti_affinity: list[PodAntiAffinityTerm] | None = None
     pod_affinity: list[PodAntiAffinityTerm] | None = None  # positive co-location twin
+    preferred_pod_affinity: list[WeightedPodAffinityTerm] | None = None  # soft, weighted
+    preferred_pod_anti_affinity: list[WeightedPodAffinityTerm] | None = None
     topology_spread: list[TopologySpreadConstraint] | None = None
     tolerations: list[Toleration] | None = None
     node_affinity: list[NodeSelectorTerm] | None = None  # required terms, ORed
@@ -298,38 +315,31 @@ class Pod:
                     for e in exprs
                 ]
 
-            anti = None
-            terms = (
-                ((spec_d.get("affinity") or {}).get("podAntiAffinity") or {}).get(
-                    "requiredDuringSchedulingIgnoredDuringExecution"
+            def parse_term(t: Mapping[str, Any]) -> PodAntiAffinityTerm:
+                return PodAntiAffinityTerm(
+                    match_labels=(t.get("labelSelector") or {}).get("matchLabels"),
+                    topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
+                    match_expressions=parse_expressions(t.get("labelSelector")),
                 )
-                or []
-            )
-            if terms:
-                anti = [
-                    PodAntiAffinityTerm(
-                        match_labels=(t.get("labelSelector") or {}).get("matchLabels"),
-                        topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
-                        match_expressions=parse_expressions(t.get("labelSelector")),
-                    )
-                    for t in terms
+
+            def parse_weighted(entries) -> list[WeightedPodAffinityTerm] | None:
+                if not entries:
+                    return None
+                return [
+                    WeightedPodAffinityTerm(weight=int(e.get("weight", 1)), term=parse_term(e.get("podAffinityTerm") or {}))
+                    for e in entries
                 ]
+
+            paa_d = (spec_d.get("affinity") or {}).get("podAntiAffinity") or {}
+            anti_terms = paa_d.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+            anti = [parse_term(t) for t in anti_terms] or None
             pod_aff = None
-            aff_terms = (
-                ((spec_d.get("affinity") or {}).get("podAffinity") or {}).get(
-                    "requiredDuringSchedulingIgnoredDuringExecution"
-                )
-                or []
-            )
+            pa_d = (spec_d.get("affinity") or {}).get("podAffinity") or {}
+            aff_terms = pa_d.get("requiredDuringSchedulingIgnoredDuringExecution") or []
             if aff_terms:
-                pod_aff = [
-                    PodAntiAffinityTerm(
-                        match_labels=(t.get("labelSelector") or {}).get("matchLabels"),
-                        topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
-                        match_expressions=parse_expressions(t.get("labelSelector")),
-                    )
-                    for t in aff_terms
-                ]
+                pod_aff = [parse_term(t) for t in aff_terms]
+            pref_pod_aff = parse_weighted(pa_d.get("preferredDuringSchedulingIgnoredDuringExecution"))
+            pref_pod_anti = parse_weighted(paa_d.get("preferredDuringSchedulingIgnoredDuringExecution"))
             spread = None
             constraints = spec_d.get("topologySpreadConstraints") or []
             if constraints:  # hard (DoNotSchedule) and soft (ScheduleAnyway) alike
@@ -376,6 +386,8 @@ class Pod:
                 priority=spec_d.get("priority", 0),
                 anti_affinity=anti,
                 pod_affinity=pod_aff,
+                preferred_pod_affinity=pref_pod_aff,
+                preferred_pod_anti_affinity=pref_pod_anti,
                 topology_spread=spread,
                 tolerations=tolerations,
                 node_affinity=node_aff,
@@ -460,25 +472,30 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
             }
             for t in pod.spec.tolerations
         ]
+    def _term_to_dict(t) -> dict[str, Any]:
+        term: dict[str, Any] = {"topologyKey": t.topology_key}
+        sel = _selector_to_dict(t.match_labels, t.match_expressions)
+        if sel:
+            term["labelSelector"] = sel
+        return term
+
     affinity: dict[str, Any] = {}
     if pod.spec.anti_affinity:
-        terms = []
-        for t in pod.spec.anti_affinity:
-            term: dict[str, Any] = {"topologyKey": t.topology_key}
-            sel = _selector_to_dict(t.match_labels, t.match_expressions)
-            if sel:
-                term["labelSelector"] = sel
-            terms.append(term)
-        affinity["podAntiAffinity"] = {"requiredDuringSchedulingIgnoredDuringExecution": terms}
+        affinity["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [_term_to_dict(t) for t in pod.spec.anti_affinity]
+        }
+    if pod.spec.preferred_pod_anti_affinity:
+        affinity.setdefault("podAntiAffinity", {})["preferredDuringSchedulingIgnoredDuringExecution"] = [
+            {"weight": w.weight, "podAffinityTerm": _term_to_dict(w.term)} for w in pod.spec.preferred_pod_anti_affinity
+        ]
     if pod.spec.pod_affinity:
-        terms = []
-        for t in pod.spec.pod_affinity:
-            term = {"topologyKey": t.topology_key}
-            sel = _selector_to_dict(t.match_labels, t.match_expressions)
-            if sel:
-                term["labelSelector"] = sel
-            terms.append(term)
-        affinity["podAffinity"] = {"requiredDuringSchedulingIgnoredDuringExecution": terms}
+        affinity["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": [_term_to_dict(t) for t in pod.spec.pod_affinity]
+        }
+    if pod.spec.preferred_pod_affinity:
+        affinity.setdefault("podAffinity", {})["preferredDuringSchedulingIgnoredDuringExecution"] = [
+            {"weight": w.weight, "podAffinityTerm": _term_to_dict(w.term)} for w in pod.spec.preferred_pod_affinity
+        ]
     if pod.spec.node_affinity or pod.spec.preferred_node_affinity:
         node_affinity: dict[str, Any] = {}
         if pod.spec.node_affinity:

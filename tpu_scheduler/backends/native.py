@@ -51,6 +51,8 @@ class NativeBackend(SchedulingBackend):
         cons = packed.constraints
         cmeta = cstate = cpods = None
         soft_spread = cons is not None and cons.n_spread_soft > 0
+        soft_pa = cons is not None and cons.n_ppa_terms > 0
+        hard_pa = cons is not None and cons.n_pa_terms > 0
         if cons is not None:
             from ..ops.constraints import blocked_block, constraint_commit, constraint_filter, round_blocked_masks
 
@@ -66,7 +68,11 @@ class NativeBackend(SchedulingBackend):
         rounds = 0
 
         while rounds < profile.max_rounds and active.any():
-            round_masks = round_blocked_masks(np, cstate, cmeta, soft_spread=soft_spread) if cons is not None else None
+            round_masks = (
+                round_blocked_masks(np, cstate, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
+                if cons is not None
+                else None
+            )
             choice = np.zeros((p,), dtype=np.int32)
             has = np.zeros((p,), dtype=bool)
             node_idx = np.arange(n, dtype=np.uint32)
@@ -86,6 +92,8 @@ class NativeBackend(SchedulingBackend):
                     pod_ntol_soft=ntol_soft[lo:hi], node_taints_soft=node_taints_soft,
                     pod_sps_declares=cpods["pod_sps_declares"][lo:hi] if soft_spread else None,
                     sp_penalty_node=round_masks["sp_penalty_node"] if soft_spread else None,
+                    pod_ppa_w=cpods["pod_ppa_w"][lo:hi] if soft_pa else None,
+                    ppa_cnt_node=round_masks["ppa_cnt_node"] if soft_pa else None,
                     salt=rounds,
                 )
                 sc = np.where(m, sc, -np.inf)
@@ -112,8 +120,10 @@ class NativeBackend(SchedulingBackend):
             accepted[order] = acc_s
 
             if cons is not None:
-                accepted = constraint_filter(np, accepted, choice, ranks, cpods, cstate, cmeta)
-                cstate = constraint_commit(np, accepted, choice, cpods, cstate, cmeta, soft_spread=soft_spread)
+                accepted = constraint_filter(np, accepted, choice, ranks, cpods, cstate, cmeta, hard_pa=hard_pa)
+                cstate = constraint_commit(
+                    np, accepted, choice, cpods, cstate, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa
+                )
 
             assigned = np.where(accepted, choice, assigned)
             acc_round = np.where(accepted, rounds, acc_round)
@@ -122,7 +132,7 @@ class NativeBackend(SchedulingBackend):
             avail = (avail.astype(np.int64) - dec[:n]).astype(np.int32)
             was_active = active
             active = cand & ~accepted
-            if cons is not None:
+            if cons is not None and hard_pa:
                 # Positive-affinity declarers blocked everywhere stay active
                 # while the round placed anyone — a same-round placement can
                 # activate their term (mirrors ops/assign.py exactly).

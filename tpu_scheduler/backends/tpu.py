@@ -141,6 +141,8 @@ class TpuBackend(SchedulingBackend):
             cmeta=cmeta,
             cstate=cstate,
             soft_spread=cons is not None and cons.n_spread_soft > 0,
+            soft_pa=cons is not None and cons.n_ppa_terms > 0,
+            hard_pa=cons is not None and cons.n_pa_terms > 0,
         )
         # ONE device→host fetch for the whole result.  Each fresh fetch
         # costs ~80 ms of tunnel latency regardless of size (measured on the

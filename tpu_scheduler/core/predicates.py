@@ -16,7 +16,7 @@ import enum
 from itertools import chain
 from typing import Callable, Sequence
 
-from ..api.objects import LabelSelectorRequirement, Node, Pod, total_pod_resources
+from ..api.objects import LabelSelectorRequirement, Node, Pod, full_name, total_pod_resources
 from .snapshot import ClusterSnapshot, node_allocatable, node_used_resources
 
 __all__ = [
@@ -41,6 +41,7 @@ __all__ = [
     "preferred_affinity_score",
     "soft_taint_penalty",
     "make_soft_spread_scorer",
+    "make_preferred_pod_affinity_scorer",
     "check_node_validity",
     "PREDICATE_CHAIN",
     "NODE_LOCAL_PREDICATES",
@@ -284,6 +285,7 @@ def make_pod_affinity_checker(
     pod: Pod,
     snapshot: ClusterSnapshot,
     extra_placed: Sequence[tuple[Pod, Node]] = (),
+    exclude: frozenset[str] = frozenset(),
 ) -> Callable[[Node], bool]:
     """Positive inter-pod affinity (requiredDuringScheduling co-location):
     for EVERY declared term, the candidate node's topology domain must hold
@@ -297,7 +299,10 @@ def make_pod_affinity_checker(
     Unlike anti-affinity there is no symmetric direction: a placed pod's
     affinity terms do not constrain newcomers.  ``extra_placed`` overlays
     same-cycle commitments (the sequential host path), which also activate
-    waived terms for later pods in the same cycle.
+    waived terms for later pods in the same cycle.  ``exclude`` removes
+    placed pods (by full name) from consideration — the preemption pass
+    re-checks candidates as if its victims were already evicted (kube's
+    selectVictimsOnNode re-filter).
     """
     my_terms = (pod.spec.pod_affinity or []) if pod.spec is not None else []
     if not my_terms:
@@ -308,6 +313,8 @@ def make_pod_affinity_checker(
     for t in my_terms:
         doms: set[tuple[str, str]] = set()
         for q, qnode in chain(snapshot.placed_pods(), extra_placed):
+            if exclude and full_name(q) in exclude:
+                continue
             if q.metadata.namespace == my_ns and term_matches(t, q.metadata.labels):
                 doms.add(node_topology_domain(qnode, t.topology_key))
         if doms:
@@ -341,6 +348,7 @@ def make_spread_checker(
     pod: Pod,
     snapshot: ClusterSnapshot,
     extra_placed: Sequence[tuple[Pod, Node]] = (),
+    exclude: frozenset[str] = frozenset(),
 ) -> Callable[[Node], bool]:
     """Precompute per-constraint domain counts once, returning an
     O(#constraints) per-node checker for the hard topology-spread predicate.
@@ -363,6 +371,8 @@ def make_spread_checker(
             if v is not None:
                 counts.setdefault(v, 0)
         for q, qnode in chain(snapshot.placed_pods(), extra_placed):
+            if exclude and full_name(q) in exclude:
+                continue
             v = (qnode.metadata.labels or {}).get(c.topology_key)
             if v is None or q.metadata.namespace != my_ns:
                 continue
@@ -423,6 +433,45 @@ def soft_taint_penalty(pod: Pod, node: Node) -> int:
         if not any(t.tolerates(taint) for t in tolerations):
             n += 1
     return n
+
+
+def make_preferred_pod_affinity_scorer(
+    pod: Pod,
+    snapshot: ClusterSnapshot,
+    extra_placed: Sequence[tuple[Pod, Node]] = (),
+) -> Callable[[Node], float]:
+    """Soft inter-pod (anti-)affinity — kube InterPodAffinity scoring: every
+    placed pod (same namespace) in the candidate node's topology domain that
+    matches one of this pod's preferred terms contributes +weight (affinity)
+    or −weight (anti-affinity).  Term weights (1-100) are the only scale —
+    no global profile knob, matching the tensor path (ops/score.py).
+    Symmetric scoring from placed pods' own preferred terms is deliberately
+    out of scope (see WeightedPodAffinityTerm)."""
+    spec = pod.spec
+    weighted = [
+        *((w.weight, w.term) for w in ((spec.preferred_pod_affinity or []) if spec is not None else [])),
+        *((-w.weight, w.term) for w in ((spec.preferred_pod_anti_affinity or []) if spec is not None else [])),
+    ]
+    if not weighted:
+        return lambda node: 0.0
+    my_ns = pod.metadata.namespace
+    # Per (signed weight, term): match counts per domain of the term's key.
+    per_term: list[tuple[float, str, dict[tuple[str, str], int]]] = []
+    for w, t in weighted:
+        counts: dict[tuple[str, str], int] = {}
+        for q, qnode in chain(snapshot.placed_pods(), extra_placed):
+            if q.metadata.namespace == my_ns and term_matches(t, q.metadata.labels):
+                d = node_topology_domain(qnode, t.topology_key)
+                counts[d] = counts.get(d, 0) + 1
+        per_term.append((float(w), t.topology_key, counts))
+
+    def score(node: Node) -> float:
+        total = 0.0
+        for w, key, counts in per_term:
+            total += w * counts.get(node_topology_domain(node, key), 0)
+        return total
+
+    return score
 
 
 def make_soft_spread_scorer(

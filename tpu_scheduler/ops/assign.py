@@ -83,6 +83,8 @@ _CONSTRAINT_KEYS = (
     "pod_sp_matched",
     "pod_sps_declares",
     "pod_sps_matched",
+    "pod_ppa_w",
+    "pod_ppa_matched",
 )
 
 
@@ -164,6 +166,7 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
 
         m = m & ~blocked_block(jnp, blk, round_masks)
     soft_sp = round_masks is not None and "sp_penalty_node" in round_masks
+    soft_pa = round_masks is not None and "ppa_cnt_node" in round_masks
     sc = score_block(
         jnp,
         blk["pod_req"],
@@ -178,6 +181,8 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         node_taints_soft=nodes["node_taints_soft"],
         pod_sps_declares=blk["pod_sps_declares"] if soft_sp else None,
         sp_penalty_node=round_masks["sp_penalty_node"] if soft_sp else None,
+        pod_ppa_w=blk["pod_ppa_w"] if soft_pa else None,
+        ppa_cnt_node=round_masks["ppa_cnt_node"] if soft_pa else None,
         salt=salt,
     )
     sc = jnp.where(m, sc, -jnp.inf)
@@ -274,7 +279,7 @@ def _prepare_pods(pods, block: int):
     return perm, _compact(ps)
 
 
-def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread):
+def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa=False, hard_pa=True):
     """One auction round as a while_loop body (shared by the monolithic
     assign_cycle and the size-shrinking epoch driver)."""
     n = nodes["node_avail"].shape[0]
@@ -286,7 +291,7 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
         if cmeta is not None:
             from .constraints import constraint_commit, constraint_filter, round_blocked_masks
 
-            round_masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread)
+            round_masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
         choice, has = _choose(
             avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks, salt=rounds
         )
@@ -310,8 +315,8 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
         if cmeta is not None:
             # Within-round conflict resolution + domain-state commit
             # (deferred pods stay active and retry next round).
-            accepted = constraint_filter(jnp, accepted, choice, ps["ranks"], ps, cst, cmeta)
-            cst = constraint_commit(jnp, accepted, choice, ps, cst, cmeta, soft_spread=soft_spread)
+            accepted = constraint_filter(jnp, accepted, choice, ps["ranks"], ps, cst, cmeta, hard_pa=hard_pa)
+            cst = constraint_commit(jnp, accepted, choice, ps, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
 
         ps["assigned"] = jnp.where(accepted, choice, ps["assigned"])
         ps["acc_round"] = jnp.where(accepted, rounds, ps["acc_round"])
@@ -319,7 +324,7 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
         avail = avail - dec[:n]
         was_active = ps["active"]
         ps["active"] = cand & ~accepted
-        if cmeta is not None:
+        if cmeta is not None and hard_pa:
             # Positive affinity breaks the "feasibility only shrinks" rule
             # the no-feasible-node drop-out relies on: a pod placed THIS
             # round can activate a declarer's term and open nodes for it.
@@ -334,7 +339,7 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
     return body
 
 
-@partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread"))
+@partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread", "soft_pa", "hard_pa"))
 def assign_cycle(
     nodes: dict,
     pods: dict,
@@ -346,6 +351,8 @@ def assign_cycle(
     cmeta: dict | None = None,
     cstate: dict | None = None,
     soft_spread: bool = False,
+    soft_pa: bool = False,
+    hard_pa: bool = True,
 ):
     """Assign all pending pods to nodes in one on-device cycle.
 
@@ -377,7 +384,7 @@ def assign_cycle(
         _, _, n_active, rounds, _ = state
         return (rounds < max_rounds) & (n_active > 0)
 
-    body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread)
+    body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa)
     state0 = (nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32), jnp.int32(0), cstate)
     avail, ps, _, rounds, _ = lax.while_loop(cond, body, state0)
 
@@ -404,10 +411,10 @@ def _epoch_prelude(nodes, pods, block: int):
     return perm, nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread", "floor"))
+@partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread", "soft_pa", "hard_pa", "floor"))
 def _assign_epoch(
     nodes, ps, avail, n_active, rounds, cst, weights, cmeta,
-    max_rounds: int, block: int, use_pallas: bool, pallas_interpret: bool, soft_spread: bool, floor: bool,
+    max_rounds: int, block: int, use_pallas: bool, pallas_interpret: bool, soft_spread: bool, soft_pa: bool, hard_pa: bool, floor: bool,
 ):
     """Run auction rounds until done — or, when not at the ``floor`` size,
     until the active count falls to half the (static) pod-array size, so the
@@ -417,7 +424,7 @@ def _assign_epoch(
     of the jit cache key, which is what lets the body builder branch on it
     at trace time (same contract as assign_cycle)."""
     p = ps["pod_req"].shape[0]
-    body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread)
+    body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa)
 
     def cond(state):
         _, _, n_active, rounds, _ = state
@@ -440,6 +447,8 @@ def assign_cycle_epochs(
     cmeta: dict | None = None,
     cstate: dict | None = None,
     soft_spread: bool = False,
+    soft_pa: bool = False,
+    hard_pa: bool = True,
 ):
     """assign_cycle with host-driven SIZE SHRINKING — the backend's driver.
 
@@ -474,7 +483,7 @@ def assign_cycle_epochs(
         floor = p_cur <= _MIN_EPOCH_SIZE
         avail, ps, n_active_dev, rounds, cst = _assign_epoch(
             nodes, ps, avail, n_active_dev, rounds, cst, weights, cmeta,
-            max_rounds, block, use_pallas, pallas_interpret, soft_spread, floor,
+            max_rounds, block, use_pallas, pallas_interpret, soft_spread, soft_pa, hard_pa, floor,
         )
         n_active = int(n_active_dev)  # host sync — once per epoch, not per round
         rounds_i = int(rounds)

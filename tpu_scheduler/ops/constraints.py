@@ -149,11 +149,14 @@ class ConstraintSet:
     pod_sp_matched: np.ndarray
     pod_sps_declares: np.ndarray  # soft (ScheduleAnyway) spread declarations
     pod_sps_matched: np.ndarray
+    pod_ppa_w: np.ndarray  # [P, Tp] SIGNED preferred-(anti-)affinity weights
+    pod_ppa_matched: np.ndarray  # [P, Tp] pod matches the preferred term
     # Node side
     node_dom_c: np.ndarray  # [N, D] float32 one-hot (one col per carried key)
     # Term metadata
     term_uses_dom: np.ndarray  # [T, D] float32 — domains of the term's key
     pa_uses_dom: np.ndarray  # [Ta, D] float32 — positive-affinity term keys
+    ppa_uses_dom: np.ndarray  # [Tp, D] float32 — preferred-term keys
     sp_uses_dom: np.ndarray  # [S, D] float32
     sp_skew: np.ndarray  # [S] float32
     sps_uses_dom: np.ndarray  # [Ss, D] float32 — soft-spread constraint keys
@@ -164,11 +167,14 @@ class ConstraintSet:
     aa_node_c: np.ndarray  # [T, N] 0/1
     pa_dom_m: np.ndarray  # [Ta, D] 0/1 — domain holds a pod matched by PA term
     pa_node_m: np.ndarray  # [Ta, N] 0/1 — fine-granularity twin
+    ppa_dom_cnt: np.ndarray  # [Tp, D] float32 — preferred-term match counts
+    ppa_node_cnt: np.ndarray  # [Tp, N] float32 — fine-granularity twin
     sp_counts: np.ndarray  # [S, D] float32 — matching placed pods per domain
     sps_counts: np.ndarray  # [Ss, D] float32 — soft-spread matching counts
 
     n_terms: int
     n_pa_terms: int
+    n_ppa_terms: int
     n_spread: int
     n_spread_soft: int
 
@@ -182,6 +188,8 @@ class ConstraintSet:
             "pod_sp_matched": self.pod_sp_matched,
             "pod_sps_declares": self.pod_sps_declares,
             "pod_sps_matched": self.pod_sps_matched,
+            "pod_ppa_w": self.pod_ppa_w,
+            "pod_ppa_matched": self.pod_ppa_matched,
         }
 
     def meta_arrays(self) -> dict:
@@ -189,6 +197,7 @@ class ConstraintSet:
             "node_dom_c": self.node_dom_c,
             "term_uses_dom": self.term_uses_dom,
             "pa_uses_dom": self.pa_uses_dom,
+            "ppa_uses_dom": self.ppa_uses_dom,
             "sp_uses_dom": self.sp_uses_dom,
             "sp_skew": self.sp_skew,
             "sps_uses_dom": self.sps_uses_dom,
@@ -202,6 +211,8 @@ class ConstraintSet:
             "aa_node_c": self.aa_node_c,
             "pa_dom_m": self.pa_dom_m,
             "pa_node_m": self.pa_node_m,
+            "ppa_dom_cnt": self.ppa_dom_cnt,
+            "ppa_node_cnt": self.ppa_node_cnt,
             "sp_counts": self.sp_counts,
             "sps_counts": self.sps_counts,
         }
@@ -243,6 +254,12 @@ def pack_constraints(
         if p.spec is not None and p.spec.pod_affinity:
             for t in p.spec.pod_affinity:
                 pa_vocab.setdefault(_aa_key(p.metadata.namespace, t), (p.metadata.namespace, t))
+    # Preferred (soft, signed-weight) inter-pod terms — scoring only.
+    ppa_vocab: dict[tuple, tuple] = {}
+    for p in pending:
+        if p.spec is not None:
+            for w in (p.spec.preferred_pod_affinity or []) + (p.spec.preferred_pod_anti_affinity or []):
+                ppa_vocab.setdefault(_aa_key(p.metadata.namespace, w.term), (p.metadata.namespace, w.term))
     sp_vocab: dict[tuple, tuple] = {}  # hard (DoNotSchedule) — blocking
     sps_vocab: dict[tuple, tuple] = {}  # soft (ScheduleAnyway) — scoring only
     for p in pending:
@@ -251,12 +268,14 @@ def pack_constraints(
                 target = sp_vocab if c.is_hard else sps_vocab
                 target.setdefault(_sp_key(p.metadata.namespace, c), (p.metadata.namespace, c))
 
-    if not aa_vocab and not pa_vocab and not sp_vocab and not sps_vocab:
+    if not aa_vocab and not pa_vocab and not ppa_vocab and not sp_vocab and not sps_vocab:
         return None
     if len(aa_vocab) > max_aa_terms:
         raise UntensorizableConstraints(f"{len(aa_vocab)} anti-affinity terms > budget {max_aa_terms}")
     if len(pa_vocab) > max_aa_terms:
         raise UntensorizableConstraints(f"{len(pa_vocab)} pod-affinity terms > budget {max_aa_terms}")
+    if len(ppa_vocab) > max_aa_terms:
+        raise UntensorizableConstraints(f"{len(ppa_vocab)} preferred pod-affinity terms > budget {max_aa_terms}")
     if len(sp_vocab) > max_spread:
         raise UntensorizableConstraints(f"{len(sp_vocab)} spread constraints > budget {max_spread}")
     if len(sps_vocab) > max_spread:
@@ -266,6 +285,7 @@ def pack_constraints(
     keys = (
         {k for (_ns, k, _sel) in aa_vocab}
         | {k for (_ns, k, _sel) in pa_vocab}
+        | {k for (_ns, k, _sel) in ppa_vocab}
         | {k for (_ns, k, _sk, _sel) in sp_vocab}
         | {k for (_ns, k, _sk, _sel) in sps_vocab}
     )
@@ -298,6 +318,7 @@ def pack_constraints(
     d_pad = round_up(max(len(dom_vocab), 1), label_block)
     t_pad = round_up(max(len(aa_vocab), 1), label_block)
     ta_pad = round_up(max(len(pa_vocab), 1), label_block)
+    tp_pad = round_up(max(len(ppa_vocab), 1), label_block)
     s_pad = round_up(max(len(sp_vocab), 1), label_block)
     ss_pad = round_up(max(len(sps_vocab), 1), label_block)
     n_pad = padded_nodes
@@ -309,6 +330,7 @@ def pack_constraints(
 
     aa_terms = list(aa_vocab.items())  # [(key, (ns, term))]
     pa_terms = list(pa_vocab.items())
+    ppa_terms = list(ppa_vocab.items())
     sp_terms = list(sp_vocab.items())
     sps_terms = list(sps_vocab.items())
 
@@ -322,6 +344,11 @@ def pack_constraints(
         if term.topology_key not in fine_keys:
             for v in key_values.get(term.topology_key, ()):  # noqa: B007
                 pa_uses_dom[ti, dom_vocab[(term.topology_key, v)]] = 1.0
+    ppa_uses_dom = np.zeros((tp_pad, d_pad), dtype=np.float32)
+    for ti, (key, (_ns, term)) in enumerate(ppa_terms):
+        if term.topology_key not in fine_keys:
+            for v in key_values.get(term.topology_key, ()):  # noqa: B007
+                ppa_uses_dom[ti, dom_vocab[(term.topology_key, v)]] = 1.0
     sp_uses_dom = np.zeros((s_pad, d_pad), dtype=np.float32)
     sp_skew = np.zeros((s_pad,), dtype=np.float32)
     for si, (key, (_ns, c)) in enumerate(sp_terms):
@@ -342,8 +369,11 @@ def pack_constraints(
     pod_sp_matched = np.zeros((padded_pods, s_pad), dtype=np.float32)
     pod_sps_declares = np.zeros((padded_pods, ss_pad), dtype=np.float32)
     pod_sps_matched = np.zeros((padded_pods, ss_pad), dtype=np.float32)
+    pod_ppa_w = np.zeros((padded_pods, tp_pad), dtype=np.float32)
+    pod_ppa_matched = np.zeros((padded_pods, tp_pad), dtype=np.float32)
     aa_index = {key: i for i, (key, _) in enumerate(aa_terms)}
     pa_index = {key: i for i, (key, _) in enumerate(pa_terms)}
+    ppa_index = {key: i for i, (key, _) in enumerate(ppa_terms)}
     sp_index = {key: i for i, (key, _) in enumerate(sp_terms)}
     sps_index = {key: i for i, (key, _) in enumerate(sps_terms)}
     for pi, p in enumerate(pending):
@@ -354,6 +384,11 @@ def pack_constraints(
         if p.spec is not None and p.spec.pod_affinity:
             for t in p.spec.pod_affinity:
                 pod_pa_declares[pi, pa_index[_aa_key(ns, t)]] = 1.0
+        if p.spec is not None:
+            for w in p.spec.preferred_pod_affinity or []:
+                pod_ppa_w[pi, ppa_index[_aa_key(ns, w.term)]] += float(w.weight)
+            for w in p.spec.preferred_pod_anti_affinity or []:
+                pod_ppa_w[pi, ppa_index[_aa_key(ns, w.term)]] -= float(w.weight)
         if p.spec is not None and p.spec.topology_spread:
             for c in p.spec.topology_spread:
                 if c.is_hard:
@@ -366,6 +401,9 @@ def pack_constraints(
         for ti, (_key, (t_ns, term)) in enumerate(pa_terms):
             if t_ns == ns and term_matches(term, labels):
                 pod_pa_matched[pi, ti] = 1.0
+        for ti, (_key, (t_ns, term)) in enumerate(ppa_terms):
+            if t_ns == ns and term_matches(term, labels):
+                pod_ppa_matched[pi, ti] = 1.0
         for si, (_key, (c_ns, c)) in enumerate(sp_terms):
             if c_ns == ns and term_matches(c, labels):
                 pod_sp_matched[pi, si] = 1.0
@@ -380,6 +418,8 @@ def pack_constraints(
     aa_node_c = np.zeros((t_pad, n_pad), dtype=np.float32)
     pa_dom_m = np.zeros((ta_pad, d_pad), dtype=np.float32)
     pa_node_m = np.zeros((ta_pad, n_pad), dtype=np.float32)
+    ppa_dom_cnt = np.zeros((tp_pad, d_pad), dtype=np.float32)
+    ppa_node_cnt = np.zeros((tp_pad, n_pad), dtype=np.float32)
     sp_counts = np.zeros((s_pad, d_pad), dtype=np.float32)
     sps_counts = np.zeros((ss_pad, d_pad), dtype=np.float32)
     node_index = {n.name: i for i, n in enumerate(nodes)}
@@ -393,7 +433,17 @@ def pack_constraints(
         else:
             arr_node[ti, ni] = 1.0
 
-    if aa_terms or pa_terms:
+    def _count(arr_dom, arr_node, ti, term, qnode_name):
+        """+= twin of _mark for the count-valued preferred-term state."""
+        ni = node_index[qnode_name]
+        k = term.topology_key
+        v = (nodes[ni].metadata.labels or {}).get(k)
+        if k not in fine_keys and v is not None:
+            arr_dom[ti, dom_vocab[(k, v)]] += 1.0
+        else:
+            arr_node[ti, ni] += 1.0
+
+    if aa_terms or pa_terms or ppa_terms:
         for q, qnode in snapshot.placed_pods():
             q_ns, q_labels = q.metadata.namespace, q.metadata.labels
             for ti, (_key, (t_ns, term)) in enumerate(aa_terms):
@@ -402,6 +452,9 @@ def pack_constraints(
             for ti, (_key, (t_ns, term)) in enumerate(pa_terms):
                 if t_ns == q_ns and term_matches(term, q_labels):
                     _mark(pa_dom_m, pa_node_m, ti, term, qnode.name)
+            for ti, (_key, (t_ns, term)) in enumerate(ppa_terms):
+                if t_ns == q_ns and term_matches(term, q_labels):
+                    _count(ppa_dom_cnt, ppa_node_cnt, ti, term, qnode.name)
         for q, qnode in placed_with_terms:
             ns = q.metadata.namespace
             for t in q.spec.anti_affinity:
@@ -433,9 +486,12 @@ def pack_constraints(
         pod_sp_matched=pod_sp_matched,
         pod_sps_declares=pod_sps_declares,
         pod_sps_matched=pod_sps_matched,
+        pod_ppa_w=pod_ppa_w,
+        pod_ppa_matched=pod_ppa_matched,
         node_dom_c=node_dom_c,
         term_uses_dom=term_uses_dom,
         pa_uses_dom=pa_uses_dom,
+        ppa_uses_dom=ppa_uses_dom,
         sp_uses_dom=sp_uses_dom,
         sp_skew=sp_skew,
         sps_uses_dom=sps_uses_dom,
@@ -445,10 +501,13 @@ def pack_constraints(
         aa_node_c=aa_node_c,
         pa_dom_m=pa_dom_m,
         pa_node_m=pa_node_m,
+        ppa_dom_cnt=ppa_dom_cnt,
+        ppa_node_cnt=ppa_node_cnt,
         sp_counts=sp_counts,
         sps_counts=sps_counts,
         n_terms=len(aa_terms),
         n_pa_terms=len(pa_terms),
+        n_ppa_terms=len(ppa_terms),
         n_spread=len(sp_terms),
         n_spread_soft=len(sps_terms),
     )
@@ -463,7 +522,9 @@ def _clip01(xp, a):
     return xp.minimum(a, 1.0)
 
 
-def round_blocked_masks(xp, state: dict, meta: dict, soft_spread: bool = False) -> dict:
+def round_blocked_masks(
+    xp, state: dict, meta: dict, soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True
+) -> dict:
     """Per-round [·, N] blocked-node masks from the current domain state.
 
     aa_m_node[T,N]: node's domain (under term t's key) holds a matched pod —
@@ -486,24 +547,26 @@ def round_blocked_masks(xp, state: dict, meta: dict, soft_spread: bool = False) 
     # the term is globally inactive (no match anywhere) AND the pod matches
     # its own term (the bootstrap waiver; blocked_block applies the pod-side
     # gate from pa_inactive).
-    pa_m_node = _clip01(xp, state["pa_dom_m"] @ ndc_t + state["pa_node_m"])
-    pa_unmatched_node = 1.0 - pa_m_node
-    pa_inactive = (state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0  # [Ta]
+    if hard_pa:
+        pa_m_node = _clip01(xp, state["pa_dom_m"] @ ndc_t + state["pa_node_m"])
+        pa_unmatched_node = 1.0 - pa_m_node
+        pa_inactive = (state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0  # [Ta]
     uses = meta["sp_uses_dom"]
     counts = state["sp_counts"]
     lo = xp.min(xp.where(uses > 0, counts, RANK_INF), axis=1)
     lo = xp.where(lo >= RANK_INF, 0.0, lo)
     blockcell = uses * (counts >= (meta["sp_skew"] + lo)[:, None])
     sp_node = _clip01(xp, blockcell @ ndc_t)
-    masks = {
-        "aa_m_node": aa_m_node,
-        "aa_c_node": aa_c_node,
-        "sp_node": sp_node,
-        "pa_unmatched_node": pa_unmatched_node,
-        "pa_inactive": pa_inactive.astype(xp.float32),
-    }
+    masks = {"aa_m_node": aa_m_node, "aa_c_node": aa_c_node, "sp_node": sp_node}
+    if hard_pa:
+        masks["pa_unmatched_node"] = pa_unmatched_node
+        masks["pa_inactive"] = pa_inactive.astype(xp.float32)
     if soft_spread:
         masks["sp_penalty_node"] = state["sps_counts"] @ ndc_t
+    if soft_pa:
+        # Preferred inter-pod terms: per-term match COUNT at each node's
+        # domain; score_block adds pod_ppa_w (signed weights) @ this.
+        masks["ppa_cnt_node"] = state["ppa_dom_cnt"] @ ndc_t + state["ppa_node_cnt"]
     return masks
 
 
@@ -518,8 +581,9 @@ def blocked_block(xp, blk: dict, masks: dict):
     # nodes (terms AND — any unmet term blocks).  A non-self-matching pod
     # with an inactive term keeps it → unmatched everywhere → unschedulable
     # this round, exactly the scalar checker's "unmatchable" rule.
-    gated = blk["pod_pa_declares"] * (1.0 - blk["pod_pa_matched"] * masks["pa_inactive"][None, :])
-    b = b + gated @ masks["pa_unmatched_node"]
+    if "pa_unmatched_node" in masks:
+        gated = blk["pod_pa_declares"] * (1.0 - blk["pod_pa_matched"] * masks["pa_inactive"][None, :])
+        b = b + gated @ masks["pa_unmatched_node"]
     return b > 0
 
 
@@ -554,7 +618,7 @@ def _cummax(xp, a):
     return lax.cummax(a, axis=0)
 
 
-def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: dict) -> object:
+def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: dict, hard_pa: bool = True) -> object:
     """Within-round conflict resolution — returns the surviving subset of
     ``accepted`` (see module docstring for the rank rules)."""
     ndc = meta["node_dom_c"]
@@ -593,13 +657,14 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     # one round (the term is then active and the round-start mask routes
     # them to its domain).  Over-inclusive min (it counts matches a later
     # filter may drop) only defers more — never admits a violation.
-    pa_inactive_f = ((state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0).astype(xp.float32)
-    keep_pa_f = keep.astype(xp.float32)
-    pa_m_acc = ps["pod_pa_matched"] * keep_pa_f[:, None]  # [P, Ta]
-    min_match_rank = xp.min(xp.where(pa_m_acc > 0, rank_f[:, None], RANK_INF), axis=0)  # [Ta]
-    waived = ps["pod_pa_declares"] * ps["pod_pa_matched"] * pa_inactive_f[None, :]  # [P, Ta]
-    bad_pa = (waived > 0) & keep[:, None] & (rank_f[:, None] > min_match_rank[None, :])
-    keep = keep & ~bad_pa.any(axis=1)
+    if hard_pa:
+        pa_inactive_f = ((state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0).astype(xp.float32)
+        keep_pa_f = keep.astype(xp.float32)
+        pa_m_acc = ps["pod_pa_matched"] * keep_pa_f[:, None]  # [P, Ta]
+        min_match_rank = xp.min(xp.where(pa_m_acc > 0, rank_f[:, None], RANK_INF), axis=0)  # [Ta]
+        waived = ps["pod_pa_declares"] * ps["pod_pa_matched"] * pa_inactive_f[None, :]  # [P, Ta]
+        bad_pa = (waived > 0) & keep[:, None] & (rank_f[:, None] > min_match_rank[None, :])
+        keep = keep & ~bad_pa.any(axis=1)
 
     # ---- topology spread (vectorized over S) ------------------------------
     uses_sp = meta["sp_uses_dom"]  # [S, D]
@@ -684,7 +749,17 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     return keep & ~bad_sp.any(axis=1)
 
 
-def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict, soft_spread: bool = False) -> dict:
+def constraint_commit(
+    xp,
+    accepted,
+    choice,
+    ps: dict,
+    state: dict,
+    meta: dict,
+    soft_spread: bool = False,
+    soft_pa: bool = False,
+    hard_pa: bool = True,
+) -> dict:
     """Fold the round's final accepted placements into the domain state."""
     ndc = meta["node_dom_c"]
     d = ndc.shape[1]
@@ -705,16 +780,39 @@ def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict, s
     gn = (xp.arange(t, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
     aa_node_m = _scatter_max1(xp, state["aa_node_m"].reshape(-1), gn, fine_m).reshape(t, n)
     aa_node_c = _scatter_max1(xp, state["aa_node_c"].reshape(-1), gn, fine_c).reshape(t, n)
-    # Positive affinity: every accepted pod matching a PA term activates its
-    # landing domain (declaring or not — matches are matches).
-    uses_pa = meta["pa_uses_dom"]
-    ta = uses_pa.shape[0]
-    matc_pa = ps["pod_pa_matched"] * accf[:, None]  # [P, Ta]
-    pa_dom_m = _clip01(xp, state["pa_dom_m"] + (matc_pa.T @ nd) * uses_pa)
-    has_c_pa = nd @ uses_pa.T  # [P, Ta]
-    fine_pa = (matc_pa * (has_c_pa == 0)).T.reshape(-1)
-    gn_pa = (xp.arange(ta, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
-    pa_node_m = _scatter_max1(xp, state["pa_node_m"].reshape(-1), gn_pa, fine_pa).reshape(ta, n)
+    if hard_pa:
+        # Positive affinity: every accepted pod matching a PA term activates
+        # its landing domain (declaring or not — matches are matches).
+        uses_pa = meta["pa_uses_dom"]
+        ta = uses_pa.shape[0]
+        matc_pa = ps["pod_pa_matched"] * accf[:, None]  # [P, Ta]
+        pa_dom_m = _clip01(xp, state["pa_dom_m"] + (matc_pa.T @ nd) * uses_pa)
+        has_c_pa = nd @ uses_pa.T  # [P, Ta]
+        fine_pa = (matc_pa * (has_c_pa == 0)).T.reshape(-1)
+        gn_pa = (xp.arange(ta, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
+        pa_node_m = _scatter_max1(xp, state["pa_node_m"].reshape(-1), gn_pa, fine_pa).reshape(ta, n)
+    else:
+        pa_dom_m = state["pa_dom_m"]
+        pa_node_m = state["pa_node_m"]
+    if soft_pa:
+        # Preferred terms: accepted matched pods bump their landing domain's
+        # count (coarse) or node's count (fine/keyless) — same split as PA.
+        uses_ppa = meta["ppa_uses_dom"]
+        tpp = uses_ppa.shape[0]
+        matc_ppa = ps["pod_ppa_matched"] * accf[:, None]  # [P, Tp]
+        ppa_dom_cnt = state["ppa_dom_cnt"] + (matc_ppa.T @ nd) * uses_ppa
+        has_c_ppa = nd @ uses_ppa.T  # [P, Tp]
+        fine_ppa = (matc_ppa * (has_c_ppa == 0)).T.reshape(-1)
+        gn_ppa = (xp.arange(tpp, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
+        if xp is np:
+            flat = state["ppa_node_cnt"].reshape(-1).copy()
+            np.add.at(flat, gn_ppa, fine_ppa)
+            ppa_node_cnt = flat.reshape(tpp, n)
+        else:
+            ppa_node_cnt = state["ppa_node_cnt"].reshape(-1).at[gn_ppa].add(fine_ppa).reshape(tpp, n)
+    else:
+        ppa_dom_cnt = state["ppa_dom_cnt"]
+        ppa_node_cnt = state["ppa_node_cnt"]
     sp_m = ps["pod_sp_matched"] * accf[:, None]  # [P, S]
     sp_counts = state["sp_counts"] + (sp_m.T @ nd) * meta["sp_uses_dom"]
     if soft_spread:
@@ -729,6 +827,8 @@ def constraint_commit(xp, accepted, choice, ps: dict, state: dict, meta: dict, s
         "aa_node_c": aa_node_c,
         "pa_dom_m": pa_dom_m,
         "pa_node_m": pa_node_m,
+        "ppa_dom_cnt": ppa_dom_cnt,
+        "ppa_node_cnt": ppa_node_cnt,
         "sp_counts": sp_counts,
         "sps_counts": sps_counts,
     }

@@ -43,6 +43,8 @@ def score_block(
     node_taints_soft=None,
     pod_sps_declares=None,
     sp_penalty_node=None,
+    pod_ppa_w=None,
+    ppa_cnt_node=None,
     salt=None,
 ):
     """[B, N] combined priority score of a block of pods against all nodes.
@@ -62,7 +64,12 @@ def score_block(
       • ScheduleAnyway topology spread: −w₅ per matching placed pod already
         in the node's domain, per declared soft constraint
         (pod_sps_declares [B,Ss] · sp_penalty_node [Ss,N],
-        ops/constraints.round_blocked_masks) — emptier domains score higher.
+        ops/constraints.round_blocked_masks) — emptier domains score higher;
+      • preferred inter-pod (anti-)affinity: ± term-weight per matching pod
+        in the node's domain (pod_ppa_w [B,Tp] SIGNED weights ·
+        ppa_cnt_node [Tp,N] domain match counts, kube InterPodAffinity
+        scoring; anti-preference rides the same matmul with negative
+        weights, so no extra global knob — the 1-100 term weights rule).
     """
     f32 = xp.float32
     used_after = (node_alloc - node_avail)[None, :, :] + pod_req[:, None, :]  # [B,N,2] int32
@@ -89,4 +96,6 @@ def score_block(
         score = score + weights[2] * (h.astype(f32) / f32(65536.0))
     if pod_sps_declares is not None and sp_penalty_node is not None:
         score = score - weights[5] * (pod_sps_declares @ sp_penalty_node)
+    if pod_ppa_w is not None and ppa_cnt_node is not None:
+        score = score + pod_ppa_w @ ppa_cnt_node
     return score.astype(f32)

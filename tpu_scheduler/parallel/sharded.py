@@ -59,7 +59,7 @@ __all__ = ["sharded_assign_cycle", "ShardedBackend", "IN_SPECS", "CONSTRAINT_KEY
 def _local_choose(
     avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels, node_taints,
     node_aff, node_valid, node_pref, node_taints_soft, weights, pod_idx, node_idx,
-    blocked=None, sps_declares=None, sp_penalty=None, salt=None,
+    blocked=None, sps_declares=None, sp_penalty=None, ppa_w=None, ppa_cnt=None, salt=None,
 ):
     """Best local node per pod of this shard: (best_score, local idx, has).
 
@@ -75,7 +75,8 @@ def _local_choose(
     sc = score_block(
         jnp, req, node_alloc, avail, weights, pod_idx, node_idx,
         pod_pref_w=pref_w, node_pref=node_pref, pod_ntol_soft=ntol_soft, node_taints_soft=node_taints_soft,
-        pod_sps_declares=sps_declares, sp_penalty_node=sp_penalty, salt=salt,
+        pod_sps_declares=sps_declares, sp_penalty_node=sp_penalty,
+        pod_ppa_w=ppa_w, ppa_cnt_node=ppa_cnt, salt=salt,
     )
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.max(sc, axis=1), jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
@@ -107,10 +108,13 @@ CONSTRAINT_KEYS = (
     "pod_sp_matched",
     "pod_sps_declares",
     "pod_sps_matched",
+    "pod_ppa_w",
+    "pod_ppa_matched",
     # meta (node_dom_c is [N,D] with N padded to the tp multiple)
     "node_dom_c",
     "term_uses_dom",
     "pa_uses_dom",
+    "ppa_uses_dom",
     "sp_uses_dom",
     "sp_skew",
     "sps_uses_dom",
@@ -121,15 +125,19 @@ CONSTRAINT_KEYS = (
     "aa_node_c",
     "pa_dom_m",
     "pa_node_m",
+    "ppa_dom_cnt",
+    "ppa_node_cnt",
     "sp_counts",
     "sps_counts",
 )
-_N_PODKEYS = 8
-_N_METAKEYS = 6
+_N_PODKEYS = 10
+_N_METAKEYS = 7
 
 
 @lru_cache(maxsize=64)
-def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False):
+def _build_shard_map(
+    mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True
+):
     """The shard_map'd per-device cycle fn (not yet jitted/wrapped) — shared
     by the single-process run wrapper below and the multi-host path
     (parallel/multihost.py), so both execute the identical program."""
@@ -172,9 +180,9 @@ def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spre
 
             # 1. choose: local tile (with the constraint-blocked columns of
             # this shard when constrained), then argmax across the tp axis.
-            blocked_l = sps_dec_l = sp_pen_l = None
+            blocked_l = sps_dec_l = sp_pen_l = ppa_w_l = ppa_cnt_l = None
             if constrained:
-                masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread)  # [·, n_tot]
+                masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)  # [·, n_tot]
                 # Node-axis masks slice to this shard's columns; pa_inactive
                 # is per-TERM ([Ta], no node axis) and stays whole.
                 lm = {
@@ -185,10 +193,14 @@ def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spre
                 if soft_spread:
                     sps_dec_l = blk_l["pod_sps_declares"]
                     sp_pen_l = lm["sp_penalty_node"]
+                if soft_pa:
+                    ppa_w_l = blk_l["pod_ppa_w"]
+                    ppa_cnt_l = lm["ppa_cnt_node"]
             best_l, idx_l, _ = _local_choose(
                 avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels,
                 node_taints, node_aff, node_valid, node_pref, node_taints_soft, w, g_pod_idx, g_node_idx,
-                blocked=blocked_l, sps_declares=sps_dec_l, sp_penalty=sp_pen_l, salt=rounds,
+                blocked=blocked_l, sps_declares=sps_dec_l, sp_penalty=sp_pen_l,
+                ppa_w=ppa_w_l, ppa_cnt=ppa_cnt_l, salt=rounds,
             )
             bests = lax.all_gather(best_l, "tp")  # [tp, p_local]
             idxs = lax.all_gather(idx_l + node_base, "tp")
@@ -225,8 +237,8 @@ def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spre
             # every device computes the identical result (no collective).
             if constrained:
                 gi = jnp.minimum(g_choice, n_tot - 1).astype(jnp.int32)  # clamp the non-claimant sentinel
-                accepted = constraint_filter(jnp, accepted, gi, g_ranks, cpods, cst, cmeta)
-                cst = constraint_commit(jnp, accepted, gi, cpods, cst, cmeta, soft_spread=soft_spread)
+                accepted = constraint_filter(jnp, accepted, gi, g_ranks, cpods, cst, cmeta, hard_pa=hard_pa)
+                cst = constraint_commit(jnp, accepted, gi, cpods, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
 
             # 4. capacity commit from the FILTERED accepted set; each column
             # scatter-subtracts its own nodes.
@@ -238,7 +250,7 @@ def _build_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spre
             assigned = jnp.where(acc_local, choice, assigned)
             was_active = active  # round-start actives (not yet rebound)
             new_active = cand & ~acc_local
-            if constrained:
+            if constrained and hard_pa:
                 # PA declarers blocked everywhere stay active while the round
                 # placed anyone (see ops/assign.py) — `accepted` is global
                 # and replicated, so every device computes the same flag.
@@ -307,22 +319,25 @@ def constraint_operands(cons, n_pad_from: int, n_pad_to: int) -> dict:
     meta = cons.meta_arrays()
     state = cons.state_arrays()
     ops["node_dom_c"] = np.pad(meta["node_dom_c"], ((0, extra), (0, 0)))
-    for k in ("term_uses_dom", "pa_uses_dom", "sp_uses_dom", "sp_skew", "sps_uses_dom"):
+    for k in ("term_uses_dom", "pa_uses_dom", "ppa_uses_dom", "sp_uses_dom", "sp_skew", "sps_uses_dom"):
         ops[k] = meta[k]
-    for k in ("aa_dom_m", "aa_dom_c", "pa_dom_m", "sp_counts", "sps_counts"):
+    for k in ("aa_dom_m", "aa_dom_c", "pa_dom_m", "ppa_dom_cnt", "sp_counts", "sps_counts"):
         ops[k] = state[k]
     ops["aa_node_m"] = np.pad(state["aa_node_m"], ((0, 0), (0, extra)))
     ops["aa_node_c"] = np.pad(state["aa_node_c"], ((0, 0), (0, extra)))
     ops["pa_node_m"] = np.pad(state["pa_node_m"], ((0, 0), (0, extra)))
+    ops["ppa_node_cnt"] = np.pad(state["ppa_node_cnt"], ((0, 0), (0, extra)))
     return ops
 
 
 @lru_cache(maxsize=64)
-def _build_sharded_fn(mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False):
+def _build_sharded_fn(
+    mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True
+):
     """Jitted (mesh, max_rounds)-specialised cycle fn — cached so repeated
     cycles reuse the compiled executable (jit re-specialises per shape)."""
     dp = mesh.shape["dp"]
-    sharded = _build_shard_map(mesh, max_rounds, constrained, soft_spread)
+    sharded = _build_shard_map(mesh, max_rounds, constrained, soft_spread, soft_pa, hard_pa)
 
     @jax.jit
     def run(a, c):
@@ -357,7 +372,10 @@ def _build_sharded_fn(mesh, max_rounds: int, constrained: bool = False, soft_spr
     return run
 
 
-def sharded_assign_cycle(mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None, soft_spread: bool = False):
+def sharded_assign_cycle(
+    mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None,
+    soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True,
+):
     """Run one cycle over the mesh. ``arrays`` are the PackedCluster device
     arrays with N pre-padded to a tp multiple (pods pad internally, post-
     permute); ``constraints`` the :func:`constraint_operands` dict for
@@ -365,7 +383,7 @@ def sharded_assign_cycle(mesh, arrays: dict, weights, max_rounds: int = 32, cons
     assert arrays["node_avail"].shape[0] % mesh.shape["tp"] == 0
     a = dict(arrays)
     a["weights"] = np.asarray(weights, dtype=np.float32)
-    run = _build_sharded_fn(mesh, max_rounds, constraints is not None, soft_spread)
+    run = _build_sharded_fn(mesh, max_rounds, constraints is not None, soft_spread, soft_pa, hard_pa)
     return run(a, constraints if constraints is not None else {})
 
 
@@ -398,6 +416,8 @@ class ShardedBackend(SchedulingBackend):
             cons = packed.constraints
             c = constraint_operands(cons, packed.padded_nodes, n_pad) if cons is not None else None
             soft_spread = cons is not None and cons.n_spread_soft > 0
+            soft_pa = cons is not None and cons.n_ppa_terms > 0
+            hard_pa = cons is not None and cons.n_pa_terms > 0
             if jax.process_count() > 1:
                 # Multi-controller runtime: host-local numpy can't feed a jit
                 # over non-addressable devices — route through the global-
@@ -405,11 +425,13 @@ class ShardedBackend(SchedulingBackend):
                 from .multihost import sharded_assign_multihost
 
                 assigned, rounds = sharded_assign_multihost(
-                    self.mesh, a, profile.weights(), profile.max_rounds, constraints=c, soft_spread=soft_spread
+                    self.mesh, a, profile.weights(), profile.max_rounds, constraints=c,
+                    soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa,
                 )
                 return np.asarray(assigned), int(rounds)
             assigned, rounds, _avail = sharded_assign_cycle(
-                self.mesh, a, profile.weights(), profile.max_rounds, constraints=c, soft_spread=soft_spread
+                self.mesh, a, profile.weights(), profile.max_rounds, constraints=c,
+                soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa,
             )
             return np.asarray(jax.device_get(assigned)), int(rounds)
         except jax.errors.JaxRuntimeError as e:

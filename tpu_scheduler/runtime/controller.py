@@ -42,6 +42,7 @@ from ..core.predicates import (
     anti_affinity_ok,
     make_affinity_checker,
     make_pod_affinity_checker,
+    make_preferred_pod_affinity_scorer,
     make_soft_spread_scorer,
     make_spread_checker,
     pod_affinity_ok,
@@ -276,7 +277,13 @@ class Scheduler:
         plain: list[Pod] = []
         constrained: list[Pod] = []
         for p in pending:
-            if p.spec is not None and (p.spec.anti_affinity or p.spec.pod_affinity or p.spec.topology_spread):
+            if p.spec is not None and (
+                p.spec.anti_affinity
+                or p.spec.pod_affinity
+                or p.spec.preferred_pod_affinity
+                or p.spec.preferred_pod_anti_affinity
+                or p.spec.topology_spread
+            ):
                 constrained.append(p)
                 continue
             ns = p.metadata.namespace
@@ -295,6 +302,7 @@ class Scheduler:
         ledger: dict[str, PodResources],
         weights,
         soft_spread_penalty: float = 0.0,
+        preferred_pod_score: float = 0.0,
     ) -> float:
         """LeastRequested + BalancedAllocation + soft terms for one
         (pod, node) — the scalar twin of ops/score.py (without the tie-break
@@ -317,6 +325,9 @@ class Scheduler:
         score += float(weights[3]) * preferred_affinity_score(pod, node)
         score -= float(weights[4]) * soft_taint_penalty(pod, node)
         score -= float(weights[5]) * soft_spread_penalty
+        # Preferred inter-pod (anti-)affinity carries its own 1-100 term
+        # weights, signed — no profile knob (mirrors ops/score.py).
+        score += preferred_pod_score
         return score
 
     def _run_constrained_phase(
@@ -347,6 +358,7 @@ class Scheduler:
             pod_affinity_checker = make_pod_affinity_checker(pod, snapshot, placed)
             spread_checker = make_spread_checker(pod, snapshot, placed)
             soft_spread = make_soft_spread_scorer(pod, snapshot, placed)
+            ppa_scorer = make_preferred_pod_affinity_scorer(pod, snapshot, placed)
             best: Node | None = None
             best_score = 0.0
             for node in snapshot.nodes:
@@ -357,7 +369,7 @@ class Scheduler:
                 )
                 if reason is not None:
                     continue
-                score = self._scalar_score(pod, node, snapshot, ledger, weights, soft_spread(node))
+                score = self._scalar_score(pod, node, snapshot, ledger, weights, soft_spread(node), ppa_scorer(node))
                 if best is None or score > best_score:
                     best, best_score = node, score
             if best is None:
@@ -807,17 +819,17 @@ class Scheduler:
             prio = _pod_priority(pod)
             req = total_pod_resources(pod)
             best = best_key = None
+            # Hoisted per-pod checkers: one placed-pod scan, O(1) per node.
+            aa_checker = make_affinity_checker(pod, snapshot, placed_overlay)
+            # Positive affinity gates candidates too: eviction frees
+            # capacity but can never conjure a co-location match, so a
+            # node outside the pod's required domain is never a target.
+            pa_checker = make_pod_affinity_checker(pod, snapshot, placed_overlay)
+            sp_checker = make_spread_checker(pod, snapshot, placed_overlay)
             for node in snapshot.nodes:
                 if any(not pred(pod, node, snapshot) for _, pred in NODE_LOCAL_PREDICATES):
                     continue
-                if not anti_affinity_ok(pod, node, snapshot, extra_placed=placed_overlay):
-                    continue
-                # Positive affinity gates candidates too: eviction frees
-                # capacity but can never conjure a co-location match, so a
-                # node outside the pod's required domain is never a target.
-                if not pod_affinity_ok(pod, node, snapshot, extra_placed=placed_overlay):
-                    continue
-                if not topology_spread_ok(pod, node, snapshot, extra_placed=placed_overlay):
+                if not aa_checker(node) or not pa_checker(node) or not sp_checker(node):
                     continue
                 avail = node_allocatable(node)
                 avail -= node_used_resources(snapshot, node.name)
@@ -836,6 +848,19 @@ class Scheduler:
                     victims.append(q)
                     got += total_pod_resources(q)
                 if got.cpu >= need_cpu and got.memory >= need_mem:
+                    if victims:
+                        # kube's selectVictimsOnNode re-filter: the node must
+                        # still satisfy affinity/spread AS IF the victims were
+                        # already gone — evicting the very pod that satisfies
+                        # the preemptor's required podAffinity (or shifting a
+                        # spread minimum) disqualifies the candidate.
+                        # (Anti-affinity only relaxes when pods leave — no
+                        # re-check needed.)
+                        vnames = frozenset(full_name(q) for q in victims)
+                        if not make_pod_affinity_checker(pod, snapshot, placed_overlay, exclude=vnames)(node):
+                            continue
+                        if not make_spread_checker(pod, snapshot, placed_overlay, exclude=vnames)(node):
+                            continue
                     key = (_pod_priority(victims[-1]) if victims else -(2**31), len(victims))
                     if best_key is None or key < best_key:
                         best, best_key = (node, victims), key

@@ -64,6 +64,8 @@ def make_pod(
     labels: dict[str, str] | None = None,
     anti_affinity: list[PodAntiAffinityTerm] | None = None,
     pod_affinity: list[PodAntiAffinityTerm] | None = None,
+    preferred_pod_affinity: list | None = None,
+    preferred_pod_anti_affinity: list | None = None,
     topology_spread: list[TopologySpreadConstraint] | None = None,
     tolerations: list[Toleration] | None = None,
     node_affinity: list[NodeSelectorTerm] | None = None,
@@ -81,6 +83,8 @@ def make_pod(
             priority=priority,
             anti_affinity=anti_affinity,
             pod_affinity=pod_affinity,
+            preferred_pod_affinity=preferred_pod_affinity,
+            preferred_pod_anti_affinity=preferred_pod_anti_affinity,
             topology_spread=topology_spread,
             tolerations=tolerations,
             node_affinity=node_affinity,
@@ -108,6 +112,7 @@ def synth_cluster(
     schedule_anyway_fraction: float = 0.0,
     gang_fraction: float = 0.0,
     pod_affinity_fraction: float = 0.0,
+    preferred_pod_affinity_fraction: float = 0.0,
 ) -> ClusterSnapshot:
     """Generate a synthetic cluster snapshot.
 
@@ -138,6 +143,11 @@ def synth_cluster(
     affinity: self-affine co-location groups (the term matches the pod's own
     ``pa-group`` label over the zone key), so the first member exercises the
     bootstrap waiver and later members must follow it into its zone.
+
+    ``preferred_pod_affinity_fraction`` declare SOFT inter-pod terms: a
+    weighted preference to co-locate with their own soft group over the
+    zone key, and (30% of them) a weighted anti-preference against another
+    group — the signed-weight scoring path (ops/score.py ppa matmul).
     """
     rng = random.Random(seed)
     if n_nodes == 0:
@@ -197,6 +207,27 @@ def synth_cluster(
         if pod_affinity_fraction and rng.random() < pod_affinity_fraction:
             pa_label = f"pa-group-{rng.randrange(0, 8)}"
             pod_aff = [PodAntiAffinityTerm(match_labels={"pa": pa_label}, topology_key="zone")]
+        pref_pod_aff = pref_pod_anti = None
+        sg_label = None
+        if preferred_pod_affinity_fraction and rng.random() < preferred_pod_affinity_fraction:
+            from .api.objects import WeightedPodAffinityTerm
+
+            sg = rng.randrange(0, 6)
+            sg_label = f"soft-g{sg}"
+            pref_pod_aff = [
+                WeightedPodAffinityTerm(
+                    weight=rng.choice([10, 50, 100]),
+                    term=PodAntiAffinityTerm(match_labels={"sg": sg_label}, topology_key="zone"),
+                )
+            ]
+            if rng.random() < 0.3:
+                other = f"soft-g{(sg + 1) % 6}"
+                pref_pod_anti = [
+                    WeightedPodAffinityTerm(
+                        weight=rng.choice([10, 50]),
+                        term=PodAntiAffinityTerm(match_labels={"sg": other}, topology_key="zone"),
+                    )
+                ]
         spread = None
         if rng.random() < spread_fraction:
             spread = [TopologySpreadConstraint(topology_key="zone", max_skew=rng.choice([1, 2]), match_labels={"app": app})]
@@ -275,9 +306,15 @@ def synth_cluster(
             memory=f"{rng.choice([128, 256, 512, 1024, 4096])}Mi",
             node_selector=selector,
             priority=rng.randrange(0, 10),
-            labels={"app": app, **({"pa": pa_label} if pa_label else {})},
+            labels={
+                "app": app,
+                **({"pa": pa_label} if pa_label else {}),
+                **({"sg": sg_label} if sg_label else {}),
+            },
             anti_affinity=anti,
             pod_affinity=pod_aff,
+            preferred_pod_affinity=pref_pod_aff,
+            preferred_pod_anti_affinity=pref_pod_anti,
             topology_spread=spread,
             tolerations=tols,
             node_affinity=node_aff,
