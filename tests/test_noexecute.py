@@ -1,0 +1,170 @@
+"""NoExecute taint lifecycle — the eviction side of taints (kube's taint
+manager), beyond the scheduling-time filter the framework already enforces.
+Absent in the reference (no taints at all, src/predicates.rs)."""
+
+from tpu_scheduler.api.objects import Taint, Toleration
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+NOEXEC = Taint(key="maint", value="drain", effect="NoExecute")
+TOL_FOREVER = Toleration(key="maint", operator="Equal", value="drain", effect="NoExecute")
+TOL_60S = Toleration(key="maint", operator="Equal", value="drain", effect="NoExecute", toleration_seconds=60)
+
+
+def _cluster(api, pods, taints=None):
+    # n2 is deliberately too small for the 7-cpu mover pod: freed capacity on
+    # n1 is the only place it fits.
+    api.load(
+        nodes=[make_node("n1", cpu="8", memory="32Gi", taints=taints), make_node("n2", cpu="4", memory="32Gi")],
+        pods=pods,
+    )
+
+
+def test_untolerated_pod_evicted_and_capacity_freed():
+    api = FakeApiServer()
+    _cluster(
+        api,
+        pods=[
+            make_pod("victim", cpu="7", memory="1Gi", node_name="n1", phase="Running"),
+            # big pending pod that only fits n1 once the victim is gone, and
+            # tolerates the taint so it may schedule there
+            make_pod("mover", cpu="7", memory="1Gi", tolerations=[TOL_FOREVER]),
+        ],
+        taints=[NOEXEC],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    names = {p.metadata.name: p for p in api.list_pods()}
+    assert "victim" not in names, "untolerated pod must be evicted from the NoExecute node"
+    assert names["mover"].spec.node_name == "n1", "freed capacity must be usable the same cycle"
+    assert m.bound == 1
+    assert sched.metrics.snapshot()["scheduler_noexecute_evictions_total"] == 1
+
+
+def test_tolerating_pod_stays():
+    api = FakeApiServer()
+    _cluster(
+        api,
+        pods=[make_pod("keeper", cpu="1", memory="1Gi", node_name="n1", phase="Running", tolerations=[TOL_FOREVER])],
+        taints=[NOEXEC],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    sched.run_cycle()
+    names = {p.metadata.name for p in api.list_pods()}
+    assert "keeper" in names
+
+
+def test_toleration_seconds_grace_then_eviction():
+    now = [0.0]
+    api = FakeApiServer()
+    _cluster(
+        api,
+        pods=[make_pod("graced", cpu="1", memory="1Gi", node_name="n1", phase="Running", tolerations=[TOL_60S])],
+        taints=[NOEXEC],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    sched.run_cycle()  # first sighting starts the grace clock
+    assert "graced" in {p.metadata.name for p in api.list_pods()}
+    now[0] = 30.0
+    sched.run_cycle()  # still within 60s
+    assert "graced" in {p.metadata.name for p in api.list_pods()}
+    now[0] = 61.0
+    sched.run_cycle()  # grace expired
+    assert "graced" not in {p.metadata.name for p in api.list_pods()}
+
+
+def test_taint_removal_resets_grace_clock():
+    now = [0.0]
+    api = FakeApiServer()
+    _cluster(
+        api,
+        pods=[make_pod("graced", cpu="1", memory="1Gi", node_name="n1", phase="Running", tolerations=[TOL_60S])],
+        taints=[NOEXEC],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    sched.run_cycle()  # clock starts
+    # taint removed: the grace state must be forgotten
+    n1 = next(n for n in api.list_nodes() if n.metadata.name == "n1")
+    n1.spec.taints = []
+    now[0] = 45.0
+    sched.run_cycle()
+    # taint returns: a FRESH 60s window begins at the next sighting (t=61),
+    # so t=100 is still safe and t=122 is past the 61+60 deadline
+    n1.spec.taints = [NOEXEC]
+    now[0] = 61.0
+    sched.run_cycle()
+    assert "graced" in {p.metadata.name for p in api.list_pods()}
+    now[0] = 100.0
+    sched.run_cycle()
+    assert "graced" in {p.metadata.name for p in api.list_pods()}
+    now[0] = 122.0
+    sched.run_cycle()
+    assert "graced" not in {p.metadata.name for p in api.list_pods()}
+
+
+def test_toleration_seconds_round_trip():
+    from tpu_scheduler.api.objects import Pod, pod_to_dict
+
+    pod = make_pod("p", tolerations=[TOL_60S])
+    back = Pod.from_dict(pod_to_dict(pod))
+    assert back.spec.tolerations[0].toleration_seconds == 60
+    pod2 = make_pod("q", tolerations=[TOL_FOREVER])
+    back2 = Pod.from_dict(pod_to_dict(pod2))
+    assert back2.spec.tolerations[0].toleration_seconds is None
+
+
+def test_later_taint_gets_its_own_grace_window():
+    """Review repro: a taint added mid-way must not inherit the first
+    taint's clock start — each (pod, taint) pair gets its own window."""
+    now = [0.0]
+    api = FakeApiServer()
+    t_a = Taint(key="a", value="1", effect="NoExecute")
+    t_b = Taint(key="b", value="1", effect="NoExecute")
+    tol_a = Toleration(key="a", operator="Equal", value="1", effect="NoExecute", toleration_seconds=3600)
+    tol_b = Toleration(key="b", operator="Equal", value="1", effect="NoExecute", toleration_seconds=600)
+    _cluster(api, pods=[make_pod("p", cpu="1", memory="1Gi", node_name="n1", phase="Running",
+                                 tolerations=[tol_a, tol_b])], taints=[t_a])
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    sched.run_cycle()  # taint a clock starts at 0 (deadline 3600)
+    n1 = next(n for n in api.list_nodes() if n.metadata.name == "n1")
+    now[0] = 1800.0
+    n1.spec.taints = [t_a, t_b]
+    sched.run_cycle()  # taint b clock starts at 1800 (deadline 2400)
+    assert "p" in {p.metadata.name for p in api.list_pods()}, "b's window must not be backdated"
+    now[0] = 2300.0
+    sched.run_cycle()
+    assert "p" in {p.metadata.name for p in api.list_pods()}
+    now[0] = 2401.0
+    sched.run_cycle()  # b's 600s window (1800+600) expired
+    assert "p" not in {p.metadata.name for p in api.list_pods()}
+
+
+def test_failed_eviction_does_not_reset_grace():
+    """Review repro: a transient delete failure must retry against the
+    ORIGINAL deadline next cycle, not grant a fresh tolerationSeconds."""
+    from tpu_scheduler.runtime.fake_api import ApiError
+
+    now = [0.0]
+    api = FakeApiServer()
+    _cluster(api, pods=[make_pod("p", cpu="1", memory="1Gi", node_name="n1", phase="Running",
+                                 tolerations=[TOL_60S])], taints=[NOEXEC])
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    sched.run_cycle()  # clock starts at 0, deadline 60
+    real_delete = api.delete_pod
+    fails = [0]
+
+    def flaky(ns, name):
+        fails[0] += 1
+        raise ApiError(500, "transient")
+
+    api.delete_pod = flaky
+    now[0] = 61.0
+    sched.run_cycle()  # eviction attempted, fails
+    assert fails[0] == 1
+    assert "p" in {p.metadata.name for p in api.list_pods()}
+    api.delete_pod = real_delete
+    now[0] = 62.0
+    sched.run_cycle()  # retried against the ORIGINAL deadline — not re-graced
+    assert "p" not in {p.metadata.name for p in api.list_pods()}

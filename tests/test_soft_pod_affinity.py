@@ -77,25 +77,46 @@ def test_preference_steers_placement():
     assert zones["default/hater"] != "z1"
 
 
-def test_same_cycle_placements_update_preference_counts():
-    """A high-priority cache pod placing THIS cycle pulls a low-priority
-    preferring pod into its zone on a later round (count state commits)."""
+def test_constraint_commit_updates_preference_counts():
+    """The per-round commit path: accepted pods matching a preferred term
+    bump their landing domain's count (coarse) or node's count (fine /
+    keyless), so later rounds of the SAME cycle see them.  Exercised
+    directly — deleting the ppa commit logic must fail this test."""
+    import numpy as np
+
+    from tpu_scheduler.ops.constraints import constraint_commit, pack_constraints, round_blocked_masks
+    from tpu_scheduler.ops.pack import pack_snapshot
+
+    keyless = make_node("bare", cpu="8", memory="32Gi")  # no zone label -> fine domain
+    nodes = ZONE_NODES + [keyless]
     pods = [
-        make_pod("cache-0", labels={"app": "cache"}, priority=10),
-        # Preferring pod, low priority; capacity forces multi-round? No —
-        # same round: the preference only sees round-start counts, so give
-        # the preferrer a reason to defer: it also prefers with weight but
-        # all zones tie at round start, so it may land anywhere in round 1.
-        # Make the test deterministic by blocking round-1 placement via a
-        # full node set... simpler: strong preference + hard pod_affinity is
-        # covered elsewhere; here just assert the cycle is valid and both
-        # bind.
-        make_pod("web-0", labels={"app": "web"}, priority=1, preferred_pod_affinity=[_pref(100, "cache")]),
+        make_pod("cache-0", labels={"app": "cache"}),  # matches the term, declares nothing
+        make_pod("cache-1", labels={"app": "cache"}),
+        make_pod("web-0", labels={"app": "web"}, preferred_pod_affinity=[_pref(10, "cache")]),
     ]
-    snap = ClusterSnapshot.build(ZONE_NODES, pods)
-    packed, r = _schedule_both(snap)
-    assert len(r.bindings) == 2
-    assert _replay_validity(snap, packed, r) == 0
+    snap = ClusterSnapshot.build(nodes, pods)
+    packed = pack_snapshot(snap)
+    cons = pack_constraints(snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes)
+    assert cons is not None and cons.n_ppa_terms == 1
+    p = packed.padded_pods
+    accepted = np.zeros((p,), bool)
+    accepted[0] = accepted[1] = True  # both cache pods accepted this round
+    choice = np.zeros((p,), np.int32)
+    choice[0] = 1  # cache-0 -> n1 (zone z1)
+    choice[1] = 6  # cache-1 -> bare (keyless -> fine twin)
+    state = constraint_commit(
+        np, accepted, choice, cons.pod_arrays(), cons.state_arrays(), cons.meta_arrays(), soft_pa=True
+    )
+    ndc = cons.node_dom_c  # [N, D]
+    z1_col = int(np.argmax(ndc[1]))  # n1's one-hot domain column
+    assert state["ppa_dom_cnt"][0, z1_col] == 1.0, "coarse domain count not bumped"
+    assert state["ppa_node_cnt"][0, 6] == 1.0, "fine (keyless node) count not bumped"
+    # and the next round's score operand sees both
+    masks = round_blocked_masks(np, state, cons.meta_arrays(), soft_pa=True, hard_pa=False)
+    assert masks["ppa_cnt_node"][0, 1] == 1.0  # n1 itself
+    assert masks["ppa_cnt_node"][0, 4] == 1.0  # n4 shares zone z1
+    assert masks["ppa_cnt_node"][0, 6] == 1.0  # the keyless node
+    assert masks["ppa_cnt_node"][0, 2] == 0.0  # z2 untouched
 
 
 def test_synth_preferred_pod_affinity_parity():

@@ -40,6 +40,8 @@ def _kitchen_sink(seed):
         preferred_affinity_fraction=0.2,
         schedule_anyway_fraction=0.12,
         gang_fraction=0.12,
+        pod_affinity_fraction=0.1,
+        preferred_pod_affinity_fraction=0.15,
     )
 
 

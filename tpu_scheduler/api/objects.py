@@ -220,6 +220,10 @@ class Toleration:
     operator: str = "Equal"
     value: str = ""
     effect: str = ""
+    # NoExecute only (k8s tolerationSeconds): how long the pod may keep
+    # RUNNING on a node after a matching NoExecute taint appears; None =
+    # tolerate forever.  Ignored at scheduling time.
+    toleration_seconds: int | None = None
 
     def tolerates(self, taint: Taint) -> bool:
         if self.effect and self.effect != taint.effect:
@@ -376,6 +380,7 @@ class Pod:
                     operator=t.get("operator", "Equal"),
                     value=t.get("value", ""),
                     effect=t.get("effect", ""),
+                    toleration_seconds=t.get("tolerationSeconds"),
                 )
                 for t in spec_d.get("tolerations") or []
             ] or None
@@ -469,6 +474,7 @@ def pod_to_dict(pod: "Pod") -> dict[str, Any]:
                 "operator": t.operator,
                 **({"value": t.value} if t.value else {}),
                 **({"effect": t.effect} if t.effect else {}),
+                **({"tolerationSeconds": t.toleration_seconds} if t.toleration_seconds is not None else {}),
             }
             for t in pod.spec.tolerations
         ]

@@ -111,6 +111,12 @@ class Scheduler:
         self.reflector = ClusterReflector(api, clock=clock)
         self.metrics = MetricsRegistry()
         self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
+        # NoExecute taint lifecycle: (pod full name, taint key, taint value)
+        # -> first time the pod was seen coexisting with that NoExecute taint
+        # while tolerating it only for tolerationSeconds (the per-taint
+        # eviction grace clock, _evict_noexecute; a taint added later starts
+        # its own window, it does not inherit an earlier taint's).
+        self._noexecute_seen: dict[tuple[str, str, str], float] = {}
         self._cycle_count = 0
         self._packed = None
         self._node_sig = None
@@ -167,6 +173,65 @@ class Scheduler:
         self.requeue_at[pod_name] = self.clock() + self.requeue_seconds
         self.metrics.inc("scheduler_requeues_total")
         logger.warning("reconcile failed on pod %s: %s; requeue in %.0fs", pod_name, reason, self.requeue_seconds)
+
+    def _evict_noexecute(self, snapshot: ClusterSnapshot) -> set[str]:
+        """NoExecute taint lifecycle (kube's taint manager, which the
+        reference lacks entirely): a RUNNING pod on a node carrying NoExecute
+        taints is evicted unless it tolerates every one of them.  A taint
+        tolerated only via tolerations carrying ``tolerationSeconds`` grants
+        a grace window from when this scheduler first sees the (pod, taint)
+        coexistence — an approximation of kube's taint-added timestamps, which
+        the API surface does not carry.  Returns the evicted pod full names.
+        """
+        now = self.clock()
+        evicted: set[str] = set()
+        live_keys: set[tuple[str, str, str]] = set()
+        for pod, node in snapshot.placed_pods():
+            taints = [t for t in ((node.spec.taints or []) if node.spec is not None else []) if t.effect == "NoExecute"]
+            if not taints:
+                continue
+            full = full_name(pod)
+            tols = (pod.spec.tolerations or []) if pod.spec is not None else []
+            evict_now = False
+            expired = False
+            pod_keys: list[tuple[str, str, str]] = []
+            for taint in taints:
+                matching = [t for t in tols if t.tolerates(taint)]
+                if not matching:
+                    evict_now = True
+                    break
+                if any(t.toleration_seconds is None for t in matching):
+                    continue  # tolerated forever for this taint
+                grace = float(min(t.toleration_seconds for t in matching))
+                # Per-(pod, taint) clock: a taint added later starts its own
+                # window instead of inheriting an earlier taint's start.
+                key = (full, taint.key, taint.value)
+                first = self._noexecute_seen.setdefault(key, now)
+                pod_keys.append(key)
+                if now >= first + grace:
+                    expired = True
+            if not evict_now:
+                live_keys.update(pod_keys)
+                if not expired:
+                    continue
+            try:
+                self.api.delete_pod(pod.metadata.namespace or "default", pod.metadata.name)
+            except ApiError as e:
+                # Keep the grace state (still live) — the eviction retries
+                # next cycle against the ORIGINAL deadline; a transient API
+                # failure must not grant a fresh window.
+                logger.warning("NoExecute eviction of %s failed: %s", full, e)
+                continue
+            evicted.add(full)
+            for key in pod_keys:
+                self._noexecute_seen.pop(key, None)
+                live_keys.discard(key)
+            self.metrics.inc("scheduler_noexecute_evictions_total")
+            logger.info("evicting %s from %s (NoExecute taint not tolerated)", full, node.name)
+        # Clocks no longer ticking (taint removed, pod gone/moved) reset.
+        for k in [k for k in self._noexecute_seen if k not in live_keys]:
+            del self._noexecute_seen[k]
+        return evicted
 
     def _mark_unschedulable(self, pod_full: str) -> None:
         """Requeue a pod the cycle could not place, and remember it for the
@@ -1044,6 +1109,14 @@ class Scheduler:
                 pending_all = []
                 pending = []
             else:
+                with span("noexecute"):
+                    evicted = self._evict_noexecute(snapshot)
+                if evicted:
+                    # Evicted pods leave the cycle immediately: their capacity
+                    # frees for this very cycle's placements.
+                    snapshot = ClusterSnapshot.build(
+                        snapshot.nodes, [p for p in snapshot.pods if full_name(p) not in evicted]
+                    )
                 pending_all = snapshot.pending_pods()
                 pending = self._eligible(pending_all)
                 # Prune requeue backoffs for pods that no longer exist / are
