@@ -168,3 +168,36 @@ def test_failed_eviction_does_not_reset_grace():
     now[0] = 62.0
     sched.run_cycle()  # retried against the ORIGINAL deadline — not re-graced
     assert "p" not in {p.metadata.name for p in api.list_pods()}
+
+
+def test_failed_eviction_keeps_other_taints_clocks():
+    """Review repro: an untolerated taint B forces eviction; the delete
+    fails transiently.  Taint A's running grace clock must survive — after
+    B is removed, A's original deadline still applies."""
+    from tpu_scheduler.runtime.fake_api import ApiError
+
+    now = [0.0]
+    api = FakeApiServer()
+    t_a = Taint(key="a", value="1", effect="NoExecute")
+    t_b = Taint(key="b", value="1", effect="NoExecute")
+    tol_a = Toleration(key="a", operator="Equal", value="1", effect="NoExecute", toleration_seconds=60)
+    _cluster(api, pods=[make_pod("p", cpu="1", memory="1Gi", node_name="n1", phase="Running",
+                                 tolerations=[tol_a])], taints=[t_a])
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    sched.run_cycle()  # taint a clock starts at 0 (deadline 60)
+    n1 = next(n for n in api.list_nodes() if n.metadata.name == "n1")
+    real_delete = api.delete_pod
+
+    def flaky(ns, name):
+        raise ApiError(500, "transient")
+
+    n1.spec.taints = [t_a, t_b]  # untolerated b appears
+    api.delete_pod = flaky
+    now[0] = 30.0
+    sched.run_cycle()  # eviction for b attempted, fails
+    assert "p" in {p.metadata.name for p in api.list_pods()}
+    api.delete_pod = real_delete
+    n1.spec.taints = [t_a]  # b removed; only a's clock governs now
+    now[0] = 61.0
+    sched.run_cycle()  # a's ORIGINAL deadline (0+60) has passed
+    assert "p" not in {p.metadata.name for p in api.list_pods()}, "taint a's clock was reset by the failed eviction"

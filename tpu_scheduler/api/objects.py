@@ -27,6 +27,7 @@ __all__ = [
     "PodAntiAffinityTerm",
     "PodAffinityTerm",
     "WeightedPodAffinityTerm",
+    "PodDisruptionBudget",
     "TopologySpreadConstraint",
     "NodeSelectorTerm",
     "PodSpec",
@@ -147,6 +148,67 @@ class WeightedPodAffinityTerm:
 
     weight: int
     term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1 PodDisruptionBudget, the subset preemption consults:
+    namespace-scoped label selector plus exactly one of ``min_available`` /
+    ``max_unavailable`` (absolute counts; percentage strings are unsupported
+    by design and fail CLOSED — zero disruptions allowed).  An empty/absent
+    selector matches every pod in the namespace (policy/v1 semantics; note
+    this differs from this codebase's affinity-term deviation where an
+    empty selector matches nothing).  Semantics here are NEVER-VIOLATE: a
+    victim whose eviction
+    would take a matching budget below its floor is simply not eligible —
+    preemption looks elsewhere (kube's PreemptLowerPriority instead
+    *minimizes* violations; the conservative subset never disrupts a
+    protected workload).  NoExecute taint evictions bypass PDBs, exactly as
+    kube's taint manager does.
+    """
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    match_labels: dict[str, str] | None = None
+    match_expressions: list[LabelSelectorRequirement] | None = None
+    min_available: int | None = None
+    max_unavailable: int | None = None
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "PodDisruptionBudget":
+        meta = d.get("metadata", {})
+        spec = d.get("spec", {})
+        sel = spec.get("selector") or {}
+        exprs = sel.get("matchExpressions") or []
+        return PodDisruptionBudget(
+            metadata=ObjectMeta(name=meta.get("name", ""), namespace=meta.get("namespace")),
+            match_labels=sel.get("matchLabels"),
+            match_expressions=[
+                LabelSelectorRequirement(key=e.get("key", ""), operator=e.get("operator", ""), values=e.get("values"))
+                for e in exprs
+            ]
+            or None,
+            min_available=spec.get("minAvailable"),
+            max_unavailable=spec.get("maxUnavailable"),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        sel: dict[str, Any] = {}
+        if self.match_labels:
+            sel["matchLabels"] = dict(self.match_labels)
+        if self.match_expressions:
+            sel["matchExpressions"] = [
+                {"key": r.key, "operator": r.operator, **({"values": list(r.values)} if r.values else {})}
+                for r in self.match_expressions
+            ]
+        spec: dict[str, Any] = {"selector": sel}
+        if self.min_available is not None:
+            spec["minAvailable"] = self.min_available
+        if self.max_unavailable is not None:
+            spec["maxUnavailable"] = self.max_unavailable
+        meta: dict[str, Any] = {"name": self.metadata.name}
+        if self.metadata.namespace is not None:
+            meta["namespace"] = self.metadata.namespace
+        return {"kind": "PodDisruptionBudget", "metadata": meta, "spec": spec}
 
 
 @dataclass

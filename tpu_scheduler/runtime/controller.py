@@ -111,6 +111,10 @@ class Scheduler:
         self.reflector = ClusterReflector(api, clock=clock)
         self.metrics = MetricsRegistry()
         self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
+        # maxUnavailable PDBs: peak healthy count ever observed per budget
+        # ("ns/name" key) — the controller-free stand-in for a desired
+        # replica count (_attempt_preemption).
+        self._pdb_peak: dict[str, int] = {}
         # NoExecute taint lifecycle: (pod full name, taint key, taint value)
         # -> first time the pod was seen coexisting with that NoExecute taint
         # while tolerating it only for tolerationSeconds (the per-taint
@@ -195,11 +199,15 @@ class Scheduler:
             evict_now = False
             expired = False
             pod_keys: list[tuple[str, str, str]] = []
+            # Scan ALL taints (no early break): every finite-grace clock must
+            # register as live even when another taint forces eviction — a
+            # FAILED eviction must not wipe the other taints' running clocks
+            # (they would otherwise restart with a fresh window).
             for taint in taints:
                 matching = [t for t in tols if t.tolerates(taint)]
                 if not matching:
                     evict_now = True
-                    break
+                    continue
                 if any(t.toleration_seconds is None for t in matching):
                     continue  # tolerated forever for this taint
                 grace = float(min(t.toleration_seconds for t in matching))
@@ -210,10 +218,9 @@ class Scheduler:
                 pod_keys.append(key)
                 if now >= first + grace:
                     expired = True
-            if not evict_now:
-                live_keys.update(pod_keys)
-                if not expired:
-                    continue
+            live_keys.update(pod_keys)
+            if not evict_now and not expired:
+                continue
             try:
                 self.api.delete_pod(pod.metadata.namespace or "default", pod.metadata.name)
             except ApiError as e:
@@ -869,6 +876,59 @@ class Scheduler:
         freed: dict[str, PodResources] = {}  # victims evicted this pass
         bound = victims_total = 0
 
+        # PodDisruptionBudgets (policy/v1 subset): remaining voluntary
+        # disruptions per budget, NEVER violated — a victim whose eviction
+        # would breach a matching budget is not eligible (api/objects.py
+        # PodDisruptionBudget for the semantics and kube deviation).
+        pdbs = list(getattr(self.api, "list_pdbs", list)())
+
+        def _pdb_matches(pdb, q: Pod) -> bool:
+            if (pdb.metadata.namespace or "default") != (q.metadata.namespace or "default"):
+                return False
+            if pdb.match_labels is None and pdb.match_expressions is None:
+                # policy/v1: an empty/absent selector matches every pod in
+                # the namespace (unlike this codebase's affinity-term
+                # deviation, where empty matches nothing).
+                return True
+            return term_matches(pdb, q.metadata.labels)
+
+        pdb_allow: list[int] = []
+        for pdb in pdbs:
+            key = f"{pdb.metadata.namespace or 'default'}/{pdb.metadata.name}"
+            healthy = sum(1 for q, _qn in snapshot.placed_pods() if _pdb_matches(pdb, q))
+            try:
+                if pdb.min_available is not None:
+                    pdb_allow.append(max(0, healthy - int(pdb.min_available)))
+                elif pdb.max_unavailable is not None:
+                    # No controllers exist to report a desired replica count,
+                    # so "already unavailable" is derived from the PEAK
+                    # healthy count ever observed for this budget: a pod this
+                    # (or an earlier) pass evicted stays counted against the
+                    # budget until the workload is actually recreated —
+                    # otherwise every pass would reset to a full allowance
+                    # and repeated cycles could breach the budget.
+                    peak = max(self._pdb_peak.get(key, 0), healthy)
+                    self._pdb_peak[key] = peak
+                    pdb_allow.append(max(0, int(pdb.max_unavailable) - (peak - healthy)))
+                else:
+                    pdb_allow.append(1 << 30)  # selector-only budget: no bound
+            except (TypeError, ValueError):
+                # Malformed budget (e.g. a kube percentage string, which is
+                # unsupported by design) fails CLOSED: zero allowance — the
+                # never-violate stance protects rather than exposes.
+                logger.warning("PDB %s has non-integer bound %r/%r; treating as zero disruptions allowed",
+                               key, pdb.min_available, pdb.max_unavailable)
+                pdb_allow.append(0)
+        _pdb_memo: dict[str, tuple[int, ...]] = {}
+
+        def _pdbs_of(q: Pod) -> tuple[int, ...]:
+            full = full_name(q)
+            hit = _pdb_memo.get(full)
+            if hit is None:
+                hit = tuple(i for i, pdb in enumerate(pdbs) if _pdb_matches(pdb, q))
+                _pdb_memo[full] = hit
+            return hit
+
         # Gang members never preempt individually: evicting victims to host
         # part of a gang that may never fully place is pure disruption —
         # all-or-nothing admission stays with the gang-aware solve.
@@ -905,11 +965,17 @@ class Scheduler:
                 need_cpu, need_mem = req.cpu - avail.cpu, req.memory - avail.memory
                 victims: list[Pod] = []
                 got = PodResources()
+                pdb_used: dict[int, int] = {}
                 for q in pods_on.get(node.name, []):  # priority ascending
                     if got.cpu >= need_cpu and got.memory >= need_mem:
                         break
                     if _pod_priority(q) >= prio:
                         break  # sorted: everything after is also ineligible
+                    qpdbs = _pdbs_of(q) if pdbs else ()
+                    if any(pdb_allow[i] - pdb_used.get(i, 0) <= 0 for i in qpdbs):
+                        continue  # budget-protected: look past it, never evict
+                    for i in qpdbs:
+                        pdb_used[i] = pdb_used.get(i, 0) + 1
                     victims.append(q)
                     got += total_pod_resources(q)
                 if got.cpu >= need_cpu and got.memory >= need_mem:
@@ -928,10 +994,14 @@ class Scheduler:
                             continue
                     key = (_pod_priority(victims[-1]) if victims else -(2**31), len(victims))
                     if best_key is None or key < best_key:
-                        best, best_key = (node, victims), key
+                        best, best_key = (node, victims, pdb_used), key
             if best is None:
                 continue
-            node, victims = best
+            node, victims, pdb_used = best
+            # Commit the chosen node's budget consumption before evicting —
+            # a later preemptor in this same pass must not double-spend.
+            for i, n_used in pdb_used.items():
+                pdb_allow[i] -= n_used
             evict_failed = False
             for q in victims:
                 try:

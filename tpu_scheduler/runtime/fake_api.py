@@ -95,6 +95,7 @@ class FakeApiServer:
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
         self._pods: dict[tuple[str, str], Pod] = {}  # (namespace, name)
+        self._pdbs: dict[str, object] = {}  # "ns/name" -> PodDisruptionBudget
         self._rv = 0
         self._watches: dict[str, set[Watch]] = {"Node": set(), "Pod": set()}
         # Bounded event history for resourceVersion-based incremental watch
@@ -317,10 +318,27 @@ class FakeApiServer:
             lease = self._leases.get(name)
             return dict(lease) if lease is not None else None
 
+    # -- PodDisruptionBudgets (policy/v1 subset; consulted by preemption) --
+
+    def create_pdb(self, pdb) -> None:
+        with self._lock:
+            key = f"{pdb.metadata.namespace or 'default'}/{pdb.metadata.name}"
+            self._pdbs[key] = pdb
+
+    def delete_pdb(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._pdbs.pop(f"{namespace}/{name}", None)
+
+    def list_pdbs(self) -> list:
+        with self._lock:
+            return list(self._pdbs.values())
+
     # -- bulk helpers for synthetic clusters -------------------------------
 
-    def load(self, nodes: Iterable[Node] = (), pods: Iterable[Pod] = ()) -> None:
+    def load(self, nodes: Iterable[Node] = (), pods: Iterable[Pod] = (), pdbs: Iterable = ()) -> None:
         for n in nodes:
             self.create_node(n)
         for p in pods:
             self.create_pod(p)
+        for b in pdbs:
+            self.create_pdb(b)
