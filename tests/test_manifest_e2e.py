@@ -1,0 +1,126 @@
+"""Wire-format e2e: realistic kube manifests (dicts in the k8s JSON shape)
+flow through Pod.from_dict / Node.from_dict into the controller and come out
+as correct placements — the integration test of the whole API surface:
+affinity (node + pod, hard + soft), tolerations with tolerationSeconds,
+spread, priority, gang labels."""
+
+from tpu_scheduler.api.objects import Node, Pod, PodDisruptionBudget
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+
+
+def _node(name, zone, cpu="8", taints=None):
+    d = {
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"zone": zone, "name": name}},
+        "status": {"allocatable": {"cpu": cpu, "memory": "32Gi"}},
+    }
+    if taints:
+        d["spec"] = {"taints": taints}
+    return Node.from_dict(d)
+
+
+def _pod(name, labels=None, spec_extra=None, cpu="500m"):
+    spec = {
+        "containers": [{"name": "main", "resources": {"requests": {"cpu": cpu, "memory": "256Mi"}}}],
+        **(spec_extra or {}),
+    }
+    return Pod.from_dict(
+        {"kind": "Pod", "metadata": {"name": name, "namespace": "default", "labels": labels or {}}, "spec": spec}
+    )
+
+
+def test_manifest_cluster_schedules_correctly():
+    nodes = [
+        _node("a1", "z1"),
+        _node("a2", "z1"),
+        _node("b1", "z2"),
+        _node("c1", "z3", taints=[{"key": "maint", "value": "drain", "effect": "NoSchedule"}]),
+    ]
+    cache = _pod("cache-0", labels={"app": "cache"})
+    # required co-location with cache over zone + a soft anti-preference
+    # against noisy, node-affinity excluding z3, toleration for the taint
+    web = _pod(
+        "web-0",
+        labels={"app": "web"},
+        spec_extra={
+            "priority": 5,
+            "affinity": {
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"topologyKey": "zone", "labelSelector": {"matchLabels": {"app": "cache"}}}
+                    ]
+                },
+                "podAntiAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 100,
+                            "podAffinityTerm": {
+                                "topologyKey": "zone",
+                                "labelSelector": {"matchLabels": {"app": "noisy"}},
+                            },
+                        }
+                    ]
+                },
+            },
+            "tolerations": [{"key": "maint", "operator": "Equal", "value": "drain", "effect": "NoSchedule"}],
+        },
+    )
+    # hostname anti-affinity pair: must land on distinct nodes
+    db = [
+        _pod(
+            f"db-{i}",
+            labels={"app": "db"},
+            spec_extra={
+                "affinity": {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"topologyKey": "name", "labelSelector": {"matchLabels": {"app": "db"}}}
+                        ]
+                    }
+                }
+            },
+        )
+        for i in range(2)
+    ]
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=[cache] + db + [web])
+    api.create_pdb(
+        PodDisruptionBudget.from_dict(
+            {"metadata": {"name": "db", "namespace": "default"}, "spec": {"selector": {"matchLabels": {"app": "db"}}, "minAvailable": 2}}
+        )
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    m = sched.run_cycle()
+    assert m.bound == 4, f"all four manifest pods must place ({m.unschedulable} unschedulable)"
+    placed = {p.metadata.name: p.spec.node_name for p in api.list_pods()}
+    zone = {"a1": "z1", "a2": "z1", "b1": "z2", "c1": "z3"}
+    assert zone[placed["web-0"]] == zone[placed["cache-0"]], "required podAffinity violated"
+    assert placed["db-0"] != placed["db-1"], "hostname anti-affinity violated"
+
+
+def test_manifest_toleration_seconds_lifecycle():
+    now = [0.0]
+    api = FakeApiServer()
+    api.load(
+        nodes=[_node("a1", "z1", taints=[{"key": "maint", "value": "x", "effect": "NoExecute"}]), _node("b1", "z2")],
+        pods=[
+            _pod(
+                "graced",
+                spec_extra={
+                    "nodeName": "a1",
+                    "tolerations": [
+                        {"key": "maint", "operator": "Equal", "value": "x", "effect": "NoExecute", "tolerationSeconds": 120}
+                    ],
+                },
+            )
+        ],
+    )
+    # mark it running (from_dict defaults to Pending; nodeName set = bound)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    sched.run_cycle()
+    assert "graced" in {p.metadata.name for p in api.list_pods()}
+    now[0] = 121.0
+    sched.run_cycle()
+    assert "graced" not in {p.metadata.name for p in api.list_pods()}

@@ -197,3 +197,94 @@ def test_percentage_budget_fails_closed():
     m = sched.run_cycle()  # must not raise
     assert m.bound == 0
     assert "db-0" in {p.metadata.name for p in api.list_pods()}
+
+
+def test_explicit_empty_selector_matches_all():
+    """Review repro: matchLabels: {} in a manifest is policy/v1 match-all —
+    it must not silently protect nothing (and must survive a round-trip)."""
+    pdb = PodDisruptionBudget.from_dict(
+        {"metadata": {"name": "blanket", "namespace": "default"}, "spec": {"selector": {"matchLabels": {}}, "minAvailable": 1}}
+    )
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="2", memory="16Gi")],
+        pods=[
+            make_pod("anything", cpu="2", labels={"app": "x"}, node_name="n1", phase="Running", priority=0),
+            make_pod("urgent", cpu="2", priority=100),
+        ],
+        pdbs=[pdb],
+    )
+    sched = _preempting_sched(api)
+    m = sched.run_cycle()
+    assert m.bound == 0
+    assert "anything" in {p.metadata.name for p in api.list_pods()}
+    # round-trip keeps match-all semantics
+    back = PodDisruptionBudget.from_dict(pdb.to_dict())
+    assert not back.match_labels and not back.match_expressions
+
+
+def test_user_scale_down_does_not_zero_budget():
+    """Review repro: a user deleting replicas (no preemption involved) is
+    not a disruption THIS scheduler inflicted — the maxUnavailable budget
+    must remain spendable."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="2", memory="16Gi"), make_node("n2", cpu="2", memory="16Gi"), make_node("n3", cpu="2", memory="16Gi")],
+        pods=[
+            make_pod(f"db-{i}", cpu="2", labels={"app": "db"}, node_name=f"n{i+1}", phase="Running", priority=0)
+            for i in range(3)
+        ],
+        pdbs=[_pdb("db-pdb", {"app": "db"}, max_unavailable=1)],
+    )
+    sched = _preempting_sched(api)
+    sched.run_cycle()  # establishes ledger state (healthy=3, outstanding=0)
+    api.delete_pod("default", "db-2")  # user scales down
+    sched.run_cycle()
+    api.create_pod(make_pod("urgent", cpu="2", priority=100))
+    m = sched.run_cycle()
+    assert m.bound == 1, "the scheduler's own budget is unspent; preemption must proceed"
+
+
+def test_selector_only_budget_fails_closed():
+    """Neither minAvailable nor maxUnavailable (e.g. a typo'd field): fail
+    CLOSED like malformed bounds, not unlimited disruptions."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="2", memory="16Gi")],
+        pods=[
+            make_pod("db-0", cpu="2", labels={"app": "db"}, node_name="n1", phase="Running", priority=0),
+            make_pod("urgent", cpu="2", priority=100),
+        ],
+        pdbs=[_pdb("odd", {"app": "db"})],
+    )
+    sched = _preempting_sched(api)
+    m = sched.run_cycle()
+    assert m.bound == 0
+    assert "db-0" in {p.metadata.name for p in api.list_pods()}
+
+
+def test_pdbs_flow_over_the_http_boundary():
+    """Review finding: the never-violate guarantee must hold for a scheduler
+    attached over HTTP, not just in-process — PDBs list through the wire."""
+    from tpu_scheduler.runtime.http_api import HttpApiServer, KubeApiClient, RemoteApiAdapter
+
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="2", memory="16Gi")],
+        pods=[
+            make_pod("db-0", cpu="2", labels={"app": "db"}, node_name="n1", phase="Running", priority=0),
+            make_pod("urgent", cpu="2", priority=100),
+        ],
+        pdbs=[_pdb("db-pdb", {"app": "db"}, min_available=1)],
+    )
+    server = HttpApiServer(api).start()
+    try:
+        remote = RemoteApiAdapter(KubeApiClient(server.base_url))
+        got = remote.list_pdbs()
+        assert len(got) == 1 and got[0].min_available == 1
+        sched = Scheduler(remote, NativeBackend(), requeue_seconds=0.0, profile=DEFAULT_PROFILE.with_(preemption=True))
+        m = sched.run_cycle()
+        assert m.bound == 0, "remote scheduler must honor the budget"
+        assert "db-0" in {p.metadata.name for p in api.list_pods()}
+    finally:
+        server.stop()

@@ -123,3 +123,55 @@ def test_kitchen_sink_preemption_waves():
     final = _check_invariants(api, snap)
     vips_placed = sum(1 for p in final.pods if p.metadata.name.startswith("vip") and p.spec.node_name)
     assert vips_placed >= 20  # preemption made room for most of the wave
+
+
+def test_chaos_cycles_hold_invariants():
+    """Multi-cycle chaos: new pods arriving, cordon/taint toggling, priority
+    preemption with a PodDisruptionBudget in play (no NoExecute — taint
+    evictions legitimately bypass budgets).  After every cycle: capacity
+    exact, gang atomicity, and the PDB floor never breached by preemption."""
+    import random
+
+    from tpu_scheduler.api.objects import ObjectMeta, PodDisruptionBudget, Taint
+    from tpu_scheduler.testing import make_node, make_pod
+
+    rng = random.Random(7)
+    api = FakeApiServer()
+    nodes = [make_node(f"n{i}", cpu="8", memory="32Gi", labels={"zone": f"z{i % 3}", "name": f"n{i}"}) for i in range(12)]
+    db = [make_pod(f"db-{i}", cpu="2", memory="2Gi", labels={"app": "db"}, priority=0) for i in range(6)]
+    api.load(nodes=nodes, pods=db)
+    api.create_pdb(
+        PodDisruptionBudget(metadata=ObjectMeta(name="db", namespace="default"), match_labels={"app": "db"}, min_available=4)
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, profile=DEFAULT_PROFILE.with_(preemption=True))
+    sched.run_cycle()
+    assert sum(1 for p in api.list_pods() if p.metadata.name.startswith("db-") and p.spec.node_name) == 6
+
+    seq = 0
+    for cycle in range(12):
+        # chaos: arrivals (some high-priority hogs that trigger preemption)
+        for _ in range(rng.randrange(0, 5)):
+            seq += 1
+            prio = rng.choice([0, 1, 5, 50, 100])
+            cpu = rng.choice(["500m", "1", "2", "6"])
+            api.create_pod(make_pod(f"w{seq}", cpu=cpu, memory="1Gi", priority=prio))
+        # chaos: cordon/uncordon + NoSchedule taint toggling
+        from tpu_scheduler.api.objects import NodeSpec
+
+        for n in api.list_nodes():
+            if rng.random() < 0.1:
+                if n.spec is None:
+                    n.spec = NodeSpec()
+                n.spec.unschedulable = not n.spec.unschedulable
+            if rng.random() < 0.1:
+                if n.spec is None:
+                    n.spec = NodeSpec()
+                n.spec.taints = [] if n.spec.taints else [Taint(key="flaky", value="1", effect="NoSchedule")]
+        sched.run_cycle()
+        snap = ClusterSnapshot.build(api.list_nodes(), api.list_pods())
+        for n in snap.nodes:
+            used = node_used_resources(snap, n.name)
+            alloc = node_allocatable(n)
+            assert used.cpu <= alloc.cpu and used.memory <= alloc.memory, f"cycle {cycle}: {n.name} oversubscribed"
+        healthy_db = sum(1 for q, _ in snap.placed_pods() if (q.metadata.labels or {}).get("app") == "db")
+        assert healthy_db >= 4, f"cycle {cycle}: PDB floor breached ({healthy_db} < 4)"

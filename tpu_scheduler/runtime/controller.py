@@ -111,10 +111,10 @@ class Scheduler:
         self.reflector = ClusterReflector(api, clock=clock)
         self.metrics = MetricsRegistry()
         self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
-        # maxUnavailable PDBs: peak healthy count ever observed per budget
-        # ("ns/name" key) — the controller-free stand-in for a desired
-        # replica count (_attempt_preemption).
-        self._pdb_peak: dict[str, int] = {}
+        # maxUnavailable PDBs: per-budget ("ns/name") pair of (outstanding
+        # disruptions this scheduler inflicted, last observed healthy count)
+        # — the controller-free disruption ledger (_attempt_preemption).
+        self._pdb_disruptions: dict[str, tuple[int, int]] = {}
         # NoExecute taint lifecycle: (pod full name, taint key, taint value)
         # -> first time the pod was seen coexisting with that NoExecute taint
         # while tolerating it only for tolerationSeconds (the per-taint
@@ -885,33 +885,44 @@ class Scheduler:
         def _pdb_matches(pdb, q: Pod) -> bool:
             if (pdb.metadata.namespace or "default") != (q.metadata.namespace or "default"):
                 return False
-            if pdb.match_labels is None and pdb.match_expressions is None:
-                # policy/v1: an empty/absent selector matches every pod in
-                # the namespace (unlike this codebase's affinity-term
-                # deviation, where empty matches nothing).
+            if not pdb.match_labels and not pdb.match_expressions:
+                # policy/v1: an empty selector — absent, None, or an explicit
+                # {} / [] — matches every pod in the namespace (unlike this
+                # codebase's affinity-term deviation, where empty matches
+                # nothing).  Truthiness, not None-ness: a manifest's
+                # `matchLabels: {}` must not silently protect nothing.
                 return True
             return term_matches(pdb, q.metadata.labels)
 
         pdb_allow: list[int] = []
+        live_pdb_keys: set[str] = set()
         for pdb in pdbs:
             key = f"{pdb.metadata.namespace or 'default'}/{pdb.metadata.name}"
+            live_pdb_keys.add(key)
             healthy = sum(1 for q, _qn in snapshot.placed_pods() if _pdb_matches(pdb, q))
             try:
                 if pdb.min_available is not None:
                     pdb_allow.append(max(0, healthy - int(pdb.min_available)))
                 elif pdb.max_unavailable is not None:
-                    # No controllers exist to report a desired replica count,
-                    # so "already unavailable" is derived from the PEAK
-                    # healthy count ever observed for this budget: a pod this
-                    # (or an earlier) pass evicted stays counted against the
-                    # budget until the workload is actually recreated —
-                    # otherwise every pass would reset to a full allowance
-                    # and repeated cycles could breach the budget.
-                    peak = max(self._pdb_peak.get(key, 0), healthy)
-                    self._pdb_peak[key] = peak
-                    pdb_allow.append(max(0, int(pdb.max_unavailable) - (peak - healthy)))
+                    # maxUnavailable needs a desired replica count no
+                    # controller exists to report.  Track OUR outstanding
+                    # disruptions instead: evictions this scheduler inflicted
+                    # count against the budget until replicas return
+                    # (recoveries pay tracked debt down first), so repeated
+                    # passes cannot re-spend the allowance — while a user's
+                    # intentional scale-down (healthy drops with no eviction
+                    # of ours) leaves the budget untouched.
+                    out, prev = self._pdb_disruptions.get(key, (0, healthy))
+                    if healthy > prev:
+                        out = max(0, out - (healthy - prev))
+                    self._pdb_disruptions[key] = (out, healthy)
+                    pdb_allow.append(max(0, int(pdb.max_unavailable) - out))
                 else:
-                    pdb_allow.append(1 << 30)  # selector-only budget: no bound
+                    # Neither bound set (e.g. a typo'd field dropped by
+                    # from_dict): fail CLOSED like any other malformed
+                    # budget — kube would reject the manifest at admission.
+                    logger.warning("PDB %s sets neither minAvailable nor maxUnavailable; zero disruptions allowed", key)
+                    pdb_allow.append(0)
             except (TypeError, ValueError):
                 # Malformed budget (e.g. a kube percentage string, which is
                 # unsupported by design) fails CLOSED: zero allowance — the
@@ -919,6 +930,9 @@ class Scheduler:
                 logger.warning("PDB %s has non-integer bound %r/%r; treating as zero disruptions allowed",
                                key, pdb.min_available, pdb.max_unavailable)
                 pdb_allow.append(0)
+        # Deleted/recreated budgets must not inherit stale debt.
+        for k in [k for k in self._pdb_disruptions if k not in live_pdb_keys]:
+            del self._pdb_disruptions[k]
         _pdb_memo: dict[str, tuple[int, ...]] = {}
 
         def _pdbs_of(q: Pod) -> tuple[int, ...]:
@@ -999,9 +1013,16 @@ class Scheduler:
                 continue
             node, victims, pdb_used = best
             # Commit the chosen node's budget consumption before evicting —
-            # a later preemptor in this same pass must not double-spend.
+            # a later preemptor in this same pass must not double-spend —
+            # and record maxUnavailable debt in the cross-cycle ledger (paid
+            # down as replicas return; see pdb_allow construction above).
             for i, n_used in pdb_used.items():
                 pdb_allow[i] -= n_used
+                b = pdbs[i]
+                if b.min_available is None and b.max_unavailable is not None:
+                    bkey = f"{b.metadata.namespace or 'default'}/{b.metadata.name}"
+                    out, prev = self._pdb_disruptions.get(bkey, (0, 0))
+                    self._pdb_disruptions[bkey] = (out + n_used, prev)
             evict_failed = False
             for q in victims:
                 try:

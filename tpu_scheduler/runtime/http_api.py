@@ -109,6 +109,12 @@ class HttpApiServer:
                         pods, rv = outer.api.list_pods_with_rv(field_selector=selector)
                         items = [pod_to_dict(p) for p in pods]
                         self._send_json(200, {"kind": "PodList", "metadata": {"resourceVersion": str(rv)}, "items": items})
+                    elif parsed.path == "/apis/policy/v1/poddisruptionbudgets":
+                        budgets = getattr(outer.api, "list_pdbs", list)()
+                        self._send_json(
+                            200,
+                            {"kind": "PodDisruptionBudgetList", "items": [b.to_dict() for b in budgets]},
+                        )
                     else:
                         self._send_json(404, {"message": f"not found: {parsed.path}"})
                 except ApiError as e:
@@ -309,6 +315,19 @@ class KubeApiClient:
         if with_rv:
             return nodes, int(body.get("metadata", {}).get("resourceVersion", 0) or 0)
         return nodes
+
+    def list_pdbs(self):
+        """policy/v1 PodDisruptionBudgets (the preemption pass's guard).
+        A 404 from an older server means the resource simply doesn't exist
+        there — an empty list, not an error."""
+        code, body = self._request_json("GET", "/apis/policy/v1/poddisruptionbudgets")
+        if code == 404:
+            return []
+        if code != 200:
+            raise ApiError(code, body.get("message", "list pdbs failed"))
+        from ..api.objects import PodDisruptionBudget
+
+        return [PodDisruptionBudget.from_dict(d) for d in body.get("items", [])]
 
     def list_pods(self, field_selector: str | None = None, with_rv: bool = False):
         path = "/api/v1/pods"
@@ -547,6 +566,9 @@ class RemoteApiAdapter:
 
     def list_pods(self, field_selector: str | None = None):
         return self.client.list_pods(field_selector=field_selector)
+
+    def list_pdbs(self):
+        return self.client.list_pdbs()
 
     def create_binding(self, namespace: str, pod_name: str, target: ObjectReference) -> None:
         self.client.create_binding(namespace, pod_name, target)
