@@ -685,28 +685,77 @@ class Binding:
 @dataclass
 class PodResources:
     """(cpu millicores, memory bytes) pair with the arithmetic the reference
-    defines on ``PodResources`` (``src/util.rs:17-36``)."""
+    defines on ``PodResources`` (``src/util.rs:17-36``), extended with
+    arbitrary countable EXTENDED resources (kube device-plugin semantics:
+    ``google.com/tpu: 4``, ``nvidia.com/gpu: 8``, hugepages) — the resource
+    class a TPU-native scheduler exists to place.  ``extended`` is None (not
+    an empty dict) whenever no extended resource is present, so the
+    cpu/mem-only fast paths carry zero overhead."""
 
     cpu: int = 0  # millicores
     memory: int = 0  # bytes
+    extended: dict[str, int] | None = None  # resource name -> integer count
+
+    def _ext_add(self, other: "PodResources", sign: int) -> None:
+        if other.extended:
+            if self.extended is None:
+                self.extended = {}
+            for k, v in other.extended.items():
+                self.extended[k] = self.extended.get(k, 0) + sign * v
 
     def __isub__(self, other: "PodResources") -> "PodResources":
         self.cpu -= other.cpu
         self.memory -= other.memory
+        self._ext_add(other, -1)
         return self
 
     def __iadd__(self, other: "PodResources") -> "PodResources":
         self.cpu += other.cpu
         self.memory += other.memory
+        self._ext_add(other, +1)
         return self
+
+    def fits_in(self, avail: "PodResources") -> bool:
+        """request ≤ available on EVERY axis (cpu, memory, each extended
+        resource; an extended request against a node lacking the resource
+        fails — kube device-plugin semantics)."""
+        if self.cpu > avail.cpu or self.memory > avail.memory:
+            return False
+        if self.extended:
+            a = avail.extended or {}
+            for k, v in self.extended.items():
+                if v > a.get(k, 0):
+                    return False
+        return True
+
+    def covers(self, need: "PodResources") -> bool:
+        """self ≥ need on every axis where need is positive (preemption's
+        freed-capacity test; negative/zero needs are already satisfied)."""
+        if need.cpu > self.cpu and need.cpu > 0 or need.memory > self.memory and need.memory > 0:
+            return False
+        if need.extended:
+            mine = self.extended or {}
+            for k, v in need.extended.items():
+                if v > 0 and v > mine.get(k, 0):
+                    return False
+        return True
+
+
+def is_extended_resource(name: str) -> bool:
+    """Kube's definition: extended resources are domain-qualified
+    (``vendor.example/thing``) or hugepages; kube-native names this
+    framework doesn't model (ephemeral-storage, pods, …) stay IGNORED, as
+    the reference ignores everything but cpu/memory — a common manifest
+    requesting ephemeral-storage must not become unschedulable."""
+    return "/" in name or name.startswith("hugepages-")
 
 
 def total_pod_resources(pod: Pod) -> PodResources:
-    """Sum container *requests* (cpu, memory) — reference ``src/util.rs:54-75``.
-
-    Containers without a resources/requests block contribute zero; resource
-    names other than cpu/memory are ignored, matching the reference.
-    """
+    """Sum container *requests* — reference ``src/util.rs:54-75`` for
+    cpu/memory, plus kube EXTENDED resources (``is_extended_resource``:
+    domain-qualified device-plugin names and hugepages-*): each accumulates
+    as an exact integer (device counts; hugepages sizes in bytes).  Other
+    names are ignored, matching the reference."""
     out = PodResources()
     if pod.spec is None:
         return out
@@ -714,10 +763,15 @@ def total_pod_resources(pod: Pod) -> PodResources:
         if c.resources is None or c.resources.requests is None:
             continue
         req = c.resources.requests
-        if "cpu" in req:
-            out.cpu += cpu_to_millis(req["cpu"])
-        if "memory" in req:
-            out.memory += memory_to_bytes(req["memory"])
+        for name, q in req.items():
+            if name == "cpu":
+                out.cpu += cpu_to_millis(q)
+            elif name == "memory":
+                out.memory += memory_to_bytes(q)
+            elif is_extended_resource(name):
+                if out.extended is None:
+                    out.extended = {}
+                out.extended[name] = out.extended.get(name, 0) + memory_to_bytes(q)
     return out
 
 

@@ -113,7 +113,7 @@ class NativeBackend(SchedulingBackend):
             base = (cum - claim_s)[start_idx]
             within = np.minimum(cum - base, INT32_MAX)
 
-            avail_ext = np.concatenate([avail, np.zeros((1, 2), avail.dtype)], axis=0)
+            avail_ext = np.concatenate([avail, np.zeros((1, avail.shape[1]), avail.dtype)], axis=0)
             fits_prefix = (within <= avail_ext[ch_s]).all(-1)
             acc_s = fits_prefix & (ch_s < n)
             accepted = np.zeros((p,), dtype=bool)
@@ -127,7 +127,7 @@ class NativeBackend(SchedulingBackend):
 
             assigned = np.where(accepted, choice, assigned)
             acc_round = np.where(accepted, rounds, acc_round)
-            dec = np.zeros((n + 1, 2), dtype=np.int64)
+            dec = np.zeros((n + 1, avail.shape[1]), dtype=np.int64)
             np.add.at(dec, ch, np.where(accepted[:, None], req, 0).astype(np.int64))
             avail = (avail.astype(np.int64) - dec[:n]).astype(np.int32)
             was_active = active

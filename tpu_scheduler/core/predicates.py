@@ -74,7 +74,7 @@ def pod_fits_resources(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> bool:
     available = node_allocatable(node)
     available -= node_used_resources(snapshot, node.name)
     req = total_pod_resources(pod)
-    return req.cpu <= available.cpu and req.memory <= available.memory
+    return req.fits_in(available)
 
 
 def node_selector_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:

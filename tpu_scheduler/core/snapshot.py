@@ -14,7 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Iterable
 
-from ..api.objects import Node, Pod, PodResources, is_pod_bound, total_pod_resources
+from ..api.objects import Node, Pod, PodResources, is_extended_resource, is_pod_bound, total_pod_resources
 from ..api.quantity import cpu_to_millis, memory_to_bytes
 
 __all__ = ["ClusterSnapshot", "node_allocatable", "node_used_resources"]
@@ -29,10 +29,19 @@ def node_allocatable(node: Node) -> PodResources:
     out = PodResources()
     if node.status is not None and node.status.allocatable is not None:
         alloc = node.status.allocatable
-        if "cpu" in alloc:
-            out.cpu = cpu_to_millis(alloc["cpu"])
-        if "memory" in alloc:
-            out.memory = memory_to_bytes(alloc["memory"])
+        for name, q in alloc.items():
+            if name == "cpu":
+                out.cpu = cpu_to_millis(q)
+            elif name == "memory":
+                out.memory = memory_to_bytes(q)
+            elif is_extended_resource(name):
+                # Extended resources (device plugins: google.com/tpu,
+                # nvidia.com/gpu, hugepages-*): exact integers.  Kube-native
+                # names the framework doesn't model (pods,
+                # ephemeral-storage) are ignored on both sides.
+                if out.extended is None:
+                    out.extended = {}
+                out.extended[name] = memory_to_bytes(q)
     return out
 
 

@@ -203,6 +203,10 @@ def _choose(
     """
     p = ps["pod_req"].shape[0]
 
+    if use_pallas and nodes["node_avail"].shape[1] > 5:
+        # More than 3 extended resources exceed the kernel's [8, N] info
+        # rows (pallas_choose.build_node_info) — jnp path, still exact.
+        use_pallas = False
     pallas_pack = None
     if use_pallas:
         from .pallas_choose import build_node_info
@@ -307,7 +311,7 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
         is_start = jnp.concatenate([jnp.ones((1,), bool), ch_s[1:] != ch_s[:-1]])[:, None]
         _, within = lax.associative_scan(_seg_scan_op, (is_start, claim_s))
 
-        avail_ext = jnp.concatenate([avail, jnp.zeros((1, 2), avail.dtype)], axis=0)
+        avail_ext = jnp.concatenate([avail, jnp.zeros((1, avail.shape[1]), avail.dtype)], axis=0)
         fits_prefix = (within <= avail_ext[ch_s]).all(-1)
         acc_s = fits_prefix & (ch_s < n)
         accepted = jnp.zeros((p,), bool).at[order].set(acc_s)
@@ -320,7 +324,7 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
 
         ps["assigned"] = jnp.where(accepted, choice, ps["assigned"])
         ps["acc_round"] = jnp.where(accepted, rounds, ps["acc_round"])
-        dec = jnp.zeros((n + 1, 2), jnp.int32).at[ch].add(jnp.where(accepted[:, None], ps["pod_req"], 0))
+        dec = jnp.zeros((n + 1, avail.shape[1]), jnp.int32).at[ch].add(jnp.where(accepted[:, None], ps["pod_req"], 0))
         avail = avail - dec[:n]
         was_active = ps["active"]
         ps["active"] = cand & ~accepted

@@ -58,7 +58,7 @@ from dataclasses import dataclass, replace
 
 import numpy as np
 
-from ..api.objects import Pod, total_pod_resources
+from ..api.objects import Pod, is_extended_resource, total_pod_resources
 from ..api.quantity import cpu_to_millis, memory_to_bytes
 from ..core.snapshot import ClusterSnapshot
 from ..errors import PackingError
@@ -74,6 +74,7 @@ __all__ = [
     "build_affinity_vocab",
     "build_soft_taint_vocab",
     "build_pref_vocab",
+    "resource_vocab",
     "round_up",
     "INT32_MAX",
 ]
@@ -136,6 +137,14 @@ class PackedCluster:
     # state depends on current placements, so it is never cached), None for
     # unconstrained cycles.
     constraints: object | None = None
+
+    # Resource axis names for the [·, R] request/capacity tensors: always
+    # ("cpu", "memory") first — millicores and ceil/floor-KiB, the exact
+    # reference semantics — then any EXTENDED resources (device plugins:
+    # google.com/tpu, nvidia.com/gpu, hugepages-*) requested by any pod in
+    # the snapshot, as raw integer counts.  R == 2 for clusters without
+    # extended requests, so the flagship path is unchanged.
+    res_vocab: tuple[str, ...] = ("cpu", "memory")
 
     # The pod OBJECTS behind the rows (same order as pod_names) — the
     # identity keys of the O(delta) row-reuse path in repack_incremental:
@@ -359,13 +368,43 @@ def _pack_ntol(pending: list[Pod], taint_vocab: dict, p_pad: int, t_pad: int) ->
     return ntol
 
 
+def resource_vocab(snapshot: ClusterSnapshot, res_memo: dict | None = None) -> tuple[str, ...]:
+    """("cpu", "memory") plus every EXTENDED resource name
+    (api/objects.is_extended_resource) any pod in the snapshot REQUESTS —
+    bound pods too, since their usage must subtract from node capacity —
+    sorted for a stable column order.  With ``res_memo`` (the same
+    object-identity memo _alloc_and_used64 uses) the per-cycle cost is
+    O(delta): unchanged pods answer from their cached PodResources."""
+    names: set[str] = set()
+    for pod in snapshot.pods:
+        if pod.spec is None:
+            continue
+        if res_memo is not None:
+            hit = res_memo.get(id(pod))
+            if hit is not None and hit[0] is pod:
+                res = hit[1]
+            else:
+                res = total_pod_resources(pod)
+                res_memo[id(pod)] = (pod, res)
+            if res.extended:
+                names.update(res.extended)
+            continue
+        for c in pod.spec.containers:
+            if c.resources is not None and c.resources.requests is not None:
+                for k in c.resources.requests:
+                    if k != "cpu" and k != "memory" and is_extended_resource(k):
+                        names.add(k)
+    return ("cpu", "memory", *sorted(names))
+
+
 def _alloc_and_used64(
-    snapshot: ClusterSnapshot, n_pad: int, res_memo: dict | None = None
+    snapshot: ClusterSnapshot, n_pad: int, res_memo: dict | None = None, res_vocab: tuple[str, ...] = ("cpu", "memory")
 ) -> tuple[np.ndarray, np.ndarray, dict[str, int]]:
     """Exact int64 (allocatable, bound-usage) per node — shared by pack and
     the incremental avail refresh."""
-    alloc64 = np.zeros((n_pad, 2), dtype=np.int64)
-    used64 = np.zeros((n_pad, 2), dtype=np.int64)
+    r = len(res_vocab)
+    alloc64 = np.zeros((n_pad, r), dtype=np.int64)
+    used64 = np.zeros((n_pad, r), dtype=np.int64)
     node_index: dict[str, int] = {}
     for i, node in enumerate(snapshot.nodes):
         node_index[node.name] = i
@@ -375,6 +414,9 @@ def _alloc_and_used64(
                 alloc64[i, CPU] = cpu_to_millis(alloc["cpu"])
             if "memory" in alloc:
                 alloc64[i, MEM] = memory_to_bytes(alloc["memory"])
+            for j, name in enumerate(res_vocab[2:], start=2):
+                if name in alloc:
+                    alloc64[i, j] = memory_to_bytes(alloc[name])
     # Bound-pod usage, summed exactly in int64 bytes before the KiB floor.
     # ``res_memo`` (id(pod) -> (pod, PodResources), object-identity keyed
     # with the reference held so an id can never alias) amortizes the
@@ -396,13 +438,29 @@ def _alloc_and_used64(
                 res = total_pod_resources(pod)
             used64[i, CPU] += res.cpu
             used64[i, MEM] += res.memory
+            if res.extended and len(res_vocab) > 2:
+                for j, name in enumerate(res_vocab[2:], start=2):
+                    v = res.extended.get(name)
+                    if v:
+                        used64[i, j] += v
     return alloc64, used64, node_index
 
 
-def _avail_i32(alloc64: np.ndarray, used64: np.ndarray) -> np.ndarray:
+def _res_scales(res_vocab: tuple[str, ...]) -> np.ndarray:
+    """Per-column unit divisor: byte-valued columns (memory, hugepages-*)
+    store KiB in the int32 tensors so >=2 GiB quantities don't saturate;
+    device counts stay exact at scale 1."""
+    return np.array(
+        [1, 1024] + [1024 if name.startswith("hugepages-") else 1 for name in res_vocab[2:]],
+        dtype=np.int64,
+    )
+
+
+def _avail_i32(alloc64: np.ndarray, used64: np.ndarray, res_vocab: tuple[str, ...] = ("cpu", "memory")) -> np.ndarray:
     avail64 = alloc64 - used64
-    # Floor the available memory to KiB (conservative); cpu millis are exact.
-    return _clamp_i32(np.stack([avail64[:, CPU], np.floor_divide(avail64[:, MEM], 1024)], axis=1))
+    # Floor byte-valued columns to KiB (conservative); cpu millis and
+    # device counts are exact.
+    return _clamp_i32(np.floor_divide(avail64, _res_scales(res_vocab)[None, :]))
 
 
 def pack_snapshot(
@@ -446,7 +504,8 @@ def pack_snapshot(
         pref_vocab = build_pref_vocab(pending)
     a2_pad = round_up(len(pref_vocab), label_block)
 
-    alloc64, used64, _ = _alloc_and_used64(snapshot, n_pad, res_memo)
+    res_vocab = resource_vocab(snapshot, res_memo)
+    alloc64, used64, _ = _alloc_and_used64(snapshot, n_pad, res_memo, res_vocab)
     node_labels = np.zeros((n_pad, l_pad), dtype=np.float32)
     node_taints = np.zeros((n_pad, t_pad), dtype=np.float32)
     node_taints_soft = np.zeros((n_pad, ts_pad), dtype=np.float32)
@@ -476,10 +535,10 @@ def pack_snapshot(
                         raise PackingError(f"taint {(t.key, t.value, t.effect)} missing from supplied soft_taint_vocab")
                     node_taints_soft[i, j] = 1.0
 
-    node_alloc = _clamp_i32(np.stack([alloc64[:, CPU], alloc64[:, MEM] // 1024], axis=1))
-    node_avail = _avail_i32(alloc64, used64)
+    node_alloc = _clamp_i32(np.floor_divide(alloc64, _res_scales(res_vocab)[None, :]))
+    node_avail = _avail_i32(alloc64, used64, res_vocab)
 
-    pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad)
+    pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad, res_vocab)
     pod_ntol = _pack_ntol(pending, taint_vocab, p_pad, t_pad)
     pod_aff, pod_has_aff = _pack_affinity(pending, aff_vocab, p_pad, a_pad)
     pod_ntol_soft = _pack_ntol(pending, soft_taint_vocab, p_pad, ts_pad)
@@ -498,6 +557,7 @@ def pack_snapshot(
         aff_vocab=dict(aff_vocab),
         soft_taint_vocab=dict(soft_taint_vocab),
         pref_vocab=dict(pref_vocab),
+        res_vocab=res_vocab,
         pod_ntol=pod_ntol,
         pod_aff=pod_aff,
         pod_has_aff=pod_has_aff,
@@ -509,11 +569,11 @@ def pack_snapshot(
     )
 
 
-def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int) -> dict:
+def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int, res_vocab: tuple[str, ...] = ("cpu", "memory")) -> dict:
     """Pod-side tensors (the part that changes every cycle as pods bind)."""
     from ..api.objects import full_name
 
-    pod_req64 = np.zeros((p_pad, 2), dtype=np.int64)
+    pod_req64 = np.zeros((p_pad, len(res_vocab)), dtype=np.int64)
     pod_sel = np.zeros((p_pad, l_pad), dtype=np.float32)
     pod_sel_count = np.zeros((p_pad,), dtype=np.float32)
     pod_prio = np.zeros((p_pad,), dtype=np.int32)
@@ -524,6 +584,14 @@ def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int) -> dict:
         res = total_pod_resources(pod)
         pod_req64[i, CPU] = res.cpu
         pod_req64[i, MEM] = -(-res.memory // 1024)  # ceil KiB (conservative)
+        if res.extended and len(res_vocab) > 2:
+            for j, name in enumerate(res_vocab[2:], start=2):
+                v = res.extended.get(name)
+                if v:
+                    # Byte-valued columns (hugepages-*) ceil to KiB — the
+                    # dual of the node side's floor (_res_scales).
+                    scale = 1024 if name.startswith("hugepages-") else 1
+                    pod_req64[i, j] = -(-v // scale)
         pod_valid[i] = True
         pod_names.append(full_name(pod))
         if pod.spec is not None:
@@ -556,8 +624,10 @@ def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedClus
     fresh_names = tuple(n.name for n in snapshot.nodes)
     if fresh_names != packed.node_names:
         raise ValueError("repack_avail requires an identical node set/order; run a full pack_snapshot instead")
-    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes)
-    return replace(packed, node_avail=_avail_i32(alloc64, used64))
+    if resource_vocab(snapshot) != packed.res_vocab:
+        raise ValueError("resource vocabulary changed; run a full pack_snapshot instead")
+    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_vocab=packed.res_vocab)
+    return replace(packed, node_avail=_avail_i32(alloc64, used64, packed.res_vocab))
 
 
 def _grow_columns(arr: np.ndarray, total: int, label_block: int) -> np.ndarray:
@@ -699,7 +769,11 @@ def repack_incremental(
     fresh_nodes = tuple(n.name for n in snapshot.nodes)
     if fresh_nodes != packed.node_names:
         raise ValueError("repack_incremental requires an identical node set/order; run a full pack_snapshot instead")
-    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_memo)
+    if resource_vocab(snapshot, res_memo) != packed.res_vocab:
+        # A new extended-resource name widens every [·,R] tensor — that is a
+        # full-pack event (the controller catches ValueError and degrades).
+        raise ValueError("resource vocabulary changed; run a full pack_snapshot instead")
+    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_memo, packed.res_vocab)
     pending = snapshot.pending_pods()
     p_pad = max(packed.padded_pods, round_up(len(pending), pod_block))
     # Pod tensor widths come from the NODE side: extend_node_vocabs may have
@@ -725,7 +799,7 @@ def repack_incremental(
         else:
             fresh_idx.append(i)
 
-    pod_req = np.zeros((p_pad, 2), dtype=np.int32)
+    pod_req = np.zeros((p_pad, len(packed.res_vocab)), dtype=np.int32)
     pod_sel = np.zeros((p_pad, l_w), dtype=np.float32)
     pod_sel_count = np.zeros((p_pad,), dtype=np.float32)
     pod_prio = np.zeros((p_pad,), dtype=np.int32)
@@ -754,7 +828,7 @@ def repack_incremental(
         fp = [pending[i] for i in fresh_idx]
         fi = np.asarray(fresh_idx, dtype=np.intp)
         n_f = len(fp)
-        sub = _pack_pods(fp, packed.vocab, n_f, l_w)
+        sub = _pack_pods(fp, packed.vocab, n_f, l_w, packed.res_vocab)
         pod_req[fi] = sub["pod_req"]
         pod_sel[fi] = sub["pod_sel"]
         pod_sel_count[fi] = sub["pod_sel_count"]
@@ -768,7 +842,7 @@ def repack_incremental(
 
     return replace(
         packed,
-        node_avail=_avail_i32(alloc64, used64),
+        node_avail=_avail_i32(alloc64, used64, packed.res_vocab),
         pod_req=pod_req,
         pod_sel=pod_sel,
         pod_sel_count=pod_sel_count,

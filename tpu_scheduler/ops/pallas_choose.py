@@ -43,18 +43,25 @@ NEG_INF = float("-inf")
 
 
 def build_node_info(node_avail, node_alloc, node_valid):
-    """Pack node resources into the kernel's [8, N] int32 layout."""
+    """Pack node resources into the kernel's [8, N] int32 layout.
+
+    Rows 0-1: available cpu/mem; 2-3: allocatable cpu/mem (scoring); 4:
+    valid; 5-7: available EXTENDED resources (res_vocab columns 2..4 —
+    up to three; wider clusters bypass the kernel, see assign._choose)."""
     n = node_avail.shape[0]
+    r = node_avail.shape[1]
+    assert r <= 5, "pallas choose supports at most 3 extended resources"
     rows = [
         node_avail[:, 0],
         node_avail[:, 1],
         node_alloc[:, 0],
         node_alloc[:, 1],
         node_valid.astype(jnp.int32),
-        jnp.zeros((n,), jnp.int32),
-        jnp.zeros((n,), jnp.int32),
-        jnp.zeros((n,), jnp.int32),
     ]
+    for j in range(2, r):
+        rows.append(node_avail[:, j])
+    while len(rows) < 8:
+        rows.append(jnp.zeros((n,), jnp.int32))
     return jnp.stack(rows, axis=0)
 
 
@@ -98,8 +105,11 @@ def _choose_kernel(
     req_cpu = req_ref[:, 0:1]  # [BP, 1] i32
     req_mem = req_ref[:, 1:2]
 
-    # PodFitsResources — exact int32, identical to ops/masks.py.
+    # PodFitsResources — exact int32, identical to ops/masks.py; extended
+    # resources (req columns 2+, info rows 5+) join the same AND.
     fit = (req_cpu <= avail[0:1, :]) & (req_mem <= avail[1:2, :])  # [BP, TN]
+    for e in range(req_ref.shape[1] - 2):
+        fit = fit & (req_ref[:, 2 + e : 3 + e] <= info_ref[5 + e : 6 + e, :])
 
     # nodeSelector — selector-pair counting matmul (MXU; counts are tiny
     # integers, exact in f32).
@@ -193,6 +203,7 @@ def choose_block_pallas(
     inactive, padded nodes invalid, so results are unaffected.
     """
     b, n = req.shape[0], node_info.shape[1]
+    r = req.shape[1]
     l = sel.shape[1]
     t = ntol.shape[1]
     a_dim = aff.shape[1]
@@ -232,7 +243,7 @@ def choose_block_pallas(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 8), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((bp, 2), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, r), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, l), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, t), lambda i, j: (i, 0)),

@@ -224,7 +224,7 @@ def _build_shard_map(
             claim_s = claim[order]
             is_start = jnp.concatenate([jnp.ones((1,), bool), ch_s[1:] != ch_s[:-1]])[:, None]
             _, within = lax.associative_scan(_seg_scan_op, (is_start, claim_s))
-            avail_ext = jnp.concatenate([avail, jnp.zeros((1, 2), avail.dtype)], axis=0)
+            avail_ext = jnp.concatenate([avail, jnp.zeros((1, avail.shape[1]), avail.dtype)], axis=0)
             acc_s = (within <= avail_ext[ch_s]).all(-1) & (ch_s < n_local)
             accepted_rng = jnp.zeros((p_tot,), bool).at[order].set(acc_s)
 
@@ -243,7 +243,7 @@ def _build_shard_map(
             # 4. capacity commit from the FILTERED accepted set; each column
             # scatter-subtracts its own nodes.
             acc_here = accepted & in_range
-            dec = jnp.zeros((n_local + 1, 2), jnp.int32).at[ch_local].add(jnp.where(acc_here[:, None], claim, 0))
+            dec = jnp.zeros((n_local + 1, avail.shape[1]), jnp.int32).at[ch_local].add(jnp.where(acc_here[:, None], claim, 0))
             avail = avail - dec[:n_local]
             acc_local = lax.dynamic_slice(accepted, (dp_idx * p_local,), (p_local,))
 

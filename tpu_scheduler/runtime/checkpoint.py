@@ -62,6 +62,7 @@ def save_scheduler(scheduler, path: str) -> None:
             [[[k, op, list(vals)] for k, op, vals in key], i] for key, i in packed.pref_vocab.items()
         ]
         state["node_names"] = list(packed.node_names)
+        state["res_vocab"] = list(packed.res_vocab)
         fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
         with os.fdopen(fd, "wb") as f:  # file object: savez can't append ".npz"
             np.savez(
@@ -123,8 +124,9 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 tuple((k, op, tuple(vals)) for k, op, vals in key): i for key, i in state.get("pref_vocab", [])
             }
             n_pad = z["node_alloc"].shape[0]
+            res_vocab = tuple(state.get("res_vocab", ("cpu", "memory")))
             consistent = (
-                z["node_avail"].shape == z["node_alloc"].shape == (n_pad, 2)
+                z["node_avail"].shape == z["node_alloc"].shape == (n_pad, len(res_vocab))
                 and z["node_labels"].shape[0] == n_pad
                 and "node_taints" in z
                 and z["node_taints"].shape[0] == n_pad
@@ -158,7 +160,7 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 node_taints_soft=z["node_taints_soft"],
                 node_pref=z["node_pref"],
                 node_names=tuple(state.get("node_names", [])),
-                pod_req=np.zeros((p, 2), np.int32),
+                pod_req=np.zeros((p, len(res_vocab)), np.int32),
                 pod_sel=np.zeros((p, z["node_labels"].shape[1]), np.float32),
                 pod_sel_count=np.zeros((p,), np.float32),
                 pod_ntol=np.zeros((p, z["node_taints"].shape[1]), np.float32),
@@ -170,6 +172,7 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 pod_valid=np.zeros((p,), bool),
                 pod_names=(),
                 vocab=vocab,
+                res_vocab=res_vocab,
                 taint_vocab=taint_vocab,
                 aff_vocab=aff_vocab,
                 soft_taint_vocab=soft_taint_vocab,

@@ -976,12 +976,18 @@ class Scheduler:
                     avail -= extra_used[node.name]
                 if node.name in freed:
                     avail += freed[node.name]
-                need_cpu, need_mem = req.cpu - avail.cpu, req.memory - avail.memory
+                # Per-axis deficit (cpu, memory, each extended resource the
+                # preemptor requests): victims accumulate until every axis
+                # is covered.
+                need = PodResources(cpu=req.cpu - avail.cpu, memory=req.memory - avail.memory)
+                if req.extended:
+                    a_ext = avail.extended or {}
+                    need.extended = {k: v - a_ext.get(k, 0) for k, v in req.extended.items()}
                 victims: list[Pod] = []
                 got = PodResources()
                 pdb_used: dict[int, int] = {}
                 for q in pods_on.get(node.name, []):  # priority ascending
-                    if got.cpu >= need_cpu and got.memory >= need_mem:
+                    if got.covers(need):
                         break
                     if _pod_priority(q) >= prio:
                         break  # sorted: everything after is also ineligible
@@ -992,7 +998,7 @@ class Scheduler:
                         pdb_used[i] = pdb_used.get(i, 0) + 1
                     victims.append(q)
                     got += total_pod_resources(q)
-                if got.cpu >= need_cpu and got.memory >= need_mem:
+                if got.covers(need):
                     if victims:
                         # kube's selectVictimsOnNode re-filter: the node must
                         # still satisfy affinity/spread AS IF the victims were
@@ -1103,7 +1109,7 @@ class Scheduler:
         if assumed is not None:
             available -= assumed
         req = total_pod_resources(pod)
-        if not (req.cpu <= available.cpu and req.memory <= available.memory):
+        if not req.fits_in(available):
             return InvalidNodeReason.NOT_ENOUGH_RESOURCES
         for reason, pred in NODE_LOCAL_PREDICATES:
             if not pred(pod, node, snapshot):

@@ -43,11 +43,12 @@ def make_node(
     labels: dict[str, str] | None = None,
     taints: list[Taint] | None = None,
     unschedulable: bool = False,
+    extended: dict[str, str | int] | None = None,
 ) -> Node:
     spec = NodeSpec(taints=taints, unschedulable=unschedulable) if (taints or unschedulable) else None
     return Node(
         metadata=ObjectMeta(name=name, labels=labels),
-        status=NodeStatus(allocatable={"cpu": cpu, "memory": memory}),
+        status=NodeStatus(allocatable={"cpu": cpu, "memory": memory, **(extended or {})}),
         spec=spec,
     )
 
@@ -62,6 +63,7 @@ def make_pod(
     phase: str = "Pending",
     priority: int = 0,
     labels: dict[str, str] | None = None,
+    extended: dict[str, str | int] | None = None,
     anti_affinity: list[PodAntiAffinityTerm] | None = None,
     pod_affinity: list[PodAntiAffinityTerm] | None = None,
     preferred_pod_affinity: list | None = None,
@@ -76,7 +78,10 @@ def make_pod(
         metadata=ObjectMeta(name=name, namespace=namespace, labels=labels),
         spec=PodSpec(
             containers=[
-                Container(name="main", resources=ResourceRequirements(requests={"cpu": cpu, "memory": memory}))
+                Container(
+                    name="main",
+                    resources=ResourceRequirements(requests={"cpu": cpu, "memory": memory, **(extended or {})}),
+                )
             ],
             node_selector=node_selector,
             node_name=node_name,
@@ -113,6 +118,7 @@ def synth_cluster(
     gang_fraction: float = 0.0,
     pod_affinity_fraction: float = 0.0,
     preferred_pod_affinity_fraction: float = 0.0,
+    extended_fraction: float = 0.0,
 ) -> ClusterSnapshot:
     """Generate a synthetic cluster snapshot.
 
@@ -148,6 +154,10 @@ def synth_cluster(
     weighted preference to co-locate with their own soft group over the
     zone key, and (30% of them) a weighted anti-preference against another
     group — the signed-weight scoring path (ops/score.py ppa matmul).
+
+    ``extended_fraction``: that fraction of pending pods request
+    ``example.com/tpu`` chips (1-4); every 'compute' pool node exposes 8 —
+    the device-plugin resource axis (R > 2 tensors end to end).
     """
     rng = random.Random(seed)
     if n_nodes == 0:
@@ -167,8 +177,12 @@ def synth_cluster(
             soft = Taint(key="degraded", value=_ZONES[i % len(_ZONES)], effect="PreferNoSchedule")
             taints = (taints or []) + [soft]
         cordoned = rng.random() < cordoned_fraction
+        ext_alloc = {"example.com/tpu": "8"} if extended_fraction and pool == "compute" else None
         nodes.append(
-            make_node(f"node-{i}", cpu=cores, memory=f"{gib}Gi", labels=labels, taints=taints, unschedulable=cordoned)
+            make_node(
+                f"node-{i}", cpu=cores, memory=f"{gib}Gi", labels=labels, taints=taints,
+                unschedulable=cordoned, extended=ext_alloc,
+            )
         )
 
     pods: list[Pod] = []
@@ -300,10 +314,14 @@ def synth_cluster(
                         ),
                     )
                 )
+        ext_req = None
+        if extended_fraction and rng.random() < extended_fraction:
+            ext_req = {"example.com/tpu": str(rng.choice([1, 2, 4]))}
         pod = make_pod(
             f"pending-{i}",
             cpu=f"{rng.choice([100, 250, 500, 1000, 2000])}m",
             memory=f"{rng.choice([128, 256, 512, 1024, 4096])}Mi",
+            extended=ext_req,
             node_selector=selector,
             priority=rng.randrange(0, 10),
             labels={
