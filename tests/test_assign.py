@@ -145,6 +145,7 @@ def test_no_pending_pods():
         (16, 200, 0, {}),  # contention: many rounds, several shrinks
         (64, 500, 1, {"selector_fraction": 0.4}),
         (24, 120, 2, {"soft_taint_fraction": 0.3, "preferred_affinity_fraction": 0.3}),
+        (24, 160, 5, {"extended_fraction": 0.3}),  # [·,3] resource tensors
     ],
 )
 def test_epoch_driver_matches_monolithic(n_nodes, n_pending, seed, kw):

@@ -217,3 +217,40 @@ def test_kube_native_names_stay_ignored():
     assert packed.res_vocab == ("cpu", "memory")
     rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
     assert rn.bindings == [("default/web", "n1")]
+
+
+def test_byte_valued_non_hugepages_resource_does_not_saturate():
+    """Review repro (sgx.intel.com/epc): any byte-valued extended resource
+    gets a value-derived column divisor, so >=2 GiB quantities never clamp
+    into a false fit — the tensor path agrees with the scalar oracle."""
+    epc = "sgx.intel.com/epc"
+    nodes = [make_node("n1", cpu="16", memory="64Gi", extended={epc: "3Gi"})]
+    pods = [make_pod("p", cpu="1", memory="1Gi", extended={epc: "4Gi"})]
+    snap = ClusterSnapshot.build(nodes, pods)
+    assert not P.pod_fits_resources(pods[0], nodes[0], snap)
+    packed = pack_snapshot(snap)
+    assert packed.res_scales[2] > 1  # value-derived divisor kicked in
+    rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
+    rt = TpuBackend().schedule(packed, DEFAULT_PROFILE)
+    assert rn.bindings == rt.bindings == []
+    # and a genuinely fitting request still binds
+    snap2 = ClusterSnapshot.build(nodes, [make_pod("q", cpu="1", memory="1Gi", extended={epc: "2Gi"})])
+    packed2 = pack_snapshot(snap2)
+    assert NativeBackend().schedule(packed2, DEFAULT_PROFILE).bindings == [("default/q", "n1")]
+
+
+def test_kubernetes_io_domain_is_not_extended():
+    """Review repro: *.kubernetes.io/* names are NOT extended resources
+    (kube IsExtendedResourceName) — requesting one must not gate scheduling."""
+    from tpu_scheduler.api.objects import is_extended_resource
+
+    assert not is_extended_resource("something.kubernetes.io/foo")
+    assert not is_extended_resource("kubernetes.io/batteries")
+    assert is_extended_resource("google.com/tpu")
+    assert is_extended_resource("hugepages-2Mi")
+    pod = make_pod("p", cpu="1", memory="1Gi", extended={"something.kubernetes.io/foo": "1"})
+    snap = ClusterSnapshot.build([make_node("n1", cpu="8", memory="32Gi")], [pod])
+    assert P.pod_fits_resources(pod, snap.nodes[0], snap)
+    packed = pack_snapshot(snap)
+    assert packed.res_vocab == ("cpu", "memory")
+    assert NativeBackend().schedule(packed, DEFAULT_PROFILE).bindings == [("default/p", "n1")]

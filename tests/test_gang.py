@@ -246,3 +246,24 @@ def test_desynchronized_backoffs_do_not_livelock_the_gang():
         if bound_names == {"w0", "w1", "w2"}:
             break
     assert bound_names == {"w0", "w1", "w2"}, f"gang livelocked; bound={bound_names}"
+
+
+def test_placed_gang_members_are_not_preemption_victims():
+    """Evicting one worker of a placed gang destroys the group's value for
+    partial gain and would break all-or-nothing — members are victim-
+    ineligible (found by the kitchen-sink preemption-wave invariant)."""
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="4", memory="16Gi")],
+        pods=[
+            make_pod("g-0", cpu="2", gang="j", node_name="n1", phase="Running", priority=0),
+            make_pod("g-1", cpu="2", gang="j", node_name="n1", phase="Running", priority=0),
+            make_pod("vip", cpu="2", priority=100),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, profile=DEFAULT_PROFILE.with_(preemption=True))
+    m = sched.run_cycle()
+    assert m.bound == 0, "no victims available: the gang is whole or nothing"
+    assert {p.metadata.name for p in api.list_pods()} >= {"g-0", "g-1"}

@@ -42,6 +42,7 @@ def _kitchen_sink(seed):
         gang_fraction=0.12,
         pod_affinity_fraction=0.1,
         preferred_pod_affinity_fraction=0.15,
+        extended_fraction=0.15,
     )
 
 

@@ -742,12 +742,18 @@ class PodResources:
 
 
 def is_extended_resource(name: str) -> bool:
-    """Kube's definition: extended resources are domain-qualified
-    (``vendor.example/thing``) or hugepages; kube-native names this
-    framework doesn't model (ephemeral-storage, pods, …) stay IGNORED, as
-    the reference ignores everything but cpu/memory — a common manifest
-    requesting ephemeral-storage must not become unschedulable."""
-    return "/" in name or name.startswith("hugepages-")
+    """Kube's definition (IsExtendedResourceName): domain-qualified names
+    OUTSIDE the kubernetes.io domain, plus hugepages-*.  Kube-native names
+    this framework doesn't model (ephemeral-storage, pods,
+    *.kubernetes.io/*) stay IGNORED, as the reference ignores everything
+    but cpu/memory — a common manifest requesting them must not become
+    unschedulable."""
+    if name.startswith("hugepages-"):
+        return True
+    if "/" not in name:
+        return False
+    domain = name.split("/", 1)[0]
+    return not (domain == "kubernetes.io" or domain.endswith(".kubernetes.io"))
 
 
 def total_pod_resources(pod: Pod) -> PodResources:

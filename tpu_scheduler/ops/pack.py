@@ -145,6 +145,14 @@ class PackedCluster:
     # the snapshot, as raw integer counts.  R == 2 for clusters without
     # extended requests, so the flagship path is unchanged.
     res_vocab: tuple[str, ...] = ("cpu", "memory")
+    # Per-column unit divisor for the int32 tensors.  cpu is exact millis,
+    # memory is fixed KiB (reference semantics); each EXTENDED column gets
+    # the smallest power-of-1024 divisor under which every value in the
+    # snapshot fits int32 — device counts stay exact at 1, byte-valued
+    # quantities (hugepages, SGX EPC, ...) scale to KiB/MiB as needed, so
+    # the fit comparison NEVER saturates into a false positive.  Rounding
+    # stays conservative: requests ceil, capacities floor.
+    res_scales: tuple[int, ...] = (1, 1024)
 
     # The pod OBJECTS behind the rows (same order as pod_names) — the
     # identity keys of the O(delta) row-reuse path in repack_incremental:
@@ -446,21 +454,39 @@ def _alloc_and_used64(
     return alloc64, used64, node_index
 
 
-def _res_scales(res_vocab: tuple[str, ...]) -> np.ndarray:
-    """Per-column unit divisor: byte-valued columns (memory, hugepages-*)
-    store KiB in the int32 tensors so >=2 GiB quantities don't saturate;
-    device counts stay exact at scale 1."""
-    return np.array(
-        [1, 1024] + [1024 if name.startswith("hugepages-") else 1 for name in res_vocab[2:]],
-        dtype=np.int64,
-    )
+def _fit_scales(alloc64: np.ndarray, req64: np.ndarray) -> tuple[int, ...]:
+    """Per-column divisors (see PackedCluster.res_scales): columns 0-1 are
+    fixed (millis, KiB); each extended column takes the smallest
+    power-of-1024 under which every allocatable AND request value fits
+    int32 — computed jointly over both sides so scaled comparisons are
+    consistent and never saturate."""
+    r = alloc64.shape[1]
+    scales = [1, 1024]
+    for j in range(2, r):
+        m = 0
+        if alloc64.shape[0]:
+            m = max(m, int(np.abs(alloc64[:, j]).max()))
+        if req64.shape[0]:
+            m = max(m, int(np.abs(req64[:, j]).max()))
+        scale = 1
+        while m // scale > INT32_MAX:
+            scale *= 1024
+        scales.append(scale)
+    return tuple(scales)
 
 
-def _avail_i32(alloc64: np.ndarray, used64: np.ndarray, res_vocab: tuple[str, ...] = ("cpu", "memory")) -> np.ndarray:
+def _req_i32(req64: np.ndarray, res_scales: tuple[int, ...]) -> np.ndarray:
+    """Requests CEIL under the column divisors (conservative dual of the
+    capacity floor)."""
+    sc = np.asarray(res_scales, dtype=np.int64)[None, :]
+    return _clamp_i32(-(np.floor_divide(-req64, sc)))
+
+
+def _avail_i32(alloc64: np.ndarray, used64: np.ndarray, res_scales: tuple[int, ...] = (1, 1024)) -> np.ndarray:
     avail64 = alloc64 - used64
-    # Floor byte-valued columns to KiB (conservative); cpu millis and
-    # device counts are exact.
-    return _clamp_i32(np.floor_divide(avail64, _res_scales(res_vocab)[None, :]))
+    # Floor capacities under the column divisors (conservative; a clamped
+    # availability only ever UNDERestimates, which is safe).
+    return _clamp_i32(np.floor_divide(avail64, np.asarray(res_scales, dtype=np.int64)[None, :]))
 
 
 def pack_snapshot(
@@ -535,10 +561,12 @@ def pack_snapshot(
                         raise PackingError(f"taint {(t.key, t.value, t.effect)} missing from supplied soft_taint_vocab")
                     node_taints_soft[i, j] = 1.0
 
-    node_alloc = _clamp_i32(np.floor_divide(alloc64, _res_scales(res_vocab)[None, :]))
-    node_avail = _avail_i32(alloc64, used64, res_vocab)
-
     pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad, res_vocab)
+    pod_req64 = pod_tensors.pop("pod_req64")
+    res_scales = _fit_scales(alloc64, pod_req64)
+    pod_tensors["pod_req"] = _req_i32(pod_req64, res_scales)
+    node_alloc = _clamp_i32(np.floor_divide(alloc64, np.asarray(res_scales, dtype=np.int64)[None, :]))
+    node_avail = _avail_i32(alloc64, used64, res_scales)
     pod_ntol = _pack_ntol(pending, taint_vocab, p_pad, t_pad)
     pod_aff, pod_has_aff = _pack_affinity(pending, aff_vocab, p_pad, a_pad)
     pod_ntol_soft = _pack_ntol(pending, soft_taint_vocab, p_pad, ts_pad)
@@ -558,6 +586,7 @@ def pack_snapshot(
         soft_taint_vocab=dict(soft_taint_vocab),
         pref_vocab=dict(pref_vocab),
         res_vocab=res_vocab,
+        res_scales=res_scales,
         pod_ntol=pod_ntol,
         pod_aff=pod_aff,
         pod_has_aff=pod_has_aff,
@@ -583,15 +612,12 @@ def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int, res_voca
     for i, pod in enumerate(pending):
         res = total_pod_resources(pod)
         pod_req64[i, CPU] = res.cpu
-        pod_req64[i, MEM] = -(-res.memory // 1024)  # ceil KiB (conservative)
+        pod_req64[i, MEM] = res.memory  # raw bytes; caller ceils by res_scales
         if res.extended and len(res_vocab) > 2:
             for j, name in enumerate(res_vocab[2:], start=2):
                 v = res.extended.get(name)
                 if v:
-                    # Byte-valued columns (hugepages-*) ceil to KiB — the
-                    # dual of the node side's floor (_res_scales).
-                    scale = 1024 if name.startswith("hugepages-") else 1
-                    pod_req64[i, j] = -(-v // scale)
+                    pod_req64[i, j] = v
         pod_valid[i] = True
         pod_names.append(full_name(pod))
         if pod.spec is not None:
@@ -605,7 +631,7 @@ def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int, res_voca
                 pod_sel_count[i] = len(pod.spec.node_selector)
 
     return dict(
-        pod_req=_clamp_i32(pod_req64),
+        pod_req64=pod_req64,
         pod_sel=pod_sel,
         pod_sel_count=pod_sel_count,
         pod_prio=pod_prio,
@@ -627,7 +653,7 @@ def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedClus
     if resource_vocab(snapshot) != packed.res_vocab:
         raise ValueError("resource vocabulary changed; run a full pack_snapshot instead")
     alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_vocab=packed.res_vocab)
-    return replace(packed, node_avail=_avail_i32(alloc64, used64, packed.res_vocab))
+    return replace(packed, node_avail=_avail_i32(alloc64, used64, packed.res_scales))
 
 
 def _grow_columns(arr: np.ndarray, total: int, label_block: int) -> np.ndarray:
@@ -829,7 +855,12 @@ def repack_incremental(
         fi = np.asarray(fresh_idx, dtype=np.intp)
         n_f = len(fp)
         sub = _pack_pods(fp, packed.vocab, n_f, l_w, packed.res_vocab)
-        pod_req[fi] = sub["pod_req"]
+        sc = np.asarray(packed.res_scales, dtype=np.int64)
+        if (np.floor_divide(sub["pod_req64"], sc[None, :]) > INT32_MAX).any():
+            # A request outgrew the cached column divisors — full-pack event
+            # (recomputes res_scales); the controller catches ValueError.
+            raise ValueError("resource scales outgrown; run a full pack_snapshot instead")
+        pod_req[fi] = _req_i32(sub["pod_req64"], packed.res_scales)
         pod_sel[fi] = sub["pod_sel"]
         pod_sel_count[fi] = sub["pod_sel_count"]
         pod_prio[fi] = sub["pod_prio"]
@@ -842,7 +873,7 @@ def repack_incremental(
 
     return replace(
         packed,
-        node_avail=_avail_i32(alloc64, used64, packed.res_vocab),
+        node_avail=_avail_i32(alloc64, used64, packed.res_scales),
         pod_req=pod_req,
         pod_sel=pod_sel,
         pod_sel_count=pod_sel_count,

@@ -72,6 +72,12 @@ def score_block(
         weights, so no extra global knob — the 1-100 term weights rule).
     """
     f32 = xp.float32
+    # Scoring reads cpu/mem only (columns 0-1) — slice BEFORE the [B,N,·]
+    # broadcast so extended-resource columns (R > 2) never materialize in
+    # the hot path; bit-identical at R == 2.
+    pod_req = pod_req[:, :2]
+    node_alloc = node_alloc[:, :2]
+    node_avail = node_avail[:, :2]
     used_after = (node_alloc - node_avail)[None, :, :] + pod_req[:, None, :]  # [B,N,2] int32
     safe = (node_alloc > 0)[None, :, :]
     denom = xp.where(safe, node_alloc.astype(f32)[None, :, :], f32(1.0))

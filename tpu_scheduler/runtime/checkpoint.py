@@ -63,6 +63,7 @@ def save_scheduler(scheduler, path: str) -> None:
         ]
         state["node_names"] = list(packed.node_names)
         state["res_vocab"] = list(packed.res_vocab)
+        state["res_scales"] = list(packed.res_scales)
         fd, tmp = tempfile.mkstemp(dir=path, suffix=".npz.tmp")
         with os.fdopen(fd, "wb") as f:  # file object: savez can't append ".npz"
             np.savez(
@@ -125,6 +126,7 @@ def restore_scheduler(scheduler, path: str) -> bool:
             }
             n_pad = z["node_alloc"].shape[0]
             res_vocab = tuple(state.get("res_vocab", ("cpu", "memory")))
+            res_scales = tuple(state.get("res_scales", (1, 1024)))
             consistent = (
                 z["node_avail"].shape == z["node_alloc"].shape == (n_pad, len(res_vocab))
                 and z["node_labels"].shape[0] == n_pad
@@ -173,6 +175,7 @@ def restore_scheduler(scheduler, path: str) -> bool:
                 pod_names=(),
                 vocab=vocab,
                 res_vocab=res_vocab,
+                res_scales=res_scales,
                 taint_vocab=taint_vocab,
                 aff_vocab=aff_vocab,
                 soft_taint_vocab=soft_taint_vocab,

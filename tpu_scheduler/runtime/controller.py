@@ -991,6 +991,14 @@ class Scheduler:
                         break
                     if _pod_priority(q) >= prio:
                         break  # sorted: everything after is also ineligible
+                    if q.spec is not None and q.spec.gang:
+                        # Placed gang members are never INDIVIDUAL victims:
+                        # evicting one worker destroys the whole group's
+                        # value (the members left running are useless) for
+                        # partial capacity gain — and it would break the
+                        # framework's all-or-nothing gang guarantee.  Look
+                        # past them, like budget-protected pods.
+                        continue
                     qpdbs = _pdbs_of(q) if pdbs else ()
                     if any(pdb_allow[i] - pdb_used.get(i, 0) <= 0 for i in qpdbs):
                         continue  # budget-protected: look past it, never evict
