@@ -254,3 +254,36 @@ def test_kubernetes_io_domain_is_not_extended():
     packed = pack_snapshot(snap)
     assert packed.res_vocab == ("cpu", "memory")
     assert NativeBackend().schedule(packed, DEFAULT_PROFILE).bindings == [("default/p", "n1")]
+
+
+def test_oversized_memory_clamps_without_breaking_incremental():
+    """Review repro: a >2 TiB-KiB memory request keeps the documented clamp
+    (cpu/memory scales are fixed) — it must NOT force a full repack every
+    cycle."""
+    from tpu_scheduler.ops.pack import repack_incremental
+
+    nodes = [make_node("n1", cpu="8", memory="32Gi")]
+    snap = ClusterSnapshot.build(nodes, [make_pod("small", cpu="1", memory="1Gi")])
+    packed = pack_snapshot(snap)
+    snap2 = ClusterSnapshot.build(
+        nodes, [make_pod("small", cpu="1", memory="1Gi"), make_pod("huge", cpu="1", memory="3Ti")]
+    )
+    packed2 = repack_incremental(packed, snap2)  # must not raise
+    assert packed2.pod_req[:, 1].max() == 2**31 - 1  # clamped, unschedulable
+    rn = NativeBackend().schedule(packed2, DEFAULT_PROFILE)
+    assert ("default/huge", "n1") not in rn.bindings
+
+
+def test_exact_boundary_request_never_false_fits():
+    """Review repro: a request of INT32_MAX*scale + 1 must escalate the
+    divisor (ceil-consistent scale selection), never clamp into a fit."""
+    epc = "sgx.intel.com/epc"
+    cap = (2**31 - 1) * 1  # node capacity = INT32_MAX units at scale 1
+    nodes = [make_node("n1", cpu="8", memory="32Gi", extended={epc: str(cap)})]
+    pod = make_pod("p", cpu="1", memory="1Gi", extended={epc: str(cap + 1)})
+    snap = ClusterSnapshot.build(nodes, [pod])
+    assert not P.pod_fits_resources(pod, nodes[0], snap)
+    packed = pack_snapshot(snap)
+    rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
+    rt = TpuBackend().schedule(packed, DEFAULT_PROFILE)
+    assert rn.bindings == rt.bindings == []

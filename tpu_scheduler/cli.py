@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pods", type=int, default=1000, help="synthetic cluster: pending pods")
     p.add_argument("--bound-pods", type=int, default=0, help="synthetic cluster: pre-bound pods")
     p.add_argument("--seed", type=int, default=0, help="synthetic cluster seed")
+    p.add_argument(
+        "--workload",
+        default="plain",
+        choices=["plain", "mixed"],
+        help="synthetic workload shape: 'mixed' exercises the full feature surface "
+        "(selectors, taints, node+pod affinity hard+soft, spread, gangs, extended TPU-chip requests)",
+    )
     p.add_argument("--cycles", type=int, default=None, help="max scheduling cycles (default: run until settled)")
     p.add_argument("--daemon", action="store_true", help="serve forever: never exit on settle, idle between cycles (reference main.rs:146-149)")
     p.add_argument(
@@ -94,7 +101,27 @@ def main(argv: list[str] | None = None) -> int:
         api = RemoteApiAdapter(KubeApiClient(args.api_server, token=args.api_token))
     else:
         api = FakeApiServer()
-        snap = synth_cluster(n_nodes=args.nodes, n_pending=args.pods, n_bound=args.bound_pods, seed=args.seed)
+        mixed = (
+            dict(
+                selector_fraction=0.25,
+                anti_affinity_fraction=0.1,
+                spread_fraction=0.1,
+                tainted_fraction=0.15,
+                node_affinity_fraction=0.15,
+                soft_taint_fraction=0.15,
+                preferred_affinity_fraction=0.15,
+                schedule_anyway_fraction=0.1,
+                gang_fraction=0.1,
+                pod_affinity_fraction=0.1,
+                preferred_pod_affinity_fraction=0.15,
+                extended_fraction=0.15,
+            )
+            if args.workload == "mixed"
+            else {}
+        )
+        snap = synth_cluster(
+            n_nodes=args.nodes, n_pending=args.pods, n_bound=args.bound_pods, seed=args.seed, **mixed
+        )
         api.load(snap.nodes, snap.pods)
 
     if args.distributed or args.backend == "tpu-sharded":

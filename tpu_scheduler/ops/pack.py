@@ -469,7 +469,10 @@ def _fit_scales(alloc64: np.ndarray, req64: np.ndarray) -> tuple[int, ...]:
         if req64.shape[0]:
             m = max(m, int(np.abs(req64[:, j]).max()))
         scale = 1
-        while m // scale > INT32_MAX:
+        # Ceiled quotient — the same rounding _req_i32 applies — so a
+        # request of exactly INT32_MAX*scale + r can never clamp into a
+        # false fit.
+        while -(-m // scale) > INT32_MAX:
             scale *= 1024
         scales.append(scale)
     return tuple(scales)
@@ -856,9 +859,12 @@ def repack_incremental(
         n_f = len(fp)
         sub = _pack_pods(fp, packed.vocab, n_f, l_w, packed.res_vocab)
         sc = np.asarray(packed.res_scales, dtype=np.int64)
-        if (np.floor_divide(sub["pod_req64"], sc[None, :]) > INT32_MAX).any():
-            # A request outgrew the cached column divisors — full-pack event
-            # (recomputes res_scales); the controller catches ValueError.
+        # Extended columns only (a full pack re-derives those divisors and
+        # cures the raise); cpu/memory scales are FIXED, so an oversized
+        # value there keeps the documented clamp behavior (module header)
+        # instead of degrading every future cycle to a full pack.  Ceiled
+        # quotient to match _req_i32's rounding exactly.
+        if sc.shape[0] > 2 and (-(np.floor_divide(-sub["pod_req64"][:, 2:], sc[None, 2:])) > INT32_MAX).any():
             raise ValueError("resource scales outgrown; run a full pack_snapshot instead")
         pod_req[fi] = _req_i32(sub["pod_req64"], packed.res_scales)
         pod_sel[fi] = sub["pod_sel"]

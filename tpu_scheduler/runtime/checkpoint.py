@@ -129,6 +129,7 @@ def restore_scheduler(scheduler, path: str) -> bool:
             res_scales = tuple(state.get("res_scales", (1, 1024)))
             consistent = (
                 z["node_avail"].shape == z["node_alloc"].shape == (n_pad, len(res_vocab))
+                and len(res_scales) == len(res_vocab)
                 and z["node_labels"].shape[0] == n_pad
                 and "node_taints" in z
                 and z["node_taints"].shape[0] == n_pad
