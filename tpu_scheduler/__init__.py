@@ -24,7 +24,14 @@ from .api.objects import (  # noqa: F401
     Node,
     ObjectMeta,
     Pod,
+    PodAffinityTerm,
+    PodAntiAffinityTerm,
+    PodDisruptionBudget,
     PodResources,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
     full_name,
     is_pod_bound,
     total_pod_resources,
