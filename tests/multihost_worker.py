@@ -60,6 +60,7 @@ def main() -> int:
     csnap = synth_cluster(
         n_nodes=16, n_pending=48, n_bound=16, seed=5,
         anti_affinity_fraction=0.25, spread_fraction=0.25, schedule_anyway_fraction=0.2,
+        pod_affinity_fraction=0.2, preferred_pod_affinity_fraction=0.2, extended_fraction=0.2,
     )
     cpacked = pack_snapshot(csnap, pod_block=16, node_block=8)
     cons = pack_constraints(csnap, csnap.pending_pods(), cpacked.padded_pods, cpacked.node_names, cpacked.padded_nodes)
@@ -69,6 +70,7 @@ def main() -> int:
     cassigned, crounds = sharded_assign_multihost(
         mesh, cpacked.device_arrays(), profile.weights(), max_rounds=16,
         constraints=c, soft_spread=cons.n_spread_soft > 0,
+        soft_pa=cons.n_ppa_terms > 0, hard_pa=cons.n_pa_terms > 0,
     )
     coracle, _, _ = NativeBackend().assign(replace(cpacked, constraints=cons), profile)
     if not np.array_equal(cassigned, np.asarray(coracle)):
