@@ -311,3 +311,73 @@ def test_bind_transport_error_requeues_single_pod():
     assert sched.metrics.snapshot().get("scheduler_requeues_total") == 1
     m2 = sched.run_cycle()
     assert m2.bound == 1  # requeued pod binds on retry
+
+
+def test_device_failure_drops_upload_cache():
+    """A device-runtime failure may orphan cached uploads (dead device
+    session after a tunnel drop): the backend must forget them so recovery
+    re-uploads instead of reusing corpses."""
+    import jax
+
+    from tpu_scheduler.errors import BackendUnavailable
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.testing import synth_cluster
+
+    b = TpuBackend()
+    packed = pack_snapshot(synth_cluster(n_nodes=10, n_pending=40, n_bound=5, seed=1))
+    b.schedule(packed, DEFAULT_PROFILE)
+    assert len(b._dev_cache) > 0
+
+    orig = b._assign_once
+
+    def boom(*a, **kw):
+        raise jax.errors.JaxRuntimeError("device lost")
+
+    b._assign_once = boom
+    try:
+        b.schedule(packed, DEFAULT_PROFILE)
+        raise AssertionError("expected BackendUnavailable")
+    except BackendUnavailable:
+        pass
+    assert len(b._dev_cache) == 0, "failure must drop cached uploads"
+    b._assign_once = orig
+    r = b.schedule(packed, DEFAULT_PROFILE)  # recovery re-uploads
+    assert len(r.bindings) == 40
+
+
+def test_cache_drop_covers_shards_and_dedups_finalizers():
+    """Review repros: a session-wide failure must also drop SHARD backends'
+    caches (dead buffers on siblings), and re-uploading the same live array
+    after a drop must not stack a second finalizer."""
+    import gc
+
+    from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.testing import synth_cluster
+
+    b = TpuBackend()
+    shard = TpuBackend()
+    b._shards[99] = shard
+    packed = pack_snapshot(synth_cluster(n_nodes=10, n_pending=40, n_bound=5, seed=1))
+    b.schedule(packed, DEFAULT_PROFILE)
+    shard.schedule(packed, DEFAULT_PROFILE)
+    assert len(shard._dev_cache) > 0
+    b._drop_dev_cache()
+    assert len(b._dev_cache) == 0 and len(shard._dev_cache) == 0
+
+    # re-upload the SAME arrays: finalizer registry must not grow
+    b.schedule(packed, DEFAULT_PROFILE)
+    n_keys = len(b._finalizer_keys)
+    b._drop_dev_cache()
+    b.schedule(packed, DEFAULT_PROFILE)
+    assert len(b._finalizer_keys) == n_keys, "finalizers must not stack per failure"
+    del packed
+    gc.collect()
+    # Some arrays legitimately outlive the pack (module-level template
+    # caches); the contract is: every REMAINING registered key belongs to a
+    # live cached array — dead arrays left the registry.
+    assert len(b._finalizer_keys) < n_keys, "dead arrays must leave the registry"
+    assert all(k in b._dev_cache and b._dev_cache[k][0]() is not None for k in b._finalizer_keys)

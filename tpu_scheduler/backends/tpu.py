@@ -84,9 +84,26 @@ class TpuBackend(SchedulingBackend):
         # tens of MB of HBM).
         self._dev_cache: dict[int, tuple[weakref.ref, object]] = {}
         self._put_lock = threading.Lock()
+        # Host-array ids that already carry a weakref.finalize for eviction:
+        # re-uploading a still-alive array (e.g. after a failure-triggered
+        # cache drop) must not stack a second finalizer.
+        self._finalizer_keys: set[int] = set()
+
+    def _drop_dev_cache(self) -> None:
+        """Forget every cached upload — after a device-runtime failure the
+        buffers may belong to a dead device session (tunnel drop, device
+        reset); recovery must re-upload, not reuse corpses.  A tunnel drop
+        kills the whole session, so sibling per-device shard backends
+        (shard_for) drop theirs too."""
+        with self._put_lock:
+            self._dev_cache.clear()
+        for sh in list(self._shards.values()):
+            if sh is not self:
+                sh._drop_dev_cache()
 
     def _evict(self, key: int) -> None:
         with self._put_lock:
+            self._finalizer_keys.discard(key)
             ent = self._dev_cache.get(key)
             # Only drop dead entries: by the time a finalizer runs, the id
             # may already belong to a NEW cached array (CPython reuses ids).
@@ -103,10 +120,14 @@ class TpuBackend(SchedulingBackend):
         buf = self._jax.device_put(arr, self.device)
         try:
             wr = weakref.ref(arr)
-            weakref.finalize(arr, self._evict, key)
         except TypeError:  # non-weakref-able input (e.g. a jax array): skip caching
             return buf
         with self._put_lock:
+            if key not in self._finalizer_keys:
+                # One finalizer per live array, ever — a re-upload of the
+                # same array (post-failure) reuses the existing one.
+                weakref.finalize(arr, self._evict, key)
+                self._finalizer_keys.add(key)
             self._dev_cache[key] = (wr, buf)
         return buf
 
@@ -167,6 +188,7 @@ class TpuBackend(SchedulingBackend):
             # Device-runtime failure (OOM, device lost, …) — the recovery
             # scenario the native fallback exists for (SURVEY.md §5).  Python
             # programming errors deliberately propagate instead.
+            self._drop_dev_cache()
             raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
 
     def _assign_proving(self, packed: PackedCluster, profile: SchedulingProfile):
@@ -195,6 +217,7 @@ class TpuBackend(SchedulingBackend):
                     if self._pallas_strikes >= 2:
                         log.warning("pallas kernel failed %d first-use attempts; disabling pallas", self._pallas_strikes)
                         self.use_pallas = False
+                    self._drop_dev_cache()
                     raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
                 # Non-runtime exceptions (tracing/lowering errors) are
                 # deterministic kernel bugs — disable immediately and serve
@@ -212,6 +235,7 @@ class TpuBackend(SchedulingBackend):
             # Device-runtime failure (OOM, device lost, …) — the recovery
             # scenario the native fallback exists for (SURVEY.md §5).  Python
             # programming errors deliberately propagate instead.
+            self._drop_dev_cache()
             raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
 
     def shard_for(self, index: int) -> "TpuBackend":
