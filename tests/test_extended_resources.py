@@ -287,3 +287,33 @@ def test_exact_boundary_request_never_false_fits():
     rn = NativeBackend().schedule(packed, DEFAULT_PROFILE)
     rt = TpuBackend().schedule(packed, DEFAULT_PROFILE)
     assert rn.bindings == rt.bindings == []
+
+
+def test_sample_policy_respects_extended_and_pod_affinity():
+    """The faithful ≤5-random-candidates policy shares _check_with_ledger,
+    so chips and co-location gate it identically to the batch path."""
+    from tpu_scheduler.api.objects import PodAffinityTerm
+
+    api = FakeApiServer()
+    api.load(
+        nodes=[
+            make_node("gpu-z1", cpu="16", memory="64Gi", labels={"zone": "z1"}, extended={TPU: "8"}),
+            make_node("gpu-z2", cpu="16", memory="64Gi", labels={"zone": "z2"}, extended={TPU: "8"}),
+            make_node("plain", cpu="16", memory="64Gi", labels={"zone": "z2"}),
+        ],
+        pods=[
+            make_pod("anchor", cpu="1", labels={"app": "cache"}, node_name="gpu-z1", phase="Running"),
+            make_pod(
+                "train",
+                cpu="1",
+                extended={TPU: "4"},
+                labels={"app": "train"},
+                pod_affinity=[PodAffinityTerm(match_labels={"app": "cache"}, topology_key="zone")],
+            ),
+        ],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, policy="sample", attempts=50)
+    m = sched.run_cycle()
+    assert m.bound == 1
+    train = next(p for p in api.list_pods() if p.metadata.name == "train")
+    assert train.spec.node_name == "gpu-z1"  # only node with chips AND in the anchor's zone
