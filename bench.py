@@ -180,6 +180,44 @@ def phase_breakdown(backend, packed, profile, full_seconds: float, rounds: int) 
     return out
 
 
+def constrained_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
+    """Timed CONSTRAINED cycle (anti-affinity + spread + positive/preferred
+    pod affinity + extended chips): perf evidence for the constraint engine,
+    on the same device as the flagship number."""
+    from dataclasses import replace
+
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    try:
+        snap = synth_cluster(
+            n_nodes=nodes, n_pending=pods, n_bound=2 * nodes, seed=seed,
+            anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+            pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+        )
+        packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+        cons = pack_constraints(
+            snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+            # 10k synth pods spread over ~50 app groups exceed the default
+            # term budgets; the state stays domain-granular either way.
+            max_aa_terms=256, max_spread=256,
+        )
+        packed = replace(packed, constraints=cons)
+        r = backend.schedule(packed, profile)  # warm/compile
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            r = backend.schedule(packed, profile)
+            times.append(time.perf_counter() - t0)
+        dt = statistics.median(times)
+        log(f"constrained {pods}x{nodes}: {dt:.3f}s ({len(r.bindings)} bound, {r.rounds} rounds)")
+        return {f"constrained_{pods}x{nodes}_seconds": round(dt, 4), "constrained_rounds": r.rounds}
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"constrained row skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
 def sharded_scaling_row(pods: int, nodes: int, seed: int) -> dict:
     """Single-chip vs 8-way-mesh scaling check on a CPU-emulated mesh, run in
     a subprocess so its platform/device-count overrides can't disturb the
@@ -240,6 +278,7 @@ def main() -> int:
     )
     ap.add_argument("--target-seconds", type=float, default=1.0)
     ap.add_argument("--no-sharded-row", action="store_true")
+    ap.add_argument("--no-constrained-row", action="store_true")
     ap.add_argument("--force-cpu", action="store_true", help="testing: skip the TPU entirely")
     args = ap.parse_args()
 
@@ -294,6 +333,8 @@ def main() -> int:
     out.update(phases)
     if used_pods != args.pods:
         out["downscaled_from"] = f"{args.pods}x{args.nodes}"
+    if not args.no_constrained_row:
+        out.update(constrained_row(backend, profile, 10_000, 1_000, args.seed))
     if not args.no_sharded_row:
         row = sharded_scaling_row(8192, 512, args.seed)
         if row:

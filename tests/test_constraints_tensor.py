@@ -336,3 +336,34 @@ def test_plain_cycles_unchanged_by_constraint_plumbing():
     rt = TpuBackend().schedule(packed, DEFAULT_PROFILE)
     assert rn.bindings == rt.bindings
     assert len(rn.unschedulable) == 0
+
+
+def test_stalled_constraint_auction_stops_early():
+    """A spread water line frozen by a capacity-full minimum domain can
+    defer the same pods every round; the auction must detect consecutive
+    zero-acceptance rounds and stop (measured: 48 wasted rounds to the cap
+    before the stall rule), with the stragglers requeued — and the
+    controller's NEXT cycle must still make progress on them."""
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops.constraints import pack_constraints as _pc
+
+    snap = synth_cluster(n_nodes=100, n_pending=1200, n_bound=200, seed=0, spread_fraction=0.15)
+    packed = pack_snapshot(snap)
+    cons = _pc(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    packed = replace(packed, constraints=cons)
+    prof = PROFILES["throughput"].with_(max_rounds=64)
+    rn = NativeBackend().schedule(packed, prof)
+    rt = TpuBackend().schedule(packed, prof)
+    assert rn.bindings == rt.bindings and rn.rounds == rt.rounds
+    assert rn.rounds < 32, f"stall detection failed: {rn.rounds} rounds"
+    assert len(rn.bindings) > 1000  # the bulk still binds
+    # end-to-end: the controller requeues stragglers and settles
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, profile=prof)
+    sched.run(until_settled=True, max_cycles=6)
+    placed = sum(1 for p in api.list_pods() if p.spec is not None and p.spec.node_name)
+    assert placed >= len(rn.bindings) + 200  # pre-bound + at least the one-shot count

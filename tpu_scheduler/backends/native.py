@@ -16,7 +16,7 @@ import numpy as np
 
 from ..models.profiles import SchedulingProfile
 from ..ops.masks import feasibility_block
-from ..ops.pack import INT32_MAX, PackedCluster
+from ..ops.pack import INT32_MAX, STALL_ROUNDS, PackedCluster
 from ..ops.score import score_block
 from .base import SchedulingBackend
 
@@ -66,8 +66,9 @@ class NativeBackend(SchedulingBackend):
         active = valid.copy()
         ranks = np.arange(p, dtype=np.uint32)  # already in priority-rank order
         rounds = 0
+        stall = 0  # consecutive zero-acceptance rounds (ops/assign.py STALL_ROUNDS)
 
-        while rounds < profile.max_rounds and active.any():
+        while rounds < profile.max_rounds and active.any() and stall < STALL_ROUNDS:
             round_masks = (
                 round_blocked_masks(np, cstate, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
                 if cons is not None
@@ -121,6 +122,7 @@ class NativeBackend(SchedulingBackend):
 
             if cons is not None:
                 accepted = constraint_filter(np, accepted, choice, ranks, cpods, cstate, cmeta, hard_pa=hard_pa)
+                stall = 0 if accepted.any() else stall + 1
                 cstate = constraint_commit(
                     np, accepted, choice, cpods, cstate, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa
                 )

@@ -53,7 +53,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .masks import feasibility_block
-from .pack import INT32_MAX
+from .pack import INT32_MAX, STALL_ROUNDS
 from .score import score_block
 
 __all__ = ["assign_cycle", "assign_cycle_epochs", "split_device_arrays", "INT32_MAX"]
@@ -320,7 +320,9 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
             # Within-round conflict resolution + domain-state commit
             # (deferred pods stay active and retry next round).
             accepted = constraint_filter(jnp, accepted, choice, ps["ranks"], ps, cst, cmeta, hard_pa=hard_pa)
+            stall = jnp.where(accepted.any(), jnp.int32(0), cst["stall"] + 1)
             cst = constraint_commit(jnp, accepted, choice, ps, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
+            cst["stall"] = stall
 
         ps["assigned"] = jnp.where(accepted, choice, ps["assigned"])
         ps["acc_round"] = jnp.where(accepted, rounds, ps["acc_round"])
@@ -383,10 +385,15 @@ def assign_cycle(
     n = nodes["node_avail"].shape[0]
     perm, ps = _prepare_pods(pods, block)
     p = ps["pod_req"].shape[0]
+    if cmeta is not None:
+        cstate = {**cstate, "stall": jnp.int32(0)}
 
     def cond(state):
-        _, _, n_active, rounds, _ = state
-        return (rounds < max_rounds) & (n_active > 0)
+        _, _, n_active, rounds, cst = state
+        go = (rounds < max_rounds) & (n_active > 0)
+        if cmeta is not None:
+            go = go & (cst["stall"] < STALL_ROUNDS)
+        return go
 
     body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa)
     state0 = (nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32), jnp.int32(0), cstate)
@@ -405,6 +412,16 @@ def assign_cycle(
 # Epoch-size floor: below this the accept phase is negligible and further
 # halvings would only multiply compiled variants.
 _MIN_EPOCH_SIZE = 256
+
+# Constraint cycles stop after STALL_ROUNDS consecutive ZERO-acceptance
+# rounds (constant in ops/pack.py — jax-free for the native backend):
+# unconstrained rounds always accept >=1 claimant (progress guarantee), but
+# the within-round constraint filter can defer the same pods forever (e.g. a
+# spread water line frozen by a capacity-full minimum domain) — measured 48
+# wasted rounds to the cap at 5k pods.  Jitter re-rolls each round, so a few
+# zero rounds may still unstick; after STALL_ROUNDS identical-state rounds
+# the stragglers requeue to the next cycle instead (reference main.rs:122-125
+# semantics — a retry later, never a crash or a spin).
 
 
 @partial(jax.jit, static_argnames=("block",))
@@ -431,8 +448,10 @@ def _assign_epoch(
     body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa)
 
     def cond(state):
-        _, _, n_active, rounds, _ = state
+        _, _, n_active, rounds, cst = state
         go = (rounds < max_rounds) & (n_active > 0)
+        if cmeta is not None:
+            go = go & (cst["stall"] < STALL_ROUNDS)
         if not floor:
             go = go & (2 * n_active > p)
         return go
@@ -477,7 +496,7 @@ def assign_cycle_epochs(
     p_pad = ps["pod_req"].shape[0]
     n_active = int(n_active_dev)
     rounds = jnp.int32(0)
-    cst = cstate
+    cst = {**cstate, "stall": jnp.int32(0)} if cmeta is not None else cstate
     assigned_rank = jnp.full((p_pad,), -1, jnp.int32)
     acc_round_rank = jnp.full((p_pad,), -1, jnp.int32)
 
@@ -489,8 +508,17 @@ def assign_cycle_epochs(
             nodes, ps, avail, n_active_dev, rounds, cst, weights, cmeta,
             max_rounds, block, use_pallas, pallas_interpret, soft_spread, soft_pa, hard_pa, floor,
         )
-        n_active = int(n_active_dev)  # host sync — once per epoch, not per round
-        rounds_i = int(rounds)
+        # ONE host sync per epoch: n_active, rounds, and the stall counter
+        # ride home in a single fetch (~80 ms tunnel latency each otherwise).
+        if cmeta is not None:
+            trio = jnp.stack([n_active_dev, rounds, cst["stall"]])
+            n_active, rounds_i, stall_i = (int(v) for v in trio)
+        else:
+            duo = jnp.stack([n_active_dev, rounds])
+            n_active, rounds_i = (int(v) for v in duo)
+            stall_i = 0
+        if stall_i >= STALL_ROUNDS:
+            break
         if floor:
             break
         # Halving chain: sizes above ``block`` stay block multiples (the

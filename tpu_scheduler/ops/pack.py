@@ -77,11 +77,18 @@ __all__ = [
     "resource_vocab",
     "round_up",
     "INT32_MAX",
+    "STALL_ROUNDS",
 ]
 
 CPU, MEM = 0, 1  # resource axis indices
 INT32_MAX = 2**31 - 1
 INT32_MIN = -(2**31)
+
+# Constraint-cycle auctions stop after this many consecutive ZERO-acceptance
+# rounds (shared by every backend so round counts stay bit-identical; see
+# ops/assign.py for the rationale).  Lives here, not in assign.py, because
+# the native recovery backend must import it without pulling in jax.
+STALL_ROUNDS = 3
 
 
 def round_up(x: int, multiple: int) -> int:

@@ -47,6 +47,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.profiles import SchedulingProfile
 from ..ops.assign import _seg_scan_op
+from ..ops.pack import STALL_ROUNDS
 from ..ops.masks import feasibility_block
 from ..ops.pack import PackedCluster, round_up
 from ..ops.score import score_block
@@ -165,6 +166,7 @@ def _build_shard_map(
             cpods = {k: named[k] for k in CONSTRAINT_KEYS[:_N_PODKEYS]}
             cmeta = {k: named[k] for k in CONSTRAINT_KEYS[_N_PODKEYS : _N_PODKEYS + _N_METAKEYS]}
             cst0 = {k: named[k] for k in CONSTRAINT_KEYS[_N_PODKEYS + _N_METAKEYS :]}
+            cst0["stall"] = jnp.int32(0)
             # This device's dp rows of the (replicated) pod bitmaps.
             blk_l = {k: lax.dynamic_slice_in_dim(v, dp_idx * p_local, p_local) for k, v in cpods.items()}
             g_ranks = jnp.arange(p_tot, dtype=jnp.uint32)
@@ -172,8 +174,11 @@ def _build_shard_map(
             cst0 = {}
 
         def cond(state):
-            _, _, _, go, rounds, _ = state
-            return (rounds < max_rounds) & go
+            _, _, _, go, rounds, cst = state
+            keep = (rounds < max_rounds) & go
+            if constrained:
+                keep = keep & (cst["stall"] < STALL_ROUNDS)
+            return keep
 
         def body(state):
             avail, assigned, active, _, rounds, cst = state
@@ -238,7 +243,9 @@ def _build_shard_map(
             if constrained:
                 gi = jnp.minimum(g_choice, n_tot - 1).astype(jnp.int32)  # clamp the non-claimant sentinel
                 accepted = constraint_filter(jnp, accepted, gi, g_ranks, cpods, cst, cmeta, hard_pa=hard_pa)
+                stall = jnp.where(accepted.any(), jnp.int32(0), cst["stall"] + 1)
                 cst = constraint_commit(jnp, accepted, gi, cpods, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
+                cst["stall"] = stall
 
             # 4. capacity commit from the FILTERED accepted set; each column
             # scatter-subtracts its own nodes.
