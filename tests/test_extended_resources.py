@@ -317,3 +317,29 @@ def test_sample_policy_respects_extended_and_pod_affinity():
     assert m.bound == 1
     train = next(p for p in api.list_pods() if p.metadata.name == "train")
     assert train.spec.node_name == "gpu-z1"  # only node with chips AND in the anchor's zone
+
+
+def test_new_extended_name_mid_run_forces_full_repack():
+    """A pod requesting a never-seen device name widens every [·,R] tensor —
+    the incremental path must degrade to a full pack (counter) and the pod
+    must schedule correctly against the widened tensors."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("gpu-1", cpu="16", memory="64Gi", extended={TPU: "8", "vendor.example/fpga": "2"})],
+        pods=[make_pod("a", cpu="1", memory="1Gi", extended={TPU: "2"})],
+    )
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    sched.run_cycle()
+    full0 = sched.metrics.snapshot()["scheduler_full_packs_total"]
+    api.create_pod(make_pod("b", cpu="1", memory="1Gi", extended={TPU: "1"}))
+    sched.run_cycle()  # same vocab: incremental
+    assert sched.metrics.snapshot()["scheduler_full_packs_total"] == full0
+    api.create_pod(make_pod("c", cpu="1", memory="1Gi", extended={"vendor.example/fpga": "1"}))
+    sched.run_cycle()  # new name -> vocab change -> full pack
+    assert sched.metrics.snapshot()["scheduler_full_packs_total"] == full0 + 1
+    placed = {p.metadata.name: p.spec.node_name for p in api.list_pods() if p.spec.node_name}
+    assert placed == {"a": "gpu-1", "b": "gpu-1", "c": "gpu-1"}
+    # and the widened pack keeps incremental service afterwards
+    api.create_pod(make_pod("d", cpu="1", memory="1Gi", extended={"vendor.example/fpga": "1"}))
+    sched.run_cycle()
+    assert sched.metrics.snapshot()["scheduler_full_packs_total"] == full0 + 1
