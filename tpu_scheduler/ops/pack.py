@@ -5,12 +5,13 @@ world (ops/, backends/).  It replaces the reference's per-candidate live
 API-server list + quantity subtraction loop (``src/predicates.rs:21-38``)
 with a one-shot pack of the whole cluster:
 
-  node_alloc[N,2]  int32   total allocatable  (cpu millicores, memory KiB)
-  node_avail[N,2]  int32   remaining = allocatable − Σ bound-pod requests
+  node_alloc[N,R]  int32   total allocatable  (cpu millis, memory KiB, then
+                           extended device resources — res_vocab/res_scales)
+  node_avail[N,R]  int32   remaining = allocatable − Σ bound-pod requests
   node_labels[N,L] float32 bitmap over the selector-pair vocabulary
   node_taints[N,T] float32 bitmap over the hard-taint vocabulary
   node_aff[N,A]    float32 bitmap: node satisfies affinity-term vocab entry
-  pod_req[P,2]     int32   pending-pod requests (millicores, KiB ceil)
+  pod_req[P,R]     int32   pending-pod requests (millis, KiB ceil, counts)
   pod_sel[P,L]     float32 selector bitmap; pod_sel_count[P] = #selector keys
   pod_ntol[P,T]    float32 1 where the pod does NOT tolerate vocab taint t
   pod_aff[P,A]     float32 bitmap of the pod's node-affinity terms
@@ -107,8 +108,8 @@ class PackedCluster:
     """Static-shape tensor view of one scheduling cycle's input."""
 
     # Nodes (padded to N)
-    node_alloc: np.ndarray  # [N,2] int32 — total allocatable (millis, KiB)
-    node_avail: np.ndarray  # [N,2] int32 — remaining after bound pods
+    node_alloc: np.ndarray  # [N,R] int32 — total allocatable (see res_vocab)
+    node_avail: np.ndarray  # [N,R] int32 — remaining after bound pods
     node_labels: np.ndarray  # [N,L] float32 — selector-pair bitmap
     node_taints: np.ndarray  # [N,T] float32 — hard-taint bitmap
     node_aff: np.ndarray  # [N,A] float32 — affinity-term satisfaction bitmap
@@ -116,7 +117,7 @@ class PackedCluster:
     node_names: tuple[str, ...]  # real nodes only (len = num_nodes)
 
     # Pending pods (padded to P)
-    pod_req: np.ndarray  # [P,2] int32 — (millis, KiB ceil)
+    pod_req: np.ndarray  # [P,R] int32 — (millis, KiB ceil, counts)
     pod_sel: np.ndarray  # [P,L] float32
     pod_sel_count: np.ndarray  # [P] float32
     pod_ntol: np.ndarray  # [P,T] float32 — 1 where vocab taint NOT tolerated
