@@ -283,6 +283,10 @@ def main() -> int:
     args = ap.parse_args()
 
     jax, devices, platform = init_devices(force_cpu=args.force_cpu)
+    if platform != "tpu":
+        # Fallback runs are about producing SOME honest number, not medians:
+        # a 100k x 10k cycle takes minutes on CPU, so keep repeats small.
+        args.repeats = min(args.repeats, 2)
 
     from tpu_scheduler.utils.compile_cache import enable_compilation_cache
 
