@@ -13,13 +13,23 @@ per-pod assignments back.  Packing (host-side, amortisable/incremental in the
 controller) is reported separately on stderr.
 
 Hardened against the round-1 failure mode (BENCH_r01.json: rc=1, the axon
-backend was UNAVAILABLE before any work ran):
-  • device init retries with bounded backoff, via re-exec because jax caches
-    a failed backend init in-process (never SIGKILL mid-init — that wedges
-    the TPU tunnel; each attempt runs to completion or raises on its own);
-  • on persistent TPU unavailability, falls back to a smaller problem and
-    finally to CPU — the JSON line then carries "platform" honestly so a
-    degraded number is never mistaken for the flagship one;
+backend was UNAVAILABLE before any work ran) and the round-3 one
+(BENCH_r03.json: rc=124 — each *failed* axon init costs ~1500 s, so an
+attempt-bounded retry loop outran the driver's timeout before the CPU
+fallback could print):
+  • a TOTAL WALL-CLOCK budget (BENCH_MAX_TOTAL_SECONDS, default 2400 s)
+    tracked across re-execs via the BENCH_DEADLINE env var; TPU init is
+    attempted only while the remaining budget can absorb a worst-case
+    failed init (~1500 s measured) AND a CPU fallback run;
+  • device init retries via re-exec because jax caches a failed backend
+    init in-process (never SIGKILL mid-init — that wedges the TPU tunnel;
+    each attempt runs to completion or raises on its own);
+  • a fresh tunnel-down report from the sibling probe
+    (scripts/tpu_status.json) skips TPU entirely instead of burning the
+    budget rediscovering the outage;
+  • on CPU fallback the problem ladder starts at 25k×2.5k so the honest
+    degraded row prints in minutes, with "platform" labeled so it is never
+    mistaken for the flagship number;
   • reports whether the fused Pallas kernel actually ran ("pallas": true) —
     the TpuBackend's first-use guard may downgrade to the jnp path on a
     Mosaic failure, and that must be visible, not silent.
@@ -36,9 +46,16 @@ import time
 
 INIT_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", "5"))
 ATTEMPT_ENV = "BENCH_INIT_ATTEMPT"
+DEADLINE_ENV = "BENCH_DEADLINE"
+MAX_TOTAL_SECONDS = float(os.environ.get("BENCH_MAX_TOTAL_SECONDS", "2400"))
+# Measured (scripts/tpu_status.json round 3): a FAILED axon init runs
+# ~1500 s before raising UNAVAILABLE, and must not be interrupted (killing
+# mid-init wedges the tunnel for hours).  A successful init is < 30 s.
+AXON_FAILED_INIT_WORST = 1600.0
+CPU_FALLBACK_BUDGET = 600.0
 # Sibling probe (scripts/tpu_probe.py) records its last device-init outcome
-# here; a fresh failure report shrinks our retry budget so a known-down
-# tunnel doesn't cost INIT_ATTEMPTS × ~25 min before the CPU fallback.
+# here; a fresh failure report sends us straight to the CPU fallback so a
+# known-down tunnel doesn't cost ~25 min rediscovering the outage.
 PROBE_STATUS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts", "tpu_status.json")
 
 
@@ -46,25 +63,49 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _attempt_budget() -> int:
+def deadline() -> float:
+    """Absolute wall-clock deadline for the WHOLE bench, set once on first
+    exec and inherited by every re-exec (execv preserves os.environ)."""
+    dl = os.environ.get(DEADLINE_ENV)
+    if dl is None:
+        dl = str(time.time() + MAX_TOTAL_SECONDS)
+        os.environ[DEADLINE_ENV] = dl
+    return float(dl)
+
+
+def _remaining() -> float:
+    return deadline() - time.time()
+
+
+def _probe_reports_down() -> bool:
     try:
         with open(PROBE_STATUS) as f:
             st = json.load(f)
         age = time.time() - float(st.get("ts", 0))
-        if not st.get("ok") and age < 1800:
-            log(f"probe reported TPU down {age/60:.0f} min ago ({st.get('error', '')[:120]}); shrinking retries")
-            return min(2, INIT_ATTEMPTS)
+        if not st.get("ok") and age < 2400:
+            log(f"probe reported TPU down {age/60:.0f} min ago ({st.get('error', '')[:120]})")
+            return True
     except (OSError, ValueError, KeyError):
         pass
-    return INIT_ATTEMPTS
+    return False
 
 
 def init_devices(force_cpu: bool = False):
-    """jax.devices() with re-exec retries (jax caches a failed backend).
-    Returns (jax, devices, platform)."""
+    """jax.devices() with wall-clock-bounded re-exec retries (jax caches a
+    failed backend init in-process).  Returns (jax, devices, platform)."""
     attempt = int(os.environ.get(ATTEMPT_ENV, "0"))
     import jax
 
+    if not force_cpu and attempt == 0:
+        # Pre-init gate: only try the TPU when the budget can absorb a
+        # worst-case FAILED init plus the CPU fallback run.  This is safe
+        # in-process — no backend init has been attempted yet.
+        if _probe_reports_down():
+            log("skipping TPU init (probe says tunnel down); running CPU fallback")
+            force_cpu = True
+        elif _remaining() < AXON_FAILED_INIT_WORST + CPU_FALLBACK_BUDGET:
+            log(f"skipping TPU init ({_remaining():.0f}s budget left < worst-case failed init); running CPU fallback")
+            force_cpu = True
     if force_cpu:
         # The axon sitecustomize overrides JAX_PLATFORMS at interpreter
         # start; flipping jax.config after import is the only reliable way
@@ -85,10 +126,17 @@ def init_devices(force_cpu: bool = False):
             + ("present" if any("axon" in p for p in sys.path) else "MISSING — axon backend can't register")
             + f"; JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')}"
         )
-        budget = _attempt_budget()
-        if attempt + 1 < budget:
+        # Retry only while the remaining wall budget can absorb ANOTHER
+        # worst-case failed init plus the CPU fallback (round-3 lesson:
+        # attempt counts don't bound time — failed inits cost ~25 min each).
+        can_retry = (
+            attempt + 1 < INIT_ATTEMPTS
+            and _remaining() > AXON_FAILED_INIT_WORST + CPU_FALLBACK_BUDGET
+            and not _probe_reports_down()
+        )
+        if can_retry:
             delay = min(120, 20 * (attempt + 1))
-            log(f"retrying in {delay}s (attempt {attempt + 1}/{budget})")
+            log(f"retrying in {delay}s (attempt {attempt + 1}/{INIT_ATTEMPTS}, {_remaining():.0f}s budget left)")
             time.sleep(delay)
             os.environ[ATTEMPT_ENV] = str(attempt + 1)
             os.execv(sys.executable, [sys.executable] + sys.argv)
@@ -96,7 +144,7 @@ def init_devices(force_cpu: bool = False):
         # re-exec — the failed backend init is cached in this process, so an
         # in-process platform flip would re-raise (or re-enter the slow axon
         # init).  --force-cpu flips jax.config before any device use.
-        log("TPU unavailable after all attempts; re-exec degrading to CPU (flagged in output)")
+        log(f"TPU unavailable ({_remaining():.0f}s budget left); re-exec degrading to CPU (flagged in output)")
         argv = [sys.executable] + sys.argv + (["--force-cpu"] if "--force-cpu" not in sys.argv else [])
         os.execv(sys.executable, argv)
 
@@ -282,6 +330,7 @@ def main() -> int:
     ap.add_argument("--force-cpu", action="store_true", help="testing: skip the TPU entirely")
     args = ap.parse_args()
 
+    deadline()  # arm the wall-clock budget before any time is spent
     jax, devices, platform = init_devices(force_cpu=args.force_cpu)
     if platform != "tpu":
         # Fallback runs are about producing SOME honest number, not medians:
@@ -300,15 +349,28 @@ def main() -> int:
     profile = PROFILES[args.profile].with_(pod_block=args.block, max_rounds=args.max_rounds)
     n_bound = args.bound if args.bound is not None else 2 * args.nodes
 
-    # Downscale ladder: a partial number beats none (VERDICT r1 #1).
-    scales = [(args.pods, args.nodes, n_bound)]
-    if args.pods >= 100_000:
-        scales += [(50_000, args.nodes, n_bound), (25_000, 5_000, 10_000), (10_000, 1_000, 2_000)]
+    # Downscale ladder: a partial number beats none (VERDICT r1 #1).  On a
+    # CPU fallback the flagship scale would take many minutes per cycle
+    # (each [P,N] intermediate at 100k x 10k is 4 GB); start the ladder at a
+    # size a CPU finishes in minutes so the honest degraded row always
+    # prints inside the wall budget (round-3 lesson).
+    if platform != "tpu" and args.pods >= 100_000:
+        scales = [(25_000, 2_500, 5_000), (10_000, 1_000, 2_000)]
+    else:
+        scales = [(args.pods, args.nodes, n_bound)]
+        if args.pods >= 100_000:
+            scales += [(50_000, args.nodes, n_bound), (25_000, 5_000, 10_000), (10_000, 1_000, 2_000)]
 
     value = bound = rounds = None
     used_pods = used_nodes = None
     phases = {}
-    for pods, nodes, bnd in scales:
+    for i, (pods, nodes, bnd) in enumerate(scales):
+        # Deadline-aware rung choice: a big rung that would blow the
+        # remaining budget is skipped in favour of a smaller one that can
+        # still print (the last rung always runs — some number beats none).
+        if i < len(scales) - 1 and pods > 10_000 and _remaining() < (600 if platform == "tpu" else 300):
+            log(f"skipping {pods}x{nodes} rung ({_remaining():.0f}s budget left)")
+            continue
         try:
             value, bound, rounds, pack_s, phases = run_scale(
                 jax, backend, profile, pods, nodes, bnd, args.seed, args.block, args.repeats
@@ -337,12 +399,12 @@ def main() -> int:
     out.update(phases)
     if used_pods != args.pods:
         out["downscaled_from"] = f"{args.pods}x{args.nodes}"
-    if not args.no_constrained_row:
+    if not args.no_constrained_row and _remaining() > 120:
         # Evidence row, not the headline: quarter scale on a CPU fallback so
         # a tunnel-down bench stays bounded (~50 s at full scale on CPU).
         cp, cn = (10_000, 1_000) if platform == "tpu" else (2_500, 250)
         out.update(constrained_row(backend, profile, cp, cn, args.seed))
-    if not args.no_sharded_row:
+    if not args.no_sharded_row and _remaining() > 120:
         row = sharded_scaling_row(8192, 512, args.seed)
         if row:
             # Toy-scale canary (8192x512 on an emulated CPU mesh): guards the
@@ -350,6 +412,7 @@ def main() -> int:
             # overhead dominates at this size.
             row["sharded_row_note"] = "toy-scale CPU-mesh regression canary, not a perf claim"
         out.update(row)
+    out["budget_seconds_left"] = round(_remaining(), 1)
     print(json.dumps(out))
     return 0
 

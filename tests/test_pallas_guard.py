@@ -1,0 +1,100 @@
+"""Per-variant Pallas first-use guard (backends/tpu.py): the unconstrained
+and constrained cycles compile DIFFERENT Pallas programs, so proving,
+strikes, and disablement are tracked per variant — a constrained-kernel
+failure must never take down a proven flagship (unconstrained) kernel, and
+vice versa."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tpu_scheduler.errors import BackendUnavailable  # noqa: E402
+from tpu_scheduler.backends.tpu import TpuBackend  # noqa: E402
+from tpu_scheduler.models.profiles import DEFAULT_PROFILE  # noqa: E402
+from tpu_scheduler.ops.constraints import pack_constraints  # noqa: E402
+from tpu_scheduler.ops.pack import pack_snapshot  # noqa: E402
+from tpu_scheduler.testing import synth_cluster  # noqa: E402
+
+
+def _packed(constrained: bool):
+    kw = dict(anti_affinity_fraction=0.3, spread_fraction=0.3) if constrained else {}
+    snap = synth_cluster(n_nodes=8, n_pending=12, n_bound=8, seed=1, **kw)
+    packed = pack_snapshot(snap, pod_block=8, node_block=8)
+    if constrained:
+        from dataclasses import replace
+
+        cons = pack_constraints(
+            snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes
+        )
+        assert cons is not None
+        packed = replace(packed, constraints=cons)
+    return packed
+
+
+def _fake_result(packed):
+    return np.full((packed.padded_pods,), -1, np.int32), 1, {}
+
+
+def _instrument(backend, fail_variant, exc_factory):
+    """Replace _assign_once with a stub failing one variant's pallas path."""
+    calls = []
+
+    def fake(packed, profile, use_pallas):
+        variant = packed.constraints is not None
+        calls.append((variant, use_pallas))
+        if use_pallas and variant == fail_variant:
+            raise exc_factory()
+        return _fake_result(packed)
+
+    backend._assign_once = fake
+    return calls
+
+
+def test_deterministic_constrained_failure_keeps_plain_kernel():
+    backend = TpuBackend(use_pallas=True)
+    calls = _instrument(backend, fail_variant=True, exc_factory=lambda: TypeError("lowering bug"))
+    plain, cons = _packed(False), _packed(True)
+
+    backend.assign(plain, DEFAULT_PROFILE)  # proves the plain variant
+    assert backend._proven_variants == {False}
+
+    backend.assign(cons, DEFAULT_PROFILE)  # deterministic bug → disable + jnp retry
+    assert backend._disabled_variants == {True}
+    assert calls[-1] == (True, False)  # served via jnp, same cycle
+
+    backend.assign(plain, DEFAULT_PROFILE)  # flagship kernel must stay on
+    assert calls[-1] == (False, True)
+    assert backend.use_pallas and backend._pallas_proven
+
+
+def test_transient_strikes_are_per_variant():
+    backend = TpuBackend(use_pallas=True)
+    calls = _instrument(
+        backend, fail_variant=True, exc_factory=lambda: jax.errors.JaxRuntimeError("transient")
+    )
+    plain, cons = _packed(False), _packed(True)
+
+    backend.assign(plain, DEFAULT_PROFILE)
+    for _ in range(2):  # two strikes → constrained variant disabled
+        with pytest.raises(BackendUnavailable):
+            backend.assign(cons, DEFAULT_PROFILE)
+    assert backend._disabled_variants == {True}
+    assert backend._pallas_strikes[True] == 2 and backend._pallas_strikes[False] == 0
+
+    backend.assign(cons, DEFAULT_PROFILE)  # now serves via jnp
+    assert calls[-1] == (True, False)
+    backend.assign(plain, DEFAULT_PROFILE)  # plain kernel still armed
+    assert calls[-1] == (False, True)
+
+
+def test_plain_failure_does_not_disable_constrained():
+    backend = TpuBackend(use_pallas=True)
+    calls = _instrument(backend, fail_variant=False, exc_factory=lambda: TypeError("lowering bug"))
+    plain, cons = _packed(False), _packed(True)
+
+    backend.assign(plain, DEFAULT_PROFILE)
+    assert backend._disabled_variants == {False}
+    backend.assign(cons, DEFAULT_PROFILE)
+    assert backend._proven_variants == {True}
+    assert calls[-1] == (True, True)

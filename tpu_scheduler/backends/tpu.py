@@ -66,8 +66,15 @@ class TpuBackend(SchedulingBackend):
         # killing the cycle: Mosaic lowering errors are *not*
         # JaxRuntimeError subclasses, so they would otherwise bypass the
         # BackendUnavailable→native fallback on the flagship platform.
-        self._pallas_proven = False
-        self._pallas_strikes = 0
+        # Proving, strikes and disablement are per KERNEL VARIANT
+        # (unconstrained / constrained): the two cycles compile different
+        # Pallas programs, so a proven flagship kernel says nothing about the
+        # constrained one's Mosaic fate — and a constrained-variant failure
+        # must not take down a proven flagship kernel.
+        self._pallas_proven = False  # any variant proven (bench honesty flag)
+        self._proven_variants: set[bool] = set()  # {False: plain, True: constrained}
+        self._disabled_variants: set[bool] = set()
+        self._pallas_strikes: dict[bool, int] = {False: 0, True: 0}
         # Serializes the first-use proving attempt: concurrent routed-shard
         # threads must not double-count strikes on one transient fault (the
         # guard tolerates exactly one) or race the unproven kernel.
@@ -173,17 +180,22 @@ class TpuBackend(SchedulingBackend):
         extras = {"acc_round": combined[1], "rank": combined[2]}
         return combined[0], int(combined[3, 0]), extras
 
+    def _variant_enabled(self, variant: bool) -> bool:
+        return self.use_pallas and variant not in self._disabled_variants
+
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         jax = self._jax
-        # Constraint cycles ride the jnp path (the fused Pallas kernel does
-        # not carry the blocked-domain matmuls yet) — and must NOT count as
-        # a proving run for the first-use guard below.
-        pallas_eligible = self.use_pallas and packed.constraints is None
-        if pallas_eligible and not self._pallas_proven:
+        # Constraint cycles ride the kernel too: the per-round blocked/
+        # penalty masks enter as extra node-side operands (ops/pallas_choose
+        # ``cons_pod``/``cons_node``); accept/commit stay jnp.
+        variant = packed.constraints is not None
+        if self._variant_enabled(variant) and variant not in self._proven_variants:
             with self._guard_lock:
-                return self._assign_proving(packed, profile)
+                return self._assign_proving(packed, profile, variant)
         try:
-            return self._assign_once(packed, profile, use_pallas=pallas_eligible and self.use_pallas)
+            # Re-read eligibility at call time: another thread may have just
+            # disabled this variant under the guard lock.
+            return self._assign_once(packed, profile, use_pallas=self._variant_enabled(variant))
         except jax.errors.JaxRuntimeError as e:
             # Device-runtime failure (OOM, device lost, …) — the recovery
             # scenario the native fallback exists for (SURVEY.md §5).  Python
@@ -191,14 +203,16 @@ class TpuBackend(SchedulingBackend):
             self._drop_dev_cache()
             raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
 
-    def _assign_proving(self, packed: PackedCluster, profile: SchedulingProfile):
-        """First-use pallas attempt under the guard lock (a second thread
-        re-checks the flags it may have just changed)."""
+    def _assign_proving(self, packed: PackedCluster, profile: SchedulingProfile, variant: bool):
+        """First-use pallas attempt for one kernel ``variant`` under the
+        guard lock (a second thread re-checks the flags it may have just
+        changed).  Failures strike/disable only THIS variant: a constrained-
+        kernel rejection must not take down a proven flagship kernel."""
         jax = self._jax
-        pallas_eligible = self.use_pallas
-        if pallas_eligible and not self._pallas_proven:
+        if self._variant_enabled(variant) and variant not in self._proven_variants:
             try:
                 result = self._assign_once(packed, profile, use_pallas=True)
+                self._proven_variants.add(variant)
                 self._pallas_proven = True
                 return result
             except Exception as e:  # noqa: BLE001 — first-compile guard, see __init__
@@ -209,28 +223,33 @@ class TpuBackend(SchedulingBackend):
                     # Could be either a Mosaic compile rejection or a
                     # transient device fault — indistinguishable without
                     # parsing messages.  Strike-based: fall back to native
-                    # for this cycle (BackendUnavailable), keep pallas armed;
-                    # a deterministic compile failure strikes again next
-                    # cycle and is then disabled, while a transient device
-                    # fault clears and pallas proves itself.
-                    self._pallas_strikes += 1
-                    if self._pallas_strikes >= 2:
-                        log.warning("pallas kernel failed %d first-use attempts; disabling pallas", self._pallas_strikes)
-                        self.use_pallas = False
+                    # for this cycle (BackendUnavailable), keep the variant
+                    # armed; a deterministic compile failure strikes again
+                    # next cycle and is then disabled, while a transient
+                    # device fault clears and the variant proves itself.
+                    self._pallas_strikes[variant] += 1
+                    if self._pallas_strikes[variant] >= 2:
+                        log.warning(
+                            "pallas %s kernel failed %d first-use attempts; disabling that variant",
+                            "constrained" if variant else "plain",
+                            self._pallas_strikes[variant],
+                        )
+                        self._disabled_variants.add(variant)
                     self._drop_dev_cache()
                     raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
                 # Non-runtime exceptions (tracing/lowering errors) are
-                # deterministic kernel bugs — disable immediately and serve
-                # the cycle via the jnp path on the same device.
+                # deterministic kernel bugs — disable this variant
+                # immediately and serve the cycle via the jnp path on the
+                # same device.
                 log.warning(
-                    "pallas choose kernel failed on first use (%s: %s); disabling pallas, retrying jnp path",
+                    "pallas %s choose kernel failed on first use (%s: %s); disabling that variant, retrying jnp path",
+                    "constrained" if variant else "plain",
                     type(e).__name__,
                     e,
                 )
-                self.use_pallas = False
-                pallas_eligible = False
+                self._disabled_variants.add(variant)
         try:
-            return self._assign_once(packed, profile, use_pallas=pallas_eligible and self.use_pallas)
+            return self._assign_once(packed, profile, use_pallas=self._variant_enabled(variant))
         except jax.errors.JaxRuntimeError as e:
             # Device-runtime failure (OOM, device lost, …) — the recovery
             # scenario the native fallback exists for (SURVEY.md §5).  Python

@@ -123,7 +123,23 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
     if pallas_pack is not None:
         from .pallas_choose import choose_block_pallas
 
-        node_info, labels_t, taints_t, aff_t, pref_t, taints_soft_t, interpret = pallas_pack
+        node_info, labels_t, taints_t, aff_t, pref_t, taints_soft_t, interpret, cons_node = pallas_pack
+        cons_pod = cons_node_args = None
+        if cons_node is not None:
+            aamn, aacn, spn, paun, spspen, ppacnt, pa_inactive = cons_node
+            # Positive-affinity bootstrap gate is pod-side (blocked_block):
+            # a self-matching declarer of a globally-inactive term drops the
+            # term from its requirement set for this round.
+            gated = blk["pod_pa_declares"] * (1.0 - blk["pod_pa_matched"] * pa_inactive[None, :])
+            cons_pod = (
+                blk["pod_aa_carries"],
+                blk["pod_aa_matched"],
+                blk["pod_sp_declares"],
+                gated,
+                blk["pod_sps_declares"],
+                blk["pod_ppa_w"],
+            )
+            cons_node_args = (aamn, aacn, spn, paun, spspen, ppacnt)
         return choose_block_pallas(
             blk["pod_req"],
             blk["pod_sel"],
@@ -143,6 +159,8 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
             taints_soft_t,
             weights,
             salt=salt,
+            cons_pod=cons_pod,
+            cons_node=cons_node_args,
             interpret=interpret,
         )
     node_idx = jnp.arange(avail.shape[0], dtype=jnp.uint32)
@@ -211,6 +229,35 @@ def _choose(
     if use_pallas:
         from .pallas_choose import build_node_info
 
+        cons_node = None
+        if round_masks is not None:
+            # Constrained kernel operands: the per-round [·, N] masks ride
+            # into the kernel directly; features absent from this cycle
+            # become exact-zero operands (bitwise-neutral — the matmul adds
+            # an exact 0.0), so one kernel variant serves every constraint
+            # mix.  Widths come from the pod-side bitmaps (always packed).
+            n_nodes = avail.shape[0]
+            f32 = jnp.float32
+            paun = round_masks.get("pa_unmatched_node")
+            pa_inactive = round_masks.get("pa_inactive")
+            if paun is None:
+                paun = jnp.zeros((ps["pod_pa_declares"].shape[1], n_nodes), f32)
+                pa_inactive = jnp.zeros((ps["pod_pa_declares"].shape[1],), f32)
+            spspen = round_masks.get("sp_penalty_node")
+            if spspen is None:
+                spspen = jnp.zeros((ps["pod_sps_declares"].shape[1], n_nodes), f32)
+            ppacnt = round_masks.get("ppa_cnt_node")
+            if ppacnt is None:
+                ppacnt = jnp.zeros((ps["pod_ppa_w"].shape[1], n_nodes), f32)
+            cons_node = (
+                round_masks["aa_m_node"],
+                round_masks["aa_c_node"],
+                round_masks["sp_node"],
+                paun,
+                spspen,
+                ppacnt,
+                pa_inactive,
+            )
         # Rebuilt each round (avail changes); O(N) next to the O(B·N) choose.
         pallas_pack = (
             build_node_info(avail, nodes["node_alloc"], nodes["node_valid"]),
@@ -220,6 +267,7 @@ def _choose(
             nodes["node_pref"].T,
             nodes["node_taints_soft"].T,
             pallas_interpret,
+            cons_node,
         )
 
     choose_keys = _CHOOSE_KEYS + (_CONSTRAINT_KEYS if round_masks is not None else ())
@@ -370,17 +418,11 @@ def assign_cycle(
     on the anti-affinity + topology-spread path: choose gains the blocked-
     domain matmuls, accept gains the within-round conflict filter, and the
     domain state threads through the loop carry.  ``pods`` must then also
-    carry the constraint pod bitmaps (ConstraintSet.pod_arrays); the Pallas
-    fused kernel is bypassed on constraint cycles (jnp path only).
+    carry the constraint pod bitmaps (ConstraintSet.pod_arrays).  The fused
+    Pallas kernel covers constraint cycles too: the per-round blocked/penalty
+    node masks ride in as extra node-side kernel operands (choose_block_pallas
+    ``cons_pod``/``cons_node``), while accept/commit stay in jnp.
     """
-    # The fused Pallas kernel does not evaluate the constraint matmuls; a
-    # pallas choose on a constraint cycle would pick blocked nodes, the
-    # filter would reject them every round, and the pod would livelock to
-    # max_rounds.  Force the jnp path (static decision — both flags are
-    # trace constants).
-    if cmeta is not None:
-        use_pallas = False
-
     p_out = pods["pod_req"].shape[0]
     n = nodes["node_avail"].shape[0]
     perm, ps = _prepare_pods(pods, block)
@@ -488,9 +530,6 @@ def assign_cycle_epochs(
     NOT jittable (host control flow) — jittable contexts (dryrun, graft
     entry) use :func:`assign_cycle`.
     """
-    if cmeta is not None:
-        use_pallas = False
-
     p_out = pods["pod_req"].shape[0]
     perm, avail, ps, n_active_dev = _epoch_prelude(nodes, pods, block)
     p_pad = ps["pod_req"].shape[0]

@@ -65,112 +65,170 @@ def build_node_info(node_avail, node_alloc, node_valid):
     return jnp.stack(rows, axis=0)
 
 
-def _choose_kernel(
-    weights_ref,  # [1, 8] f32 SMEM  (w_lr, w_ba, w_jitter, w_pref, w_soft_taint, w_topo, round_salt, pad)
-    req_ref,  # [BP, 2] i32
-    sel_ref,  # [BP, L] f32
-    selc_ref,  # [BP, 1] f32
-    ntol_ref,  # [BP, T] f32  (1 where vocab taint NOT tolerated)
-    aff_ref,  # [BP, A] f32  (the pod's affinity-term bitmap)
-    hasaff_ref,  # [BP, 1] f32  (1 if the pod declares node affinity)
-    prefw_ref,  # [BP, A2] f32  (pod's weight per preferred-affinity term)
-    ntols_ref,  # [BP, Ts] f32  (1 where soft vocab taint NOT tolerated)
-    act_ref,  # [BP, 1] i32
-    idx_ref,  # [BP, 1] u32  (priority ranks, jitter hash input)
-    info_ref,  # [8, TN] i32  (node resources, see ROW_*)
-    labels_ref,  # [L, TN] f32
-    taints_ref,  # [T, TN] f32
-    aff_t_ref,  # [A, TN] f32  (node satisfies affinity-term bitmap, transposed)
-    pref_t_ref,  # [A2, TN] f32  (node satisfies preferred-term bitmap, transposed)
-    taints_soft_t_ref,  # [Ts, TN] f32  (PreferNoSchedule bitmap, transposed)
-    choice_ref,  # [BP, 1] i32 out
-    has_ref,  # [BP, 1] i32 out
-    best_ref,  # [BP, 1] f32 scratch
-    bestidx_ref,  # [BP, 1] i32 scratch
-):
-    j = pl.program_id(1)
-    nb = pl.num_programs(1)
-    tn = info_ref.shape[1]
-    f32 = jnp.float32
+def _make_choose_kernel(constrained: bool):
+    """Kernel body factory.  ``constrained=True`` adds six pod-side and six
+    node-side refs carrying the per-round constraint operands
+    (ops/constraints.round_blocked_masks): three hard blocked-node matmuls
+    (anti-affinity matched/carrier, spread saturation), the gated positive-
+    affinity matmul, and the two soft score matmuls (ScheduleAnyway spread
+    penalty, preferred inter-pod counts).  Absent features ride as exact-zero
+    operands, so results stay bitwise equal to the jnp expression tree."""
 
-    @pl.when(j == 0)
-    def _():
-        best_ref[:] = jnp.full_like(best_ref, NEG_INF)
-        bestidx_ref[:] = jnp.zeros_like(bestidx_ref)
+    def kernel(*refs):
+        # Single slice-based unpack — the group order here is the ONE place
+        # that must mirror the in_specs/operands construction in
+        # choose_block_pallas (grouped identically there).
+        (
+            weights_ref,  # [1, 8] f32 SMEM (w_lr, w_ba, w_jitter, w_pref, w_soft_taint, w_topo, round_salt, pad)
+            req_ref,  # [BP, R] i32
+            sel_ref,  # [BP, L] f32
+            selc_ref,  # [BP, 1] f32
+            ntol_ref,  # [BP, T] f32  (1 where vocab taint NOT tolerated)
+            aff_ref,  # [BP, A] f32  (the pod's affinity-term bitmap)
+            hasaff_ref,  # [BP, 1] f32  (1 if the pod declares node affinity)
+            prefw_ref,  # [BP, A2] f32  (pod's weight per preferred-affinity term)
+            ntols_ref,  # [BP, Ts] f32  (1 where soft vocab taint NOT tolerated)
+        ) = refs[:9]
+        k = 9
+        if constrained:
+            (
+                aac_ref,  # [BP, Tc] f32  (pod carries anti-affinity term)
+                aam_ref,  # [BP, Tc] f32  (pod matched by anti-affinity term)
+                spd_ref,  # [BP, S] f32  (pod declares hard spread constraint)
+                pag_ref,  # [BP, Ta] f32  (gated positive-affinity declarations)
+                sps_ref,  # [BP, Ss] f32  (pod declares soft spread constraint)
+                ppaw_ref,  # [BP, Tp] f32  (signed preferred inter-pod weights)
+            ) = refs[k : k + 6]
+            k += 6
+        (
+            act_ref,  # [BP, 1] i32
+            idx_ref,  # [BP, 1] u32  (priority ranks, jitter hash input)
+            info_ref,  # [8, TN] i32  (node resources, see ROW_*)
+            labels_ref,  # [L, TN] f32
+            taints_ref,  # [T, TN] f32
+            aff_t_ref,  # [A, TN] f32
+            pref_t_ref,  # [A2, TN] f32
+            taints_soft_t_ref,  # [Ts, TN] f32
+        ) = refs[k : k + 8]
+        k += 8
+        if constrained:
+            (
+                aamn_ref,  # [Tc, TN] f32  (domain holds matched pod — blocks carriers)
+                aacn_ref,  # [Tc, TN] f32  (domain holds carrier — blocks matched)
+                spn_ref,  # [S, TN] f32  (spread-saturated domains)
+                paun_ref,  # [Ta, TN] f32  (positive-affinity unmatched domains)
+                spspen_ref,  # [Ss, TN] f32  (soft-spread penalty counts)
+                ppacnt_ref,  # [Tp, TN] f32  (preferred inter-pod match counts)
+            ) = refs[k : k + 6]
+            k += 6
+        (
+            choice_ref,  # [BP, 1] i32 out
+            has_ref,  # [BP, 1] i32 out
+            best_ref,  # [BP, 1] f32 scratch
+            bestidx_ref,  # [BP, 1] i32 scratch
+        ) = refs[k : k + 4]
 
-    avail = info_ref[0:2, :]  # [2, TN] i32
-    alloc = info_ref[2:4, :]
-    valid = info_ref[ROW_VALID : ROW_VALID + 1, :]  # [1, TN] i32
+        j = pl.program_id(1)
+        nb = pl.num_programs(1)
+        tn = info_ref.shape[1]
+        f32 = jnp.float32
 
-    req_cpu = req_ref[:, 0:1]  # [BP, 1] i32
-    req_mem = req_ref[:, 1:2]
+        @pl.when(j == 0)
+        def _():
+            best_ref[:] = jnp.full_like(best_ref, NEG_INF)
+            bestidx_ref[:] = jnp.zeros_like(bestidx_ref)
 
-    # PodFitsResources — exact int32, identical to ops/masks.py; extended
-    # resources (req columns 2+, info rows 5+) join the same AND.
-    fit = (req_cpu <= avail[0:1, :]) & (req_mem <= avail[1:2, :])  # [BP, TN]
-    for e in range(req_ref.shape[1] - 2):
-        fit = fit & (req_ref[:, 2 + e : 3 + e] <= info_ref[5 + e : 6 + e, :])
+        avail = info_ref[0:2, :]  # [2, TN] i32
+        alloc = info_ref[2:4, :]
+        valid = info_ref[ROW_VALID : ROW_VALID + 1, :]  # [1, TN] i32
 
-    # nodeSelector — selector-pair counting matmul (MXU; counts are tiny
-    # integers, exact in f32).
-    counts = jnp.dot(sel_ref[:], labels_ref[:], preferred_element_type=f32)  # [BP, TN]
-    sel_ok = counts == selc_ref[:]
+        req_cpu = req_ref[:, 0:1]  # [BP, 1] i32
+        req_mem = req_ref[:, 1:2]
 
-    # taints/tolerations — untolerated-taint counting matmul (ops/masks.py).
-    untol = jnp.dot(ntol_ref[:], taints_ref[:], preferred_element_type=f32)  # [BP, TN]
-    taint_ok = untol == f32(0.0)
+        # PodFitsResources — exact int32, identical to ops/masks.py; extended
+        # resources (req columns 2+, info rows 5+) join the same AND.
+        fit = (req_cpu <= avail[0:1, :]) & (req_mem <= avail[1:2, :])  # [BP, TN]
+        for e in range(req_ref.shape[1] - 2):
+            fit = fit & (req_ref[:, 2 + e : 3 + e] <= info_ref[5 + e : 6 + e, :])
 
-    # node affinity — ORed terms: eligible iff no affinity or >=1 term hit.
-    aff_hits = jnp.dot(aff_ref[:], aff_t_ref[:], preferred_element_type=f32)  # [BP, TN]
-    aff_ok = (aff_hits > f32(0.0)) | (hasaff_ref[:] == f32(0.0))
+        # nodeSelector — selector-pair counting matmul (MXU; counts are tiny
+        # integers, exact in f32).
+        counts = jnp.dot(sel_ref[:], labels_ref[:], preferred_element_type=f32)  # [BP, TN]
+        sel_ok = counts == selc_ref[:]
 
-    mask = fit & sel_ok & taint_ok & aff_ok & (valid > 0) & (act_ref[:] > 0)
+        # taints/tolerations — untolerated-taint counting matmul (ops/masks.py).
+        untol = jnp.dot(ntol_ref[:], taints_ref[:], preferred_element_type=f32)  # [BP, TN]
+        taint_ok = untol == f32(0.0)
 
-    # LeastRequested + BalancedAllocation — same op order as ops/score.py.
-    used_cpu = (alloc[0:1, :] - avail[0:1, :]) + req_cpu  # [BP, TN] i32
-    used_mem = (alloc[1:2, :] - avail[1:2, :]) + req_mem
-    safe_cpu = alloc[0:1, :] > 0
-    safe_mem = alloc[1:2, :] > 0
-    denom_cpu = jnp.where(safe_cpu, alloc[0:1, :].astype(f32), f32(1.0))
-    denom_mem = jnp.where(safe_mem, alloc[1:2, :].astype(f32), f32(1.0))
-    frac_cpu = jnp.where(safe_cpu, used_cpu.astype(f32) / denom_cpu, f32(1.0))
-    frac_mem = jnp.where(safe_mem, used_mem.astype(f32) / denom_mem, f32(1.0))
-    least_requested = ((f32(1.0) - frac_cpu) + (f32(1.0) - frac_mem)) * f32(50.0)
-    balanced = (f32(1.0) - jnp.abs(frac_cpu - frac_mem)) * f32(100.0)
-    score = weights_ref[0, 0] * least_requested + weights_ref[0, 1] * balanced
+        # node affinity — ORed terms: eligible iff no affinity or >=1 term hit.
+        aff_hits = jnp.dot(aff_ref[:], aff_t_ref[:], preferred_element_type=f32)  # [BP, TN]
+        aff_ok = (aff_hits > f32(0.0)) | (hasaff_ref[:] == f32(0.0))
 
-    # Soft terms, same op order as ops/score.py: preferred node affinity
-    # (+w₃ · matching-term weights), then PreferNoSchedule taints (−w₄ per
-    # untolerated soft taint).  Both are exact small-int matmuls in f32.
-    pref = jnp.dot(prefw_ref[:], pref_t_ref[:], preferred_element_type=f32)  # [BP, TN]
-    score = score + weights_ref[0, 3] * pref
-    untol_soft = jnp.dot(ntols_ref[:], taints_soft_t_ref[:], preferred_element_type=f32)
-    score = score - weights_ref[0, 4] * untol_soft
+        mask = fit & sel_ok & taint_ok & aff_ok & (valid > 0) & (act_ref[:] > 0)
 
-    # Deterministic tie-break jitter — same uint32 hash as ops/score.py,
-    # including the auction-round salt (rides the spare SMEM weights slot;
-    # rounds < 2^24, so the f32 round-trip is exact).
-    u32 = jnp.uint32
-    node_idx = (j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
-    salt = weights_ref[0, 6].astype(jnp.int32).astype(u32)
-    h = idx_ref[:].astype(u32) * u32(2654435761) + node_idx * u32(2246822519) + salt * u32(3266489917)
-    h = (h ^ (h >> u32(15))) & u32(0xFFFF)
-    # Mosaic lacks a direct uint32→f32 cast; h < 2^16 so int32 is exact.
-    score = score + weights_ref[0, 2] * (h.astype(jnp.int32).astype(f32) / f32(65536.0))
+        if constrained:
+            # Constraint-blocked domains — same four matmuls and sum order as
+            # ops/constraints.blocked_block (exact small ints in f32).
+            blocked = jnp.dot(aac_ref[:], aamn_ref[:], preferred_element_type=f32)
+            blocked = blocked + jnp.dot(aam_ref[:], aacn_ref[:], preferred_element_type=f32)
+            blocked = blocked + jnp.dot(spd_ref[:], spn_ref[:], preferred_element_type=f32)
+            blocked = blocked + jnp.dot(pag_ref[:], paun_ref[:], preferred_element_type=f32)
+            mask = mask & ~(blocked > f32(0.0))
 
-    sc = jnp.where(mask, score.astype(f32), NEG_INF)
+        # LeastRequested + BalancedAllocation — same op order as ops/score.py.
+        used_cpu = (alloc[0:1, :] - avail[0:1, :]) + req_cpu  # [BP, TN] i32
+        used_mem = (alloc[1:2, :] - avail[1:2, :]) + req_mem
+        safe_cpu = alloc[0:1, :] > 0
+        safe_mem = alloc[1:2, :] > 0
+        denom_cpu = jnp.where(safe_cpu, alloc[0:1, :].astype(f32), f32(1.0))
+        denom_mem = jnp.where(safe_mem, alloc[1:2, :].astype(f32), f32(1.0))
+        frac_cpu = jnp.where(safe_cpu, used_cpu.astype(f32) / denom_cpu, f32(1.0))
+        frac_mem = jnp.where(safe_mem, used_mem.astype(f32) / denom_mem, f32(1.0))
+        least_requested = ((f32(1.0) - frac_cpu) + (f32(1.0) - frac_mem)) * f32(50.0)
+        balanced = (f32(1.0) - jnp.abs(frac_cpu - frac_mem)) * f32(100.0)
+        score = weights_ref[0, 0] * least_requested + weights_ref[0, 1] * balanced
 
-    tile_best = jnp.max(sc, axis=1, keepdims=True)  # [BP, 1]
-    tile_arg = jnp.argmax(sc, axis=1).reshape(-1, 1).astype(jnp.int32) + j * tn
+        # Soft terms, same op order as ops/score.py: preferred node affinity
+        # (+w₃ · matching-term weights), then PreferNoSchedule taints (−w₄ per
+        # untolerated soft taint).  Both are exact small-int matmuls in f32.
+        pref = jnp.dot(prefw_ref[:], pref_t_ref[:], preferred_element_type=f32)  # [BP, TN]
+        score = score + weights_ref[0, 3] * pref
+        untol_soft = jnp.dot(ntols_ref[:], taints_soft_t_ref[:], preferred_element_type=f32)
+        score = score - weights_ref[0, 4] * untol_soft
 
-    improve = tile_best > best_ref[:]
-    bestidx_ref[:] = jnp.where(improve, tile_arg, bestidx_ref[:])
-    best_ref[:] = jnp.where(improve, tile_best, best_ref[:])
+        # Deterministic tie-break jitter — same uint32 hash as ops/score.py,
+        # including the auction-round salt (rides the spare SMEM weights slot;
+        # rounds < 2^24, so the f32 round-trip is exact).
+        u32 = jnp.uint32
+        node_idx = (j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
+        salt = weights_ref[0, 6].astype(jnp.int32).astype(u32)
+        h = idx_ref[:].astype(u32) * u32(2654435761) + node_idx * u32(2246822519) + salt * u32(3266489917)
+        h = (h ^ (h >> u32(15))) & u32(0xFFFF)
+        # Mosaic lacks a direct uint32→f32 cast; h < 2^16 so int32 is exact.
+        score = score + weights_ref[0, 2] * (h.astype(jnp.int32).astype(f32) / f32(65536.0))
 
-    @pl.when(j == nb - 1)
-    def _():
-        choice_ref[:] = bestidx_ref[:]
-        has_ref[:] = (best_ref[:] > NEG_INF).astype(jnp.int32)
+        if constrained:
+            # Soft constraint scores AFTER the jitter — ops/score.py order:
+            # −w₅ · ScheduleAnyway penalty, then +signed preferred counts.
+            spspen = jnp.dot(sps_ref[:], spspen_ref[:], preferred_element_type=f32)
+            score = score - weights_ref[0, 5] * spspen
+            score = score + jnp.dot(ppaw_ref[:], ppacnt_ref[:], preferred_element_type=f32)
+
+        sc = jnp.where(mask, score.astype(f32), NEG_INF)
+
+        tile_best = jnp.max(sc, axis=1, keepdims=True)  # [BP, 1]
+        tile_arg = jnp.argmax(sc, axis=1).reshape(-1, 1).astype(jnp.int32) + j * tn
+
+        improve = tile_best > best_ref[:]
+        bestidx_ref[:] = jnp.where(improve, tile_arg, bestidx_ref[:])
+        best_ref[:] = jnp.where(improve, tile_best, best_ref[:])
+
+        @pl.when(j == nb - 1)
+        def _():
+            choice_ref[:] = bestidx_ref[:]
+            has_ref[:] = (best_ref[:] > NEG_INF).astype(jnp.int32)
+
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("pod_tile", "node_tile", "interpret"))
@@ -193,6 +251,10 @@ def choose_block_pallas(
     taints_soft_t,  # [Ts, N] f32
     weights,  # [6] f32 (SchedulingProfile.weights())
     salt=None,  # auction round (int32 scalar) — jitter re-roll per round
+    cons_pod=None,  # (aa_carries [B,Tc], aa_matched [B,Tc], sp_declares [B,S],
+    #                pa_gated [B,Ta], sps_declares [B,Ss], ppa_w [B,Tp]) f32
+    cons_node=None,  # (aa_m_node [Tc,N], aa_c_node [Tc,N], sp_node [S,N],
+    #                 pa_unmatched [Ta,N], sp_penalty [Ss,N], ppa_cnt [Tp,N]) f32
     pod_tile: int = 256,
     node_tile: int = 512,
     interpret: bool = False,
@@ -201,7 +263,15 @@ def choose_block_pallas(
 
     Pads pods/nodes up to tile multiples internally; padded pods are
     inactive, padded nodes invalid, so results are unaffected.
+
+    ``cons_pod``/``cons_node`` (given together) switch on the constrained
+    kernel: the per-round blocked/penalty node masks ride as six extra
+    node-side operands ([·, N]-shaped, VMEM-cheap) and the pod-side constraint
+    bitmaps as six extra pod rows — the accept/commit phases stay in jnp
+    (ops/assign.py).  Features absent from a cycle are exact-zero operands,
+    keeping results bitwise equal to the jnp path.
     """
+    constrained = cons_pod is not None
     b, n = req.shape[0], node_info.shape[1]
     r = req.shape[1]
     l = sel.shape[1]
@@ -225,6 +295,8 @@ def choose_block_pallas(
         ntol_soft = jnp.pad(ntol_soft, ((0, b_pad - b), (0, 0)))
         act = jnp.pad(act, ((0, b_pad - b),))
         ranks = jnp.pad(ranks, ((0, b_pad - b),))
+        if constrained:
+            cons_pod = tuple(jnp.pad(v, ((0, b_pad - b), (0, 0))) for v in cons_pod)
     if n_pad != n:
         node_info = jnp.pad(node_info, ((0, 0), (0, n_pad - n)))
         labels_t = jnp.pad(labels_t, ((0, 0), (0, n_pad - n)))
@@ -232,34 +304,70 @@ def choose_block_pallas(
         aff_t = jnp.pad(aff_t, ((0, 0), (0, n_pad - n)))
         pref_t = jnp.pad(pref_t, ((0, 0), (0, n_pad - n)))
         taints_soft_t = jnp.pad(taints_soft_t, ((0, 0), (0, n_pad - n)))
+        if constrained:
+            cons_node = tuple(jnp.pad(v, ((0, 0), (0, n_pad - n))) for v in cons_node)
 
     w = jnp.pad(weights.astype(jnp.float32), (0, 8 - weights.shape[0])).reshape(1, 8)
     if salt is not None:
         w = w.at[0, 6].set(jnp.asarray(salt).astype(jnp.float32))
 
+    pod_row = lambda width: pl.BlockSpec((bp, width), lambda i, j: (i, 0))  # noqa: E731
+    node_row = lambda rows: pl.BlockSpec((rows, node_tile), lambda i, j: (0, j))  # noqa: E731
+
+    in_specs = [
+        pl.BlockSpec((1, 8), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+        pod_row(r),
+        pod_row(l),
+        pod_row(1),
+        pod_row(t),
+        pod_row(a_dim),
+        pod_row(1),
+        pod_row(a2_dim),
+        pod_row(ts_dim),
+    ]
+    operands = [
+        w,
+        req,
+        sel,
+        selc.reshape(-1, 1),
+        ntol,
+        aff,
+        has_aff.astype(jnp.float32).reshape(-1, 1),
+        pref_w,
+        ntol_soft,
+    ]
+    if constrained:
+        in_specs += [pod_row(v.shape[1]) for v in cons_pod]
+        operands += [v.astype(jnp.float32) for v in cons_pod]
+    in_specs += [
+        pod_row(1),
+        pod_row(1),
+        node_row(8),
+        node_row(l),
+        node_row(t),
+        node_row(a_dim),
+        node_row(a2_dim),
+        node_row(ts_dim),
+    ]
+    operands += [
+        act.astype(jnp.int32).reshape(-1, 1),
+        ranks.astype(jnp.uint32).reshape(-1, 1),
+        node_info,
+        labels_t,
+        taints_t,
+        aff_t,
+        pref_t,
+        taints_soft_t,
+    ]
+    if constrained:
+        in_specs += [node_row(v.shape[0]) for v in cons_node]
+        operands += [v.astype(jnp.float32) for v in cons_node]
+
     grid = (pb, nbt)
     choice, has = pl.pallas_call(
-        _choose_kernel,
+        _make_choose_kernel(constrained),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 8), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((bp, r), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, l), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, t), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, a_dim), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, a2_dim), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, ts_dim), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((8, node_tile), lambda i, j: (0, j)),
-            pl.BlockSpec((l, node_tile), lambda i, j: (0, j)),
-            pl.BlockSpec((t, node_tile), lambda i, j: (0, j)),
-            pl.BlockSpec((a_dim, node_tile), lambda i, j: (0, j)),
-            pl.BlockSpec((a2_dim, node_tile), lambda i, j: (0, j)),
-            pl.BlockSpec((ts_dim, node_tile), lambda i, j: (0, j)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
@@ -273,23 +381,5 @@ def choose_block_pallas(
             pltpu.VMEM((bp, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(
-        w,
-        req,
-        sel,
-        selc.reshape(-1, 1),
-        ntol,
-        aff,
-        has_aff.astype(jnp.float32).reshape(-1, 1),
-        pref_w,
-        ntol_soft,
-        act.astype(jnp.int32).reshape(-1, 1),
-        ranks.astype(jnp.uint32).reshape(-1, 1),
-        node_info,
-        labels_t,
-        taints_t,
-        aff_t,
-        pref_t,
-        taints_soft_t,
-    )
+    )(*operands)
     return choice[:b, 0], has[:b, 0].astype(bool)
