@@ -109,3 +109,44 @@ def test_cli_tpu_sharded_constrained_cluster(capsys):
     assert summary["counters"].get("scheduler_constraint_tensor_cycles_total", 0) >= 1
     assert summary["counters"].get("scheduler_constraint_host_fallbacks_total", 0) == 0
     assert summary["bound_total"] > 0
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_sharded_pallas_parity(tp):
+    """VERDICT r3 #3: the fused choose kernel inside shard_map (interpret
+    mode on the CPU mesh) must equal the jnp shard program and the native
+    oracle binding-for-binding — the jitter hash sees GLOBAL node indices
+    via the kernel's node_offset, so tp slicing must not shift choices."""
+    snap = synth_cluster(n_nodes=48, n_pending=280, n_bound=60, seed=2)
+    packed = pack_snapshot(snap, pod_block=64, node_block=16)
+    native = NativeBackend().schedule(packed)
+    sharded = ShardedBackend(make_mesh(tp=tp), use_pallas=True, pallas_interpret=True).schedule(packed)
+    assert (native.assigned == sharded.assigned).all(), np.flatnonzero(native.assigned != sharded.assigned)[:10]
+    assert native.rounds == sharded.rounds
+    check_validity(snap, packed, sharded)
+
+
+def test_sharded_pallas_constrained_parity():
+    """Constrained cycles through the sharded pallas path: blocked/penalty
+    masks slice per tp shard and feed the constrained kernel variant."""
+    snap = synth_cluster(
+        n_nodes=32, n_pending=120, n_bound=64, seed=5,
+        anti_affinity_fraction=0.2, spread_fraction=0.2, schedule_anyway_fraction=0.2,
+        pod_affinity_fraction=0.15, preferred_pod_affinity_fraction=0.2,
+    )
+    from dataclasses import replace
+
+    from tpu_scheduler.ops.constraints import pack_constraints
+
+    packed = pack_snapshot(snap, pod_block=32, node_block=16)
+    cons = pack_constraints(snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes)
+    assert cons is not None
+    packed = replace(packed, constraints=cons)
+    native = NativeBackend().schedule(packed)
+    sharded = ShardedBackend(make_mesh(tp=2), use_pallas=True, pallas_interpret=True).schedule(packed)
+    # Bit-parity with the native oracle is the contract; check_validity's
+    # "unscheduled => infeasible" clause doesn't apply to constrained
+    # clusters (constraints legitimately defer resource-feasible pods —
+    # the order-witness replay in test_constraints_tensor covers validity).
+    assert (native.assigned == sharded.assigned).all(), np.flatnonzero(native.assigned != sharded.assigned)[:10]
+    assert native.rounds == sharded.rounds

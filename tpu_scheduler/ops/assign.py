@@ -123,23 +123,13 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
     if pallas_pack is not None:
         from .pallas_choose import choose_block_pallas
 
+        from .pallas_choose import constrained_kernel_pod_operands
+
         node_info, labels_t, taints_t, aff_t, pref_t, taints_soft_t, interpret, cons_node = pallas_pack
         cons_pod = cons_node_args = None
         if cons_node is not None:
-            aamn, aacn, spn, paun, spspen, ppacnt, pa_inactive = cons_node
-            # Positive-affinity bootstrap gate is pod-side (blocked_block):
-            # a self-matching declarer of a globally-inactive term drops the
-            # term from its requirement set for this round.
-            gated = blk["pod_pa_declares"] * (1.0 - blk["pod_pa_matched"] * pa_inactive[None, :])
-            cons_pod = (
-                blk["pod_aa_carries"],
-                blk["pod_aa_matched"],
-                blk["pod_sp_declares"],
-                gated,
-                blk["pod_sps_declares"],
-                blk["pod_ppa_w"],
-            )
-            cons_node_args = (aamn, aacn, spn, paun, spspen, ppacnt)
+            cons_node_args, pa_inactive = cons_node
+            cons_pod = constrained_kernel_pod_operands(blk, pa_inactive)
         return choose_block_pallas(
             blk["pod_req"],
             blk["pod_sel"],
@@ -232,32 +222,11 @@ def _choose(
         cons_node = None
         if round_masks is not None:
             # Constrained kernel operands: the per-round [·, N] masks ride
-            # into the kernel directly; features absent from this cycle
-            # become exact-zero operands (bitwise-neutral — the matmul adds
-            # an exact 0.0), so one kernel variant serves every constraint
-            # mix.  Widths come from the pod-side bitmaps (always packed).
-            n_nodes = avail.shape[0]
-            f32 = jnp.float32
-            paun = round_masks.get("pa_unmatched_node")
-            pa_inactive = round_masks.get("pa_inactive")
-            if paun is None:
-                paun = jnp.zeros((ps["pod_pa_declares"].shape[1], n_nodes), f32)
-                pa_inactive = jnp.zeros((ps["pod_pa_declares"].shape[1],), f32)
-            spspen = round_masks.get("sp_penalty_node")
-            if spspen is None:
-                spspen = jnp.zeros((ps["pod_sps_declares"].shape[1], n_nodes), f32)
-            ppacnt = round_masks.get("ppa_cnt_node")
-            if ppacnt is None:
-                ppacnt = jnp.zeros((ps["pod_ppa_w"].shape[1], n_nodes), f32)
-            cons_node = (
-                round_masks["aa_m_node"],
-                round_masks["aa_c_node"],
-                round_masks["sp_node"],
-                paun,
-                spspen,
-                ppacnt,
-                pa_inactive,
-            )
+            # into the kernel directly (zero-fill convention documented on
+            # the helper — one source of truth with parallel/sharded.py).
+            from .pallas_choose import constrained_kernel_node_operands
+
+            cons_node = constrained_kernel_node_operands(ps, round_masks, avail.shape[0])
         # Rebuilt each round (avail changes); O(N) next to the O(B·N) choose.
         pallas_pack = (
             build_node_info(avail, nodes["node_alloc"], nodes["node_valid"]),

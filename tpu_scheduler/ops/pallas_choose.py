@@ -34,7 +34,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["choose_block_pallas", "build_node_info"]
+__all__ = [
+    "choose_block_pallas",
+    "build_node_info",
+    "constrained_kernel_node_operands",
+    "constrained_kernel_pod_operands",
+]
 
 # Row indices of the packed [8, N] node-resource array.
 ROW_AVAIL_CPU, ROW_AVAIL_MEM, ROW_ALLOC_CPU, ROW_ALLOC_MEM, ROW_VALID = 0, 1, 2, 3, 4
@@ -65,6 +70,48 @@ def build_node_info(node_avail, node_alloc, node_valid):
     return jnp.stack(rows, axis=0)
 
 
+def constrained_kernel_node_operands(pods: dict, masks: dict, n_nodes: int):
+    """(six node-side kernel operands, pa_inactive) from one round's
+    blocked/penalty masks (ops/constraints.round_blocked_masks, node axis
+    already sliced to this shard where applicable).
+
+    THE one source of truth for the zero-fill convention: features absent
+    from the cycle (no hard PA / soft spread / preferred terms) become
+    exact-zero operands whose matmuls add an exact 0.0 — bitwise-neutral —
+    so a single constrained kernel variant serves every constraint mix.
+    ``pods`` supplies the feature widths (any dict holding the constraint
+    pod bitmaps: the full pod dict or a sliced block)."""
+    f32 = jnp.float32
+    paun = masks.get("pa_unmatched_node")
+    pa_inactive = masks.get("pa_inactive")
+    if paun is None:
+        paun = jnp.zeros((pods["pod_pa_declares"].shape[1], n_nodes), f32)
+        pa_inactive = jnp.zeros((pods["pod_pa_declares"].shape[1],), f32)
+    spspen = masks.get("sp_penalty_node")
+    if spspen is None:
+        spspen = jnp.zeros((pods["pod_sps_declares"].shape[1], n_nodes), f32)
+    ppacnt = masks.get("ppa_cnt_node")
+    if ppacnt is None:
+        ppacnt = jnp.zeros((pods["pod_ppa_w"].shape[1], n_nodes), f32)
+    return (masks["aa_m_node"], masks["aa_c_node"], masks["sp_node"], paun, spspen, ppacnt), pa_inactive
+
+
+def constrained_kernel_pod_operands(blk: dict, pa_inactive):
+    """Six pod-side kernel operands for one pod block.  The positive-
+    affinity bootstrap gate (a self-matching declarer of a globally-inactive
+    term drops the term for this round — ops/constraints.blocked_block) is
+    applied HERE, pod-side, so the kernel's matmul sees the gated bitmap."""
+    gated = blk["pod_pa_declares"] * (1.0 - blk["pod_pa_matched"] * pa_inactive[None, :])
+    return (
+        blk["pod_aa_carries"],
+        blk["pod_aa_matched"],
+        blk["pod_sp_declares"],
+        gated,
+        blk["pod_sps_declares"],
+        blk["pod_ppa_w"],
+    )
+
+
 def _make_choose_kernel(constrained: bool):
     """Kernel body factory.  ``constrained=True`` adds six pod-side and six
     node-side refs carrying the per-round constraint operands
@@ -79,7 +126,7 @@ def _make_choose_kernel(constrained: bool):
         # that must mirror the in_specs/operands construction in
         # choose_block_pallas (grouped identically there).
         (
-            weights_ref,  # [1, 8] f32 SMEM (w_lr, w_ba, w_jitter, w_pref, w_soft_taint, w_topo, round_salt, pad)
+            weights_ref,  # [1, 8] f32 SMEM (w_lr, w_ba, w_jitter, w_pref, w_soft_taint, w_topo, round_salt, node_offset)
             req_ref,  # [BP, R] i32
             sel_ref,  # [BP, L] f32
             selc_ref,  # [BP, 1] f32
@@ -124,9 +171,10 @@ def _make_choose_kernel(constrained: bool):
         (
             choice_ref,  # [BP, 1] i32 out
             has_ref,  # [BP, 1] i32 out
+            bestout_ref,  # [BP, 1] f32 out (best score — tp-merge operand)
             best_ref,  # [BP, 1] f32 scratch
             bestidx_ref,  # [BP, 1] i32 scratch
-        ) = refs[k : k + 4]
+        ) = refs[k : k + 5]
 
         j = pl.program_id(1)
         nb = pl.num_programs(1)
@@ -197,10 +245,13 @@ def _make_choose_kernel(constrained: bool):
         score = score - weights_ref[0, 4] * untol_soft
 
         # Deterministic tie-break jitter — same uint32 hash as ops/score.py,
-        # including the auction-round salt (rides the spare SMEM weights slot;
-        # rounds < 2^24, so the f32 round-trip is exact).
+        # including the auction-round salt (rides SMEM weights slot 6) and
+        # the node-index offset (slot 7 — nonzero only under a sharded mesh,
+        # where this shard's nodes start at a global base; < 2^24 so the f32
+        # round-trip is exact).
         u32 = jnp.uint32
-        node_idx = (j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
+        off = weights_ref[0, 7].astype(jnp.int32)
+        node_idx = (off + j * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)).astype(u32)
         salt = weights_ref[0, 6].astype(jnp.int32).astype(u32)
         h = idx_ref[:].astype(u32) * u32(2654435761) + node_idx * u32(2246822519) + salt * u32(3266489917)
         h = (h ^ (h >> u32(15))) & u32(0xFFFF)
@@ -227,11 +278,12 @@ def _make_choose_kernel(constrained: bool):
         def _():
             choice_ref[:] = bestidx_ref[:]
             has_ref[:] = (best_ref[:] > NEG_INF).astype(jnp.int32)
+            bestout_ref[:] = best_ref[:]
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("pod_tile", "node_tile", "interpret"))
+@functools.partial(jax.jit, static_argnames=("pod_tile", "node_tile", "interpret", "return_best"))
 def choose_block_pallas(
     req,  # [B, 2] i32
     sel,  # [B, L] f32
@@ -255,11 +307,17 @@ def choose_block_pallas(
     #                pa_gated [B,Ta], sps_declares [B,Ss], ppa_w [B,Tp]) f32
     cons_node=None,  # (aa_m_node [Tc,N], aa_c_node [Tc,N], sp_node [S,N],
     #                 pa_unmatched [Ta,N], sp_penalty [Ss,N], ppa_cnt [Tp,N]) f32
+    node_offset=None,  # global index of node 0 (sharded meshes; jitter hash)
     pod_tile: int = 256,
     node_tile: int = 512,
     interpret: bool = False,
+    return_best: bool = False,
 ):
-    """Fused choose over a block of pods: returns (choice [B] i32, has [B] bool).
+    """Fused choose over a block of pods: returns (choice [B] i32, has [B]
+    bool), plus the per-pod best score ([B] f32, −inf where infeasible) when
+    ``return_best`` — the cross-shard merge operand of parallel/sharded.py.
+    ``node_offset`` shifts the jitter hash's node indices to global space
+    when the node tensors are one shard of a mesh-sharded cluster.
 
     Pads pods/nodes up to tile multiples internally; padded pods are
     inactive, padded nodes invalid, so results are unaffected.
@@ -310,6 +368,8 @@ def choose_block_pallas(
     w = jnp.pad(weights.astype(jnp.float32), (0, 8 - weights.shape[0])).reshape(1, 8)
     if salt is not None:
         w = w.at[0, 6].set(jnp.asarray(salt).astype(jnp.float32))
+    if node_offset is not None:
+        w = w.at[0, 7].set(jnp.asarray(node_offset).astype(jnp.float32))
 
     pod_row = lambda width: pl.BlockSpec((bp, width), lambda i, j: (i, 0))  # noqa: E731
     node_row = lambda rows: pl.BlockSpec((rows, node_tile), lambda i, j: (0, j))  # noqa: E731
@@ -364,17 +424,19 @@ def choose_block_pallas(
         operands += [v.astype(jnp.float32) for v in cons_node]
 
     grid = (pb, nbt)
-    choice, has = pl.pallas_call(
+    choice, has, best = pl.pallas_call(
         _make_choose_kernel(constrained),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i, j: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b_pad, 1), jnp.int32),
             jax.ShapeDtypeStruct((b_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bp, 1), jnp.float32),
@@ -382,4 +444,6 @@ def choose_block_pallas(
         ],
         interpret=interpret,
     )(*operands)
+    if return_best:
+        return choice[:b, 0], has[:b, 0].astype(bool), best[:b, 0]
     return choice[:b, 0], has[:b, 0].astype(bool)

@@ -40,13 +40,22 @@ __all__ = ["sharded_assign_multihost", "make_global_array"]
 
 
 @lru_cache(maxsize=64)
-def _jitted_shard_map(mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True):
+def _jitted_shard_map(
+    mesh,
+    max_rounds: int,
+    constrained: bool = False,
+    soft_spread: bool = False,
+    soft_pa: bool = False,
+    hard_pa: bool = True,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+):
     """Cached jit of the shard_map program — without this every cycle would
     re-trace and re-compile (the single-process twin _build_sharded_fn is
     lru_cached for the same reason)."""
     import jax
 
-    return jax.jit(_build_shard_map(mesh, max_rounds, constrained, soft_spread, soft_pa, hard_pa))
+    return jax.jit(_build_shard_map(mesh, max_rounds, constrained, soft_spread, soft_pa, hard_pa, use_pallas, pallas_interpret))
 
 
 def make_global_array(mesh, spec, arr: np.ndarray):
@@ -58,7 +67,11 @@ def make_global_array(mesh, spec, arr: np.ndarray):
     return jax.make_array_from_callback(arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx])
 
 
-def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None, soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True):
+def sharded_assign_multihost(
+    mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None,
+    soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True,
+    use_pallas: bool = False, pallas_interpret: bool = False,
+):
     """Run one scheduling cycle over a (possibly multi-host) mesh.
 
     ``arrays`` is the PackedCluster ``device_arrays()`` dict (numpy, same on
@@ -116,7 +129,9 @@ def sharded_assign_multihost(mesh, arrays: dict, weights, max_rounds: int = 32, 
         specs = specs + (P(),) * len(CONSTRAINT_KEYS)
     global_ins = [make_global_array(mesh, spec, arr) for spec, arr in zip(specs, operands)]
 
-    fn = _jitted_shard_map(mesh, max_rounds, constraints is not None, soft_spread, soft_pa, hard_pa)
+    fn = _jitted_shard_map(
+        mesh, max_rounds, constraints is not None, soft_spread, soft_pa, hard_pa, use_pallas, pallas_interpret
+    )
     assigned_p, rounds, _avail = fn(*global_ins)
 
     assigned_full = np.asarray(multihost_utils.process_allgather(assigned_p, tiled=True))

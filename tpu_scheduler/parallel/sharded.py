@@ -137,11 +137,25 @@ _N_METAKEYS = 7
 
 @lru_cache(maxsize=64)
 def _build_shard_map(
-    mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True
+    mesh,
+    max_rounds: int,
+    constrained: bool = False,
+    soft_spread: bool = False,
+    soft_pa: bool = False,
+    hard_pa: bool = True,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ):
     """The shard_map'd per-device cycle fn (not yet jitted/wrapped) — shared
     by the single-process run wrapper below and the multi-host path
-    (parallel/multihost.py), so both execute the identical program."""
+    (parallel/multihost.py), so both execute the identical program.
+
+    ``use_pallas`` routes each shard's choose through the fused kernel
+    (ops/pallas_choose.py) — the per-shard best SCORE rides out as the
+    kernel's third output for the cross-tp merge, and the jitter hash gets
+    this shard's global node base via ``node_offset``, so results stay
+    bit-identical to the jnp shard program.  ``pallas_interpret`` runs the
+    kernel in interpreter mode (CPU meshes: tests, dryrun_multichip)."""
     dp = mesh.shape["dp"]
     tp = mesh.shape["tp"]
 
@@ -158,6 +172,10 @@ def _build_shard_map(
         node_base = tp_idx * n_local
         g_pod_idx = (dp_idx * p_local + jnp.arange(p_local)).astype(jnp.uint32)
         g_node_idx = (node_base + jnp.arange(n_local)).astype(jnp.uint32)
+        if use_pallas:
+            # Loop-invariant transposed node operands (kernel layout).
+            labels_t, taints_t, aff_t = node_labels.T, node_taints.T, node_aff.T
+            pref_t, tsoft_t = node_pref.T, node_taints_soft.T
 
         if constrained:
             from ..ops.constraints import blocked_block, constraint_commit, constraint_filter, round_blocked_masks
@@ -186,6 +204,7 @@ def _build_shard_map(
             # 1. choose: local tile (with the constraint-blocked columns of
             # this shard when constrained), then argmax across the tp axis.
             blocked_l = sps_dec_l = sp_pen_l = ppa_w_l = ppa_cnt_l = None
+            cons_pod_l = cons_node_l = None
             if constrained:
                 masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)  # [·, n_tot]
                 # Node-axis masks slice to this shard's columns; pa_inactive
@@ -194,19 +213,42 @@ def _build_shard_map(
                     k: (v if k == "pa_inactive" else lax.dynamic_slice_in_dim(v, node_base, n_local, axis=1))
                     for k, v in masks.items()
                 }
-                blocked_l = blocked_block(jnp, blk_l, lm)  # [p_local, n_local]
-                if soft_spread:
-                    sps_dec_l = blk_l["pod_sps_declares"]
-                    sp_pen_l = lm["sp_penalty_node"]
-                if soft_pa:
-                    ppa_w_l = blk_l["pod_ppa_w"]
-                    ppa_cnt_l = lm["ppa_cnt_node"]
-            best_l, idx_l, _ = _local_choose(
-                avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels,
-                node_taints, node_aff, node_valid, node_pref, node_taints_soft, w, g_pod_idx, g_node_idx,
-                blocked=blocked_l, sps_declares=sps_dec_l, sp_penalty=sp_pen_l,
-                ppa_w=ppa_w_l, ppa_cnt=ppa_cnt_l, salt=rounds,
-            )
+                if use_pallas:
+                    # Constrained kernel operands over this shard's sliced
+                    # masks — the SAME helpers as ops/assign._choose, so the
+                    # zero-fill and PA-gating conventions have one home.
+                    from ..ops.pallas_choose import (
+                        constrained_kernel_node_operands,
+                        constrained_kernel_pod_operands,
+                    )
+
+                    cons_node_l, pa_inactive = constrained_kernel_node_operands(blk_l, lm, n_local)
+                    cons_pod_l = constrained_kernel_pod_operands(blk_l, pa_inactive)
+                else:
+                    blocked_l = blocked_block(jnp, blk_l, lm)  # [p_local, n_local]
+                    if soft_spread:
+                        sps_dec_l = blk_l["pod_sps_declares"]
+                        sp_pen_l = lm["sp_penalty_node"]
+                    if soft_pa:
+                        ppa_w_l = blk_l["pod_ppa_w"]
+                        ppa_cnt_l = lm["ppa_cnt_node"]
+            if use_pallas:
+                from ..ops.pallas_choose import build_node_info, choose_block_pallas
+
+                idx_l, _has_l, best_l = choose_block_pallas(
+                    req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, active, g_pod_idx,
+                    build_node_info(avail, node_alloc, node_valid),
+                    labels_t, taints_t, aff_t, pref_t, tsoft_t, w,
+                    salt=rounds, cons_pod=cons_pod_l, cons_node=cons_node_l,
+                    node_offset=node_base, interpret=pallas_interpret, return_best=True,
+                )
+            else:
+                best_l, idx_l, _ = _local_choose(
+                    avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels,
+                    node_taints, node_aff, node_valid, node_pref, node_taints_soft, w, g_pod_idx, g_node_idx,
+                    blocked=blocked_l, sps_declares=sps_dec_l, sp_penalty=sp_pen_l,
+                    ppa_w=ppa_w_l, ppa_cnt=ppa_cnt_l, salt=rounds,
+                )
             bests = lax.all_gather(best_l, "tp")  # [tp, p_local]
             idxs = lax.all_gather(idx_l + node_base, "tp")
             best, choice = bests[0], idxs[0]
@@ -339,12 +381,19 @@ def constraint_operands(cons, n_pad_from: int, n_pad_to: int) -> dict:
 
 @lru_cache(maxsize=64)
 def _build_sharded_fn(
-    mesh, max_rounds: int, constrained: bool = False, soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True
+    mesh,
+    max_rounds: int,
+    constrained: bool = False,
+    soft_spread: bool = False,
+    soft_pa: bool = False,
+    hard_pa: bool = True,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ):
     """Jitted (mesh, max_rounds)-specialised cycle fn — cached so repeated
     cycles reuse the compiled executable (jit re-specialises per shape)."""
     dp = mesh.shape["dp"]
-    sharded = _build_shard_map(mesh, max_rounds, constrained, soft_spread, soft_pa, hard_pa)
+    sharded = _build_shard_map(mesh, max_rounds, constrained, soft_spread, soft_pa, hard_pa, use_pallas, pallas_interpret)
 
     @jax.jit
     def run(a, c):
@@ -382,6 +431,7 @@ def _build_sharded_fn(
 def sharded_assign_cycle(
     mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None,
     soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True,
+    use_pallas: bool = False, pallas_interpret: bool = False,
 ):
     """Run one cycle over the mesh. ``arrays`` are the PackedCluster device
     arrays with N pre-padded to a tp multiple (pods pad internally, post-
@@ -390,7 +440,9 @@ def sharded_assign_cycle(
     assert arrays["node_avail"].shape[0] % mesh.shape["tp"] == 0
     a = dict(arrays)
     a["weights"] = np.asarray(weights, dtype=np.float32)
-    run = _build_sharded_fn(mesh, max_rounds, constraints is not None, soft_spread, soft_pa, hard_pa)
+    run = _build_sharded_fn(
+        mesh, max_rounds, constraints is not None, soft_spread, soft_pa, hard_pa, use_pallas, pallas_interpret
+    )
     return run(a, constraints if constraints is not None else {})
 
 
@@ -407,44 +459,100 @@ class ShardedBackend(SchedulingBackend):
     # buys nothing on a single mesh — the devices are shared anyway).
     supports_concurrent_shards = False
 
-    def __init__(self, mesh=None, tp: int | None = None):
+    def __init__(self, mesh=None, tp: int | None = None, use_pallas: bool | None = None, pallas_interpret: bool = False):
         self.mesh = mesh if mesh is not None else make_mesh(tp=tp)
+        # The fused kernel runs compiled on TPU meshes only; other platforms
+        # need interpret mode (explicitly requested — tests, dryrun).
+        platform = next(iter(self.mesh.devices.flat)).platform
+        if use_pallas is None:
+            use_pallas = platform == "tpu"
+        self.use_pallas = use_pallas
+        self.pallas_interpret = pallas_interpret or (use_pallas and platform != "tpu")
+        # First-use proving guard, per kernel variant — the sharded twin of
+        # TpuBackend's: until the pallas shard program survives one real
+        # compile+run, a failure downgrades to the (bit-identical) jnp shard
+        # program instead of killing the cycle.
+        self._proven_variants: set[bool] = set()
+        self._disabled_variants: set[bool] = set()
+        self._pallas_strikes: dict[bool, int] = {False: 0, True: 0}
 
-    def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
-        try:
-            tp = self.mesh.shape["tp"]
-            a = dict(packed.device_arrays())
-            # Node padding to the tp multiple happens here; pod padding to the dp
-            # multiple happens inside the jitted run, after the priority permute.
-            n_pad = round_up(packed.padded_nodes, tp)
-            for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff", "node_pref", "node_taints_soft"):
-                a[k] = np.pad(a[k], ((0, n_pad - packed.padded_nodes), (0, 0)))
-            a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - packed.padded_nodes),))
-            cons = packed.constraints
-            c = constraint_operands(cons, packed.padded_nodes, n_pad) if cons is not None else None
-            soft_spread = cons is not None and cons.n_spread_soft > 0
-            soft_pa = cons is not None and cons.n_ppa_terms > 0
-            hard_pa = cons is not None and cons.n_pa_terms > 0
-            if jax.process_count() > 1:
-                # Multi-controller runtime: host-local numpy can't feed a jit
-                # over non-addressable devices — route through the global-
-                # array path (parallel/multihost.py; same shard_map program).
-                from .multihost import sharded_assign_multihost
+    def _dispatch(self, a, c, profile, soft_spread, soft_pa, hard_pa, use_pallas):
+        if jax.process_count() > 1:
+            # Multi-controller runtime: host-local numpy can't feed a jit
+            # over non-addressable devices — route through the global-
+            # array path (parallel/multihost.py; same shard_map program).
+            from .multihost import sharded_assign_multihost
 
-                assigned, rounds = sharded_assign_multihost(
-                    self.mesh, a, profile.weights(), profile.max_rounds, constraints=c,
-                    soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa,
-                )
-                return np.asarray(assigned), int(rounds)
-            assigned, rounds, _avail = sharded_assign_cycle(
+            assigned, rounds = sharded_assign_multihost(
                 self.mesh, a, profile.weights(), profile.max_rounds, constraints=c,
                 soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa,
+                use_pallas=use_pallas, pallas_interpret=self.pallas_interpret,
             )
-            return np.asarray(jax.device_get(assigned)), int(rounds)
+            return np.asarray(assigned), int(rounds)
+        assigned, rounds, _avail = sharded_assign_cycle(
+            self.mesh, a, profile.weights(), profile.max_rounds, constraints=c,
+            soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa,
+            use_pallas=use_pallas, pallas_interpret=self.pallas_interpret,
+        )
+        return np.asarray(jax.device_get(assigned)), int(rounds)
+
+    def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
+        from ..errors import BackendUnavailable
+
+        tp = self.mesh.shape["tp"]
+        a = dict(packed.device_arrays())
+        # Node padding to the tp multiple happens here; pod padding to the dp
+        # multiple happens inside the jitted run, after the priority permute.
+        n_pad = round_up(packed.padded_nodes, tp)
+        for k in ("node_alloc", "node_avail", "node_labels", "node_taints", "node_aff", "node_pref", "node_taints_soft"):
+            a[k] = np.pad(a[k], ((0, n_pad - packed.padded_nodes), (0, 0)))
+        a["node_valid"] = np.pad(a["node_valid"], ((0, n_pad - packed.padded_nodes),))
+        cons = packed.constraints
+        c = constraint_operands(cons, packed.padded_nodes, n_pad) if cons is not None else None
+        soft_spread = cons is not None and cons.n_spread_soft > 0
+        soft_pa = cons is not None and cons.n_ppa_terms > 0
+        hard_pa = cons is not None and cons.n_pa_terms > 0
+        variant = cons is not None
+        # Same guard as ops/assign._choose: >3 extended resources exceed the
+        # kernel's [8, N] info rows — jnp shard program, still exact.
+        use_pallas = (
+            self.use_pallas and a["node_avail"].shape[1] <= 5 and variant not in self._disabled_variants
+        )
+        if use_pallas and variant not in self._proven_variants:
+            try:
+                out = self._dispatch(a, c, profile, soft_spread, soft_pa, hard_pa, True)
+                self._proven_variants.add(variant)
+                return out
+            except jax.errors.JaxRuntimeError as e:
+                # Transient fault or Mosaic rejection — indistinguishable;
+                # strike-based like TpuBackend: native fallback this cycle,
+                # kernel variant disabled after two strikes.
+                self._pallas_strikes[variant] += 1
+                if self._pallas_strikes[variant] >= 2:
+                    import logging
+
+                    logging.getLogger("tpu_scheduler.backend").warning(
+                        "sharded pallas %s kernel failed %d first-use attempts; disabling that variant",
+                        "constrained" if variant else "plain",
+                        self._pallas_strikes[variant],
+                    )
+                    self._disabled_variants.add(variant)
+                raise BackendUnavailable(f"sharded backend runtime failure: {e}") from e
+            except Exception as e:  # noqa: BLE001 — first-compile guard (see TpuBackend)
+                import logging
+
+                logging.getLogger("tpu_scheduler.backend").warning(
+                    "sharded pallas %s kernel failed on first use (%s: %s); disabling that variant, retrying jnp path",
+                    "constrained" if variant else "plain",
+                    type(e).__name__,
+                    e,
+                )
+                self._disabled_variants.add(variant)
+                use_pallas = False
+        try:
+            return self._dispatch(a, c, profile, soft_spread, soft_pa, hard_pa, use_pallas)
         except jax.errors.JaxRuntimeError as e:
             # Same contract as TpuBackend: device-runtime failures become the
             # explicit unavailability signal the controller's fallback keys
             # on; programming errors propagate.
-            from ..errors import BackendUnavailable
-
             raise BackendUnavailable(f"sharded backend runtime failure: {e}") from e
