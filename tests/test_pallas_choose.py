@@ -94,6 +94,52 @@ def test_pallas_choose_matches_jnp_soft_terms(seed):
     np.testing.assert_array_equal(jc[jh], pc[ph])
 
 
+@pytest.mark.parametrize("seed", [0, 3])
+def test_pallas_choose_banded_decomposition_dense(seed):
+    """The banded hard matmul's base decomposition must stay exact when all
+    three count groups (selector pairs, untolerated taints, affinity hits)
+    are simultaneously dense — the failure mode would be cross-band carry."""
+    a, weights = _case(
+        32, 48, seed,
+        selector_fraction=0.8, tainted_fraction=0.6, node_affinity_fraction=0.6,
+        soft_taint_fraction=0.5, preferred_affinity_fraction=0.5,
+    )
+    jc, jh, pc, ph = _both_paths(a, weights)
+    np.testing.assert_array_equal(jh, ph)
+    np.testing.assert_array_equal(jc[jh], pc[ph])
+
+
+def test_band_width_guard():
+    """Vocab widths beyond the banded-matmul exactness bound must be
+    rejected by the kernel wrapper and routed to jnp by the assign path."""
+    from tpu_scheduler.ops.assign import assign_cycle, split_device_arrays
+    from tpu_scheduler.ops.pallas_choose import MAX_BAND_WIDTH, pallas_band_widths_ok
+
+    assert pallas_band_widths_ok(MAX_BAND_WIDTH, 8, 8)
+    assert not pallas_band_widths_ok(MAX_BAND_WIDTH + 1, 8, 8)
+    # 255·65536 + 255·256 + 255 == 2**24 − 1: the packing bound is exactly
+    # the f32 integer-exactness limit.
+    assert MAX_BAND_WIDTH * 65536 + MAX_BAND_WIDTH * 256 + MAX_BAND_WIDTH == 2**24 - 1
+
+    # Over-wide selector vocab (zero-padded, so results are unchanged):
+    # the wrapper must refuse it outright...
+    a, weights = _case(16, 24, seed=0)
+    wide = 264  # > MAX_BAND_WIDTH, multiple of 8
+    a["pod_sel"] = jnp.pad(a["pod_sel"], ((0, 0), (0, wide - a["pod_sel"].shape[1])))
+    a["node_labels"] = jnp.pad(a["node_labels"], ((0, 0), (0, wide - a["node_labels"].shape[1])))
+    with pytest.raises(AssertionError, match="banded-matmul bound"):
+        _both_paths(a, weights)
+    # ...and assign_cycle(use_pallas=True) must silently route the cluster
+    # to the jnp path with identical results.
+    nodes, pods = split_device_arrays(a)
+    base_assigned, base_rounds, _, _, _ = assign_cycle(nodes, pods, weights, max_rounds=8, block=16)
+    p_assigned, p_rounds, _, _, _ = assign_cycle(
+        nodes, pods, weights, max_rounds=8, block=16, use_pallas=True, pallas_interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(base_assigned), np.asarray(p_assigned))
+    assert int(base_rounds) == int(p_rounds)
+
+
 def test_pallas_choose_tile_remainders():
     """Pod/node counts that don't divide the tiles exercise internal padding."""
     a, weights = _case(19, 13, seed=7)

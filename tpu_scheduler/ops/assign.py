@@ -211,10 +211,17 @@ def _choose(
     """
     p = ps["pod_req"].shape[0]
 
-    if use_pallas and nodes["node_avail"].shape[1] > 5:
-        # More than 3 extended resources exceed the kernel's [8, N] info
-        # rows (pallas_choose.build_node_info) — jnp path, still exact.
-        use_pallas = False
+    if use_pallas:
+        from .pallas_choose import pallas_band_widths_ok
+
+        if nodes["node_avail"].shape[1] > 5 or not pallas_band_widths_ok(
+            ps["pod_sel"].shape[1], ps["pod_ntol"].shape[1], ps["pod_aff"].shape[1]
+        ):
+            # More than 3 extended resources exceed the kernel's [8, N] info
+            # rows (pallas_choose.build_node_info), and vocab widths beyond
+            # the banded-matmul bound break its exact decomposition — jnp
+            # path either way, still exact.
+            use_pallas = False
     pallas_pack = None
     if use_pallas:
         from .pallas_choose import build_node_info

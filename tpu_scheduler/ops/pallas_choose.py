@@ -19,6 +19,20 @@ cpu/mem, alloc cpu/mem, valid, 3× pad) so the int32 (8, 128) min-tile is hit
 exactly; labels ride transposed ``[L, N]`` so the selector-count matmul
 ``sel @ labelsT`` feeds the MXU directly.
 
+Banded hard predicates (PERF.md "known remaining headroom", landed): the
+three hard count matmuls (selector pairs, untolerated taints, affinity
+hits) ride ONE banded matmul — pod side ``[sel | 256·ntol | 65536·aff]``,
+node side ``[labelsT; taintsT; affT]`` — and the kernel recovers the three
+exact counts by power-of-2 base decomposition.  Each count is bounded by
+its (static) vocab width ≤ 255, so the packed value is < 2²⁴ and every
+intermediate is an exact f32 integer: decomposition returns bitwise the
+same counts the separate matmuls would, preserving the parity contract.
+(The soft score matmuls stay separate: their weighted sums are not exact
+integers, so folding them into one accumulation would change float
+rounding order.)  Constrained cycles band the four blocked-domain matmuls
+the same way WITHOUT decomposition — only their sum feeds ``blocked > 0``,
+and sums of exact small ints are order-independent.
+
 Reference capability anchor: this is the batched form of the predicate chain
 ``check_node_validity`` (reference ``src/predicates.rs:63-77``) plus scoring
 the reference lacks (it takes the first feasible random candidate,
@@ -45,6 +59,20 @@ __all__ = [
 ROW_AVAIL_CPU, ROW_AVAIL_MEM, ROW_ALLOC_CPU, ROW_ALLOC_MEM, ROW_VALID = 0, 1, 2, 3, 4
 
 NEG_INF = float("-inf")
+
+# Base separation for the banded hard matmul: each packed count group must
+# stay < its base for exact decomposition, so the (static) vocab widths must
+# each be ≤ MAX_BAND_WIDTH.  Wider vocabs fall back to the jnp path (callers
+# check pallas_band_widths_ok); 255·65536 + 255·256 + 255 == 2²⁴ − 1, the
+# largest exactly-representable packing.
+BAND_TAINT = 256.0
+BAND_AFF = 65536.0
+MAX_BAND_WIDTH = 255
+
+
+def pallas_band_widths_ok(sel_width: int, ntol_width: int, aff_width: int) -> bool:
+    """Static guard for the banded hard matmul's exactness bounds."""
+    return max(sel_width, ntol_width, aff_width) <= MAX_BAND_WIDTH
 
 
 def build_node_info(node_avail, node_alloc, node_valid):
@@ -113,13 +141,14 @@ def constrained_kernel_pod_operands(blk: dict, pa_inactive):
 
 
 def _make_choose_kernel(constrained: bool):
-    """Kernel body factory.  ``constrained=True`` adds six pod-side and six
-    node-side refs carrying the per-round constraint operands
-    (ops/constraints.round_blocked_masks): three hard blocked-node matmuls
-    (anti-affinity matched/carrier, spread saturation), the gated positive-
-    affinity matmul, and the two soft score matmuls (ScheduleAnyway spread
-    penalty, preferred inter-pod counts).  Absent features ride as exact-zero
-    operands, so results stay bitwise equal to the jnp expression tree."""
+    """Kernel body factory.  ``constrained=True`` adds THREE pod-side and
+    THREE node-side refs carrying the per-round constraint operands
+    (ops/constraints.round_blocked_masks): the four hard blocked-node
+    bitmaps (anti-affinity matched/carrier, spread saturation, gated
+    positive affinity) banded into ONE matmul pair, plus the two soft score
+    matmuls (ScheduleAnyway spread penalty, preferred inter-pod counts).
+    Absent features ride as exact-zero operands, so results stay bitwise
+    equal to the jnp expression tree."""
 
     def kernel(*refs):
         # Single slice-based unpack — the group order here is the ONE place
@@ -128,46 +157,36 @@ def _make_choose_kernel(constrained: bool):
         (
             weights_ref,  # [1, 8] f32 SMEM (w_lr, w_ba, w_jitter, w_pref, w_soft_taint, w_topo, round_salt, node_offset)
             req_ref,  # [BP, R] i32
-            sel_ref,  # [BP, L] f32
+            hard_ref,  # [BP, L+T+A] f32  banded [sel | 256·ntol | 65536·aff]
             selc_ref,  # [BP, 1] f32
-            ntol_ref,  # [BP, T] f32  (1 where vocab taint NOT tolerated)
-            aff_ref,  # [BP, A] f32  (the pod's affinity-term bitmap)
             hasaff_ref,  # [BP, 1] f32  (1 if the pod declares node affinity)
             prefw_ref,  # [BP, A2] f32  (pod's weight per preferred-affinity term)
             ntols_ref,  # [BP, Ts] f32  (1 where soft vocab taint NOT tolerated)
-        ) = refs[:9]
-        k = 9
+        ) = refs[:7]
+        k = 7
         if constrained:
             (
-                aac_ref,  # [BP, Tc] f32  (pod carries anti-affinity term)
-                aam_ref,  # [BP, Tc] f32  (pod matched by anti-affinity term)
-                spd_ref,  # [BP, S] f32  (pod declares hard spread constraint)
-                pag_ref,  # [BP, Ta] f32  (gated positive-affinity declarations)
+                blk_ref,  # [BP, 2Tc+S+Ta] f32  banded [aa_carries | aa_matched | sp_declares | gated_pa]
                 sps_ref,  # [BP, Ss] f32  (pod declares soft spread constraint)
                 ppaw_ref,  # [BP, Tp] f32  (signed preferred inter-pod weights)
-            ) = refs[k : k + 6]
-            k += 6
+            ) = refs[k : k + 3]
+            k += 3
         (
             act_ref,  # [BP, 1] i32
             idx_ref,  # [BP, 1] u32  (priority ranks, jitter hash input)
             info_ref,  # [8, TN] i32  (node resources, see ROW_*)
-            labels_ref,  # [L, TN] f32
-            taints_ref,  # [T, TN] f32
-            aff_t_ref,  # [A, TN] f32
+            hard_t_ref,  # [L+T+A, TN] f32  banded [labelsT; taintsT; affT]
             pref_t_ref,  # [A2, TN] f32
             taints_soft_t_ref,  # [Ts, TN] f32
-        ) = refs[k : k + 8]
-        k += 8
+        ) = refs[k : k + 6]
+        k += 6
         if constrained:
             (
-                aamn_ref,  # [Tc, TN] f32  (domain holds matched pod — blocks carriers)
-                aacn_ref,  # [Tc, TN] f32  (domain holds carrier — blocks matched)
-                spn_ref,  # [S, TN] f32  (spread-saturated domains)
-                paun_ref,  # [Ta, TN] f32  (positive-affinity unmatched domains)
+                blk_t_ref,  # [2Tc+S+Ta, TN] f32  banded [aa_m_node; aa_c_node; sp_node; pa_unmatched]
                 spspen_ref,  # [Ss, TN] f32  (soft-spread penalty counts)
                 ppacnt_ref,  # [Tp, TN] f32  (preferred inter-pod match counts)
-            ) = refs[k : k + 6]
-            k += 6
+            ) = refs[k : k + 3]
+            k += 3
         (
             choice_ref,  # [BP, 1] i32 out
             has_ref,  # [BP, 1] i32 out
@@ -199,28 +218,28 @@ def _make_choose_kernel(constrained: bool):
         for e in range(req_ref.shape[1] - 2):
             fit = fit & (req_ref[:, 2 + e : 3 + e] <= info_ref[5 + e : 6 + e, :])
 
-        # nodeSelector — selector-pair counting matmul (MXU; counts are tiny
-        # integers, exact in f32).
-        counts = jnp.dot(sel_ref[:], labels_ref[:], preferred_element_type=f32)  # [BP, TN]
-        sel_ok = counts == selc_ref[:]
-
-        # taints/tolerations — untolerated-taint counting matmul (ops/masks.py).
-        untol = jnp.dot(ntol_ref[:], taints_ref[:], preferred_element_type=f32)  # [BP, TN]
-        taint_ok = untol == f32(0.0)
-
-        # node affinity — ORed terms: eligible iff no affinity or >=1 term hit.
-        aff_hits = jnp.dot(aff_ref[:], aff_t_ref[:], preferred_element_type=f32)  # [BP, TN]
+        # ONE banded matmul for all three hard count predicates, then exact
+        # base decomposition (module docstring): counts = c mod 256,
+        # untol = (c mod 65536) div 256, aff_hits = c div 65536 — every
+        # value an exact f32 integer, bitwise what three matmuls would give.
+        c = jnp.dot(hard_ref[:], hard_t_ref[:], preferred_element_type=f32)  # [BP, TN]
+        aff_hits = jnp.floor(c / BAND_AFF)
+        rem = c - aff_hits * BAND_AFF
+        untol = jnp.floor(rem / BAND_TAINT)
+        counts = rem - untol * BAND_TAINT
+        sel_ok = counts == selc_ref[:]  # nodeSelector pair counting
+        taint_ok = untol == f32(0.0)  # untolerated-taint counting
+        # node affinity — ORed terms: eligible iff no affinity or >=1 hit.
         aff_ok = (aff_hits > f32(0.0)) | (hasaff_ref[:] == f32(0.0))
 
         mask = fit & sel_ok & taint_ok & aff_ok & (valid > 0) & (act_ref[:] > 0)
 
         if constrained:
-            # Constraint-blocked domains — same four matmuls and sum order as
-            # ops/constraints.blocked_block (exact small ints in f32).
-            blocked = jnp.dot(aac_ref[:], aamn_ref[:], preferred_element_type=f32)
-            blocked = blocked + jnp.dot(aam_ref[:], aacn_ref[:], preferred_element_type=f32)
-            blocked = blocked + jnp.dot(spd_ref[:], spn_ref[:], preferred_element_type=f32)
-            blocked = blocked + jnp.dot(pag_ref[:], paun_ref[:], preferred_element_type=f32)
+            # Constraint-blocked domains — the four matmuls of
+            # ops/constraints.blocked_block as ONE band: only the sum feeds
+            # the > 0 test, and sums of exact small ints are
+            # order-independent, so no decomposition is needed.
+            blocked = jnp.dot(blk_ref[:], blk_t_ref[:], preferred_element_type=f32)
             mask = mask & ~(blocked > f32(0.0))
 
         # LeastRequested + BalancedAllocation — same op order as ops/score.py.
@@ -322,12 +341,13 @@ def choose_block_pallas(
     Pads pods/nodes up to tile multiples internally; padded pods are
     inactive, padded nodes invalid, so results are unaffected.
 
-    ``cons_pod``/``cons_node`` (given together) switch on the constrained
-    kernel: the per-round blocked/penalty node masks ride as six extra
-    node-side operands ([·, N]-shaped, VMEM-cheap) and the pod-side constraint
-    bitmaps as six extra pod rows — the accept/commit phases stay in jnp
-    (ops/assign.py).  Features absent from a cycle are exact-zero operands,
-    keeping results bitwise equal to the jnp path.
+    ``cons_pod``/``cons_node`` (six arrays each, given together) switch on
+    the constrained kernel: the wrapper bands the four blocked bitmaps of
+    each side into ONE operand pair and passes the two soft operands
+    separately — three extra pod-side and three extra node-side kernel refs
+    ([·, N]-shaped, VMEM-cheap) — while the accept/commit phases stay in
+    jnp (ops/assign.py).  Features absent from a cycle are exact-zero
+    operands, keeping results bitwise equal to the jnp path.
     """
     constrained = cons_pod is not None
     b, n = req.shape[0], node_info.shape[1]
@@ -337,6 +357,10 @@ def choose_block_pallas(
     a_dim = aff.shape[1]
     a2_dim = pref_w.shape[1]
     ts_dim = ntol_soft.shape[1]
+    assert pallas_band_widths_ok(l, t, a_dim), (
+        f"vocab widths ({l}, {t}, {a_dim}) exceed the banded-matmul bound "
+        f"{MAX_BAND_WIDTH} — callers must route this cluster to the jnp path"
+    )
     bp = min(pod_tile, max(8, b))
     pb = -(-b // bp)
     nbt = -(-n // node_tile)
@@ -374,13 +398,19 @@ def choose_block_pallas(
     pod_row = lambda width: pl.BlockSpec((bp, width), lambda i, j: (i, 0))  # noqa: E731
     node_row = lambda rows: pl.BlockSpec((rows, node_tile), lambda i, j: (0, j))  # noqa: E731
 
+    # Banded hard operands (see module docstring): scale pod-side so the one
+    # matmul packs the three counts into disjoint power-of-2 bands.
+    f32 = jnp.float32
+    hard_band = jnp.concatenate(
+        [sel.astype(f32), ntol.astype(f32) * f32(BAND_TAINT), aff.astype(f32) * f32(BAND_AFF)], axis=1
+    )
+    hard_band_t = jnp.concatenate([labels_t.astype(f32), taints_t.astype(f32), aff_t.astype(f32)], axis=0)
+
     in_specs = [
         pl.BlockSpec((1, 8), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
         pod_row(r),
-        pod_row(l),
+        pod_row(l + t + a_dim),
         pod_row(1),
-        pod_row(t),
-        pod_row(a_dim),
         pod_row(1),
         pod_row(a2_dim),
         pod_row(ts_dim),
@@ -388,24 +418,24 @@ def choose_block_pallas(
     operands = [
         w,
         req,
-        sel,
+        hard_band,
         selc.reshape(-1, 1),
-        ntol,
-        aff,
-        has_aff.astype(jnp.float32).reshape(-1, 1),
+        has_aff.astype(f32).reshape(-1, 1),
         pref_w,
         ntol_soft,
     ]
     if constrained:
-        in_specs += [pod_row(v.shape[1]) for v in cons_pod]
-        operands += [v.astype(jnp.float32) for v in cons_pod]
+        # The four blocked bitmaps band into one matmul (sum-only — no
+        # decomposition, no scaling); soft operands stay separate.
+        blk_band = jnp.concatenate([v.astype(f32) for v in cons_pod[:4]], axis=1)
+        blk_band_t = jnp.concatenate([v.astype(f32) for v in cons_node[:4]], axis=0)
+        in_specs += [pod_row(blk_band.shape[1]), pod_row(cons_pod[4].shape[1]), pod_row(cons_pod[5].shape[1])]
+        operands += [blk_band, cons_pod[4].astype(f32), cons_pod[5].astype(f32)]
     in_specs += [
         pod_row(1),
         pod_row(1),
         node_row(8),
-        node_row(l),
-        node_row(t),
-        node_row(a_dim),
+        node_row(l + t + a_dim),
         node_row(a2_dim),
         node_row(ts_dim),
     ]
@@ -413,15 +443,13 @@ def choose_block_pallas(
         act.astype(jnp.int32).reshape(-1, 1),
         ranks.astype(jnp.uint32).reshape(-1, 1),
         node_info,
-        labels_t,
-        taints_t,
-        aff_t,
+        hard_band_t,
         pref_t,
         taints_soft_t,
     ]
     if constrained:
-        in_specs += [node_row(v.shape[0]) for v in cons_node]
-        operands += [v.astype(jnp.float32) for v in cons_node]
+        in_specs += [node_row(blk_band_t.shape[0]), node_row(cons_node[4].shape[0]), node_row(cons_node[5].shape[0])]
+        operands += [blk_band_t, cons_node[4].astype(f32), cons_node[5].astype(f32)]
 
     grid = (pb, nbt)
     choice, has, best = pl.pallas_call(
