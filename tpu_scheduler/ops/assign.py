@@ -212,15 +212,9 @@ def _choose(
     p = ps["pod_req"].shape[0]
 
     if use_pallas:
-        from .pallas_choose import pallas_band_widths_ok
+        from .pallas_choose import pallas_kernel_supported
 
-        if nodes["node_avail"].shape[1] > 5 or not pallas_band_widths_ok(
-            ps["pod_sel"].shape[1], ps["pod_ntol"].shape[1], ps["pod_aff"].shape[1]
-        ):
-            # More than 3 extended resources exceed the kernel's [8, N] info
-            # rows (pallas_choose.build_node_info), and vocab widths beyond
-            # the banded-matmul bound break its exact decomposition — jnp
-            # path either way, still exact.
+        if not pallas_kernel_supported(ps, nodes):
             use_pallas = False
     pallas_pack = None
     if use_pallas:

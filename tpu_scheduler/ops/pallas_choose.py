@@ -75,6 +75,18 @@ def pallas_band_widths_ok(sel_width: int, ntol_width: int, aff_width: int) -> bo
     return max(sel_width, ntol_width, aff_width) <= MAX_BAND_WIDTH
 
 
+def pallas_kernel_supported(pods: dict, nodes: dict) -> bool:
+    """THE static can-this-cluster-ride-the-kernel predicate, for every
+    use_pallas entry point (ops/assign._choose, ShardedBackend.assign,
+    sharded_assign_multihost): >3 extended resources exceed the [8, N] info
+    rows (build_node_info), and vocab widths beyond MAX_BAND_WIDTH break the
+    banded matmul's exact decomposition.  Unsupported clusters ride the
+    bit-identical jnp path."""
+    return nodes["node_avail"].shape[1] <= 5 and pallas_band_widths_ok(
+        pods["pod_sel"].shape[1], pods["pod_ntol"].shape[1], pods["pod_aff"].shape[1]
+    )
+
+
 def build_node_info(node_avail, node_alloc, node_valid):
     """Pack node resources into the kernel's [8, N] int32 layout.
 

@@ -84,6 +84,14 @@ def sharded_assign_multihost(
     import jax
     from jax.experimental import multihost_utils
 
+    from ..ops.pallas_choose import pallas_kernel_supported
+
+    if use_pallas and not pallas_kernel_supported(arrays, arrays):
+        # Unsupported cluster shapes (extended-resource or vocab widths)
+        # ride the bit-identical jnp shard program — same guard as the
+        # other two use_pallas entry points.
+        use_pallas = False
+
     dp, tp = mesh.shape["dp"], mesh.shape["tp"]
     a = dict(arrays)
 

@@ -513,17 +513,9 @@ class ShardedBackend(SchedulingBackend):
         soft_pa = cons is not None and cons.n_ppa_terms > 0
         hard_pa = cons is not None and cons.n_pa_terms > 0
         variant = cons is not None
-        # Same guards as ops/assign._choose: >3 extended resources exceed the
-        # kernel's [8, N] info rows, and vocab widths beyond the banded bound
-        # break its exact decomposition — jnp shard program, still exact.
-        from ..ops.pallas_choose import pallas_band_widths_ok
+        from ..ops.pallas_choose import pallas_kernel_supported
 
-        use_pallas = (
-            self.use_pallas
-            and a["node_avail"].shape[1] <= 5
-            and pallas_band_widths_ok(a["pod_sel"].shape[1], a["pod_ntol"].shape[1], a["pod_aff"].shape[1])
-            and variant not in self._disabled_variants
-        )
+        use_pallas = self.use_pallas and pallas_kernel_supported(a, a) and variant not in self._disabled_variants
         if use_pallas and variant not in self._proven_variants:
             try:
                 out = self._dispatch(a, c, profile, soft_spread, soft_pa, hard_pa, True)
