@@ -83,6 +83,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--http-port", type=int, default=None, help="serve /metrics, /healthz and the k8s REST surface on this port")
     p.add_argument("--api-server", default=None, help="schedule against a remote k8s-style REST endpoint (URL) instead of the synthetic in-process cluster")
     p.add_argument("--api-token", default=None, help="bearer token for --api-server")
+    p.add_argument(
+        "--kubeconfig",
+        default=None,
+        help="schedule against the cluster this kubeconfig points at (server/token/CA/client-cert resolution; "
+        "default resolution when given without a value is $KUBECONFIG -> ~/.kube/config -> in-cluster)",
+        nargs="?",
+        const="",
+    )
+    p.add_argument("--kube-context", default=None, help="kubeconfig context to use (default: current-context)")
     return p
 
 
@@ -95,7 +104,14 @@ def main(argv: list[str] | None = None) -> int:
 
         enable_compilation_cache()
 
-    if args.api_server:
+    if args.kubeconfig is not None:
+        # Real-cluster path (reference main.rs:130 Client::try_default):
+        # kubeconfig resolution gives server + auth + TLS in one step.
+        from .runtime.http_api import RemoteApiAdapter
+        from .runtime.kubeconfig import client_from_kubeconfig
+
+        api = RemoteApiAdapter(client_from_kubeconfig(args.kubeconfig or None, context=args.kube_context))
+    elif args.api_server:
         from .runtime.http_api import KubeApiClient, RemoteApiAdapter
 
         api = RemoteApiAdapter(KubeApiClient(args.api_server, token=args.api_token))
@@ -179,7 +195,7 @@ def main(argv: list[str] | None = None) -> int:
 
         # Against a remote cluster we serve metrics/health only — the remote
         # API server owns the cluster state.
-        local_api = None if args.api_server else api
+        local_api = None if (args.api_server or args.kubeconfig is not None) else api
         http_server = HttpApiServer(local_api, metrics=sched.metrics, port=args.http_port).start()
         print(json.dumps({"http": True, "url": http_server.base_url}), file=sys.stderr)
 

@@ -19,6 +19,7 @@ Capabilities (matching what the reference consumes from kube):
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from collections import deque
@@ -108,11 +109,12 @@ class FakeApiServer:
         self._events_log: list[tuple[int, str, WatchEvent, Pod | Node | None]] = []
         self._watch_history = watch_history
         self._events_cv = threading.Condition(self._lock)
-        # Leader-election leases (coordination.k8s.io Lease, simplified to a
-        # compare-and-swap acquire RPC): name -> {holder, expires}.  The
-        # SERVER's clock arbitrates — competing schedulers on different
-        # machines cannot agree on anything else.
-        self._leases: dict[str, dict] = {}
+        # Leader-election Leases (coordination.k8s.io/v1): (namespace, name)
+        # -> kube-shaped Lease dict.  The server only stores and CASes on
+        # metadata.resourceVersion; leadership is decided CLIENT-side from
+        # spec.renewTime + leaseDurationSeconds (client-go semantics,
+        # runtime/lease.py).
+        self._leases: dict[tuple[str, str], dict] = {}
         # Fault injection: number of upcoming binding calls to fail with 500.
         self.fail_next_bindings = 0
         self.binding_count = 0
@@ -291,32 +293,108 @@ class FakeApiServer:
             self._pods[(namespace, pod_name)] = bound
             self._emit("Pod", WatchEvent("MODIFIED", bound), prev=pod)
 
-    # -- leader election (coordination.k8s.io Lease, simplified) -----------
+    # -- leader election (coordination.k8s.io/v1 Lease objects) ------------
+    #
+    # Spec-shaped primitives with resourceVersion compare-and-swap — the
+    # contract a real kube-apiserver serves — plus acquire/release helpers
+    # running the client-go election algorithm (runtime/lease.py) over them,
+    # so the in-process path and the HTTP path execute the same recipe.
+
+    def get_lease_object(self, namespace: str, name: str) -> dict | None:
+        with self._lock:
+            lease = self._leases.get((namespace, name))
+            return json.loads(json.dumps(lease)) if lease is not None else None
+
+    def create_lease_object(self, namespace: str, name: str, lease: dict) -> dict:
+        with self._lock:
+            if (namespace, name) in self._leases:
+                raise ApiError(409, f"lease {namespace}/{name} already exists")
+            self._rv += 1
+            stored = {**lease, "metadata": {**lease.get("metadata", {}), "name": name, "namespace": namespace, "resourceVersion": str(self._rv)}}
+            self._leases[(namespace, name)] = stored
+            return json.loads(json.dumps(stored))
+
+    def update_lease_object(self, namespace: str, name: str, lease: dict) -> dict:
+        """PUT with optimistic concurrency: the submitted
+        metadata.resourceVersion must equal the stored one or 409 — the CAS
+        every leader-election race resolves through."""
+        with self._lock:
+            cur = self._leases.get((namespace, name))
+            if cur is None:
+                raise ApiError(404, f"lease {namespace}/{name} not found")
+            sent_rv = str((lease.get("metadata") or {}).get("resourceVersion") or "")
+            if sent_rv != str(cur["metadata"]["resourceVersion"]):
+                raise ApiError(409, f"lease {namespace}/{name} conflict: resourceVersion {sent_rv} is stale")
+            self._rv += 1
+            stored = {**lease, "metadata": {**lease["metadata"], "name": name, "namespace": namespace, "resourceVersion": str(self._rv)}}
+            self._leases[(namespace, name)] = stored
+            return json.loads(json.dumps(stored))
 
     def acquire_lease(self, name: str, holder: str, duration_seconds: float) -> bool:
-        """Atomically acquire or renew a lease: succeeds when unheld,
-        expired, or already held by ``holder``.  Returns True on success —
-        the holder is leader until ``duration_seconds`` from now unless it
-        renews first (kube leader-election semantics)."""
-        with self._lock:
-            now = self._clock()
-            lease = self._leases.get(name)
-            if lease is None or lease["holder"] == holder or now >= lease["expires"]:
-                self._leases[name] = {"holder": holder, "expires": now + duration_seconds}
+        """One election round per the client-go algorithm: create if absent,
+        renew if ours, take over if expired/released; conflicts mean a lost
+        race (kube leader-election semantics, server holds no verbs)."""
+        from . import lease as lease_mod
+
+        def _create(obj):
+            try:
+                self.create_lease_object(lease_mod.LEASE_NAMESPACE, name, obj)
                 return True
-            return False
+            except ApiError:
+                return False
+
+        def _update(obj):
+            try:
+                self.update_lease_object(lease_mod.LEASE_NAMESPACE, name, obj)
+                return True
+            except ApiError:
+                return False
+
+        # The whole round runs under the store lock (re-entrant), so an
+        # in-process renewal thread and main loop for the SAME holder never
+        # read each other's CAS as a lost election; cross-process races
+        # still resolve through the resourceVersion conflict.
+        with self._lock:
+            return lease_mod.try_acquire_or_renew(
+                lambda: self.get_lease_object(lease_mod.LEASE_NAMESPACE, name),
+                _create,
+                _update,
+                lease_mod.LEASE_NAMESPACE,
+                name,
+                holder,
+                duration_seconds,
+                self._clock(),
+            )
 
     def release_lease(self, name: str, holder: str) -> None:
         """Voluntary hand-off (clean shutdown): only the holder may release."""
+        from . import lease as lease_mod
+
+        def _update(obj):
+            try:
+                self.update_lease_object(lease_mod.LEASE_NAMESPACE, name, obj)
+                return True
+            except ApiError:
+                return False
+
         with self._lock:
-            lease = self._leases.get(name)
-            if lease is not None and lease["holder"] == holder:
-                del self._leases[name]
+            lease_mod.release(
+                lambda: self.get_lease_object(lease_mod.LEASE_NAMESPACE, name), _update, holder, self._clock()
+            )
 
     def get_lease(self, name: str) -> dict | None:
-        with self._lock:
-            lease = self._leases.get(name)
-            return dict(lease) if lease is not None else None
+        """Back-compat summary view: {'holder', 'expires'} or None."""
+        from . import lease as lease_mod
+
+        obj = self.get_lease_object(lease_mod.LEASE_NAMESPACE, name)
+        if obj is None:
+            return None
+        spec = obj.get("spec") or {}
+        holder = spec.get("holderIdentity") or ""
+        if not holder:
+            return None
+        renew = lease_mod.parse_micro_time(spec.get("renewTime")) or 0.0
+        return {"holder": holder, "expires": renew + float(spec.get("leaseDurationSeconds") or 0)}
 
     # -- PodDisruptionBudgets (policy/v1 subset; consulted by preemption) --
 

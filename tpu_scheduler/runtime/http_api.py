@@ -30,6 +30,7 @@ from __future__ import annotations
 import http.client
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -115,6 +116,21 @@ class HttpApiServer:
                             200,
                             {"kind": "PodDisruptionBudgetList", "items": [b.to_dict() for b in budgets]},
                         )
+                    elif (
+                        len(parts := parsed.path.strip("/").split("/")) == 7
+                        and parts[:3] == ["apis", "coordination.k8s.io", "v1"]
+                        and parts[3] == "namespaces"
+                        and parts[5] == "leases"
+                    ):
+                        # GET a coordination.k8s.io/v1 Lease object.
+                        if outer.api is None:
+                            self._send_json(503, {"message": "metrics-only server: no cluster state here"})
+                            return
+                        lease = outer.api.get_lease_object(parts[4], parts[6])
+                        if lease is None:
+                            self._send_json(404, {"message": f"lease {parts[4]}/{parts[6]} not found"})
+                        else:
+                            self._send_json(200, lease)
                     else:
                         self._send_json(404, {"message": f"not found: {parsed.path}"})
                 except ApiError as e:
@@ -138,6 +154,36 @@ class HttpApiServer:
                 else:
                     self._send_json(404, {"message": f"not found: {self.path}"})
 
+            def do_PUT(self):
+                # PUT /apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{n}
+                # — Lease UPDATE with resourceVersion compare-and-swap (409
+                # Conflict on a stale rv): the primitive leader-election
+                # races resolve through.
+                parsed = urlparse(self.path)
+                parts = parsed.path.strip("/").split("/")
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError as e:
+                    self._send_json(400, {"message": f"malformed JSON body: {e}"})
+                    return
+                if outer.api is None:
+                    self._send_json(503, {"message": "metrics-only server: no cluster state here"})
+                    return
+                if (
+                    len(parts) == 7
+                    and parts[:3] == ["apis", "coordination.k8s.io", "v1"]
+                    and parts[3] == "namespaces"
+                    and parts[5] == "leases"
+                ):
+                    try:
+                        stored = outer.api.update_lease_object(parts[4], parts[6], body)
+                        self._send_json(200, stored)
+                    except ApiError as e:
+                        self._send_json(e.code, {"message": str(e)})
+                    return
+                self._send_json(404, {"message": f"not found: {parsed.path}"})
+
             def do_POST(self):
                 parsed = urlparse(self.path)
                 parts = parsed.path.strip("/").split("/")
@@ -150,32 +196,26 @@ class HttpApiServer:
                 if outer.api is None:
                     self._send_json(503, {"message": "metrics-only server: no cluster state here"})
                     return
-                # /apis/coordination.k8s.io/v1/leases/{name}/acquire|release —
-                # leader election (simplified Lease CAS; server clock rules).
-                if len(parts) == 5 and parts[:3] == ["apis", "coordination.k8s.io", "v1"] and parts[3] == "leases":
-                    self._send_json(404, {"message": "lease verbs are /leases/{name}/(acquire|release)"})
-                    return
-                if len(parts) == 6 and parts[:3] == ["apis", "coordination.k8s.io", "v1"] and parts[3] == "leases":
-                    name, verb = parts[4], parts[5]
-                    holder = body.get("holderIdentity", "")
-                    if verb == "acquire":
-                        try:
-                            duration = float(body.get("leaseDurationSeconds", 15))
-                        except (TypeError, ValueError):
-                            duration = -1.0
-                        if duration <= 0:
-                            self._send_json(400, {"message": "leaseDurationSeconds must be a positive number"})
-                            return
-                        ok = outer.api.acquire_lease(name, holder, duration)
-                        if ok:
-                            self._send_json(200, {"kind": "Lease", "acquired": True})
-                        else:
-                            self._send_json(409, {"message": f"lease {name} held", "acquired": False})
-                    elif verb == "release":
-                        outer.api.release_lease(name, holder)
-                        self._send_json(200, {"kind": "Status", "status": "Success"})
-                    else:
-                        self._send_json(404, {"message": f"unknown lease verb {verb!r}"})
+                # POST /apis/coordination.k8s.io/v1/namespaces/{ns}/leases —
+                # Lease CREATE (real coordination.k8s.io surface; leader
+                # election is a client-side recipe over GET/POST/PUT Lease
+                # objects with resourceVersion CAS, runtime/lease.py — the
+                # server holds no election verbs, like a real kube-apiserver).
+                if (
+                    len(parts) == 6
+                    and parts[:3] == ["apis", "coordination.k8s.io", "v1"]
+                    and parts[3] == "namespaces"
+                    and parts[5] == "leases"
+                ):
+                    name = (body.get("metadata") or {}).get("name", "")
+                    if not name:
+                        self._send_json(400, {"message": "lease metadata.name is required"})
+                        return
+                    try:
+                        stored = outer.api.create_lease_object(parts[4], name, body)
+                        self._send_json(201, stored)
+                    except ApiError as e:
+                        self._send_json(e.code, {"message": str(e)})
                     return
                 # /api/v1/namespaces/{ns}/pods/{name}/binding  (main.rs:94-109)
                 if (
@@ -226,12 +266,31 @@ class KubeApiClient:
     ``http.client.HTTPSConnection`` factory via ``connection_factory``.
     """
 
-    def __init__(self, base_url: str, token: str | None = None, timeout: float = 10.0, connection_factory=None):
+    def __init__(
+        self,
+        base_url: str,
+        token: str | None = None,
+        timeout: float = 10.0,
+        connection_factory=None,
+        token_provider=None,
+    ):
         parsed = urlparse(base_url)
         self._host = parsed.hostname or "127.0.0.1"
         self._port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        # An apiserver behind a path-prefixed proxy (kubectl proxy, rancher
+        # …/k8s/clusters/X) keeps its prefix on every request.
+        self._prefix = parsed.path.rstrip("/")
         self._token = token
+        # Optional () -> str|None refreshing the bearer token per request —
+        # bound serviceaccount tokens rotate (~1 h); a static copy would
+        # turn into permanent 401s in a daemon (runtime/kubeconfig.py).
+        self._token_provider = token_provider
         self._timeout = timeout
+        # Serializes whole election rounds: the controller's main loop and
+        # its renewal thread both call acquire_lease for the same holder;
+        # unserialized, the loser of the GET→PUT CAS would read its own
+        # sibling's renewal as a lost election and stand down spuriously.
+        self._lease_lock = threading.Lock()
         if connection_factory is None:
             cls = http.client.HTTPSConnection if parsed.scheme == "https" else http.client.HTTPConnection
             connection_factory = lambda: cls(self._host, self._port, timeout=self._timeout)  # noqa: E731
@@ -263,9 +322,12 @@ class KubeApiClient:
         ``read_timeout`` overrides the socket timeout for this request —
         a server-side long-poll must be allowed to park longer than the
         default request timeout."""
+        if self._prefix and path.startswith("/"):
+            path = self._prefix + path
         headers = {"Accept": "application/json"}
-        if self._token:
-            headers["Authorization"] = f"Bearer {self._token}"
+        token = self._token_provider() if self._token_provider is not None else self._token
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         payload = None
         if body is not None:
             payload = json.dumps(body).encode()
@@ -403,21 +465,69 @@ class KubeApiClient:
         if code != 200:
             raise ApiError(code, resp.get("message", "delete failed"))
 
-    def acquire_lease(self, name: str, holder: str, duration_seconds: float) -> bool:
-        body = {"holderIdentity": holder, "leaseDurationSeconds": duration_seconds}
-        code, resp = self._request_json("POST", f"/apis/coordination.k8s.io/v1/leases/{name}/acquire", body)
+    # -- leader election over the real coordination.k8s.io surface ---------
+    # Only spec-shaped requests (GET/POST/PUT Lease objects with
+    # resourceVersion CAS) — works against any real kube-apiserver; the
+    # election recipe itself is client-side (runtime/lease.py, the
+    # client-go algorithm).
+
+    def get_lease_object(self, namespace: str, name: str) -> dict | None:
+        code, resp = self._request_json(
+            "GET", f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases/{name}"
+        )
+        if code == 200:
+            return resp
+        if code == 404:
+            return None
+        raise ApiError(code, resp.get("message", "lease get failed"))
+
+    def _create_lease(self, namespace: str, lease: dict) -> bool:
+        code, resp = self._request_json(
+            "POST", f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases", lease
+        )
+        if code in (200, 201):
+            return True
+        if code == 409:
+            return False
+        raise ApiError(code, resp.get("message", "lease create failed"))
+
+    def _update_lease(self, namespace: str, name: str, lease: dict) -> bool:
+        code, resp = self._request_json(
+            "PUT", f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases/{name}", lease
+        )
         if code == 200:
             return True
         if code == 409:
             return False
-        raise ApiError(code, resp.get("message", "lease acquire failed"))
+        raise ApiError(code, resp.get("message", "lease update failed"))
+
+    def acquire_lease(self, name: str, holder: str, duration_seconds: float) -> bool:
+        from . import lease as lease_mod
+
+        ns = lease_mod.LEASE_NAMESPACE
+        with self._lease_lock:  # see __init__ — in-process rounds serialize
+            return lease_mod.try_acquire_or_renew(
+                lambda: self.get_lease_object(ns, name),
+                lambda obj: self._create_lease(ns, obj),
+                lambda obj: self._update_lease(ns, name, obj),
+                ns,
+                name,
+                holder,
+                duration_seconds,
+                time.time(),
+            )
 
     def release_lease(self, name: str, holder: str) -> None:
-        code, resp = self._request_json(
-            "POST", f"/apis/coordination.k8s.io/v1/leases/{name}/release", {"holderIdentity": holder}
-        )
-        if code != 200:
-            raise ApiError(code, resp.get("message", "lease release failed"))
+        from . import lease as lease_mod
+
+        ns = lease_mod.LEASE_NAMESPACE
+        with self._lease_lock:
+            lease_mod.release(
+                lambda: self.get_lease_object(ns, name),
+                lambda obj: self._update_lease(ns, name, obj),
+                holder,
+                time.time(),
+            )
 
     def healthz(self) -> bool:
         try:

@@ -1,0 +1,198 @@
+"""Kubeconfig / in-cluster discovery — the real-cluster edge of the HTTP
+boundary.
+
+The reference gets this for free from ``Client::try_default()``
+(``src/main.rs:130``): kubeconfig discovery ($KUBECONFIG → ~/.kube/config),
+TLS against the cluster CA, bearer/client-cert auth, and the in-cluster
+serviceaccount fallback.  This module reproduces that resolution chain for
+:class:`~tpu_scheduler.runtime.http_api.KubeApiClient` using only the
+stdlib + PyYAML:
+
+  * ``load_kubeconfig`` — parse a kubeconfig, resolve the chosen (or
+    current) context to (server, token, ssl.SSLContext);
+  * ``client_from_kubeconfig`` — ``try_default()``: explicit path →
+    $KUBECONFIG → ~/.kube/config → in-cluster serviceaccount.
+
+Supported auth: bearer ``token`` / ``tokenFile``, client certificates
+(``client-certificate(-data)`` + ``client-key(-data)``), cluster CA
+(``certificate-authority(-data)``), ``insecure-skip-tls-verify``.
+Exec-plugin credential helpers are out of scope (raise with a clear
+message) — they spawn arbitrary binaries, which a scheduler sidecar should
+not do implicitly.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import ssl
+import tempfile
+
+__all__ = ["KubeconfigError", "load_kubeconfig", "client_from_kubeconfig"]
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeconfigError(Exception):
+    """Unusable kubeconfig: missing file, unknown context, bad references."""
+
+
+def _named(seq, name: str, what: str) -> dict:
+    for item in seq or []:
+        if item.get("name") == name:
+            return item.get(what) or {}
+    raise KubeconfigError(f"kubeconfig references unknown {what} {name!r}")
+
+
+def _material(entry: dict, key: str, tmpdir: list) -> str | None:
+    """Resolve ``{key}`` (a path) or ``{key}-data`` (inline base64) to a
+    filesystem path — ssl's loaders want files, so inline data lands in a
+    private tempdir that lives as long as the returned client."""
+    data = entry.get(f"{key}-data")
+    if data:
+        if not tmpdir:
+            d = tempfile.TemporaryDirectory(prefix="tpu-sched-kubeconfig-")
+            tmpdir.append(d)
+        path = os.path.join(tmpdir[0].name, key.replace("-", "_"))
+        with open(path, "wb") as f:
+            f.write(base64.b64decode(data))
+        return path
+    return entry.get(key)
+
+
+def load_kubeconfig(path: str, context: str | None = None):
+    """Parse ``path`` and resolve ``context`` (default: current-context).
+
+    Returns (server_url, token, ssl_context_or_None, keepalive) —
+    ``keepalive`` holds the tempdir backing any inline cert material and
+    must stay referenced while the connection is in use."""
+    import yaml
+
+    try:
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+    except OSError as e:
+        raise KubeconfigError(f"cannot read kubeconfig {path!r}: {e}") from e
+
+    ctx_name = context or cfg.get("current-context")
+    if not ctx_name:
+        raise KubeconfigError(f"kubeconfig {path!r} has no current-context and none was given")
+    ctx = _named(cfg.get("contexts"), ctx_name, "context")
+    cluster = _named(cfg.get("clusters"), ctx.get("cluster", ""), "cluster")
+    user = _named(cfg.get("users"), ctx.get("user", ""), "user")
+
+    server = cluster.get("server")
+    if not server:
+        raise KubeconfigError(f"cluster {ctx.get('cluster')!r} has no server URL")
+    if "exec" in user:
+        raise KubeconfigError("exec credential plugins are not supported; use a token or client certificate")
+
+    token = user.get("token")
+    token_provider = None
+    if not token and user.get("tokenFile"):
+        # Re-read per use: bound serviceaccount tokens rotate (~1 h); a
+        # static copy turns into permanent 401s in a daemon.
+        token_provider = _file_token_provider(user["tokenFile"])
+        token_provider()  # fail fast on an unreadable file
+
+    keepalive: list = []
+    ssl_ctx = None
+    if server.startswith("https"):
+        ssl_ctx = ssl.create_default_context()
+        ca = _material(cluster, "certificate-authority", keepalive)
+        if ca:
+            ssl_ctx.load_verify_locations(cafile=ca)
+        if cluster.get("insecure-skip-tls-verify"):
+            ssl_ctx.check_hostname = False
+            ssl_ctx.verify_mode = ssl.CERT_NONE
+        cert = _material(user, "client-certificate", keepalive)
+        key = _material(user, "client-key", keepalive)
+        if cert:
+            ssl_ctx.load_cert_chain(certfile=cert, keyfile=key)
+    return server, token or token_provider, ssl_ctx, keepalive
+
+
+def _file_token_provider(path: str):
+    """() -> token, re-reading ``path`` with a short cache (rotation-safe
+    without a stat per request burst)."""
+    state = {"t": 0.0, "token": None}
+
+    def provider():
+        import time
+
+        now = time.monotonic()
+        if state["token"] is None or now - state["t"] > 60.0:
+            try:
+                with open(path) as f:
+                    state["token"] = f.read().strip()
+            except OSError as e:
+                if state["token"] is None:
+                    raise KubeconfigError(f"cannot read token file {path!r}: {e}") from e
+                # keep serving the last good token on a transient read error
+            state["t"] = now
+        return state["token"]
+
+    return provider
+
+
+def _in_cluster():
+    """Serviceaccount fallback (the pod-mounted credentials kube injects).
+    The token is a rotating projected token — re-read, never cached
+    statically."""
+    token_path = os.path.join(SERVICEACCOUNT_DIR, "token")
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    if not host or not os.path.exists(token_path):
+        return None
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    ssl_ctx = ssl.create_default_context()
+    ca_path = os.path.join(SERVICEACCOUNT_DIR, "ca.crt")
+    if os.path.exists(ca_path):
+        ssl_ctx.load_verify_locations(cafile=ca_path)
+    return f"https://{host}:{port}", _file_token_provider(token_path), ssl_ctx, []
+
+
+def client_from_kubeconfig(path: str | None = None, context: str | None = None, timeout: float = 10.0):
+    """``Client::try_default()`` (reference ``main.rs:130``): explicit path →
+    $KUBECONFIG → ~/.kube/config → in-cluster serviceaccount.  Returns a
+    ready :class:`KubeApiClient`."""
+    import http.client
+    from urllib.parse import urlparse
+
+    from .http_api import KubeApiClient
+
+    resolved = None
+    if path:
+        candidates = [path]
+    else:
+        # $KUBECONFIG is a colon-separated path LIST (kubectl semantics);
+        # client-go merges the files — here the first existing one wins,
+        # which covers the dominant single-file case without a merge engine.
+        env = os.environ.get("KUBECONFIG") or ""
+        candidates = [c for c in env.split(os.pathsep) if c] + [os.path.expanduser("~/.kube/config")]
+    for cand in candidates:
+        if cand and os.path.exists(cand):
+            resolved = load_kubeconfig(cand, context)
+            break
+    if resolved is None and not path:
+        resolved = _in_cluster()
+    if resolved is None:
+        tried = " -> ".join(str(c) for c in candidates if c) or "<none>"
+        raise KubeconfigError(f"no kubeconfig found (tried {tried}) and not running in-cluster")
+    server, token, ssl_ctx, keepalive = resolved
+    token_provider = token if callable(token) else None
+    static_token = None if callable(token) else token
+
+    parsed = urlparse(server)
+    host = parsed.hostname or "127.0.0.1"
+    port = parsed.port or (443 if parsed.scheme == "https" else 80)
+    if parsed.scheme == "https":
+        factory = lambda: http.client.HTTPSConnection(host, port, timeout=timeout, context=ssl_ctx)  # noqa: E731
+    else:
+        factory = lambda: http.client.HTTPConnection(host, port, timeout=timeout)  # noqa: E731
+    # KubeApiClient keeps the server URL's PATH prefix (proxied apiservers:
+    # kubectl proxy, rancher /k8s/clusters/X) and prepends it per request.
+    client = KubeApiClient(
+        server, token=static_token, timeout=timeout, connection_factory=factory, token_provider=token_provider
+    )
+    client._kubeconfig_keepalive = keepalive  # pin inline cert tempdir to the client's lifetime
+    return client
