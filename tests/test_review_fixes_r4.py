@@ -328,9 +328,9 @@ def test_equal_priority_levels_coalesce_segments():
     calls = []
     orig = sched._schedule_batch
 
-    def counting(batch_snapshot, placed, with_constraints=False):
+    def counting(batch_snapshot, placed, with_constraints=False, **kw):
         calls.append((len(batch_snapshot.pending_pods()), with_constraints))
-        return orig(batch_snapshot, placed, with_constraints=with_constraints)
+        return orig(batch_snapshot, placed, with_constraints=with_constraints, **kw)
 
     sched._schedule_batch = counting
     # Tensor-constraint path: ONE batch over all 12 pods, constraints attached.
@@ -348,7 +348,7 @@ def test_equal_priority_levels_coalesce_segments():
     calls2 = []
     orig2 = sched2._schedule_batch
 
-    def counting2(batch_snapshot, placed, with_constraints=False):
+    def counting2(batch_snapshot, placed, with_constraints=False, **kw):
         if with_constraints:
             raise UntensorizableConstraints("forced by test")
         calls2.append(len(batch_snapshot.pending_pods()))

@@ -737,7 +737,11 @@ class Scheduler:
         return bound, unsched, rounds
 
     def _schedule_batch(
-        self, batch_snapshot: ClusterSnapshot, placed: list[tuple[Pod, Node]], with_constraints: bool = False
+        self,
+        batch_snapshot: ClusterSnapshot,
+        placed: list[tuple[Pod, Node]],
+        with_constraints: bool = False,
+        mopup_candidates: set[str] | None = None,
     ) -> tuple[int, int, int]:
         """Pack + solve + bind one batch of plain pending pods; successful
         placements append to ``placed``.  Returns (bound, unschedulable,
@@ -747,6 +751,10 @@ class Scheduler:
         spread tensors (ops/constraints.py) so constrained pods ride the
         batch path; raises UntensorizableConstraints when the structure
         exceeds the tensor budgets (caller falls back to the host phase).
+        ``mopup_candidates`` (full names) are the constraint-AFFECTED
+        pending pods (_split_affinity_pending's classification: declarers
+        plus direction-B anti-affinity matches) — the residue subset the
+        stall mop-up re-tries sequentially.
         """
         with span("pack"):
             packed = self._pack(batch_snapshot)
@@ -768,9 +776,81 @@ class Scheduler:
                     self.metrics.inc("scheduler_constraint_tensor_cycles_total")
         with span("solve"):
             result = self._solve_gang_aware(packed, batch_snapshot)
+        mop_bound = mop_unsched = 0
+        if with_constraints and packed.constraints is not None and result.unschedulable:
+            with span("mopup"):
+                result, mop_bound, mop_unsched = self._constraint_stall_mopup(
+                    batch_snapshot, result, placed, mopup_candidates or set()
+                )
         with span("bind"):
             bound, unsched = self._bind_result(batch_snapshot, result, placed)
-        return bound, unsched, result.rounds
+        return bound + mop_bound, unsched + mop_unsched, result.rounds
+
+    # Sequential mop-up budget: the residue of a stall-stopped constraint
+    # auction is small by construction (stall-stop fires when rounds stop
+    # accepting, not when demand exceeds capacity), but a genuinely
+    # over-subscribed constrained cluster can leave thousands unschedulable —
+    # the exhaustive scalar pass is host-side Python, so its work is capped
+    # to the highest-priority declarers.
+    MOPUP_MAX = 256
+
+    def _constraint_stall_mopup(
+        self, batch_snapshot: ClusterSnapshot, result, placed: list, candidates: set[str]
+    ):
+        """Sequential completeness pass over a constraint auction's residue
+        (VERDICT r3 #7).  The auction stops after STALL_ROUNDS consecutive
+        zero-acceptance rounds (ops/assign.py) — a time/progress trade that
+        can requeue pods the sequential host oracle would still place (the
+        within-round conflict filter defers conservatively by rank; three
+        jitter re-rolls are not a completeness proof).  Here the exact
+        sequential chain (_run_constrained_phase) re-tries the residue's
+        constraint-AFFECTED pods (``candidates``: declarers plus pods
+        matched by anti-affinity terms — the filter can defer either kind)
+        against the cycle's final state: every pod it places was
+        stall-stopped, every pod it refuses is genuinely infeasible —
+        quantifying the gap the stall heuristic opened
+        (scheduler_stall_mopup_* metrics) and closing it in the same cycle.
+        Unaffected residue pods are untouched: only the constraint filter
+        defers feasible pods, so an unaffected unschedulable is already
+        proof of infeasibility."""
+        pod_by_full = {full_name(p): p for p in batch_snapshot.pending_pods()}
+        declarers = []
+        passthrough = []
+        for pod_full in result.unschedulable:
+            pod = pod_by_full.get(pod_full)
+            spec = pod.spec if pod is not None else None
+            # Gang admission is all-or-nothing; the gang engine owns it.
+            if pod is not None and spec is not None and not spec.gang and pod_full in candidates:
+                declarers.append(pod)
+            else:
+                passthrough.append(pod_full)
+        if not declarers:
+            return result, 0, 0
+        declarers.sort(key=_pod_priority, reverse=True)
+        if len(declarers) > self.MOPUP_MAX:
+            passthrough.extend(full_name(p) for p in declarers[self.MOPUP_MAX :])
+            declarers = declarers[: self.MOPUP_MAX]
+        # The sequential phase must see the auction's accepted placements as
+        # consumed capacity/domain state; they are not in ``placed`` yet
+        # (binding happens after), so seed a working copy.
+        node_by_name = {n.name: n for n in batch_snapshot.nodes}
+        seeded = list(placed)
+        for pod_full, node_name in result.bindings:
+            pod_obj, node_obj = pod_by_full.get(pod_full), node_by_name.get(node_name)
+            if pod_obj is not None and node_obj is not None:
+                seeded.append((pod_obj, node_obj))
+        self.metrics.inc("scheduler_stall_mopup_attempted_total", len(declarers))
+        seeded_len = len(seeded)
+        bound, unsched = self._run_constrained_phase(batch_snapshot, declarers, seeded)
+        placed.extend(seeded[seeded_len:])  # mop-up placements are cycle placements
+        if bound:
+            self.metrics.inc("scheduler_stall_mopup_bound_total", bound)
+            logger.info(
+                "constraint stall mop-up placed %d/%d residue pods the auction requeued", bound, len(declarers)
+            )
+        # _run_constrained_phase binds + marks its own failures; the caller
+        # must not re-mark the pods it handled.
+        return replace(result, unschedulable=passthrough), bound, unsched
 
     def _run_batch_cycle(self, snapshot: ClusterSnapshot, trace: Trace) -> tuple[int, int, int]:
         pending = snapshot.pending_pods()
@@ -802,7 +882,10 @@ class Scheduler:
         from ..ops.constraints import UntensorizableConstraints
 
         try:
-            return self._schedule_batch(snapshot, placed, with_constraints=True)
+            return self._schedule_batch(
+                snapshot, placed, with_constraints=True,
+                mopup_candidates={full_name(p) for p in constrained},
+            )
         except UntensorizableConstraints as e:
             logger.info("constraints not tensorizable (%s); host sequential fallback", e)
             self.metrics.inc("scheduler_constraint_host_fallbacks_total")
