@@ -11,7 +11,6 @@ check: lint test
 lint:
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
 	$(PY) scripts/lint.py
-	@if command -v ruff >/dev/null 2>&1; then ruff check tpu_scheduler tests scripts; else echo "ruff not installed; stdlib gate only"; fi
 
 test:
 	$(PY) -m pytest tests/ -x -q

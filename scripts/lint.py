@@ -3,10 +3,18 @@
 with (dead exports, stale imports) plus basic hygiene, implemented on the
 stdlib so the gate runs in the build image (which carries no linter).
 
-Checks (all hard failures):
+Checks (all hard failures) — the whole lint policy lives HERE; every rule
+named in pyproject.toml executes on every `make check` (no config for
+linters the image cannot run):
   F401  imported name never used in the module (``__init__.py`` re-exports
         listed in ``__all__`` are exempt)
   F822  ``__all__`` names a symbol the module does not define
+  F841  local variable assigned once and never read (conservative: plain
+        name targets only; ``_``-prefixed and tuple-unpacked names exempt —
+        unpacking documents structure)
+  E711  comparison to None with ==/!= (use is / is not)
+  E712  comparison to True/False with ==/!= (use the value or is)
+  B006  mutable default argument (list/dict/set literal or call)
   DEAD  a non-underscore symbol in a module's ``__all__`` that no other file
         in the package, tests, bench, or entry scripts references (the
         round-2 'three dead soft scorers' class)
@@ -95,6 +103,100 @@ def top_level_defs(tree: ast.Module) -> set[str]:
     return names
 
 
+class FunctionScopeChecks(ast.NodeVisitor):
+    """Per-function rules: F841 unused locals, B006 mutable defaults."""
+
+    def __init__(self, relpath: str, errors: list[str]):
+        self.relpath = relpath
+        self.errors = errors
+
+    def _check_function(self, node):
+        # B006 — mutable literals/constructors as parameter defaults.
+        for default in list(node.args.defaults) + [d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in ("list", "dict", "set")
+            ):
+                self.errors.append(f"{self.relpath}:{default.lineno}: B006 mutable default argument")
+        # F841 — plain-name single assignments never read in the function.
+        # STORES are collected from this function's OWN scope only (nested
+        # function bodies get their own visit — walking them here would
+        # double-report their dead stores against the outer scope); READS
+        # come from the full walk so a closure's use of an outer local still
+        # counts (conservative: an inner local shadowing an outer name can
+        # mask an outer dead store — false negatives over false positives).
+        def own_scope(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from own_scope(child)
+
+        assigned: dict[str, int] = {}
+        read: set[str] = set()
+        exempt: set[str] = set()
+        for sub in ast.walk(node):
+            if sub is node:
+                continue
+            if isinstance(sub, ast.AugAssign) and isinstance(sub.target, ast.Name):
+                # x += v mutates x in place — a use, not a dead store (the
+                # ledger-accumulator pattern).
+                read.add(sub.target.id)
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                read.add(sub.id)
+        for sub in own_scope(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                assigned.setdefault(sub.id, sub.lineno)
+            # global/nonlocal writes are module/outer-scope effects, and
+            # loop induction variables are iteration plumbing (ruff would
+            # file them under B007) — neither is an unused LOCAL.
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                exempt.update(sub.names)
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
+            elif isinstance(sub, ast.comprehension):
+                exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
+            elif isinstance(sub, ast.Assign):
+                # Tuple-unpack targets document structure — exempt them.
+                for t in sub.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        exempt.update(n.id for n in ast.walk(t) if isinstance(n, ast.Name))
+        args = {a.arg for a in node.args.args + node.args.kwonlyargs + node.args.posonlyargs}
+        for name, lineno in sorted(assigned.items(), key=lambda kv: kv[1]):
+            if name in read or name in exempt or name in args or name.startswith("_"):
+                continue
+            if name in ("self", "cls"):
+                continue
+            self.errors.append(f"{self.relpath}:{lineno}: F841 local variable '{name}' assigned but never used")
+
+    def visit_FunctionDef(self, node):
+        self._check_function(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def comparison_checks(tree: ast.Module, relpath: str, errors: list[str]) -> None:
+    """E711 (== None) / E712 (== True/False) — either side of the ==."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        # Operand i of op i is left for i == 0, else comparators[i-1]; check
+        # both sides so Yoda comparisons (None == x) are caught too.
+        operands = [node.left] + list(node.comparators)
+        for i, op in enumerate(node.ops):
+            if not isinstance(op, (ast.Eq, ast.NotEq)):
+                continue
+            for side in (operands[i], operands[i + 1]):
+                if not isinstance(side, ast.Constant):
+                    continue
+                if side.value is None:
+                    errors.append(f"{relpath}:{node.lineno}: E711 comparison to None (use 'is'/'is not')")
+                elif side.value is True or side.value is False:
+                    errors.append(f"{relpath}:{node.lineno}: E712 comparison to {side.value} (use the value or 'is')")
+
+
 def main(argv: list[str]) -> int:
     files = iter_py(argv or DEFAULT_PATHS)
     errors: list[str] = []
@@ -138,6 +240,9 @@ def main(argv: list[str]) -> int:
         for name in exported:
             if name not in defined:
                 errors.append(f"{f.relative_to(ROOT)}:1: F822 undefined name '{name}' in __all__")
+        relpath = str(f.relative_to(ROOT))
+        FunctionScopeChecks(relpath, errors).visit(tree)
+        comparison_checks(tree, relpath, errors)
 
     # DEAD: exported but referenced nowhere else in the repo
     pkg_files = [f for f in files if f.suffix == ".py"]

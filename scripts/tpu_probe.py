@@ -31,7 +31,7 @@ def main():
             import jax.numpy as jnp
 
             x = jnp.ones((256, 256), dtype=jnp.bfloat16)
-            y = (x @ x).block_until_ready()
+            (x @ x).block_until_ready()
             dt = time.time() - t0
             write(
                 {

@@ -198,7 +198,7 @@ def test_election_algorithm_unit():
 
         return json.loads(json.dumps(x)) if x is not None else None
 
-    def make_cas(fail_next=[False]):
+    def make_cas():
         def create(obj):
             if "l" in store:
                 return False
@@ -223,7 +223,6 @@ def test_election_algorithm_unit():
     assert lm.try_acquire_or_renew(get, create, update, holder="b", now=126.0, **kw)  # expired takeover
     assert store["l"]["spec"]["leaseTransitions"] == 1
     # lost race: another writer bumps rv between GET and PUT
-    snapshot = get()
 
     def racing_update(obj):
         store["l"]["metadata"]["resourceVersion"] = "99"  # concurrent writer

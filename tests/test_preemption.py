@@ -211,7 +211,7 @@ def test_preemption_sees_pipelined_dispatches():
         ],
     )
     sched = Scheduler(api, NativeBackend(), profile=PREEMPT, requeue_seconds=0.0, pipeline=True)
-    m = sched.run_cycle()
+    sched.run_cycle()
     sched.run(until_settled=True, max_cycles=3)
     bound = [p for p in api.list_pods() if p.spec.node_name]
     assert len(bound) == 1

@@ -179,7 +179,7 @@ def test_daemon_mode_stop_event():
         return orig()
 
     sched.run_cycle = counting
-    out = sched.run(daemon_interval=0.01, stop_event=stop)
+    sched.run(daemon_interval=0.01, stop_event=stop)
     assert calls["n"] == 3
 
 

@@ -121,9 +121,7 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
     only) adds the anti-affinity/spread blocked-node matmuls.
     """
     if pallas_pack is not None:
-        from .pallas_choose import choose_block_pallas
-
-        from .pallas_choose import constrained_kernel_pod_operands
+        from .pallas_choose import choose_block_pallas, constrained_kernel_pod_operands
 
         node_info, labels_t, taints_t, aff_t, pref_t, taints_soft_t, interpret, cons_node = pallas_pack
         cons_pod = cons_node_args = None
@@ -394,7 +392,6 @@ def assign_cycle(
     ``cons_pod``/``cons_node``), while accept/commit stay in jnp.
     """
     p_out = pods["pod_req"].shape[0]
-    n = nodes["node_avail"].shape[0]
     perm, ps = _prepare_pods(pods, block)
     p = ps["pod_req"].shape[0]
     if cmeta is not None:

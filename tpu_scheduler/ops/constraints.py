@@ -762,7 +762,6 @@ def constraint_commit(
 ) -> dict:
     """Fold the round's final accepted placements into the domain state."""
     ndc = meta["node_dom_c"]
-    d = ndc.shape[1]
     n = ndc.shape[0]
     t = meta["term_uses_dom"].shape[0]
     nd = ndc[choice]
