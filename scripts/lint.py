@@ -128,7 +128,11 @@ class FunctionScopeChecks(ast.NodeVisitor):
         # mask an outer dead store — false negatives over false positives).
         def own_scope(n):
             for child in ast.iter_child_nodes(n):
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                # Nested functions/lambdas AND class bodies are their own
+                # scopes — a class attribute is not a function local (it is
+                # read via ast.Attribute, which never registers as a Name
+                # Load, so walking it would hard-fail valid code).
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
                     continue
                 yield child
                 yield from own_scope(child)
@@ -157,6 +161,12 @@ class FunctionScopeChecks(ast.NodeVisitor):
                 exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
             elif isinstance(sub, ast.comprehension):
                 exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
+            elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                # `with ... as x:` targets are context handles pyflakes/ruff
+                # never file under F841 (e.g. pytest.raises(...) as exc).
+                for item in sub.items:
+                    if item.optional_vars is not None:
+                        exempt.update(n.id for n in ast.walk(item.optional_vars) if isinstance(n, ast.Name))
             elif isinstance(sub, ast.Assign):
                 # Tuple-unpack targets document structure — exempt them.
                 for t in sub.targets:

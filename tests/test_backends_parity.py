@@ -117,4 +117,4 @@ def test_upload_cache_reuses_and_evicts():
     # Some arrays may legitimately outlive the pack (module-level template
     # caches); the contract is: no DEAD entry may keep its device buffer.
     assert len(b._dev_cache) < n_before, "dropping the pack must evict buffers"
-    assert all(r() is not None for r, _ in b._dev_cache.values()), "dead entries must be evicted immediately"
+    assert all(r() is not None for r, _, _f in b._dev_cache.values()), "dead entries must be evicted immediately"

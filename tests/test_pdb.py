@@ -223,13 +223,15 @@ def test_explicit_empty_selector_matches_all():
     assert not back.match_labels and not back.match_expressions
 
 
-def test_user_scale_down_does_not_zero_budget():
-    """Review repro: a user deleting replicas (no preemption involved) is
-    not a disruption THIS scheduler inflicted — the maxUnavailable budget
-    must remain spendable."""
+def test_externally_degraded_workload_blocks_preemption():
+    """Round-3 advisor repro: a workload already down a replica from
+    EXTERNAL causes (crash, node loss — no eviction of ours) has no
+    disruption budget left; preempting it to maxUnavailable anyway would
+    violate what kube (desired-replica accounting) permits.  Peak observed
+    healthy is the desired proxy: deficit = peak − healthy."""
     api = FakeApiServer()
     api.load(
-        nodes=[make_node("n1", cpu="2", memory="16Gi"), make_node("n2", cpu="2", memory="16Gi"), make_node("n3", cpu="2", memory="16Gi")],
+        nodes=[make_node(f"n{i+1}", cpu="2", memory="16Gi", labels={"slot": str(i + 1)}) for i in range(3)],
         pods=[
             make_pod(f"db-{i}", cpu="2", labels={"app": "db"}, node_name=f"n{i+1}", phase="Running", priority=0)
             for i in range(3)
@@ -237,12 +239,53 @@ def test_user_scale_down_does_not_zero_budget():
         pdbs=[_pdb("db-pdb", {"app": "db"}, max_unavailable=1)],
     )
     sched = _preempting_sched(api)
-    sched.run_cycle()  # establishes ledger state (healthy=3, outstanding=0)
-    api.delete_pod("default", "db-2")  # user scales down
-    sched.run_cycle()
-    api.create_pod(make_pod("urgent", cpu="2", priority=100))
+    sched.run_cycle()  # establishes peak healthy = 3
+    api.delete_pod("default", "db-2")  # replica crashes (not our eviction)
+    # Pinned to n1 (slot=1): the crash-freed n3 cannot host it, so only
+    # preemption of the protected db-0 could bind it.
+    api.create_pod(make_pod("urgent", cpu="2", priority=100, node_selector={"slot": "1"}))
     m = sched.run_cycle()
-    assert m.bound == 1, "the scheduler's own budget is unspent; preemption must proceed"
+    assert m.bound == 0, "budget is consumed by the external degradation; never violate"
+    assert sum(1 for p in api.list_pods() if p.metadata.name.startswith("db-")) == 2
+
+    # Replica returns -> deficit clears -> the budget is spendable again.
+    api.create_pod(make_pod("db-2b", cpu="2", labels={"app": "db"}, node_name="n3", phase="Running"))
+    m2 = sched.run_cycle()
+    assert m2.bound == 1, "recovered workload has budget again"
+
+
+def test_scale_down_conservatively_freezes_budget():
+    """The documented deviation of peak-healthy accounting (README, PDB
+    row): without workload controllers there is no desired-replica signal,
+    so an intentional scale-down reads as degradation and FREEZES the
+    budget (under-preempting — the safe direction for never-violate).
+    Recreating the PDB object resets the peak."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node(f"n{i+1}", cpu="2", memory="16Gi", labels={"slot": str(i + 1)}) for i in range(3)],
+        pods=[
+            make_pod(f"db-{i}", cpu="2", labels={"app": "db"}, node_name=f"n{i+1}", phase="Running", priority=0)
+            for i in range(3)
+        ],
+        pdbs=[_pdb("db-pdb", {"app": "db"}, max_unavailable=1)],
+    )
+    sched = _preempting_sched(api)
+    sched.run_cycle()  # peak healthy = 3
+    api.delete_pod("default", "db-2")  # user scales down
+    api.create_pod(make_pod("urgent", cpu="2", priority=100, node_selector={"slot": "1"}))
+    m = sched.run_cycle()
+    assert m.bound == 0  # conservative freeze
+    # The operator's reset: delete the budget, let a cycle observe its
+    # absence (per-budget state prunes), then recreate it — the fresh
+    # budget re-derives its peak from current healthy.  The preemptor is
+    # withdrawn during the window (the workload would be unprotected).
+    api.delete_pod("default", "urgent")
+    api.delete_pdb("default", "db-pdb")
+    sched.run_cycle()
+    api.create_pdb(_pdb("db-pdb", {"app": "db"}, max_unavailable=1))
+    api.create_pod(make_pod("urgent2", cpu="2", priority=100, node_selector={"slot": "1"}))
+    m2 = sched.run_cycle()
+    assert m2.bound == 1, "recreated budget re-derives its peak from current healthy"
 
 
 def test_selector_only_budget_fails_closed():
@@ -288,3 +331,68 @@ def test_pdbs_flow_over_the_http_boundary():
         assert "db-0" in {p.metadata.name for p in api.list_pods()}
     finally:
         server.stop()
+
+
+def test_peak_window_thaws_frozen_budget():
+    """A bygone surge/scale-down stops freezing the budget once the peak
+    window expires: the observed level becomes the new baseline."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node(f"n{i+1}", cpu="2", memory="16Gi", labels={"slot": str(i + 1)}) for i in range(3)],
+        pods=[
+            make_pod(f"db-{i}", cpu="2", labels={"app": "db"}, node_name=f"n{i+1}", phase="Running", priority=0)
+            for i in range(3)
+        ],
+        pdbs=[_pdb("db-pdb", {"app": "db"}, max_unavailable=1)],
+    )
+    sched = _preempting_sched(api)
+    sched.PDB_PEAK_WINDOW = 3  # small window for the test
+    sched.run_cycle()  # peak = 3
+    api.delete_pod("default", "db-2")  # scale-down (reads as degradation)
+    api.create_pod(make_pod("urgent", cpu="2", priority=100, node_selector={"slot": "1"}))
+    m = sched.run_cycle()
+    assert m.bound == 0  # frozen inside the window
+    bound_after = sum(sched.run_cycle().bound for _ in range(4))  # window expires; peak thaws to 2
+    assert bound_after == 1, "expired peak window must re-open the budget"
+    assert sum(1 for p in api.list_pods() if p.metadata.name.startswith("db-")) == 1
+
+
+def test_pdb_ledger_survives_restart(tmp_path):
+    """The peak/debt ledger checkpoints: a successor must not baseline a
+    crashed workload at its degraded count and spend budget kube forbids."""
+    from tpu_scheduler.runtime.checkpoint import restore_scheduler, save_scheduler
+
+    def build_api(include_crashed):
+        api = FakeApiServer()
+        db = [
+            make_pod(f"db-{i}", cpu="2", labels={"app": "db"}, node_name=f"n{i+1}", phase="Running", priority=0)
+            for i in range(3)
+        ]
+        if not include_crashed:
+            db = db[:2]
+        api.load(
+            nodes=[make_node(f"n{i+1}", cpu="2", memory="16Gi", labels={"slot": str(i + 1)}) for i in range(3)],
+            pods=db,
+            pdbs=[_pdb("db-pdb", {"app": "db"}, max_unavailable=1)],
+        )
+        return api
+
+    api = build_api(include_crashed=True)
+    s1 = _preempting_sched(api)
+    s1.run_cycle()  # observes peak = 3
+    save_scheduler(s1, str(tmp_path))
+
+    # Restart against a cluster where db-2 has crashed (healthy = 2).
+    api2 = build_api(include_crashed=False)
+    api2.create_pod(make_pod("urgent", cpu="2", priority=100, node_selector={"slot": "1"}))
+    s2 = _preempting_sched(api2)
+    assert restore_scheduler(s2, str(tmp_path))
+    m = s2.run_cycle()
+    assert m.bound == 0, "restored peak must block preemption of the degraded workload"
+
+    # Control: an un-restored successor baselines at 2 and would preempt.
+    api3 = build_api(include_crashed=False)
+    api3.create_pod(make_pod("urgent", cpu="2", priority=100, node_selector={"slot": "1"}))
+    s3 = _preempting_sched(api3)
+    m3 = s3.run_cycle()
+    assert m3.bound == 1

@@ -368,16 +368,20 @@ def test_cache_drop_covers_shards_and_dedups_finalizers():
     b._drop_dev_cache()
     assert len(b._dev_cache) == 0 and len(shard._dev_cache) == 0
 
-    # re-upload the SAME arrays: finalizer registry must not grow
+    # re-upload the SAME arrays after the drop: the drop detached the old
+    # finalizers, so each cache entry carries exactly one LIVE finalizer
+    # bound to a live array (the per-weakref design — no id-keyed registry
+    # to stack or stale-block).
     b.schedule(packed, DEFAULT_PROFILE)
-    n_keys = len(b._finalizer_keys)
+    n_entries = len(b._dev_cache)
     b._drop_dev_cache()
     b.schedule(packed, DEFAULT_PROFILE)
-    assert len(b._finalizer_keys) == n_keys, "finalizers must not stack per failure"
+    assert len(b._dev_cache) == n_entries, "cache must rebuild to the same entry set"
+    assert all(ent[2].alive and ent[0]() is not None for ent in b._dev_cache.values())
     del packed
     gc.collect()
     # Some arrays legitimately outlive the pack (module-level template
-    # caches); the contract is: every REMAINING registered key belongs to a
-    # live cached array — dead arrays left the registry.
-    assert len(b._finalizer_keys) < n_keys, "dead arrays must leave the registry"
-    assert all(k in b._dev_cache and b._dev_cache[k][0]() is not None for k in b._finalizer_keys)
+    # caches); the contract is: every REMAINING entry belongs to a live
+    # array — dead arrays' finalizers evicted theirs.
+    assert len(b._dev_cache) < n_entries, "dead arrays must leave the cache"
+    assert all(ent[0]() is not None for ent in b._dev_cache.values())

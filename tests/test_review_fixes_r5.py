@@ -1,0 +1,163 @@
+"""Round-3 advisor fixes: upload-cache finalizers keyed per-weakref (id
+reuse safe), the repack alloc-side scale guard, and NoExecute grace clocks
+surviving checkpoint/restore."""
+
+import weakref
+
+import numpy as np
+import pytest
+
+from tpu_scheduler.api.objects import Taint, Toleration
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.backends.tpu import TpuBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+
+# --- upload-cache finalizer per weakref --------------------------------------
+
+
+class _Arr:  # weakref-able stand-in for a host array
+    pass
+
+
+def test_evict_ignores_id_reused_entry():
+    """A finalizer firing AFTER its id was recycled to a new cached array
+    must not evict the new owner's entry (the stored weakref's identity is
+    the discriminator, not the id)."""
+    b = TpuBackend(use_pallas=False)
+    a1, a2 = _Arr(), _Arr()
+    # Simulate: id K was cached for a1 (now dead in the story), then reused
+    # for a2's entry.  a1's late finalizer carries a1's weakref.
+    key = 12345
+    wr1, wr2 = weakref.ref(a1), weakref.ref(a2)
+    fin2 = weakref.finalize(a2, lambda: None)
+    b._dev_cache[key] = (wr2, "buf2", fin2)
+    b._evict(key, wr1)  # stale finalizer: wrong weakref -> no-op
+    assert key in b._dev_cache
+    b._evict(key, wr2)  # the entry's own finalizer evicts
+    assert key not in b._dev_cache
+
+
+def test_put_detaches_stale_finalizer_on_id_reuse():
+    """Overwriting an id-reused entry detaches the old entry's finalizer so
+    a late fire cannot pin or evict the new owner's buffer."""
+    b = TpuBackend(use_pallas=False)
+    old_owner = _Arr()
+    old_fin = weakref.finalize(old_owner, lambda: None)
+    arr = np.arange(8)
+    b._dev_cache[id(arr)] = (weakref.ref(old_owner), "stale-buf", old_fin)
+    buf = b._put(arr)
+    assert not old_fin.alive, "stale finalizer must be detached on overwrite"
+    ent = b._dev_cache[id(arr)]
+    assert ent[1] is buf and ent[0]() is arr and ent[2].alive
+
+
+def test_dead_array_evicts_its_entry():
+    b = TpuBackend(use_pallas=False)
+    arr = np.arange(16)
+    b._put(arr)
+    key = id(arr)
+    assert key in b._dev_cache
+    del arr
+    import gc
+
+    gc.collect()
+    assert key not in b._dev_cache, "finalizer must evict the dead array's buffer"
+
+
+def test_drop_dev_cache_detaches_finalizers():
+    b = TpuBackend(use_pallas=False)
+    arr = np.arange(16)
+    b._put(arr)
+    fin = b._dev_cache[id(arr)][2]
+    b._drop_dev_cache()
+    assert not fin.alive and not b._dev_cache
+    # Re-upload of the still-alive array registers a fresh finalizer.
+    b._put(arr)
+    assert b._dev_cache[id(arr)][2].alive
+
+
+# --- repack alloc-side scale guard -------------------------------------------
+
+
+def test_repack_raises_when_extended_alloc_outgrows_scale():
+    """round-3 advisor: a node update pushing an EXTENDED allocatable past
+    INT32_MAX at the frozen divisor must force a full pack (which re-derives
+    the divisor), not silently saturate capacity."""
+    from dataclasses import replace as dc_replace
+
+    from tpu_scheduler.api.objects import NodeStatus
+    from tpu_scheduler.core.snapshot import ClusterSnapshot
+    from tpu_scheduler.ops.pack import INT32_MAX, pack_snapshot, repack_avail, repack_incremental
+
+    nodes = [make_node("n0", cpu="8", memory="32Gi", extended={"example.com/chips": 4})]
+    pods = [make_pod("p0", extended={"example.com/chips": 1})]
+    snap = ClusterSnapshot.build(nodes, pods)
+    packed = pack_snapshot(snap)
+    assert packed.res_scales[2] == 1  # small values: divisor 1
+
+    grown = dc_replace(
+        nodes[0],
+        status=NodeStatus(allocatable={"cpu": "8", "memory": "32Gi", "example.com/chips": int(INT32_MAX) + 10}),
+    )
+    snap2 = ClusterSnapshot.build([grown], pods)
+    with pytest.raises(ValueError, match="outgrown by node allocatable"):
+        repack_avail(packed, snap2)
+    with pytest.raises(ValueError, match="outgrown by node allocatable"):
+        repack_incremental(packed, snap2)
+    # The full pack cures it by re-deriving the divisor.
+    repacked = pack_snapshot(snap2)
+    assert repacked.res_scales[2] > 1
+
+
+# --- NoExecute clocks survive checkpoint/restore -----------------------------
+
+
+def test_noexecute_clock_survives_restart(tmp_path):
+    """round-3 advisor: a scheduler restart must NOT grant tolerating pods a
+    fresh tolerationSeconds window — the first-seen timestamps persist in
+    the checkpoint, so the eviction deadline holds across hand-offs."""
+    from tpu_scheduler.runtime.checkpoint import restore_scheduler, save_scheduler
+
+    taint = Taint(key="maint", value="drain", effect="NoExecute")
+    tol = Toleration(key="maint", operator="Equal", value="drain", effect="NoExecute", toleration_seconds=60)
+    now = [1000.0]
+
+    def build_api():
+        api = FakeApiServer()
+        api.load(
+            nodes=[make_node("n1", cpu="8", memory="32Gi", taints=[taint])],
+            pods=[make_pod("victim", cpu="1", node_name="n1", phase="Running", tolerations=[tol])],
+        )
+        return api
+
+    api = build_api()
+    s1 = Scheduler(api, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    s1.run_cycle()  # grace clock starts at t=1000
+    now[0] = 1040.0
+    s1.run_cycle()  # 40s elapsed, still inside the 60s window
+    assert "victim" in {p.metadata.name for p in api.list_pods()}
+    save_scheduler(s1, str(tmp_path))  # checkpoints 40s ELAPSED
+
+    # Restart (clocks are process-local/monotonic, so the checkpoint stores
+    # elapsed time, like the requeue ledger): the successor inherits the 40s
+    # of progress instead of granting a fresh 60s window.
+    api2 = build_api()
+    s2 = Scheduler(api2, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    assert restore_scheduler(s2, str(tmp_path))
+    s2.run_cycle()
+    assert "victim" in {p.metadata.name for p in api2.list_pods()}  # 40s < 60
+    now[0] = 1065.0  # 65s total since the ORIGINAL first sighting
+    s2.run_cycle()
+    assert "victim" not in {p.metadata.name for p in api2.list_pods()}, (
+        "the restored clock must carry the pre-restart elapsed time"
+    )
+
+    # Control: without the restore, a fresh scheduler resets the window and
+    # keeps the pod at the same instant.
+    api3 = build_api()
+    s3 = Scheduler(api3, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
+    s3.run_cycle()
+    assert "victim" in {p.metadata.name for p in api3.list_pods()}

@@ -89,12 +89,15 @@ class TpuBackend(SchedulingBackend):
         # host array must release its device buffer within the cycle, not
         # after a size threshold (at flagship scale each stale pod pack pins
         # tens of MB of HBM).
-        self._dev_cache: dict[int, tuple[weakref.ref, object]] = {}
+        # Entry: (weakref, device_buf, finalizer).  The finalizer handle
+        # lives IN the entry (not a separate id-keyed set): ids recycle the
+        # moment an array dies, so a set would let a stale finalizer both
+        # block registration for the id's new owner and — firing later —
+        # leave the new owner's buffer pinned until _drop_dev_cache
+        # (round-3 advisor finding).  Eviction compares the stored weakref
+        # object itself, which is unambiguous across id reuse.
+        self._dev_cache: dict[int, tuple[weakref.ref, object, object]] = {}
         self._put_lock = threading.Lock()
-        # Host-array ids that already carry a weakref.finalize for eviction:
-        # re-uploading a still-alive array (e.g. after a failure-triggered
-        # cache drop) must not stack a second finalizer.
-        self._finalizer_keys: set[int] = set()
 
     def _drop_dev_cache(self) -> None:
         """Forget every cached upload — after a device-runtime failure the
@@ -103,18 +106,20 @@ class TpuBackend(SchedulingBackend):
         kills the whole session, so sibling per-device shard backends
         (shard_for) drop theirs too."""
         with self._put_lock:
+            for ent in self._dev_cache.values():
+                ent[2].detach()  # a re-upload registers a fresh finalizer
             self._dev_cache.clear()
         for sh in list(self._shards.values()):
             if sh is not self:
                 sh._drop_dev_cache()
 
-    def _evict(self, key: int) -> None:
+    def _evict(self, key: int, wr: weakref.ref) -> None:
         with self._put_lock:
-            self._finalizer_keys.discard(key)
             ent = self._dev_cache.get(key)
-            # Only drop dead entries: by the time a finalizer runs, the id
-            # may already belong to a NEW cached array (CPython reuses ids).
-            if ent is not None and ent[0]() is None:
+            # Drop only OUR entry: by the time a finalizer runs, the id may
+            # already belong to a NEW cached array (CPython reuses ids) —
+            # the stored weakref's identity disambiguates.
+            if ent is not None and ent[0] is wr:
                 del self._dev_cache[key]
 
     def _put(self, arr):
@@ -129,13 +134,16 @@ class TpuBackend(SchedulingBackend):
             wr = weakref.ref(arr)
         except TypeError:  # non-weakref-able input (e.g. a jax array): skip caching
             return buf
+        fin = weakref.finalize(arr, self._evict, key, wr)
+        fin.atexit = False  # interpreter teardown needs no cache hygiene
         with self._put_lock:
-            if key not in self._finalizer_keys:
-                # One finalizer per live array, ever — a re-upload of the
-                # same array (post-failure) reuses the existing one.
-                weakref.finalize(arr, self._evict, key)
-                self._finalizer_keys.add(key)
-            self._dev_cache[key] = (wr, buf)
+            old = self._dev_cache.get(key)
+            if old is not None and old[0] is not wr:
+                # The id's previous owner died (or this is a re-upload after
+                # a cache drop): detach its finalizer so a late fire cannot
+                # touch the new entry.
+                old[2].detach()
+            self._dev_cache[key] = (wr, buf, fin)
         return buf
 
     def _assign_once(self, packed: PackedCluster, profile: SchedulingProfile, use_pallas: bool):

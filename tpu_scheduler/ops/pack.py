@@ -652,6 +652,20 @@ def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int, res_voca
     )
 
 
+def _check_alloc_within_scales(alloc64: np.ndarray, res_scales: tuple[int, ...]) -> None:
+    """Raise when an EXTENDED allocatable column outgrows the frozen
+    per-column divisor (round-3 advisor): a full pack would re-derive the
+    divisor and stay exact, so silently saturating capacity at INT32_MAX —
+    conservative but imprecise — must instead force that full pack.
+    Extended columns only, mirroring the request-side guard: cpu/memory
+    scales are fixed by contract and keep the documented clamp behavior."""
+    sc = np.asarray(res_scales, dtype=np.int64)
+    if sc.shape[0] > 2 and alloc64.shape[1] > 2:
+        # Capacity floors under the divisor (_avail_i32's rounding).
+        if (np.floor_divide(alloc64[:, 2:], sc[None, 2:]) > INT32_MAX).any():
+            raise ValueError("resource scales outgrown by node allocatable; run a full pack_snapshot instead")
+
+
 def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedCluster:
     """Cheap refresh of ``node_avail`` from a new snapshot over the *same*
     node set — the incremental-update path the reflector uses between full
@@ -664,6 +678,7 @@ def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedClus
     if resource_vocab(snapshot) != packed.res_vocab:
         raise ValueError("resource vocabulary changed; run a full pack_snapshot instead")
     alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_vocab=packed.res_vocab)
+    _check_alloc_within_scales(alloc64, packed.res_scales)
     return replace(packed, node_avail=_avail_i32(alloc64, used64, packed.res_scales))
 
 
@@ -811,6 +826,7 @@ def repack_incremental(
         # full-pack event (the controller catches ValueError and degrades).
         raise ValueError("resource vocabulary changed; run a full pack_snapshot instead")
     alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_memo, packed.res_vocab)
+    _check_alloc_within_scales(alloc64, packed.res_scales)
     pending = snapshot.pending_pods()
     p_pad = max(packed.padded_pods, round_up(len(pending), pod_block))
     # Pod tensor widths come from the NODE side: extend_node_vocabs may have

@@ -47,6 +47,24 @@ def save_scheduler(scheduler, path: str) -> None:
         "counters": dict(scheduler.metrics.counters),
         # monotonic deadlines -> remaining seconds (clamped at 0)
         "requeue_remaining": {k: max(0.0, v - now) for k, v in scheduler.requeue_at.items()},
+        # NoExecute tolerationSeconds clocks as ELAPSED time per
+        # (pod, taint-key, taint-value): restarts/leader hand-offs must not
+        # grant affected pods a fresh grace window (round-3 advisor) — under
+        # periodic restarts a tolerating pod would otherwise never be
+        # evicted.
+        "noexecute_elapsed": [
+            [list(key), max(0.0, now - first)] for key, first in scheduler._noexecute_seen.items()
+        ],
+        # PDB never-violate ledger: a successor baselining a crashed
+        # workload at its degraded count would spend budget kube (desired-
+        # replica accounting) forbids — peaks and disruption debt survive
+        # restarts just like the NoExecute clocks.  Peak ages are stored as
+        # cycles-since-met (cycle counters restore with the checkpoint).
+        "pdb_peaks": {
+            k: [peak, max(0, scheduler._cycle_count - met_at)]
+            for k, (peak, met_at) in scheduler._pdb_peak_healthy.items()
+        },
+        "pdb_disruptions": {k: list(v) for k, v in scheduler._pdb_disruptions.items()},
         "node_sig": [list(pair) for pair in scheduler._node_sig] if scheduler._node_sig else None,
     }
     packed = scheduler._packed
@@ -109,6 +127,13 @@ def restore_scheduler(scheduler, path: str) -> bool:
         scheduler.metrics.counters[name] = value
     now = scheduler.clock()
     scheduler.requeue_at = {k: now + rem for k, rem in state.get("requeue_remaining", {}).items()}
+    scheduler._noexecute_seen = {
+        tuple(key): now - elapsed for key, elapsed in state.get("noexecute_elapsed", [])
+    }
+    scheduler._pdb_peak_healthy = {
+        k: (int(peak), scheduler._cycle_count - int(age)) for k, (peak, age) in state.get("pdb_peaks", {}).items()
+    }
+    scheduler._pdb_disruptions = {k: tuple(v) for k, v in state.get("pdb_disruptions", {}).items()}
     if state.get("node_sig"):
         scheduler._node_sig = tuple((name, rv) for name, rv in state["node_sig"])
 

@@ -74,6 +74,21 @@ def _pod_priority(p: Pod) -> int:
     return p.spec.priority if p.spec is not None else 0
 
 
+def _pdb_matches(pdb, q: Pod) -> bool:
+    """Does a PodDisruptionBudget select pod ``q``?  Shared by the
+    preemption pass and the per-cycle peak-healthy observer."""
+    if (pdb.metadata.namespace or "default") != (q.metadata.namespace or "default"):
+        return False
+    if not pdb.match_labels and not pdb.match_expressions:
+        # policy/v1: an empty selector — absent, None, or an explicit
+        # {} / [] — matches every pod in the namespace (unlike this
+        # codebase's affinity-term deviation, where empty matches
+        # nothing).  Truthiness, not None-ness: a manifest's
+        # `matchLabels: {}` must not silently protect nothing.
+        return True
+    return term_matches(pdb, q.metadata.labels)
+
+
 class Scheduler:
     def __init__(
         self,
@@ -111,6 +126,14 @@ class Scheduler:
         self.reflector = ClusterReflector(api, clock=clock)
         self.metrics = MetricsRegistry()
         self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
+        # Peak observed healthy per budget — the desired-replica proxy the
+        # maxUnavailable deficit uses for externally degraded workloads:
+        # key -> (peak, cycle the peak was last MET).  The peak holds for
+        # PDB_PEAK_WINDOW cycles after the workload last reached it, then
+        # the observed level becomes the new baseline — so a transient
+        # surge (rolling-update overlap) or a scale-down cannot freeze the
+        # budget forever, while a crash keeps it blocked for the window.
+        self._pdb_peak_healthy: dict[str, tuple[int, int]] = {}
         # maxUnavailable PDBs: per-budget ("ns/name") pair of (outstanding
         # disruptions this scheduler inflicted, last observed healthy count)
         # — the controller-free disruption ledger (_attempt_preemption).
@@ -926,6 +949,52 @@ class Scheduler:
             rounds += r
         return bound, unschedulable, rounds
 
+    # A degraded workload's maxUnavailable budget stays blocked this many
+    # cycles past the last time it was at full strength; then the observed
+    # level becomes the new baseline (surge/scale-down thaw; see
+    # _pdb_peak_healthy in __init__ and the README PDB row).
+    PDB_PEAK_WINDOW = 256
+
+    def _update_pdb_peaks(self, snapshot: ClusterSnapshot) -> None:
+        """Per-cycle peak-healthy observation for maxUnavailable budgets —
+        the desired-replica proxy (see _attempt_preemption).  Runs every
+        cycle (standby cycles included — a successor must not baseline a
+        crashed workload at its degraded count) so the proxy sees the
+        workload while it is WHOLE.  Also the one place stale per-budget
+        state (peaks + disruption debt) is pruned: a deleted/recreated
+        budget starts fresh — the operator's immediate reset."""
+        try:
+            pdbs = list(getattr(self.api, "list_pdbs", list)())
+        except (ApiError, OSError, http.client.HTTPException) as e:
+            # API outage: keep last-known peaks/debt (conservative) — the
+            # cycle itself must keep running on cached state (the same
+            # stance as watch errors; tests/test_resilience.py).
+            logger.debug("PDB peak observation skipped (api unavailable: %s)", e)
+            return
+        live: set[str] = set()
+        placed = None
+        for pdb in pdbs:
+            key = f"{pdb.metadata.namespace or 'default'}/{pdb.metadata.name}"
+            live.add(key)
+            if pdb.max_unavailable is None:
+                continue
+            if placed is None:
+                placed = list(snapshot.placed_pods())
+            healthy = sum(1 for q, _qn in placed if _pdb_matches(pdb, q))
+            peak, met_at = self._pdb_peak_healthy.get(key, (healthy, self._cycle_count))
+            if healthy >= peak:
+                peak, met_at = healthy, self._cycle_count
+            elif self._cycle_count - met_at >= self.PDB_PEAK_WINDOW:
+                # The workload has not been back to its peak for a whole
+                # window: accept the new level (thaw) instead of freezing
+                # the budget forever on a bygone surge or scale-down.
+                peak, met_at = healthy, self._cycle_count
+            self._pdb_peak_healthy[key] = (peak, met_at)
+        for k in [k for k in self._pdb_peak_healthy if k not in live]:
+            del self._pdb_peak_healthy[k]
+        for k in [k for k in self._pdb_disruptions if k not in live]:
+            del self._pdb_disruptions[k]
+
     # -- preemption (kube PostFilter; absent in the reference) -------------
 
     def _attempt_preemption(self, snapshot: ClusterSnapshot) -> tuple[int, int]:
@@ -964,42 +1033,33 @@ class Scheduler:
         # would breach a matching budget is not eligible (api/objects.py
         # PodDisruptionBudget for the semantics and kube deviation).
         pdbs = list(getattr(self.api, "list_pdbs", list)())
-
-        def _pdb_matches(pdb, q: Pod) -> bool:
-            if (pdb.metadata.namespace or "default") != (q.metadata.namespace or "default"):
-                return False
-            if not pdb.match_labels and not pdb.match_expressions:
-                # policy/v1: an empty selector — absent, None, or an explicit
-                # {} / [] — matches every pod in the namespace (unlike this
-                # codebase's affinity-term deviation, where empty matches
-                # nothing).  Truthiness, not None-ness: a manifest's
-                # `matchLabels: {}` must not silently protect nothing.
-                return True
-            return term_matches(pdb, q.metadata.labels)
-
         pdb_allow: list[int] = []
-        live_pdb_keys: set[str] = set()
         for pdb in pdbs:
             key = f"{pdb.metadata.namespace or 'default'}/{pdb.metadata.name}"
-            live_pdb_keys.add(key)
             healthy = sum(1 for q, _qn in snapshot.placed_pods() if _pdb_matches(pdb, q))
             try:
                 if pdb.min_available is not None:
                     pdb_allow.append(max(0, healthy - int(pdb.min_available)))
                 elif pdb.max_unavailable is not None:
                     # maxUnavailable needs a desired replica count no
-                    # controller exists to report.  Track OUR outstanding
-                    # disruptions instead: evictions this scheduler inflicted
-                    # count against the budget until replicas return
-                    # (recoveries pay tracked debt down first), so repeated
-                    # passes cannot re-spend the allowance — while a user's
-                    # intentional scale-down (healthy drops with no eviction
-                    # of ours) leaves the budget untouched.
+                    # controller exists to report.  Two proxies combine
+                    # (round-3 advisor): OUR outstanding disruptions (out —
+                    # evictions this scheduler inflicted, paid down as
+                    # replicas return) and the workload's EXTERNAL
+                    # degradation (peak observed healthy − healthy: crashes,
+                    # node loss).  The deficit is their max, not sum — an
+                    # eviction of ours eventually shows up in healthy too,
+                    # and counting it twice would freeze the budget.  Known
+                    # deviation: an intentional scale-down reads as
+                    # degradation until the peak ages out with the budget
+                    # object (documented beside the PDB row in README.md).
                     out, prev = self._pdb_disruptions.get(key, (0, healthy))
                     if healthy > prev:
                         out = max(0, out - (healthy - prev))
                     self._pdb_disruptions[key] = (out, healthy)
-                    pdb_allow.append(max(0, int(pdb.max_unavailable) - out))
+                    peak, _met_at = self._pdb_peak_healthy.get(key, (healthy, self._cycle_count))
+                    deficit = max(out, peak - healthy)
+                    pdb_allow.append(max(0, int(pdb.max_unavailable) - deficit))
                 else:
                     # Neither bound set (e.g. a typo'd field dropped by
                     # from_dict): fail CLOSED like any other malformed
@@ -1013,9 +1073,8 @@ class Scheduler:
                 logger.warning("PDB %s has non-integer bound %r/%r; treating as zero disruptions allowed",
                                key, pdb.min_available, pdb.max_unavailable)
                 pdb_allow.append(0)
-        # Deleted/recreated budgets must not inherit stale debt.
-        for k in [k for k in self._pdb_disruptions if k not in live_pdb_keys]:
-            del self._pdb_disruptions[k]
+        # Stale per-budget state is pruned per-cycle in _update_pdb_peaks
+        # (deleted/recreated budgets must not inherit debt or peaks).
         _pdb_memo: dict[str, tuple[int, ...]] = {}
 
         def _pdbs_of(q: Pod) -> tuple[int, ...]:
@@ -1289,6 +1348,13 @@ class Scheduler:
                     logger.info("acquired leadership lease %s as %s", self.lease_name, self.identity)
                 if self.is_leader:
                     self._ensure_renewal_thread()
+            if self.profile.preemption:
+                # Observe PDB peak healthy EVERY cycle — standby cycles
+                # included (a successor must not baseline a crashed workload
+                # at its degraded count) — but only for preemption profiles:
+                # nothing else consumes the proxy, and on the HTTP boundary
+                # each observation is a real list_pdbs round-trip.
+                self._update_pdb_peaks(snapshot)
             if self.leader_elect and not self.is_leader:
                 # Standby: the reflector cache above stays warm (fast
                 # takeover); scheduling is the leader's alone.  Local state
