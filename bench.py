@@ -267,8 +267,10 @@ def constrained_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
         packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
         cons = pack_constraints(
             snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
-            # 10k synth pods spread over ~50 app groups exceed the default
-            # term budgets; the state stays domain-granular either way.
+            # synth vocabularies are BOUNDED regardless of pod count (50 app
+            # groups, 8 pa-groups, 6 soft groups — testing.py), but their
+            # distinct terms exceed the default budgets; the state stays
+            # domain-granular either way.
             max_aa_terms=256, max_spread=256,
         )
         packed = replace(packed, constraints=cons)
@@ -419,10 +421,13 @@ def main() -> int:
     out.update(phases)
     if used_pods != args.pods:
         out["downscaled_from"] = f"{args.pods}x{args.nodes}"
-    if not args.no_constrained_row and _remaining() > 120:
-        # Evidence row, not the headline: quarter scale on a CPU fallback so
-        # a tunnel-down bench stays bounded (~50 s at full scale on CPU).
-        cp, cn = (10_000, 1_000) if platform == "tpu" else (2_500, 250)
+    # Evidence row, not the headline (VERDICT r3 #8: flagship-adjacent scale
+    # on chip — half the north-star shape with the synth constraint
+    # fractions); quarter scale on a CPU fallback so a tunnel-down bench
+    # stays bounded.  The TPU row needs the same >10k-pod headroom as the
+    # scaling ladder (synth + pack + a fresh constrained-shape compile).
+    if not args.no_constrained_row and _remaining() > (600 if platform == "tpu" else 120):
+        cp, cn = (50_000, 5_000) if platform == "tpu" else (2_500, 250)
         out.update(constrained_row(backend, profile, cp, cn, args.seed))
     if not args.no_sharded_row and _remaining() > 120:
         row = sharded_scaling_row(8192, 512, args.seed)

@@ -266,9 +266,18 @@ def _pad0(v, extra):
 
 
 def _compact(ps):
-    """Stable active-first packing — relative (priority) order preserved."""
-    order = jnp.argsort(~ps["active"], stable=True)
-    return {k: v[order] for k, v in ps.items()}
+    """Stable active-first packing — relative (priority) order preserved.
+
+    Implemented as a cumsum PARTITION, not a sort (PERF.md headroom item,
+    measured ~0.6 ms vs ~1.45 ms per round at the north-star shape): each
+    row's destination is its rank within its class (actives first), which
+    is exactly the permutation a stable argsort of ``~active`` yields — so
+    results stay bit-identical while dropping the O(P log P) sort."""
+    active = ps["active"]
+    n_act = jnp.cumsum(active.astype(jnp.int32))
+    n_inact = jnp.cumsum((~active).astype(jnp.int32))
+    dest = jnp.where(active, n_act - 1, n_act[-1] + n_inact - 1)
+    return {k: jnp.zeros_like(v).at[dest].set(v) for k, v in ps.items()}
 
 
 def _prepare_pods(pods, block: int):
