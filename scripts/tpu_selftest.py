@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""On-chip self-test + tuning sweep for the fused choose kernel — run this
+FIRST when the axon tunnel returns (the banded, constrained, and sharded
+kernel variants have never met real Mosaic; the first-use strike guards
+would downgrade silently and the bench would honestly report pallas:false).
+
+Stages (each prints one PASS/FAIL line; exits nonzero on the first failure):
+  1. plain kernel:      compiled-vs-jnp parity on a small synth cluster
+  2. constrained kernel: same, full constraint mix
+  3. full cycle:        TpuBackend.schedule with _pallas_proven asserted,
+                        plain + constrained
+  4. tile sweep:        flagship-shape choose timings across node_tile
+                        {512, 1024, 2048} (pod_tile 256) — pick the best
+                        for bench; (512, 2048)+ historically fails VMEM
+  5. bench dry pass:    one reduced bench cycle (25k x 2.5k) end to end
+
+Never kill this mid-run (SIGTERM during device init wedges the tunnel);
+budget ~10 min after a cold compile cache.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def log(msg):
+    print(msg, flush=True)
+
+
+def main() -> int:
+    import jax
+
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    platform = devices[0].platform
+    log(f"devices ({time.perf_counter()-t0:.1f}s): {devices}")
+    if platform != "tpu":
+        log(f"FAIL: platform {platform!r} is not tpu — run under the axon tunnel")
+        return 1
+
+    from tpu_scheduler.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    from dataclasses import replace
+
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops.assign import assign_cycle, split_device_arrays
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    profile = PROFILES["throughput"]
+
+    # -- 1+2: assign_cycle parity, compiled pallas vs jnp ------------------
+    def parity(constrained: bool) -> bool:
+        kw = (
+            dict(
+                anti_affinity_fraction=0.2, spread_fraction=0.2, schedule_anyway_fraction=0.2,
+                pod_affinity_fraction=0.15, preferred_pod_affinity_fraction=0.2,
+            )
+            if constrained
+            else dict(tainted_fraction=0.3, node_affinity_fraction=0.2, soft_taint_fraction=0.2)
+        )
+        snap = synth_cluster(n_nodes=96, n_pending=512, n_bound=128, seed=3, **kw)
+        packed = pack_snapshot(snap, pod_block=128, node_block=128)
+        a = {k: jax.numpy.asarray(v) for k, v in packed.device_arrays().items()}
+        nodes, pods = split_device_arrays(a)
+        solve_kw = dict(max_rounds=32, block=256)
+        if constrained:
+            cons = pack_constraints(snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes)
+            pods.update({k: jax.numpy.asarray(v) for k, v in cons.pod_arrays().items()})
+            solve_kw.update(
+                cmeta={k: jax.numpy.asarray(v) for k, v in cons.meta_arrays().items()},
+                cstate={k: jax.numpy.asarray(v) for k, v in cons.state_arrays().items()},
+                soft_spread=cons.n_spread_soft > 0, soft_pa=cons.n_ppa_terms > 0, hard_pa=cons.n_pa_terms > 0,
+            )
+        weights = jax.numpy.asarray(profile.weights())
+        base, *_ = assign_cycle(nodes, pods, weights, **solve_kw)
+        pal, *_ = assign_cycle(nodes, pods, weights, use_pallas=True, **solve_kw)
+        ok = bool((np.asarray(base) == np.asarray(pal)).all())
+        log(f"{'PASS' if ok else 'FAIL'}: {'constrained' if constrained else 'plain'} kernel parity (compiled Mosaic vs jnp)")
+        return ok
+
+    if not parity(False):
+        return 1
+    if not parity(True):
+        return 1
+
+    # -- 3: whole-backend proving ------------------------------------------
+    for constrained in (False, True):
+        kw = dict(anti_affinity_fraction=0.2, spread_fraction=0.2) if constrained else {}
+        snap = synth_cluster(n_nodes=64, n_pending=256, n_bound=64, seed=5, **kw)
+        packed = pack_snapshot(snap)
+        if constrained:
+            cons = pack_constraints(snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes)
+            packed = replace(packed, constraints=cons)
+        b = TpuBackend()
+        b.schedule(packed, profile)
+        variant = constrained
+        ok = variant in b._proven_variants and not b._disabled_variants
+        log(f"{'PASS' if ok else 'FAIL'}: TpuBackend proving ({'constrained' if constrained else 'plain'}) "
+            f"proven={sorted(b._proven_variants)} disabled={sorted(b._disabled_variants)}")
+        if not ok:
+            return 1
+
+    # -- 4: tile sweep at flagship shape -----------------------------------
+    from tpu_scheduler.ops.pallas_choose import build_node_info, choose_block_pallas
+
+    snap = synth_cluster(n_nodes=10_000, n_pending=100_000, n_bound=20_000, seed=0)
+    packed = pack_snapshot(snap, pod_block=8192, node_block=128)
+    a = {k: jax.device_put(v) for k, v in packed.device_arrays().items()}
+    info = build_node_info(a["node_avail"], a["node_alloc"], a["node_valid"])
+    ranks = jax.numpy.arange(packed.padded_pods, dtype=jax.numpy.uint32)
+    weights = jax.numpy.asarray(profile.weights())
+    args = (
+        a["pod_req"], a["pod_sel"], a["pod_sel_count"], a["pod_ntol"], a["pod_aff"], a["pod_has_aff"],
+        a["pod_pref_w"], a["pod_ntol_soft"], a["pod_valid"], ranks, info,
+        a["node_labels"].T, a["node_taints"].T, a["node_aff"].T, a["node_pref"].T, a["node_taints_soft"].T,
+        weights,
+    )
+    pairs = packed.padded_pods * packed.padded_nodes
+    best = None
+    for node_tile in (512, 1024, 2048):
+        try:
+            c, _h = choose_block_pallas(*args, node_tile=node_tile)
+            np.asarray(c)  # warm + sync (block_until_ready is unreliable here)
+            t0 = time.perf_counter()
+            c, _h = choose_block_pallas(*args, node_tile=node_tile)
+            np.asarray(c)
+            dt = time.perf_counter() - t0
+            log(f"tile (256, {node_tile}): {dt*1e3:.1f} ms  ({pairs/dt/1e9:.1f} Gpair/s)")
+            if best is None or dt < best[1]:
+                best = (node_tile, dt)
+        except Exception as e:  # noqa: BLE001 — a tile that fails VMEM is data, not a failure
+            log(f"tile (256, {node_tile}): failed ({type(e).__name__}: {str(e)[:120]})")
+    if best is None:
+        log("FAIL: no node_tile compiled")
+        return 1
+    log(f"PASS: tile sweep — best node_tile {best[0]} at {best[1]*1e3:.1f} ms "
+        f"(default is 512; if {best[0]} != 512, consider changing choose_block_pallas's default)")
+
+    # -- 5: reduced bench pass (headline shape only — the constrained and
+    # sharded evidence rows are the FULL bench's job) ----------------------
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [
+                sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+                "--pods", "25000", "--nodes", "2500", "--repeats", "2",
+                "--no-sharded-row", "--no-constrained-row",
+            ],
+            capture_output=True, text=True, timeout=1800, cwd=REPO_ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        log("FAIL: reduced bench exceeded 1800s (cold compile cache? tunnel degradation?)")
+        return 1
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    log(f"bench (25k x 2.5k): {line}")
+    ok = '"platform": "tpu"' in line and '"pallas": true' in line
+    log(f"{'PASS' if ok else 'FAIL'}: reduced bench ran on tpu with the kernel live")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
