@@ -78,6 +78,25 @@ def main() -> int:
         print(f"MULTIHOST_CONSTRAINED_MISMATCH process={process_id} diff={diff}", flush=True)
         return 1
 
+    # The fused kernel inside the multi-host shard program (interpret mode
+    # on CPU): plain + constrained must still match the oracle bitwise.
+    passigned, _prounds = sharded_assign_multihost(
+        mesh, packed.device_arrays(), profile.weights(), max_rounds=16,
+        use_pallas=True, pallas_interpret=True,
+    )
+    if not np.array_equal(passigned, np.asarray(oracle)):
+        print(f"MULTIHOST_PALLAS_MISMATCH process={process_id}", flush=True)
+        return 1
+    pcassigned, _ = sharded_assign_multihost(
+        mesh, cpacked.device_arrays(), profile.weights(), max_rounds=16,
+        constraints=c, soft_spread=cons.n_spread_soft > 0,
+        soft_pa=cons.n_ppa_terms > 0, hard_pa=cons.n_pa_terms > 0,
+        use_pallas=True, pallas_interpret=True,
+    )
+    if not np.array_equal(pcassigned, np.asarray(coracle)):
+        print(f"MULTIHOST_PALLAS_CONSTRAINED_MISMATCH process={process_id}", flush=True)
+        return 1
+
     bound = int((assigned >= 0).sum())
     cbound = int((cassigned >= 0).sum())
     print(f"MULTIHOST_OK process={process_id} bound={bound} rounds={rounds} cbound={cbound}", flush=True)
