@@ -17,7 +17,7 @@ Layout:
   utils/     tracing spans, metrics, checkpointing
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 from .api.objects import (  # noqa: F401
     Binding,
@@ -38,3 +38,4 @@ from .api.objects import (  # noqa: F401
 )
 from .core.predicates import InvalidNodeReason, check_node_validity  # noqa: F401
 from .core.snapshot import ClusterSnapshot  # noqa: F401
+from .runtime.kubeconfig import client_from_kubeconfig  # noqa: F401  (real-cluster edge, main.rs:130)
