@@ -161,3 +161,23 @@ def test_noexecute_clock_survives_restart(tmp_path):
     s3 = Scheduler(api3, NativeBackend(), requeue_seconds=0.0, clock=lambda: now[0])
     s3.run_cycle()
     assert "victim" in {p.metadata.name for p in api3.list_pods()}
+
+
+def test_dev_cache_capped_under_churn():
+    """On zero-copy platforms (CPU device_put aliases the host buffer) the
+    cached device array keeps its host array alive, so weakref eviction
+    never fires — the LRU cap must bound the cache in a long daemon
+    (found by a churn soak), with hot entries surviving over churned ones."""
+    b = TpuBackend(use_pallas=False)
+    b._dev_cache_cap = 8
+    hot = np.arange(4)
+    keep = []  # keep churn arrays alive so weakref eviction can't help
+    for i in range(50):
+        b._put(hot)  # hot entry re-touched every iteration
+        a = np.full(4, i)
+        keep.append(a)
+        b._put(a)
+    assert len(b._dev_cache) <= 8
+    assert id(hot) in b._dev_cache, "recently-touched entry must survive the cap"
+    # every evicted entry's finalizer was detached; survivors' are alive
+    assert all(ent[2].alive for ent in b._dev_cache.values())

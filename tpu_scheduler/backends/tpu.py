@@ -96,7 +96,16 @@ class TpuBackend(SchedulingBackend):
         # leave the new owner's buffer pinned until _drop_dev_cache
         # (round-3 advisor finding).  Eviction compares the stored weakref
         # object itself, which is unambiguous across id reuse.
+        #
+        # Size-capped, oldest-insertion-first: on platforms where
+        # device_put ALIASES the host buffer (CPU is zero-copy), the cached
+        # device array keeps its host array alive, so weakref eviction
+        # alone never fires and a long daemon's cache grows with every
+        # repack (found by a 800-cycle churn soak).  A flagship cycle
+        # touches a few dozen arrays; evicting a live entry is always safe
+        # (worst case: one re-upload).
         self._dev_cache: dict[int, tuple[weakref.ref, object, object]] = {}
+        self._dev_cache_cap = 512
         self._put_lock = threading.Lock()
 
     def _drop_dev_cache(self) -> None:
@@ -128,6 +137,10 @@ class TpuBackend(SchedulingBackend):
         with self._put_lock:
             ent = self._dev_cache.get(key)
             if ent is not None and ent[0]() is arr:
+                # Refresh recency (insertion order is the eviction order):
+                # hot node tensors must outlive churned pod tensors.
+                del self._dev_cache[key]
+                self._dev_cache[key] = ent
                 return ent[1]
         buf = self._jax.device_put(arr, self.device)
         try:
@@ -137,13 +150,18 @@ class TpuBackend(SchedulingBackend):
         fin = weakref.finalize(arr, self._evict, key, wr)
         fin.atexit = False  # interpreter teardown needs no cache hygiene
         with self._put_lock:
-            old = self._dev_cache.get(key)
+            old = self._dev_cache.pop(key, None)  # pop: the fresh entry must land at the MRU end
             if old is not None and old[0] is not wr:
                 # The id's previous owner died (or this is a re-upload after
                 # a cache drop): detach its finalizer so a late fire cannot
                 # touch the new entry.
                 old[2].detach()
             self._dev_cache[key] = (wr, buf, fin)
+            while len(self._dev_cache) > self._dev_cache_cap:
+                oldest = next(iter(self._dev_cache))
+                if oldest == key:  # never evict the entry just inserted
+                    break
+                self._dev_cache.pop(oldest)[2].detach()
         return buf
 
     def _assign_once(self, packed: PackedCluster, profile: SchedulingProfile, use_pallas: bool):
