@@ -234,3 +234,38 @@ def test_election_algorithm_unit():
     lm.release(get, update, holder=store["l"]["spec"]["holderIdentity"], now=210.0)
     assert store["l"]["spec"]["holderIdentity"] == ""
     assert lm.try_acquire_or_renew(get, create, update, holder="c", now=210.5, **kw)
+
+
+def test_concurrent_acquire_race_single_winner():
+    """Two clients race acquire over real HTTP sockets: the rv CAS must
+    yield EXACTLY one holder per round, every round, with the loser reading
+    a clean False (no 5xx, no double leadership)."""
+    import threading
+
+    from tpu_scheduler.runtime.http_api import HttpApiServer, KubeApiClient
+
+    api = FakeApiServer()
+    server = HttpApiServer(api).start()
+    try:
+        c1, c2 = KubeApiClient(server.base_url), KubeApiClient(server.base_url)
+        for round_no in range(12):
+            results = {}
+            barrier = threading.Barrier(2)
+
+            def race(name, client):
+                barrier.wait()
+                results[name] = client.acquire_lease("race-lease", name, duration_seconds=15)
+
+            t1 = threading.Thread(target=race, args=("a", c1))
+            t2 = threading.Thread(target=race, args=("b", c2))
+            t1.start(); t2.start(); t1.join(); t2.join()
+            winners = [k for k, v in results.items() if v]
+            # Round 0: both race the create, the CAS admits exactly one.
+            # Later rounds: the incumbent renews (holder==self), the
+            # challenger sees a fresh lease (or loses the CAS) — still
+            # exactly one winner, and it is the recorded holder.
+            assert len(winners) == 1, (round_no, results)
+            holder = (api.get_lease_object("kube-system", "race-lease") or {}).get("spec", {}).get("holderIdentity")
+            assert holder == winners[0], (round_no, holder, results)
+    finally:
+        server.stop()
