@@ -55,8 +55,13 @@ AXON_FAILED_INIT_WORST = 1600.0
 CPU_FALLBACK_BUDGET = 600.0
 # Sibling probe (scripts/tpu_probe.py) records its last device-init outcome
 # here; a fresh failure report sends us straight to the CPU fallback so a
-# known-down tunnel doesn't cost ~25 min rediscovering the outage.
-PROBE_STATUS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts", "tpu_status.json")
+# known-down tunnel doesn't cost ~25 min rediscovering the outage.  The env
+# override exists for the gate tests (tests/test_bench_gates.py) — they must
+# not touch the real status file.
+PROBE_STATUS = os.environ.get(
+    "BENCH_PROBE_STATUS",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts", "tpu_status.json"),
+)
 
 
 def log(msg: str) -> None:

@@ -1,0 +1,95 @@
+"""bench.py hardening gates (the round-3 rc=124 lesson): whatever the
+tunnel does, the driver must receive one parsed JSON line.  These tests
+drive bench.py as a subprocess with the probe status and wall budget
+injected via env — never touching the real scripts/tpu_status.json."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(args, env_extra, timeout=600):
+    env = dict(os.environ)
+    # Leaked bench state (e.g. a driver wrapper that exported the deadline)
+    # would silently change which gate fires — strip it first.
+    for leak in ("BENCH_DEADLINE", "BENCH_INIT_ATTEMPT", "BENCH_MAX_TOTAL_SECONDS", "BENCH_PROBE_STATUS"):
+        env.pop(leak, None)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, BENCH, *args], capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env
+    )
+
+
+def _parse(out):
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line in stdout: {out.stdout!r}\nstderr tail: {out.stderr[-800:]}"
+    return json.loads(lines[-1])
+
+
+def test_fresh_probe_failure_goes_straight_to_cpu(tmp_path):
+    """A fresh tunnel-down report must skip TPU init entirely (each failed
+    axon init costs ~25 min) and still print a parsed row."""
+    status = tmp_path / "status.json"
+    status.write_text(json.dumps({"ok": False, "error": "UNAVAILABLE", "ts": time.time()}))
+    out = _run(
+        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row"],
+        {"BENCH_PROBE_STATUS": str(status)},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = _parse(out)
+    assert row["platform"] == "cpu"
+    assert "skipping TPU init (probe says tunnel down)" in out.stderr
+
+
+def test_exhausted_wall_budget_goes_straight_to_cpu(tmp_path):
+    """With no probe report at all, a wall budget too small for a worst-case
+    failed init must fall back to CPU before ever touching the device."""
+    status = tmp_path / "missing.json"  # no probe report
+    out = _run(
+        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row"],
+        {"BENCH_PROBE_STATUS": str(status), "BENCH_MAX_TOTAL_SECONDS": "60"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = _parse(out)
+    assert row["platform"] == "cpu"
+    assert "skipping TPU init" in out.stderr and "budget left" in out.stderr
+
+
+def test_stale_probe_failure_does_not_gate(tmp_path):
+    """An OLD outage report must NOT force CPU (the tunnel may be back):
+    the probe branch reads the file, sees the stale age, and declines — the
+    run then falls to the BUDGET gate (tiny wall budget), proving the
+    staleness check executed without ever touching a device."""
+    status = tmp_path / "status.json"
+    status.write_text(json.dumps({"ok": False, "error": "UNAVAILABLE", "ts": time.time() - 9999}))
+    out = _run(
+        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row"],
+        {"BENCH_PROBE_STATUS": str(status), "BENCH_MAX_TOTAL_SECONDS": "60"},
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "probe says tunnel down" not in out.stderr  # stale report declined
+    assert "budget left" in out.stderr  # ...so the budget gate fired instead
+    assert _parse(out)["platform"] == "cpu"
+
+
+def test_cpu_fallback_row_shape(tmp_path):
+    """The degraded row carries the honesty fields the judge reads:
+    platform, pallas, downscaled_from (at flagship request), budget."""
+    status = tmp_path / "status.json"
+    status.write_text(json.dumps({"ok": False, "error": "UNAVAILABLE", "ts": time.time()}))
+    out = _run(
+        ["--repeats", "1", "--no-sharded-row", "--no-constrained-row"],  # default flagship 100k request
+        {"BENCH_PROBE_STATUS": str(status)},
+        timeout=1200,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    row = _parse(out)
+    assert row["platform"] == "cpu" and row["pallas"] is False
+    assert row["downscaled_from"] == "100000x10000"
+    assert row["metric"].startswith("sched_cycle_seconds_")
+    assert "budget_seconds_left" in row
