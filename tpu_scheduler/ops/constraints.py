@@ -112,6 +112,37 @@ class UntensorizableConstraints(Exception):
     """Constraint structure exceeds the tensor budgets — use the host path."""
 
 
+def _term_probe_index(term_list):
+    """(indexed, residual) over ``[(key, (ns, term)), ...]`` — the matched-
+    bitmap hot loops are O(pods × terms) naively (13M term_matches calls at
+    50k pods × ~260 terms, ~15 s host-side); a term with match_labels can
+    only match a pod carrying its first sorted (k, v) pair, so pods probe
+    the index with their own labels and run the full matcher on the few
+    candidates (the same near-linear trick as the controller's
+    _split_affinity_pending).  Terms without match_labels land in the
+    per-namespace residual."""
+    indexed: dict[tuple, list[int]] = {}
+    residual: dict[str | None, list[int]] = {}
+    for ti, (_key, (t_ns, term)) in enumerate(term_list):
+        ml = term.match_labels
+        if ml:
+            k, v = sorted(ml.items())[0]
+            indexed.setdefault((t_ns, k, v), []).append(ti)
+        else:
+            residual.setdefault(t_ns, []).append(ti)
+    return indexed, residual
+
+
+def _matched_term_ids(term_list, indexed, residual, ns, labels):
+    """Term indices of ``term_list`` whose selector matches ``labels`` in
+    namespace ``ns`` — candidates from the probe index, verified exactly."""
+    cand: set[int] = set(residual.get(ns, ()))
+    if labels:
+        for kv in labels.items():
+            cand.update(indexed.get((ns, kv[0], kv[1]), ()))
+    return [ti for ti in cand if term_matches(term_list[ti][1][1], labels)]
+
+
 def _canon_selector(match_labels, match_expressions) -> tuple:
     ml = tuple(sorted((match_labels or {}).items()))
     mx = tuple(
@@ -376,6 +407,11 @@ def pack_constraints(
     ppa_index = {key: i for i, (key, _) in enumerate(ppa_terms)}
     sp_index = {key: i for i, (key, _) in enumerate(sp_terms)}
     sps_index = {key: i for i, (key, _) in enumerate(sps_terms)}
+    aa_probe, aa_res = _term_probe_index(aa_terms)
+    pa_probe, pa_res = _term_probe_index(pa_terms)
+    ppa_probe, ppa_res = _term_probe_index(ppa_terms)
+    sp_probe, sp_res = _term_probe_index(sp_terms)
+    sps_probe, sps_res = _term_probe_index(sps_terms)
     for pi, p in enumerate(pending):
         ns, labels = p.metadata.namespace, p.metadata.labels
         if p.spec is not None and p.spec.anti_affinity:
@@ -395,21 +431,16 @@ def pack_constraints(
                     pod_sp_declares[pi, sp_index[_sp_key(ns, c)]] = 1.0
                 else:
                     pod_sps_declares[pi, sps_index[_sp_key(ns, c)]] = 1.0
-        for ti, (_key, (t_ns, term)) in enumerate(aa_terms):
-            if t_ns == ns and term_matches(term, labels):
-                pod_aa_matched[pi, ti] = 1.0
-        for ti, (_key, (t_ns, term)) in enumerate(pa_terms):
-            if t_ns == ns and term_matches(term, labels):
-                pod_pa_matched[pi, ti] = 1.0
-        for ti, (_key, (t_ns, term)) in enumerate(ppa_terms):
-            if t_ns == ns and term_matches(term, labels):
-                pod_ppa_matched[pi, ti] = 1.0
-        for si, (_key, (c_ns, c)) in enumerate(sp_terms):
-            if c_ns == ns and term_matches(c, labels):
-                pod_sp_matched[pi, si] = 1.0
-        for si, (_key, (c_ns, c)) in enumerate(sps_terms):
-            if c_ns == ns and term_matches(c, labels):
-                pod_sps_matched[pi, si] = 1.0
+        for ti in _matched_term_ids(aa_terms, aa_probe, aa_res, ns, labels):
+            pod_aa_matched[pi, ti] = 1.0
+        for ti in _matched_term_ids(pa_terms, pa_probe, pa_res, ns, labels):
+            pod_pa_matched[pi, ti] = 1.0
+        for ti in _matched_term_ids(ppa_terms, ppa_probe, ppa_res, ns, labels):
+            pod_ppa_matched[pi, ti] = 1.0
+        for si in _matched_term_ids(sp_terms, sp_probe, sp_res, ns, labels):
+            pod_sp_matched[pi, si] = 1.0
+        for si in _matched_term_ids(sps_terms, sps_probe, sps_res, ns, labels):
+            pod_sps_matched[pi, si] = 1.0
 
     # --- initial state from placed pods -----------------------------------
     aa_dom_m = np.zeros((t_pad, d_pad), dtype=np.float32)
@@ -446,15 +477,12 @@ def pack_constraints(
     if aa_terms or pa_terms or ppa_terms:
         for q, qnode in snapshot.placed_pods():
             q_ns, q_labels = q.metadata.namespace, q.metadata.labels
-            for ti, (_key, (t_ns, term)) in enumerate(aa_terms):
-                if t_ns == q_ns and term_matches(term, q_labels):
-                    _mark(aa_dom_m, aa_node_m, ti, term, qnode.name)
-            for ti, (_key, (t_ns, term)) in enumerate(pa_terms):
-                if t_ns == q_ns and term_matches(term, q_labels):
-                    _mark(pa_dom_m, pa_node_m, ti, term, qnode.name)
-            for ti, (_key, (t_ns, term)) in enumerate(ppa_terms):
-                if t_ns == q_ns and term_matches(term, q_labels):
-                    _count(ppa_dom_cnt, ppa_node_cnt, ti, term, qnode.name)
+            for ti in _matched_term_ids(aa_terms, aa_probe, aa_res, q_ns, q_labels):
+                _mark(aa_dom_m, aa_node_m, ti, aa_terms[ti][1][1], qnode.name)
+            for ti in _matched_term_ids(pa_terms, pa_probe, pa_res, q_ns, q_labels):
+                _mark(pa_dom_m, pa_node_m, ti, pa_terms[ti][1][1], qnode.name)
+            for ti in _matched_term_ids(ppa_terms, ppa_probe, ppa_res, q_ns, q_labels):
+                _count(ppa_dom_cnt, ppa_node_cnt, ti, ppa_terms[ti][1][1], qnode.name)
         for q, qnode in placed_with_terms:
             ns = q.metadata.namespace
             for t in q.spec.anti_affinity:
@@ -464,17 +492,15 @@ def pack_constraints(
             q_ns, q_labels = q.metadata.namespace, q.metadata.labels
             ni = node_index[qnode.name]
             nlabels = nodes[ni].metadata.labels or {}
-            for si, (_key, (c_ns, c)) in enumerate(sp_terms):
-                if c_ns != q_ns:
-                    continue
+            for si in _matched_term_ids(sp_terms, sp_probe, sp_res, q_ns, q_labels):
+                c = sp_terms[si][1][1]
                 v = nlabels.get(c.topology_key)
-                if v is not None and term_matches(c, q_labels):
+                if v is not None:
                     sp_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
-            for si, (_key, (c_ns, c)) in enumerate(sps_terms):
-                if c_ns != q_ns:
-                    continue
+            for si in _matched_term_ids(sps_terms, sps_probe, sps_res, q_ns, q_labels):
+                c = sps_terms[si][1][1]
                 v = nlabels.get(c.topology_key)
-                if v is not None and term_matches(c, q_labels):
+                if v is not None:
                     sps_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
 
     return ConstraintSet(

@@ -350,24 +350,18 @@ class Scheduler:
         correct first, then fast (config 5 tensorization is the ops-layer
         milestone).
         """
-        # Index carrier terms so classification stays near-linear: a term with
-        # match_labels can only match a pod carrying its first (key, value)
-        # pair, so candidates probe the index with their own labels; terms
-        # with only match_expressions (rare) fall into a per-namespace
-        # residual list.
+        # Probe-index carrier terms so classification stays near-linear —
+        # ONE implementation of the first-pair index trick, shared with
+        # pack_constraints' matched-bitmap loops (ops/constraints.py).
+        from ..ops.constraints import _matched_term_ids, _term_probe_index
+
         carriers = [q for q, _ in snapshot.placed_pods_with_terms()] + [
             q for q in pending if q.spec is not None and q.spec.anti_affinity
         ]
-        indexed: dict[tuple[str | None, str, str], list] = {}
-        residual: dict[str | None, list] = {}
-        for q in carriers:
-            ns = q.metadata.namespace
-            for t in q.spec.anti_affinity:
-                if t.match_labels:
-                    k, v = next(iter(t.match_labels.items()))
-                    indexed.setdefault((ns, k, v), []).append(t)
-                else:
-                    residual.setdefault(ns, []).append(t)
+        term_list = [
+            (None, (q.metadata.namespace, t)) for q in carriers for t in q.spec.anti_affinity
+        ]
+        probe, residual = _term_probe_index(term_list)
 
         plain: list[Pod] = []
         constrained: list[Pod] = []
@@ -381,11 +375,9 @@ class Scheduler:
             ):
                 constrained.append(p)
                 continue
-            ns = p.metadata.namespace
-            labels = p.metadata.labels or {}
-            candidates = residual.get(ns, [])
-            probed = [t for kv in labels.items() for t in indexed.get((ns, kv[0], kv[1]), ())]
-            hit = any(term_matches(t, labels) for t in chain(candidates, probed))
+            hit = bool(
+                _matched_term_ids(term_list, probe, residual, p.metadata.namespace, p.metadata.labels or {})
+            )
             (constrained if hit else plain).append(p)
         return plain, constrained
 
