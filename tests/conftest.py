@@ -22,7 +22,9 @@ import subprocess  # noqa: E402
 
 import pytest  # noqa: E402
 
-NATIVE_DIR = "/root/repo/native"
+# Resolved from THIS file, never hardcoded: a fresh clone's test run must
+# build ITS OWN tree's shim (a hardcoded path built someone else's).
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 
 
 class FakeClock:
