@@ -3,6 +3,7 @@ semantics, fallback, incremental repack, and the CLI."""
 
 import json
 import random
+import os
 import subprocess
 import sys
 
@@ -154,7 +155,7 @@ def test_cli_end_to_end_native():
         [sys.executable, "-m", "tpu_scheduler.cli", "--backend=native", "--nodes", "10", "--pods", "50", "--seed", "3"],
         capture_output=True,
         text=True,
-        cwd="/root/repo",
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert out.returncode == 0, out.stderr
     lines = [json.loads(line) for line in out.stdout.strip().splitlines()]
@@ -169,7 +170,7 @@ def test_cli_rejects_bad_backend():
         [sys.executable, "-m", "tpu_scheduler.cli", "--backend=cuda"],
         capture_output=True,
         text=True,
-        cwd="/root/repo",
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert out.returncode == 2
     assert "invalid choice" in out.stderr

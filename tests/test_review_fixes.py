@@ -70,6 +70,7 @@ def test_repack_avail_rejects_node_set_change():
 
 def test_native_backend_is_jax_free():
     # The recovery path must not import jax (BackendUnavailable fallback).
+    import os
     import subprocess
     import sys
 
@@ -83,6 +84,6 @@ def test_native_backend_is_jax_free():
         "r = NativeBackend().schedule(pack_snapshot(synth_cluster(4, 10, seed=0)))\n"
         "print(len(r.bindings))\n"
     )
-    out = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "10"
