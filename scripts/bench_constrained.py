@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Constrained-cycle driver comparison on the real chip.
+
+The round-4 on-chip capture showed the constrained 50k x 5k row at 17 s /
+64 rounds (cap) under the monolithic driver: a steep acceptance head, then a
+long genuine-dependency tail of ~1-3 accepts per round — each tail round
+still paying full padded-[P,S]/[P,T] constraint math (incl. the [S*P]
+stable argsort in constraint_filter).  This experiment times monolithic vs
+epochs (size-halving) drivers and prints the accepts-per-round profile that
+motivates auto-selecting the driver for constrained cycles.
+
+Usage: python scripts/bench_constrained.py [pods] [nodes]
+"""
+import os
+import sys
+import time
+from collections import Counter
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 5_000
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    profile = PROFILES["throughput"].with_(max_rounds=64)
+    snap = synth_cluster(
+        n_nodes=nodes, n_pending=pods, n_bound=2 * nodes, seed=7,
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+    )
+    packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+    cons = pack_constraints(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    packed = replace(packed, constraints=cons)
+    print(f"shape: {packed.num_pods}x{len(packed.node_names)} padded {packed.padded_pods}x{packed.padded_nodes}", flush=True)
+    print(f"vocab: T={cons.n_terms} Ta={cons.n_pa_terms} Tp={cons.n_ppa_terms} S={cons.n_spread} Ss={cons.n_spread_soft}", flush=True)
+    print(f"padded: T={cons.pod_aa_carries.shape[1]} S={cons.pod_sp_declares.shape[1]} D={cons.node_dom_c.shape[1]}", flush=True)
+
+    backend = TpuBackend()
+    for driver in ("monolithic", "epochs"):
+        prof = profile.with_(driver=driver)
+        r = backend.schedule(packed, prof)  # warm/compile
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            r = backend.schedule(packed, prof)
+            times.append(time.perf_counter() - t0)
+        hist = Counter(int(x) for x in r.stats["acc_round"] if x >= 0)
+        prof_str = " ".join(f"{k}:{hist[k]}" for k in sorted(hist))
+        print(f"{driver}: {min(times):.3f}s  bound={len(r.bindings)}/{packed.num_pods} rounds={r.rounds}", flush=True)
+        print(f"  accepts/round: {prof_str}", flush=True)
+        unbound = packed.num_pods - len(r.bindings)
+        print(f"  unbound: {unbound}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -182,9 +182,14 @@ class TpuBackend(SchedulingBackend):
         # Driver choice (profiles.py `driver`): monolithic keeps the whole
         # auction in one jit program — one host sync per cycle, no jit-
         # boundary relayouts — which on the real (tunnelled) chip beats the
-        # epoch driver's smaller per-round sorts by ~4x.  Both drivers are
-        # bit-identical in results (tests/test_assign.py).
-        drive = assign_cycle if profile.driver == "monolithic" else assign_cycle_epochs
+        # epoch driver by ~4x on short unconstrained cycles; the epoch
+        # driver's size-halving wins by ~4x on long-tailed constrained
+        # cycles (rationale + measurements in profiles.py).  Both drivers
+        # are bit-identical in results (tests/test_assign.py).
+        driver = profile.driver
+        if driver == "auto":
+            driver = "epochs" if cons is not None else "monolithic"
+        drive = assign_cycle if driver == "monolithic" else assign_cycle_epochs
         assigned, rounds, _avail, acc_round, rank_of = drive(
             nodes,
             pods,

@@ -40,13 +40,18 @@ class SchedulingProfile:
     topology_weight: float = 1.0
     # Auction driver (backends/tpu.py): "monolithic" runs the whole auction
     # as ONE on-device while_loop (one host sync per cycle); "epochs" is the
-    # host-driven size-shrinking driver (ops/assign.py assign_cycle_epochs).
-    # Monolithic is the default: on the real chip, every jit re-entry pays a
+    # host-driven size-shrinking driver (ops/assign.py assign_cycle_epochs);
+    # "auto" (default) picks per cycle shape.  Unconstrained cycles converge
+    # in ~9 rounds, so monolithic wins: every jit re-entry pays a
     # narrow-operand relayout (~200 ms at 100k pods) and every host sync
-    # ~70 ms of tunnel latency, so the epoch driver's per-epoch boundary
-    # crossings cost far more than its smaller sorts save (measured 2.35 s
-    # epochs vs 0.55 s monolithic on the 100k x 10k north star).
-    driver: str = "monolithic"
+    # ~70 ms of tunnel latency — measured 2.35 s epochs vs 0.55 s monolithic
+    # on the 100k x 10k north star.  Constrained cycles have a long
+    # genuine-dependency tail (tens of rounds, a handful of accepts each);
+    # monolithic pays full padded-[P,S]/[P,T] constraint math every tail
+    # round, while the epoch driver's halving chain shrinks it with the
+    # active count — measured 4.3 s epochs vs 15.7 s monolithic at 50k x 5k
+    # with the bench constraint mix (scripts/bench_constrained.py, on chip).
+    driver: str = "auto"
     # Expert-parallel routing (parallel/routing.py): node label whose values
     # partition the cluster into per-pool scheduling shards; None = off.
     pool_key: str | None = None
@@ -57,8 +62,8 @@ class SchedulingProfile:
     preemption: bool = False
 
     def __post_init__(self):
-        if self.driver not in ("monolithic", "epochs"):
-            raise ValueError(f"unknown driver {self.driver!r} (expected 'monolithic' or 'epochs')")
+        if self.driver not in ("auto", "monolithic", "epochs"):
+            raise ValueError(f"unknown driver {self.driver!r} (expected 'auto', 'monolithic' or 'epochs')")
 
     def weights(self) -> np.ndarray:
         return np.array(

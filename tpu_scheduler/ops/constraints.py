@@ -107,6 +107,19 @@ MAX_AA_TERMS = 128
 MAX_SPREAD = 64
 MAX_COARSE_DOMAINS = 128
 
+# Fast-path budget for the within-round filter/commit: below this terms×D
+# product, "who came earlier into my cell" is computed DENSELY — a [P,T,D]
+# exclusive cumsum along the (rank-ordered) pod axis — instead of the
+# sort/scatter formulation.  On TPU through the tunnel the difference is
+# stark (measured at 53k pods: scalar scatter_min ~43 ms and the [S·P]
+# stable sort ~47 ms per round, vs ~2-3 ms for the cumsum 3-tensor and
+# ~free [N,·] row scatters), because XLA lowers arbitrary-index scalar
+# scatters near-serially while cumsums ride the parallel prefix path.
+# Above the budget the 3-tensor would dominate HBM traffic, so the
+# sort/scatter path takes over (bit-identical results either way — counts
+# are small exact f32 integers and array order IS rank order).
+DENSE_CELLS = 1024
+
 
 class UntensorizableConstraints(Exception):
     """Constraint structure exceeds the tensor budgets — use the host path."""
@@ -621,13 +634,37 @@ def _scatter_min(xp, size: int, idx, vals):
     return xp.full((size,), RANK_INF, dtype=xp.float32).at[idx].min(vals)
 
 
-def _scatter_max1(xp, arr, idx, vals):
-    """arr (flat) with arr[idx] = max(arr[idx], vals)."""
+def _row_scatter_min(xp, n_rows: int, idx, vals):
+    """out[r, c] = min over {p : idx[p] == r} of vals[p, c]  (RANK_INF fill).
+
+    Row-granular scatters (one [C]-wide update per pod) lower to fast
+    windowed scatters on TPU, unlike the near-serial scalar form."""
     if xp is np:
-        out = arr.copy()
-        np.maximum.at(out, idx, vals)
+        out = np.full((n_rows, vals.shape[1]), RANK_INF, dtype=np.float32)
+        np.minimum.at(out, idx, vals)
         return out
-    return arr.at[idx].max(vals)
+    return xp.full((n_rows, vals.shape[1]), RANK_INF, dtype=xp.float32).at[idx].min(vals)
+
+
+def _row_scatter_max_t(xp, state_tn, idx, vals):
+    """[T,N] state with state[c, idx[p]] = max(state, vals[p, c]) folded in —
+    the row-scatter twin of the flattened t·n scalar scatter (transposed
+    round-trip is two [T,N] relayouts, a rounding error next to the
+    near-serial scalar form it replaces)."""
+    if xp is np:
+        out = state_tn.T.copy()  # always copy — callers may hold the old state
+        np.maximum.at(out, idx, vals)
+        return out.T
+    return state_tn.T.at[idx].max(vals).T
+
+
+def _row_scatter_add_t(xp, state_tn, idx, vals):
+    """+= twin of :func:`_row_scatter_max_t` for count-valued state."""
+    if xp is np:
+        out = state_tn.T.copy()  # always copy — callers may hold the old state
+        np.add.at(out, idx, vals)
+        return out.T
+    return state_tn.T.at[idx].add(vals).T
 
 
 def _argsort_stable(xp, a):
@@ -655,22 +692,46 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     rank_f = ranks.astype(xp.float32)
 
     # ---- anti-affinity ----------------------------------------------------
+    # Rule: in each (term, cell) — cell = coarse domain when the chosen node
+    # carries the term's key, else the node itself — a matched pod survives
+    # only if no earlier-rank accepted carrier shares the cell, and vice
+    # versa.  "Earlier rank" ≡ earlier array index (pods are compacted in
+    # priority-rank order), so existence-of-a-predecessor is an exclusive
+    # cumsum along the pod axis on the dense path, and a min-rank reduction
+    # on the fallback path — identical outcomes by construction.
     uses = meta["term_uses_dom"]  # [T, D]
     t = uses.shape[0]
-    cells = d + n
-    dom_ids = xp.arange(d, dtype=xp.float32)
-    cc = nd @ (uses * dom_ids[None, :]).T  # [P, T] coarse cell id (sum of ≤1 one-hot)
     has_c = nd @ uses.T  # [P, T] 1 if the chosen node has the term's coarse key
-    cell = xp.where(has_c > 0, cc, d + choice[:, None].astype(xp.float32))
-    g = (xp.arange(t, dtype=xp.float32)[None, :] * cells + cell).astype(xp.int32)  # [P, T]
     carr = ps["pod_aa_carries"] * accf[:, None]
     matc = ps["pod_aa_matched"] * accf[:, None]
-    gf = g.reshape(-1)
-    min_carrier = _scatter_min(xp, t * cells, gf, xp.where(carr > 0, rank_f[:, None], RANK_INF).reshape(-1))
-    min_matched = _scatter_min(xp, t * cells, gf, xp.where(matc > 0, rank_f[:, None], RANK_INF).reshape(-1))
-    min_c_at = min_carrier[g]  # [P, T]
-    min_m_at = min_matched[g]
-    bad_aa = ((matc > 0) & (rank_f[:, None] > min_c_at)) | ((carr > 0) & (rank_f[:, None] > min_m_at))
+    if t * d <= DENSE_CELLS:
+        m3 = nd[:, None, :] * uses[None, :, :]  # [P,T,D] one-hot coarse cell under t
+
+        def _earlier_in_cell(v):  # [P,T] 0/1 → [P,T] "an earlier v-pod shares my coarse cell"
+            v3 = v[:, :, None] * m3
+            ec = xp.cumsum(v3, axis=0) - v3  # exclusive
+            return (ec * m3).sum(axis=2) > 0
+
+        fine = has_c == 0
+        carr_c, matc_c = carr * has_c, matc * has_c
+        # Fine cells: min accepted rank per (node, term) via one row scatter.
+        min_c_fine = _row_scatter_min(xp, n, choice, xp.where((carr * fine) > 0, rank_f[:, None], RANK_INF))
+        min_m_fine = _row_scatter_min(xp, n, choice, xp.where((matc * fine) > 0, rank_f[:, None], RANK_INF))
+        earlier_c = _earlier_in_cell(carr_c) | (fine & (rank_f[:, None] > min_c_fine[choice]))
+        earlier_m = _earlier_in_cell(matc_c) | (fine & (rank_f[:, None] > min_m_fine[choice]))
+        bad_aa = ((matc > 0) & earlier_c) | ((carr > 0) & earlier_m)
+    else:
+        cells = d + n
+        dom_ids = xp.arange(d, dtype=xp.float32)
+        cc = nd @ (uses * dom_ids[None, :]).T  # [P, T] coarse cell id (sum of ≤1 one-hot)
+        cell = xp.where(has_c > 0, cc, d + choice[:, None].astype(xp.float32))
+        g = (xp.arange(t, dtype=xp.float32)[None, :] * cells + cell).astype(xp.int32)  # [P, T]
+        gf = g.reshape(-1)
+        min_carrier = _scatter_min(xp, t * cells, gf, xp.where(carr > 0, rank_f[:, None], RANK_INF).reshape(-1))
+        min_matched = _scatter_min(xp, t * cells, gf, xp.where(matc > 0, rank_f[:, None], RANK_INF).reshape(-1))
+        min_c_at = min_carrier[g]  # [P, T]
+        min_m_at = min_matched[g]
+        bad_aa = ((matc > 0) & (rank_f[:, None] > min_c_at)) | ((carr > 0) & (rank_f[:, None] > min_m_at))
     keep = accepted & ~bad_aa.any(axis=1)
 
     # ---- positive affinity bootstrap (within-round) -----------------------
@@ -698,16 +759,29 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     skew = meta["sp_skew"]  # [S]
     declares, matched = ps["pod_sp_declares"], ps["pod_sp_matched"]
     in_cell = nd @ uses_sp.T  # [P, S] 1 iff chosen node carries the key
-    dm = accf[:, None] * declares * matched * in_cell  # declaring+matching
+    # Claimant mass (dm/dn) is based on ``keep`` — the survivors of the
+    # anti-affinity and positive-affinity filters above — NOT on the raw
+    # capacity accept: a pod those filters already dropped can never commit
+    # this round, so counting it would (a) waste quota slots in the rank
+    # prefix (a dead claimant at prefix 0 steals the slot from a live one,
+    # deferring it a round for nothing) and (b) taint its cell's certainty
+    # mass below, freezing the water line at one level per round — measured
+    # as the 64-round tail at 50k x 5k with 10% AA/spread overlap
+    # (scripts/bench_constrained.py).
+    keep_f = keep.astype(xp.float32)
+    dm = keep_f[:, None] * declares * matched * in_cell  # declaring+matching
     mo = accf[:, None] * (1.0 - declares) * matched  # matching-only (keyless→0 via matmul)
-    dn = accf[:, None] * declares * (1.0 - matched) * in_cell  # declaring-only
+    dn = keep_f[:, None] * declares * (1.0 - matched) * in_cell  # declaring-only
     # Two count bases, deliberately different (soundness, not sloppiness):
-    #   c0 — the quota DENOMINATOR — overcounts: every capacity-accepted
-    #     matched pod is in, even ones a later filter step drops.  Overcount
-    #     only shrinks quota (conservative), and it is *required* for
-    #     cross-constraint soundness: a pod kept by its own constraint's
-    #     quota may land in this constraint's domain, so its mass must be
-    #     assumed present at the declarer's turn in the witness order.
+    #   c0 — the quota DENOMINATOR — overcounts matching-only mass: every
+    #     capacity-accepted NON-declaring matched pod is in, even ones a
+    #     later constraint's quota drops.  Overcount only shrinks quota
+    #     (conservative), and it is *required* for cross-constraint
+    #     soundness: a pod kept by its own constraint's quota may land in
+    #     this constraint's domain, so its mass must be assumed present at
+    #     the declarer's turn in the witness order.  (Declaring claimants of
+    #     THIS constraint need no such caution: their fate is decided by
+    #     this constraint's own quota below.)
     #   c0_cert — the water-line (lo) base — counts only mass CERTAIN to
     #     place this round: round-start state plus post-anti-affinity
     #     survivors that declare no spread constraint (nothing after this
@@ -715,7 +789,6 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     #     real violations: pods capacity-accepted into other domains but
     #     deferred by their own skew quota inflated the min, opening quota
     #     here (caught by the replay certificate at synth seed 4).
-    keep_f = keep.astype(xp.float32)
     declares_n = declares.sum(axis=1)  # [P]
     declares_any = xp.minimum(declares_n, 1.0)
     certain = keep_f[:, None] * (1.0 - declares_any)[:, None] * matched
@@ -723,10 +796,10 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     c0_cert = state["sp_counts"] + (certain.T @ nd) * uses_sp
     dem = (dm.T @ nd) * uses_sp  # [S, D]
     # A quota-kept claimant is certain iff nothing later can drop it: it
-    # survived anti-affinity and this is its only spread constraint.  Cells
-    # containing any uncertain claimant contribute no fill to the water line
-    # (an uncertain pod can hold a quota slot and then drop).
-    dm_cert = keep_f[:, None] * dm * (declares_n == 1.0).astype(xp.float32)[:, None]
+    # survived the filters above and this is its only spread constraint.
+    # Cells containing any uncertain claimant contribute no fill to the
+    # water line (an uncertain pod can hold a quota slot and then drop).
+    dm_cert = dm * (declares_n == 1.0).astype(xp.float32)[:, None]
     dem_unc = dem - (dm_cert.T @ nd) * uses_sp  # [S, D] uncertain demand
 
     def _masked_lo(c):
@@ -742,28 +815,38 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
         lo = _masked_lo(c0_cert + _fills(q))
     q_final = xp.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp  # [S, D]
 
-    # Rank-prefix of each declaring+matching pod within its (s, domain) cell:
-    # flatten (s, p) s-major so a stable sort by cell id groups cells while
-    # preserving rank order, then position-in-segment via a cummax of segment
-    # starts.  Array order == rank order among this round's claimants.
-    p_axis = nd.shape[0]
-    cc_sp = nd @ (uses_sp * dom_ids[None, :]).T  # [P, S] coarse cell id
-    cells_sp = d + 1
-    sentinel = xp.float32(d)
-    cell_sp = xp.where(dm > 0, cc_sp, sentinel)  # non-claimants → shared sentinel cell
-    g_sp = (xp.arange(s_axis, dtype=xp.float32)[None, :] * cells_sp + cell_sp).T.reshape(-1)  # [S*P]
-    order = _argsort_stable(xp, g_sp)
-    g_sorted = g_sp[order]
-    idx = xp.arange(s_axis * p_axis, dtype=xp.float32)
-    is_start = xp.concatenate([xp.ones((1,), dtype=bool), g_sorted[1:] != g_sorted[:-1]])
-    seg_start = _cummax(xp, xp.where(is_start, idx, 0.0))
-    pos_sorted = idx - seg_start
-    if xp is np:
-        pos_flat = np.empty_like(pos_sorted)
-        pos_flat[order] = pos_sorted
+    # Rank-prefix of each declaring+matching pod within its (s, domain) cell
+    # (array order == rank order among this round's claimants).  Dense path:
+    # exclusive cumsum of the [P,S,D] claimant one-hot along the pod axis,
+    # gathered at each pod's own cell — exact small-integer f32 counts.
+    # Fallback for huge S·D: flatten (s, p) s-major so a stable sort by cell
+    # id groups cells while preserving rank order, then position-in-segment
+    # via a cummax of segment starts.
+    if s_axis * d <= DENSE_CELLS:
+        m3_sp = nd[:, None, :] * uses_sp[None, :, :]  # [P,S,D] claimant cell one-hot
+        c3 = dm[:, :, None] * m3_sp
+        ec3 = xp.cumsum(c3, axis=0) - c3  # exclusive
+        prefix = (ec3 * m3_sp).sum(axis=2)  # [P, S]
     else:
-        pos_flat = xp.zeros_like(pos_sorted).at[order].set(pos_sorted)
-    prefix = pos_flat.reshape(s_axis, p_axis).T  # [P, S]
+        p_axis = nd.shape[0]
+        dom_ids = xp.arange(d, dtype=xp.float32)
+        cc_sp = nd @ (uses_sp * dom_ids[None, :]).T  # [P, S] coarse cell id
+        cells_sp = d + 1
+        sentinel = xp.float32(d)
+        cell_sp = xp.where(dm > 0, cc_sp, sentinel)  # non-claimants → shared sentinel cell
+        g_sp = (xp.arange(s_axis, dtype=xp.float32)[None, :] * cells_sp + cell_sp).T.reshape(-1)  # [S*P]
+        order = _argsort_stable(xp, g_sp)
+        g_sorted = g_sp[order]
+        idx = xp.arange(s_axis * p_axis, dtype=xp.float32)
+        is_start = xp.concatenate([xp.ones((1,), dtype=bool), g_sorted[1:] != g_sorted[:-1]])
+        seg_start = _cummax(xp, xp.where(is_start, idx, 0.0))
+        pos_sorted = idx - seg_start
+        if xp is np:
+            pos_flat = np.empty_like(pos_sorted)
+            pos_flat[order] = pos_sorted
+        else:
+            pos_flat = xp.zeros_like(pos_sorted).at[order].set(pos_sorted)
+        prefix = pos_flat.reshape(s_axis, p_axis).T  # [P, S]
 
     q_at = nd @ q_final.T  # [P, S] quota of own cell (0 where keyless)
     keep_dm = prefix < q_at
@@ -788,8 +871,6 @@ def constraint_commit(
 ) -> dict:
     """Fold the round's final accepted placements into the domain state."""
     ndc = meta["node_dom_c"]
-    n = ndc.shape[0]
-    t = meta["term_uses_dom"].shape[0]
     nd = ndc[choice]
     accf = accepted.astype(xp.float32)
     matc = ps["pod_aa_matched"] * accf[:, None]  # [P, T]
@@ -798,24 +879,20 @@ def constraint_commit(
     aa_dom_m = _clip01(xp, state["aa_dom_m"] + (matc.T @ nd) * uses)
     aa_dom_c = _clip01(xp, state["aa_dom_c"] + (carr.T @ nd) * uses)
     # Fine-granularity: chosen node lacks the term's coarse key (or the key
-    # itself is fine) → the node is its own domain.
+    # itself is fine) → the node is its own domain.  Row scatters (one
+    # [T]-wide update per pod, see _row_scatter_max_t) replace the flattened
+    # t·n scalar form — bit-identical, ~free vs ~14 ms each on TPU.
     has_c = nd @ uses.T  # [P, T]
-    fine_m = (matc * (has_c == 0)).T.reshape(-1)  # [T*P]
-    fine_c = (carr * (has_c == 0)).T.reshape(-1)
-    gn = (xp.arange(t, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
-    aa_node_m = _scatter_max1(xp, state["aa_node_m"].reshape(-1), gn, fine_m).reshape(t, n)
-    aa_node_c = _scatter_max1(xp, state["aa_node_c"].reshape(-1), gn, fine_c).reshape(t, n)
+    aa_node_m = _row_scatter_max_t(xp, state["aa_node_m"], choice, matc * (has_c == 0))
+    aa_node_c = _row_scatter_max_t(xp, state["aa_node_c"], choice, carr * (has_c == 0))
     if hard_pa:
         # Positive affinity: every accepted pod matching a PA term activates
         # its landing domain (declaring or not — matches are matches).
         uses_pa = meta["pa_uses_dom"]
-        ta = uses_pa.shape[0]
         matc_pa = ps["pod_pa_matched"] * accf[:, None]  # [P, Ta]
         pa_dom_m = _clip01(xp, state["pa_dom_m"] + (matc_pa.T @ nd) * uses_pa)
         has_c_pa = nd @ uses_pa.T  # [P, Ta]
-        fine_pa = (matc_pa * (has_c_pa == 0)).T.reshape(-1)
-        gn_pa = (xp.arange(ta, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
-        pa_node_m = _scatter_max1(xp, state["pa_node_m"].reshape(-1), gn_pa, fine_pa).reshape(ta, n)
+        pa_node_m = _row_scatter_max_t(xp, state["pa_node_m"], choice, matc_pa * (has_c_pa == 0))
     else:
         pa_dom_m = state["pa_dom_m"]
         pa_node_m = state["pa_node_m"]
@@ -823,18 +900,10 @@ def constraint_commit(
         # Preferred terms: accepted matched pods bump their landing domain's
         # count (coarse) or node's count (fine/keyless) — same split as PA.
         uses_ppa = meta["ppa_uses_dom"]
-        tpp = uses_ppa.shape[0]
         matc_ppa = ps["pod_ppa_matched"] * accf[:, None]  # [P, Tp]
         ppa_dom_cnt = state["ppa_dom_cnt"] + (matc_ppa.T @ nd) * uses_ppa
         has_c_ppa = nd @ uses_ppa.T  # [P, Tp]
-        fine_ppa = (matc_ppa * (has_c_ppa == 0)).T.reshape(-1)
-        gn_ppa = (xp.arange(tpp, dtype=xp.int32)[:, None] * n + choice[None, :].astype(xp.int32)).reshape(-1)
-        if xp is np:
-            flat = state["ppa_node_cnt"].reshape(-1).copy()
-            np.add.at(flat, gn_ppa, fine_ppa)
-            ppa_node_cnt = flat.reshape(tpp, n)
-        else:
-            ppa_node_cnt = state["ppa_node_cnt"].reshape(-1).at[gn_ppa].add(fine_ppa).reshape(tpp, n)
+        ppa_node_cnt = _row_scatter_add_t(xp, state["ppa_node_cnt"], choice, matc_ppa * (has_c_ppa == 0))
     else:
         ppa_dom_cnt = state["ppa_dom_cnt"]
         ppa_node_cnt = state["ppa_node_cnt"]
