@@ -393,3 +393,69 @@ def test_dense_and_fallback_filter_paths_agree(monkeypatch):
     assert r_dense.bindings == r_fallback.bindings
     assert r_dense.rounds == r_fallback.rounds
     assert (r_dense.stats["acc_round"] == r_fallback.stats["acc_round"]).all()
+
+
+def test_pack_constraints_match_memo():
+    """A warm match_memo must change nothing: identical tensors vs a fresh
+    pack, recompute on object replacement (identity miss), and self-clear
+    when the term vocabulary changes."""
+    import numpy as onp
+
+    snap = synth_cluster(
+        n_nodes=40, n_pending=200, n_bound=80, seed=5,
+        anti_affinity_fraction=0.2, spread_fraction=0.2, pod_affinity_fraction=0.1,
+        preferred_pod_affinity_fraction=0.1, schedule_anyway_fraction=0.1,
+    )
+    packed = pack_snapshot(snap)
+    args = (snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes)
+    memo: dict = {}
+    cold = pack_constraints(*args, match_memo=memo)
+    assert len(memo) > 1  # sig + per-pod entries
+    warm = pack_constraints(*args, match_memo=memo)  # 100% identity hits
+    fresh = pack_constraints(*args)  # no memo at all
+    for name in vars(cold):
+        a, b, c = getattr(cold, name), getattr(warm, name), getattr(fresh, name)
+        if isinstance(a, onp.ndarray):
+            assert (a == b).all() and (a == c).all(), name
+        else:
+            assert a == b == c, name
+
+    # A replaced pod object (the API layer's modification contract) misses
+    # the memo and is re-matched: flip one pending pod's app label to a
+    # value NO term matches (via a NEW object), and check the memoized pack
+    # agrees with a fresh one — i.e. the stale cached match is not reused.
+    pods2 = list(snap.pods)
+    victim_idx = next(
+        i for i, p in enumerate(pods2)
+        if p.spec is not None and not p.spec.node_name and p.spec.anti_affinity
+    )
+    donor = pods2[victim_idx]
+    import copy
+
+    clone = copy.deepcopy(donor)
+    clone.metadata.labels = dict(donor.metadata.labels or {})
+    clone.metadata.labels["app"] = "app-definitely-unmatched"
+    pods2[victim_idx] = clone
+    snap2 = ClusterSnapshot.build(list(snap.nodes), pods2)
+    got = pack_constraints(
+        snap2, snap2.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        match_memo=memo,
+    )
+    want = pack_constraints(
+        snap2, snap2.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+    )
+    for name in vars(got):
+        a, b = getattr(got, name), getattr(want, name)
+        if isinstance(a, onp.ndarray):
+            assert (a == b).all(), name
+
+    # Vocab change (the clone's new app label creates a new spread term key
+    # only if it declares spread; force a change by dropping every AA term):
+    pods3 = [p for p in snap.pods if p.spec is None or not p.spec.anti_affinity]
+    snap3 = ClusterSnapshot.build(list(snap.nodes), pods3)
+    sig_before = memo["sig"]
+    pack_constraints(
+        snap3, snap3.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        match_memo=memo,
+    )
+    assert memo["sig"] != sig_before  # memo was invalidated + re-signed

@@ -90,6 +90,7 @@ __all__ = [
     "ConstraintSet",
     "UntensorizableConstraints",
     "pack_constraints",
+    "prune_match_memo",
     "round_blocked_masks",
     "blocked_block",
     "constraint_filter",
@@ -123,6 +124,18 @@ DENSE_CELLS = 1024
 
 class UntensorizableConstraints(Exception):
     """Constraint structure exceeds the tensor budgets — use the host path."""
+
+
+# Sentinel key under which a match_memo stores the term-vocabulary signature
+# it is valid for (all other keys are ``id(pod)`` ints, so no collision).
+_MEMO_SIG = "sig"
+
+
+def prune_match_memo(memo: dict, live_ids: set) -> dict:
+    """Drop memo entries for dead pod objects, preserving the signature
+    sentinel — the single owner of the memo's internal key layout (callers
+    must not hand-filter by key type)."""
+    return {k: v for k, v in memo.items() if k in live_ids or k == _MEMO_SIG}
 
 
 def _term_probe_index(term_list):
@@ -272,12 +285,23 @@ def pack_constraints(
     max_spread: int = MAX_SPREAD,
     max_coarse_domains: int = MAX_COARSE_DOMAINS,
     label_block: int = 8,
+    match_memo: dict | None = None,
 ) -> ConstraintSet | None:
     """Build constraint tensors for one cycle; None if nothing constrained.
 
     Raises :class:`UntensorizableConstraints` when the structure exceeds the
     budgets (the controller's cue to run the host sequential phase instead).
-    """
+
+    ``match_memo`` (same contract as ops/pack.py ``res_memo``: object-
+    identity keyed, ``id(pod) -> (pod, matched-id tuples)``, caller-owned
+    and caller-pruned) memoizes the five selector-match queries per pod —
+    the dominant host cost of a constrained cycle (the matched-bitmap and
+    placed-state loops are O(pods × terms) term_matches calls without it;
+    PERF.md "known remaining headroom").  The memo is only valid for one
+    term-vocabulary signature: it self-clears whenever the vocab changes
+    (a new app's term appearing is a full-rematch event, steady-state
+    cycles hit ~100%).  The API layer replaces pod objects on every
+    modification, so identity hits are exactly the unchanged pods."""
     nodes = list(snapshot.nodes)
     assert tuple(n.name for n in nodes) == tuple(node_names)
 
@@ -425,8 +449,39 @@ def pack_constraints(
     ppa_probe, ppa_res = _term_probe_index(ppa_terms)
     sp_probe, sp_res = _term_probe_index(sp_terms)
     sps_probe, sps_res = _term_probe_index(sps_terms)
+
+    if match_memo is not None:
+        sig = (
+            tuple(k for k, _ in aa_terms),
+            tuple(k for k, _ in pa_terms),
+            tuple(k for k, _ in ppa_terms),
+            tuple(k for k, _ in sp_terms),
+            tuple(k for k, _ in sps_terms),
+        )
+        if match_memo.get(_MEMO_SIG) != sig:
+            match_memo.clear()
+            match_memo[_MEMO_SIG] = sig
+
+    def _matched_all(pod):
+        """(aa, pa, ppa, sp, sps) matched-id lists for one pod, memoized."""
+        if match_memo is not None:
+            hit = match_memo.get(id(pod))
+            if hit is not None and hit[0] is pod:
+                return hit[1]
+        ns, labels = pod.metadata.namespace, pod.metadata.labels
+        ids = (
+            _matched_term_ids(aa_terms, aa_probe, aa_res, ns, labels),
+            _matched_term_ids(pa_terms, pa_probe, pa_res, ns, labels),
+            _matched_term_ids(ppa_terms, ppa_probe, ppa_res, ns, labels),
+            _matched_term_ids(sp_terms, sp_probe, sp_res, ns, labels),
+            _matched_term_ids(sps_terms, sps_probe, sps_res, ns, labels),
+        )
+        if match_memo is not None:
+            match_memo[id(pod)] = (pod, ids)
+        return ids
+
     for pi, p in enumerate(pending):
-        ns, labels = p.metadata.namespace, p.metadata.labels
+        ns = p.metadata.namespace
         if p.spec is not None and p.spec.anti_affinity:
             for t in p.spec.anti_affinity:
                 pod_aa_carries[pi, aa_index[_aa_key(ns, t)]] = 1.0
@@ -444,15 +499,16 @@ def pack_constraints(
                     pod_sp_declares[pi, sp_index[_sp_key(ns, c)]] = 1.0
                 else:
                     pod_sps_declares[pi, sps_index[_sp_key(ns, c)]] = 1.0
-        for ti in _matched_term_ids(aa_terms, aa_probe, aa_res, ns, labels):
+        aa_m, pa_m, ppa_m, sp_m, sps_m = _matched_all(p)
+        for ti in aa_m:
             pod_aa_matched[pi, ti] = 1.0
-        for ti in _matched_term_ids(pa_terms, pa_probe, pa_res, ns, labels):
+        for ti in pa_m:
             pod_pa_matched[pi, ti] = 1.0
-        for ti in _matched_term_ids(ppa_terms, ppa_probe, ppa_res, ns, labels):
+        for ti in ppa_m:
             pod_ppa_matched[pi, ti] = 1.0
-        for si in _matched_term_ids(sp_terms, sp_probe, sp_res, ns, labels):
+        for si in sp_m:
             pod_sp_matched[pi, si] = 1.0
-        for si in _matched_term_ids(sps_terms, sps_probe, sps_res, ns, labels):
+        for si in sps_m:
             pod_sps_matched[pi, si] = 1.0
 
     # --- initial state from placed pods -----------------------------------
@@ -487,34 +543,32 @@ def pack_constraints(
         else:
             arr_node[ti, ni] += 1.0
 
-    if aa_terms or pa_terms or ppa_terms:
+    if aa_terms or pa_terms or ppa_terms or sp_terms or sps_terms:
+        want_sp = bool(sp_terms or sps_terms)
         for q, qnode in snapshot.placed_pods():
-            q_ns, q_labels = q.metadata.namespace, q.metadata.labels
-            for ti in _matched_term_ids(aa_terms, aa_probe, aa_res, q_ns, q_labels):
+            aa_m, pa_m, ppa_m, sp_m, sps_m = _matched_all(q)
+            for ti in aa_m:
                 _mark(aa_dom_m, aa_node_m, ti, aa_terms[ti][1][1], qnode.name)
-            for ti in _matched_term_ids(pa_terms, pa_probe, pa_res, q_ns, q_labels):
+            for ti in pa_m:
                 _mark(pa_dom_m, pa_node_m, ti, pa_terms[ti][1][1], qnode.name)
-            for ti in _matched_term_ids(ppa_terms, ppa_probe, ppa_res, q_ns, q_labels):
+            for ti in ppa_m:
                 _count(ppa_dom_cnt, ppa_node_cnt, ti, ppa_terms[ti][1][1], qnode.name)
+            if want_sp and (sp_m or sps_m):
+                nlabels = (nodes[node_index[qnode.name]].metadata.labels) or {}
+                for si in sp_m:
+                    c = sp_terms[si][1][1]
+                    v = nlabels.get(c.topology_key)
+                    if v is not None:
+                        sp_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
+                for si in sps_m:
+                    c = sps_terms[si][1][1]
+                    v = nlabels.get(c.topology_key)
+                    if v is not None:
+                        sps_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
         for q, qnode in placed_with_terms:
             ns = q.metadata.namespace
             for t in q.spec.anti_affinity:
                 _mark(aa_dom_c, aa_node_c, aa_index[_aa_key(ns, t)], t, qnode.name)
-    if sp_terms or sps_terms:
-        for q, qnode in snapshot.placed_pods():
-            q_ns, q_labels = q.metadata.namespace, q.metadata.labels
-            ni = node_index[qnode.name]
-            nlabels = nodes[ni].metadata.labels or {}
-            for si in _matched_term_ids(sp_terms, sp_probe, sp_res, q_ns, q_labels):
-                c = sp_terms[si][1][1]
-                v = nlabels.get(c.topology_key)
-                if v is not None:
-                    sp_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
-            for si in _matched_term_ids(sps_terms, sps_probe, sps_res, q_ns, q_labels):
-                c = sps_terms[si][1][1]
-                v = nlabels.get(c.topology_key)
-                if v is not None:
-                    sps_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
 
     return ConstraintSet(
         pod_aa_carries=pod_aa_carries,

@@ -151,6 +151,10 @@ class Scheduler:
         # id(pod) -> (pod, PodResources): amortizes the bound-usage request
         # summation across cycles (objects change only on watch events).
         self._res_memo: dict[int, tuple] = {}
+        # id(pod) -> (pod, matched-term-id tuples): amortizes the selector-
+        # match queries of constrained cycles the same way (self-clears on
+        # term-vocabulary change; ops/constraints.pack_constraints).
+        self._cons_memo: dict = {}
         # Pipelined binding (SURVEY.md §2b PP): the binding POSTs of cycle k
         # run on a worker thread while cycle k+1 syncs/packs/solves.  The
         # assumed cache (pod full name -> node) makes in-flight bindings
@@ -308,9 +312,15 @@ class Scheduler:
         the cached node tensors in place (ops/pack.extend_node_vocabs)
         instead of abandoning the incremental path."""
         sig = self.reflector.node_set_signature()
-        if len(self._res_memo) > 4 * max(1, len(snapshot.pods)):
+        memo_cap = 4 * max(1, len(snapshot.pods))
+        if len(self._res_memo) > memo_cap or len(self._cons_memo) > memo_cap:
             live = {id(p) for p in snapshot.pods}
-            self._res_memo = {k: v for k, v in self._res_memo.items() if k in live}
+            if len(self._res_memo) > memo_cap:
+                self._res_memo = {k: v for k, v in self._res_memo.items() if k in live}
+            if len(self._cons_memo) > memo_cap:
+                from ..ops.constraints import prune_match_memo
+
+                self._cons_memo = prune_match_memo(self._cons_memo, live)
         if self._packed is not None and sig == self._node_sig:
             try:
                 extended = extend_node_vocabs(self._packed, snapshot)
@@ -793,6 +803,7 @@ class Scheduler:
                     packed.padded_pods,
                     packed.node_names,
                     packed.padded_nodes,
+                    match_memo=self._cons_memo,
                 )
                 if cons is not None:
                     # Attached to a per-cycle copy only: the cached pack is
