@@ -181,15 +181,12 @@ class TpuBackend(SchedulingBackend):
             cstate = {k: jax.device_put(v, self.device) for k, v in cons.state_arrays().items()}
         # Driver choice (profiles.py `driver`): monolithic keeps the whole
         # auction in one jit program — one host sync per cycle, no jit-
-        # boundary relayouts — which on the real (tunnelled) chip beats the
-        # epoch driver by ~4x on short unconstrained cycles; the epoch
-        # driver's size-halving wins by ~4x on long-tailed constrained
-        # cycles (rationale + measurements in profiles.py).  Both drivers
-        # are bit-identical in results (tests/test_assign.py).
-        driver = profile.driver
-        if driver == "auto":
-            driver = "epochs" if cons is not None else "monolithic"
-        drive = assign_cycle if driver == "monolithic" else assign_cycle_epochs
+        # boundary relayouts — and since the in-jit static size chain
+        # (assign_cycle) it also shrinks the per-round cost with the active
+        # count, so it beats the host-driven epoch driver on BOTH cycle
+        # shapes (measurements in profiles.py).  Both drivers are
+        # bit-identical in results (tests/test_assign.py).
+        drive = assign_cycle_epochs if profile.driver == "epochs" else assign_cycle
         assigned, rounds, _avail, acc_round, rank_of = drive(
             nodes,
             pods,

@@ -38,19 +38,19 @@ class SchedulingProfile:
     preferred_affinity_weight: float = 1.0
     soft_taint_weight: float = 10.0
     topology_weight: float = 1.0
-    # Auction driver (backends/tpu.py): "monolithic" runs the whole auction
-    # as ONE on-device while_loop (one host sync per cycle); "epochs" is the
-    # host-driven size-shrinking driver (ops/assign.py assign_cycle_epochs);
-    # "auto" (default) picks per cycle shape.  Unconstrained cycles converge
-    # in ~9 rounds, so monolithic wins: every jit re-entry pays a
-    # narrow-operand relayout (~200 ms at 100k pods) and every host sync
-    # ~70 ms of tunnel latency — measured 2.35 s epochs vs 0.55 s monolithic
-    # on the 100k x 10k north star.  Constrained cycles have a long
-    # genuine-dependency tail (tens of rounds, a handful of accepts each);
-    # monolithic pays full padded-[P,S]/[P,T] constraint math every tail
-    # round, while the epoch driver's halving chain shrinks it with the
-    # active count — measured 4.3 s epochs vs 15.7 s monolithic at 50k x 5k
-    # with the bench constraint mix (scripts/bench_constrained.py, on chip).
+    # Auction driver (backends/tpu.py): "monolithic" (and "auto", the
+    # default) runs the whole auction as ONE jit program containing a
+    # static size chain — the round body at quartering array sizes with
+    # on-device result folding (ops/assign.py assign_cycle) — so the
+    # per-round accept/compact/constraint cost shrinks with the active
+    # count at zero host syncs.  "epochs" is the host-driven size-shrinking
+    # driver (assign_cycle_epochs), kept for environments with cheap jit
+    # boundaries; on the tunnelled chip each of its re-entries pays a
+    # narrow-operand relayout (~200 ms at 100k pods) plus ~70 ms sync.
+    # Measured on chip at 100k x 10k (scripts/bench_constrained.py +
+    # /tmp experiments, round 4): unconstrained 0.25 s staged-monolithic
+    # (epochs 2.35 s back in round 3); constrained 1.39 s staged-monolithic
+    # vs 2.13 s epochs (and 15.7 s for the round-3 unstaged monolithic).
     driver: str = "auto"
     # Expert-parallel routing (parallel/routing.py): node label whose values
     # partition the cluster into per-pool scheduling shards; None = off.

@@ -265,6 +265,21 @@ def _pad0(v, extra):
     return jnp.pad(v, ((0, extra),) + ((0, 0),) * (v.ndim - 1))
 
 
+# Shrink-chain floor: below this the accept phase is negligible and further
+# steps would only multiply compiled variants.
+_MIN_EPOCH_SIZE = 256
+
+
+def _chain_size(target: int, block: int) -> int:
+    """Align one shrinking-chain size — THE single rule for both drivers
+    (assign_cycle's static in-jit chain and assign_cycle_epochs' host-driven
+    halving): block multiples while above ``block`` (the blockwise choose
+    requires it), floored at _MIN_EPOCH_SIZE."""
+    if target > block:
+        target = ((target + block - 1) // block) * block
+    return max(_MIN_EPOCH_SIZE, target)
+
+
 def _compact(ps):
     """Stable active-first packing — relative (priority) order preserved.
 
@@ -399,6 +414,18 @@ def assign_cycle(
     Pallas kernel covers constraint cycles too: the per-round blocked/penalty
     node masks ride in as extra node-side kernel operands (choose_block_pallas
     ``cons_pod``/``cons_node``), while accept/commit stay in jnp.
+
+    The auction runs as a STATIC SIZE CHAIN inside the one jit program: the
+    same round body at shrinking pod-array sizes (quartering, block-aligned,
+    floored at _MIN_EPOCH_SIZE), advancing to the next size once the active
+    count fits it.  Compaction keeps actives in a prefix, so each stage
+    transition folds the finished rows' results into full-size rank-space
+    buffers and takes a static prefix slice — all on device, zero host
+    syncs.  This is the epoch driver's halving idea without its per-epoch
+    jit-boundary relayout (~200 ms at 100k pods) and host-sync (~70 ms)
+    costs; results are bit-identical to a single full-size loop because
+    dropped rows are exactly the inactive ones and padding rows never
+    influence a round (sentinel cells, rank-keyed jitter).
     """
     p_out = pods["pod_req"].shape[0]
     perm, ps = _prepare_pods(pods, block)
@@ -406,30 +433,60 @@ def assign_cycle(
     if cmeta is not None:
         cstate = {**cstate, "stall": jnp.int32(0)}
 
-    def cond(state):
-        _, _, n_active, rounds, cst = state
-        go = (rounds < max_rounds) & (n_active > 0)
-        if cmeta is not None:
-            go = go & (cst["stall"] < STALL_ROUNDS)
-        return go
-
     body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa)
-    state0 = (nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32), jnp.int32(0), cstate)
-    avail, ps, _, rounds, _ = lax.while_loop(cond, body, state0)
+
+    # Static size chain: p, p/4, p/16, … — ONE alignment/floor rule shared
+    # with the epoch driver (_chain_size).  A stage is only appended when it
+    # at least halves the previous one: a near-no-op tail stage (e.g. 300 →
+    # 256) would pay a full extra while_loop + compiled round-body variant
+    # for negligible savings.
+    sizes = [p]
+    while True:
+        nxt = _chain_size(sizes[-1] // 4, block)
+        if nxt > sizes[-1] // 2:
+            break
+        sizes.append(nxt)
+
+    def make_cond(next_size):
+        def cond(state):
+            _, _, n_active, rounds, cst = state
+            go = (rounds < max_rounds) & (n_active > 0)
+            if cmeta is not None:
+                go = go & (cst["stall"] < STALL_ROUNDS)
+            if next_size:
+                # Hand off to the next (smaller) stage once actives fit it.
+                go = go & (n_active > next_size)
+            return go
+
+        return cond
+
+    assigned_rank = jnp.zeros((p,), jnp.int32)
+    acc_round_rank = jnp.zeros((p,), jnp.int32)
+    avail = nodes["node_avail"]
+    n_active = ps["active"].sum(dtype=jnp.int32)
+    rounds = jnp.int32(0)
+    cst = cstate
+    for i, size in enumerate(sizes):
+        if i > 0:
+            # Fold the rows about to be dropped (all inactive — actives sit
+            # in the compacted prefix and fit ``size``), then slice.
+            assigned_rank = assigned_rank.at[ps["ranks"]].set(ps["assigned"])
+            acc_round_rank = acc_round_rank.at[ps["ranks"]].set(ps["acc_round"])
+            ps = {k: v[:size] for k, v in ps.items()}
+        next_size = sizes[i + 1] if i + 1 < len(sizes) else 0
+        avail, ps, n_active, rounds, cst = lax.while_loop(
+            make_cond(next_size), body, (avail, ps, n_active, rounds, cst)
+        )
 
     # Undo compaction (rank space), then the priority permutation (original
     # pod order), dropping block padding.
-    assigned_rank = jnp.zeros((p,), jnp.int32).at[ps["ranks"]].set(ps["assigned"])
+    assigned_rank = assigned_rank.at[ps["ranks"]].set(ps["assigned"])
     out = jnp.full((p_out,), -1, jnp.int32).at[perm].set(assigned_rank[:p_out])
-    acc_round_rank = jnp.zeros((p,), jnp.int32).at[ps["ranks"]].set(ps["acc_round"])
+    acc_round_rank = acc_round_rank.at[ps["ranks"]].set(ps["acc_round"])
     acc_round = jnp.full((p_out,), -1, jnp.int32).at[perm].set(acc_round_rank[:p_out])
     rank_of = jnp.zeros((p_out,), jnp.int32).at[perm].set(jnp.arange(p_out, dtype=jnp.int32))
     return out, rounds, avail, acc_round, rank_of
 
-
-# Epoch-size floor: below this the accept phase is negligible and further
-# halvings would only multiply compiled variants.
-_MIN_EPOCH_SIZE = 256
 
 # Constraint cycles stop after STALL_ROUNDS consecutive ZERO-acceptance
 # rounds (constant in ops/pack.py — jax-free for the native backend):
@@ -536,16 +593,12 @@ def assign_cycle_epochs(
             break
         if floor:
             break
-        # Halving chain: sizes above ``block`` stay block multiples (the
-        # blockwise choose requires it); below, the single-block choose path
-        # takes any size, so the chain continues down to _MIN_EPOCH_SIZE —
-        # late rounds then touch hundreds of rows, not a full block.
+        # Halving chain (alignment rule shared with assign_cycle's static
+        # in-jit chain: _chain_size), so late rounds touch hundreds of rows,
+        # not a full block.
         new_size = p_cur
         while new_size > _MIN_EPOCH_SIZE and n_active * 2 <= new_size:
-            half = new_size // 2
-            if half > block:
-                half = ((half + block - 1) // block) * block
-            new_size = max(_MIN_EPOCH_SIZE, half)
+            new_size = _chain_size(new_size // 2, block)
         if new_size < p_cur:
             # Fold the rows about to be dropped (all finished — actives sit
             # in the compacted prefix) into the rank-space result buffers.
