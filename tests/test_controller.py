@@ -174,3 +174,24 @@ def test_cli_rejects_bad_backend():
     )
     assert out.returncode == 2
     assert "invalid choice" in out.stderr
+
+def test_cli_driver_and_max_rounds_flags():
+    """--driver/--max-rounds reach the profile AND the driver dispatch:
+    run the TPU backend (forced-CPU jax) so profile.driver is actually
+    consumed (backends/tpu.py) — native ignores it.  Same bindings and
+    cycle count either driver; the tiny cap settles over extra cycles."""
+    base = [sys.executable, "-m", "tpu_scheduler.cli", "--backend=tpu",
+            "--nodes", "10", "--pods", "50", "--seed", "3"]
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    runs = {}
+    for driver in ("monolithic", "epochs"):
+        out = subprocess.run(
+            base + ["--driver", driver, "--max-rounds", "2"],
+            capture_output=True, text=True, cwd=cwd, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        summary = json.loads(out.stdout.strip().splitlines()[-1])
+        assert summary["bound_total"] == 50
+        runs[driver] = summary["counters"]["scheduler_cycles_total"]
+    assert runs["monolithic"] == runs["epochs"]

@@ -41,6 +41,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--policy", choices=["batch", "sample"], default="batch", help="batched cycle vs reference-style per-pod random sampling")
     p.add_argument("--profile", choices=sorted(PROFILES), default="default", help="scoring profile")
+    p.add_argument(
+        "--driver",
+        choices=["auto", "monolithic", "epochs"],
+        default=None,
+        help="auction driver override (profiles.SchedulingProfile.driver): auto/monolithic = one jit program with the in-jit size chain; epochs = host-driven size shrinking for boundary-cheap environments",
+    )
+    p.add_argument("--max-rounds", type=int, default=None, help="auction round cap override (profiles default: 32)")
     p.add_argument("--leader-elect", action="store_true", help="lease-based leader election: only the lease holder schedules; standbys keep caches warm and take over on leader loss")
     p.add_argument("--lease-name", default="tpu-scheduler", help="leader-election lease name")
     p.add_argument("--lease-duration", type=float, default=15.0, help="leader-election lease TTL (seconds)")
@@ -162,6 +169,10 @@ def main(argv: list[str] | None = None) -> int:
         fallback = None if args.no_fallback else NativeBackend()
 
     profile = PROFILES[args.profile]
+    if args.driver is not None:
+        profile = profile.with_(driver=args.driver)
+    if args.max_rounds is not None:
+        profile = profile.with_(max_rounds=args.max_rounds)
     if args.pool_key:
         profile = profile.with_(pool_key=args.pool_key)
     if args.preemption:
