@@ -10,8 +10,10 @@ Stages (each prints one PASS/FAIL line; exits nonzero on the first failure):
   3. full cycle:        TpuBackend.schedule with _pallas_proven asserted,
                         plain + constrained
   4. tile sweep:        flagship-shape choose timings across node_tile
-                        {512, 1024, 2048} (pod_tile 256) — pick the best
-                        for bench; (512, 2048)+ historically fails VMEM
+                        {512, 1024, 2048} (pod_tile 256) — a TIMING PROBE
+                        only: the default stays 512 (1024 timed faster but
+                        broke bit-parity at 20k x 2k on chip, 2026-07-31);
+                        (512, 2048)+ historically fails VMEM
   5. bench dry pass:    one reduced bench cycle (25k x 2.5k) end to end
 
 Never kill this mid-run (SIGTERM during device init wedges the tunnel);
@@ -76,7 +78,13 @@ def main() -> int:
         nodes, pods = split_device_arrays(a)
         solve_kw = dict(max_rounds=32, block=256)
         if constrained:
-            cons = pack_constraints(snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes)
+            # Same raised budgets as bench.py: the synth vocabularies are
+            # bounded but their distinct terms exceed the per-deployment-
+            # sized defaults.
+            cons = pack_constraints(
+                snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+                max_aa_terms=256, max_spread=256,
+            )
             pods.update({k: jax.numpy.asarray(v) for k, v in cons.pod_arrays().items()})
             solve_kw.update(
                 cmeta={k: jax.numpy.asarray(v) for k, v in cons.meta_arrays().items()},
@@ -101,7 +109,13 @@ def main() -> int:
         snap = synth_cluster(n_nodes=64, n_pending=256, n_bound=64, seed=5, **kw)
         packed = pack_snapshot(snap)
         if constrained:
-            cons = pack_constraints(snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes)
+            # Same raised budgets as bench.py: the synth vocabularies are
+            # bounded but their distinct terms exceed the per-deployment-
+            # sized defaults.
+            cons = pack_constraints(
+                snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+                max_aa_terms=256, max_spread=256,
+            )
             packed = replace(packed, constraints=cons)
         b = TpuBackend()
         b.schedule(packed, profile)
@@ -146,7 +160,10 @@ def main() -> int:
         log("FAIL: no node_tile compiled")
         return 1
     log(f"PASS: tile sweep — best node_tile {best[0]} at {best[1]*1e3:.1f} ms "
-        f"(default is 512; if {best[0]} != 512, consider changing choose_block_pallas's default)")
+        f"(default is 512 and must STAY 512: node_tile=1024 timed ~6%/cycle faster "
+        f"but breaks bit-parity with the jnp path at 20k x 2k on real hardware "
+        f"(measured 2026-07-31; 512 is bit-exact on the same shape), so the sweep "
+        f"is a timing probe only — any tile change needs the on-chip parity check first)")
 
     # -- 5: reduced bench pass (headline shape only — the constrained and
     # sharded evidence rows are the FULL bench's job) ----------------------
