@@ -10,10 +10,13 @@ Stages (each prints one PASS/FAIL line; exits nonzero on the first failure):
   3. full cycle:        TpuBackend.schedule with _pallas_proven asserted,
                         plain + constrained
   4. tile sweep:        flagship-shape choose timings across node_tile
-                        {512, 1024, 2048} (pod_tile 256) — a TIMING PROBE
-                        only: the default stays 512 (1024 timed faster but
-                        broke bit-parity at 20k x 2k on chip, 2026-07-31);
-                        (512, 2048)+ historically fails VMEM
+                        {512, 1024, 2048} (pod_tile 256) — a TIMING probe;
+                        any default change needs the on-chip parity check
+                        first.  History: 1024 originally broke bit-parity
+                        (Mosaic argmax tie-break, fixed 2026-07-31 with the
+                        explicit lowest-index min-reduction) and is now the
+                        measured-faster default; (512, 2048)+ historically
+                        fails VMEM
   5. bench dry pass:    one reduced bench cycle (25k x 2.5k) end to end
 
 Never kill this mid-run (SIGTERM during device init wedges the tunnel);
@@ -103,6 +106,32 @@ def main() -> int:
     if not parity(True):
         return 1
 
+    # -- 2b: exact-tie lowest-index check, COMPILED on chip ----------------
+    # Identical nodes + zero jitter weight: every feasible (pod, node)
+    # score ties exactly across a whole node tile, so any non-lowest
+    # Mosaic tie-break (the bug the min-reduction fixed) shifts choices
+    # away from node 0.  The interpret-mode twin lives in
+    # tests/test_pallas_choose.py; only THIS compiled run exercises the
+    # real Mosaic lowering.
+    from tpu_scheduler.core.snapshot import ClusterSnapshot
+    from tpu_scheduler.models.profiles import SchedulingProfile
+    from tpu_scheduler.testing import make_node, make_pod
+
+    tie_nodes = [make_node(f"n{i:04d}", cpu="8", memory="16Gi") for i in range(1500)]
+    tie_pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi") for i in range(64)]
+    tie_snap = ClusterSnapshot.build(tie_nodes, tie_pods)
+    tie_packed = pack_snapshot(tie_snap, pod_block=128, node_block=128)
+    ta = {k: jax.numpy.asarray(v) for k, v in tie_packed.device_arrays().items()}
+    tn_nodes, tn_pods = split_device_arrays(ta)
+    tie_w = jax.numpy.asarray(SchedulingProfile(spread_jitter=0.0).weights())
+    tie_out, *_ = assign_cycle(tn_nodes, tn_pods, tie_w, max_rounds=1, block=256, use_pallas=True)
+    tie_choice = np.asarray(tie_out)[: len(tie_pods)]
+    ok = bool((tie_choice == 0).all())
+    log(f"{'PASS' if ok else 'FAIL'}: compiled exact-tie lowest-index "
+        f"(identical nodes, zero jitter -> every pod chooses node 0; got {sorted(set(tie_choice.tolist()))})")
+    if not ok:
+        return 1
+
     # -- 3: whole-backend proving ------------------------------------------
     for constrained in (False, True):
         kw = dict(anti_affinity_fraction=0.2, spread_fraction=0.2) if constrained else {}
@@ -160,10 +189,9 @@ def main() -> int:
         log("FAIL: no node_tile compiled")
         return 1
     log(f"PASS: tile sweep — best node_tile {best[0]} at {best[1]*1e3:.1f} ms "
-        f"(default is 512 and must STAY 512: node_tile=1024 timed ~6%/cycle faster "
-        f"but breaks bit-parity with the jnp path at 20k x 2k on real hardware "
-        f"(measured 2026-07-31; 512 is bit-exact on the same shape), so the sweep "
-        f"is a timing probe only — any tile change needs the on-chip parity check first)")
+        f"(default is 1024, bit-exact since the explicit lowest-index tie-break "
+        f"landed — Mosaic argmax is NOT first-index at every lane width; any "
+        f"future tile change still needs the on-chip parity check first)")
 
     # -- 5: reduced bench pass (headline shape only — the constrained and
     # sharded evidence rows are the FULL bench's job) ----------------------

@@ -247,3 +247,30 @@ def test_assign_cycle_pallas_constrained_hard_only():
     )
     np.testing.assert_array_equal(np.asarray(base_assigned), np.asarray(p_assigned))
     assert int(base_rounds) == int(p_rounds)
+
+
+def test_pallas_choose_exact_tie_lowest_index():
+    """Exact score ties inside ONE node tile must resolve to the lowest
+    node index — the latent bug the explicit min-reduction tie-break fixed
+    (Mosaic's argmax lowering is not first-index at every lane width; a
+    two-node tie at node_tile=1024 returned the higher index on real
+    hardware).  Identical nodes + zero jitter weight force every (pod,
+    node) score into an exact tie across the whole tile, so ANY non-lowest
+    tie-break shifts the choice.  Interpret mode pins the lane-iota and
+    sentinel arithmetic; the compiled twin runs in scripts/tpu_selftest.py
+    stage 2b on real hardware."""
+    from tpu_scheduler.api.objects import full_name  # noqa: F401  (parity with module imports)
+    from tpu_scheduler.core.snapshot import ClusterSnapshot
+    from tpu_scheduler.models.profiles import SchedulingProfile
+    from tpu_scheduler.testing import make_node, make_pod
+
+    nodes = [make_node(f"n{i:03d}", cpu="8", memory="16Gi") for i in range(64)]
+    pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi") for i in range(16)]
+    snap = ClusterSnapshot.build(nodes, pods)
+    packed = pack_snapshot(snap, pod_block=8, node_block=8)
+    a = {k: jnp.asarray(v) for k, v in packed.device_arrays().items()}
+    weights = jnp.asarray(SchedulingProfile(spread_jitter=0.0).weights())
+    jc, jh, pc, ph = _both_paths(a, weights)  # node_tile=128 > 64 nodes: one tile
+    assert jh.all() and ph.all()
+    np.testing.assert_array_equal(jc, pc)
+    assert (pc == 0).all(), "tie across identical nodes must pick node index 0"

@@ -10,9 +10,13 @@ tensors and one [B] write per block, the minimum possible traffic.
 Bitwise parity with the jnp/NumPy expression tree is preserved by computing
 the *same* f32 operations in the same order (ops/score.py), the same exact
 int32 arithmetic for resource fit (ops/masks.py), and the same uint32
-Knuth-multiplicative jitter hash; the running cross-tile max uses a strict
-``>`` so ties resolve to the lowest node index, exactly like ``jnp.argmax``
-over the full row (tests/test_pallas_choose.py asserts equality).
+Knuth-multiplicative jitter hash; ties resolve to the lowest node index,
+exactly like ``jnp.argmax`` over the full row, via TWO guarantees: within a
+tile an explicit max + masked min-reduction over the lane iota (Mosaic's
+own argmax lowering is NOT first-index at every lane width — a two-node
+score tie at tn=1024 returned the higher index on real hardware), and
+across tiles a strict ``>`` running max that keeps the earlier tile
+(tests/test_pallas_choose.py asserts equality).
 
 Node-side layout: resources ride in one ``[8, N] int32`` array (rows: avail
 cpu/mem, alloc cpu/mem, valid, 3× pad) so the int32 (8, 128) min-tile is hit
@@ -299,7 +303,16 @@ def _make_choose_kernel(constrained: bool):
         sc = jnp.where(mask, score.astype(f32), NEG_INF)
 
         tile_best = jnp.max(sc, axis=1, keepdims=True)  # [BP, 1]
-        tile_arg = jnp.argmax(sc, axis=1).reshape(-1, 1).astype(jnp.int32) + j * tn
+        # Exact lowest-index tie-break: Mosaic's argmax lowering does NOT
+        # guarantee first-index on ties at every lane width (observed on
+        # chip at tn=1024: a two-node score tie returned the higher index,
+        # breaking bit-parity with the jnp path — jnp.argmax IS
+        # first-index).  A max + masked min-reduction over the lane iota is
+        # exact at any width; the cross-tile merge below keeps the earlier
+        # tile on ties (strict >), so the global result is always the
+        # lowest-index maximum.
+        lane = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        tile_arg = jnp.min(jnp.where(sc == tile_best, lane, jnp.int32(tn)), axis=1).reshape(-1, 1) + j * tn
 
         improve = tile_best > best_ref[:]
         bestidx_ref[:] = jnp.where(improve, tile_arg, bestidx_ref[:])
@@ -340,7 +353,7 @@ def choose_block_pallas(
     #                 pa_unmatched [Ta,N], sp_penalty [Ss,N], ppa_cnt [Tp,N]) f32
     node_offset=None,  # global index of node 0 (sharded meshes; jitter hash)
     pod_tile: int = 256,
-    node_tile: int = 512,
+    node_tile: int = 1024,
     interpret: bool = False,
     return_best: bool = False,
 ):
