@@ -259,7 +259,6 @@ def test_pallas_choose_exact_tie_lowest_index():
     tie-break shifts the choice.  Interpret mode pins the lane-iota and
     sentinel arithmetic; the compiled twin runs in scripts/tpu_selftest.py
     stage 2b on real hardware."""
-    from tpu_scheduler.api.objects import full_name  # noqa: F401  (parity with module imports)
     from tpu_scheduler.core.snapshot import ClusterSnapshot
     from tpu_scheduler.models.profiles import SchedulingProfile
     from tpu_scheduler.testing import make_node, make_pod
