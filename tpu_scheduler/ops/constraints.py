@@ -127,15 +127,30 @@ class UntensorizableConstraints(Exception):
 
 
 # Sentinel key under which a match_memo stores the term-vocabulary signature
-# it is valid for (all other keys are ``id(pod)`` ints, so no collision).
+# it is valid for.  Key spaces (owned HERE, with prune_match_memo and
+# _sig_independent — callers must not hand-filter by key type):
+#   _MEMO_SIG            — the signature sentinel
+#   id(pod) ints         — matched-term ids (vocab-DEPENDENT)
+#   ("dk", id(pod))      — declared canonical keys (vocab-independent)
 _MEMO_SIG = "sig"
+_MEMO_DK = "dk"
+
+
+def _sig_independent(k) -> bool:
+    """Memo keys that survive a vocabulary-signature change."""
+    return isinstance(k, tuple) and len(k) == 2 and k[0] == _MEMO_DK
 
 
 def prune_match_memo(memo: dict, live_ids: set) -> dict:
     """Drop memo entries for dead pod objects, preserving the signature
-    sentinel — the single owner of the memo's internal key layout (callers
-    must not hand-filter by key type)."""
-    return {k: v for k, v in memo.items() if k in live_ids or k == _MEMO_SIG}
+    sentinel (see the key-space table above)."""
+    return {
+        k: v
+        for k, v in memo.items()
+        if k == _MEMO_SIG or k in live_ids or (isinstance(k, tuple) and k[1] in live_ids)
+    }
+
+
 
 
 def _term_probe_index(term_list):
@@ -305,36 +320,66 @@ def pack_constraints(
     nodes = list(snapshot.nodes)
     assert tuple(n.name for n in nodes) == tuple(node_names)
 
+    def _declared(pod):
+        """The pod's declared canonical keys, memoized by object identity:
+        (aa [(key, term)], pa [(key, term)], ppa [(key, term, signed_w)],
+        sp [(key, c)], sps [(key, c)]).  Valid independent of the term
+        vocabulary (derived from the pod object alone), so cached under a
+        ("dk", id) key that survives vocab changes only incidentally — a
+        sig-triggered clear recomputes it for the price of one pass."""
+        mk = (_MEMO_DK, id(pod))
+        if match_memo is not None:
+            hit = match_memo.get(mk)
+            if hit is not None and hit[0] is pod:
+                return hit[1]
+        ns, spec = pod.metadata.namespace, pod.spec
+        aa = [(_aa_key(ns, t), t) for t in (spec.anti_affinity or ())] if spec is not None else []
+        pa = [(_aa_key(ns, t), t) for t in (spec.pod_affinity or ())] if spec is not None else []
+        ppa = []
+        sp: list = []
+        sps: list = []
+        if spec is not None:
+            for w in spec.preferred_pod_affinity or ():
+                ppa.append((_aa_key(ns, w.term), w.term, float(w.weight)))
+            for w in spec.preferred_pod_anti_affinity or ():
+                ppa.append((_aa_key(ns, w.term), w.term, -float(w.weight)))
+            for c in spec.topology_spread or ():
+                (sp if c.is_hard else sps).append((_sp_key(ns, c), c))
+        data = (aa, pa, ppa, sp, sps)
+        # Unconstrained pods: recomputing the five empty lists is cheaper
+        # than a memo entry per pod (the memo would double in size).
+        if match_memo is not None and (aa or pa or ppa or sp or sps):
+            match_memo[mk] = (pod, data)
+        return data
+
     # --- vocabularies -----------------------------------------------------
     aa_vocab: dict[tuple, tuple] = {}  # key -> (ns, term)
-    for p in pending:
-        if p.spec is not None and p.spec.anti_affinity:
-            for t in p.spec.anti_affinity:
-                aa_vocab.setdefault(_aa_key(p.metadata.namespace, t), (p.metadata.namespace, t))
-    placed_with_terms = snapshot.placed_pods_with_terms()
-    for q, _qn in placed_with_terms:
-        for t in q.spec.anti_affinity:
-            aa_vocab.setdefault(_aa_key(q.metadata.namespace, t), (q.metadata.namespace, t))
-    # Positive affinity: only PENDING pods' terms constrain anyone (no
-    # symmetric direction — a placed pod's affinity is already satisfied).
     pa_vocab: dict[tuple, tuple] = {}
-    for p in pending:
-        if p.spec is not None and p.spec.pod_affinity:
-            for t in p.spec.pod_affinity:
-                pa_vocab.setdefault(_aa_key(p.metadata.namespace, t), (p.metadata.namespace, t))
-    # Preferred (soft, signed-weight) inter-pod terms — scoring only.
-    ppa_vocab: dict[tuple, tuple] = {}
-    for p in pending:
-        if p.spec is not None:
-            for w in (p.spec.preferred_pod_affinity or []) + (p.spec.preferred_pod_anti_affinity or []):
-                ppa_vocab.setdefault(_aa_key(p.metadata.namespace, w.term), (p.metadata.namespace, w.term))
+    ppa_vocab: dict[tuple, tuple] = {}  # preferred (soft, signed) — scoring only
     sp_vocab: dict[tuple, tuple] = {}  # hard (DoNotSchedule) — blocking
     sps_vocab: dict[tuple, tuple] = {}  # soft (ScheduleAnyway) — scoring only
     for p in pending:
-        if p.spec is not None and p.spec.topology_spread:
-            for c in p.spec.topology_spread:
-                target = sp_vocab if c.is_hard else sps_vocab
-                target.setdefault(_sp_key(p.metadata.namespace, c), (p.metadata.namespace, c))
+        ns = p.metadata.namespace
+        aa, pa, ppa, sp, sps = _declared(p)
+        for key, t in aa:
+            aa_vocab.setdefault(key, (ns, t))
+        # Positive affinity: only PENDING pods' terms constrain anyone (no
+        # symmetric direction — a placed pod's affinity is already satisfied).
+        for key, t in pa:
+            pa_vocab.setdefault(key, (ns, t))
+        for key, t, _w in ppa:
+            ppa_vocab.setdefault(key, (ns, t))
+        for key, c in sp:
+            sp_vocab.setdefault(key, (ns, c))
+        for key, c in sps:
+            sps_vocab.setdefault(key, (ns, c))
+    # One _declared pass per placed carrier: the (key, term) pairs feed both
+    # the vocab walk here and the carrier-mark loop at the bottom.
+    placed_carrier_keys = [(q, qn, _declared(q)[0]) for q, qn in snapshot.placed_pods_with_terms()]
+    for q, _qn, aa_d in placed_carrier_keys:
+        ns = q.metadata.namespace
+        for key, t in aa_d:
+            aa_vocab.setdefault(key, (ns, t))
 
     if not aa_vocab and not pa_vocab and not ppa_vocab and not sp_vocab and not sps_vocab:
         return None
@@ -459,7 +504,12 @@ def pack_constraints(
             tuple(k for k, _ in sps_terms),
         )
         if match_memo.get(_MEMO_SIG) != sig:
+            # Matched-id entries are vocab-dependent — drop them; declared-
+            # keys entries derive from the pod object alone and survive
+            # (_sig_independent owns that distinction).
+            keep = {k: v for k, v in match_memo.items() if _sig_independent(k)}
             match_memo.clear()
+            match_memo.update(keep)
             match_memo[_MEMO_SIG] = sig
 
     def _matched_all(pod):
@@ -481,24 +531,17 @@ def pack_constraints(
         return ids
 
     for pi, p in enumerate(pending):
-        ns = p.metadata.namespace
-        if p.spec is not None and p.spec.anti_affinity:
-            for t in p.spec.anti_affinity:
-                pod_aa_carries[pi, aa_index[_aa_key(ns, t)]] = 1.0
-        if p.spec is not None and p.spec.pod_affinity:
-            for t in p.spec.pod_affinity:
-                pod_pa_declares[pi, pa_index[_aa_key(ns, t)]] = 1.0
-        if p.spec is not None:
-            for w in p.spec.preferred_pod_affinity or []:
-                pod_ppa_w[pi, ppa_index[_aa_key(ns, w.term)]] += float(w.weight)
-            for w in p.spec.preferred_pod_anti_affinity or []:
-                pod_ppa_w[pi, ppa_index[_aa_key(ns, w.term)]] -= float(w.weight)
-        if p.spec is not None and p.spec.topology_spread:
-            for c in p.spec.topology_spread:
-                if c.is_hard:
-                    pod_sp_declares[pi, sp_index[_sp_key(ns, c)]] = 1.0
-                else:
-                    pod_sps_declares[pi, sps_index[_sp_key(ns, c)]] = 1.0
+        aa_d, pa_d, ppa_d, sp_d, sps_d = _declared(p)
+        for key, _t in aa_d:
+            pod_aa_carries[pi, aa_index[key]] = 1.0
+        for key, _t in pa_d:
+            pod_pa_declares[pi, pa_index[key]] = 1.0
+        for key, _t, w in ppa_d:
+            pod_ppa_w[pi, ppa_index[key]] += w
+        for key, _c in sp_d:
+            pod_sp_declares[pi, sp_index[key]] = 1.0
+        for key, _c in sps_d:
+            pod_sps_declares[pi, sps_index[key]] = 1.0
         aa_m, pa_m, ppa_m, sp_m, sps_m = _matched_all(p)
         for ti in aa_m:
             pod_aa_matched[pi, ti] = 1.0
@@ -565,10 +608,9 @@ def pack_constraints(
                     v = nlabels.get(c.topology_key)
                     if v is not None:
                         sps_counts[si, dom_vocab[(c.topology_key, v)]] += 1.0
-        for q, qnode in placed_with_terms:
-            ns = q.metadata.namespace
-            for t in q.spec.anti_affinity:
-                _mark(aa_dom_c, aa_node_c, aa_index[_aa_key(ns, t)], t, qnode.name)
+        for _q, qnode, aa_d in placed_carrier_keys:
+            for key, t in aa_d:
+                _mark(aa_dom_c, aa_node_c, aa_index[key], t, qnode.name)
 
     return ConstraintSet(
         pod_aa_carries=pod_aa_carries,
