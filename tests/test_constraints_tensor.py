@@ -459,3 +459,28 @@ def test_pack_constraints_match_memo():
         match_memo=memo,
     )
     assert memo["sig"] != sig_before  # memo was invalidated + re-signed
+
+
+def test_rich_spread_vocab_rides_tensor_path():
+    """A cluster with ~100 distinct spread terms (50 apps x 2 skew levels —
+    the CLI's own mixed workload shape) must ride the tensor path, not the
+    host sequential fallback: the original 64-term budget silently routed
+    it to the scalar phase at 482s per 10k-pod cycle (measured)."""
+    snap = synth_cluster(
+        n_nodes=60, n_pending=600, n_bound=120, seed=9,
+        anti_affinity_fraction=0.1, spread_fraction=0.3, schedule_anyway_fraction=0.2,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1,
+    )
+    n_terms = len({
+        (c.match_labels.get("app"), c.max_skew, c.is_hard)
+        for p in snap.pending_pods() if p.spec is not None
+        for c in (p.spec.topology_spread or [])
+    })
+    assert n_terms > 64, f"cluster must exceed the OLD budget to be a regression test (got {n_terms})"
+    api = FakeApiServer()
+    api.load(snap.nodes, snap.pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, profile=DEFAULT_PROFILE)
+    sched.run_cycle()
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_constraint_host_fallbacks_total", 0) == 0, counters
+    assert counters.get("scheduler_constraint_tensor_cycles_total", 0) == 1, counters

@@ -696,6 +696,11 @@ class PodResources:
     memory: int = 0  # bytes
     extended: dict[str, int] | None = None  # resource name -> integer count
 
+    def copy(self) -> "PodResources":
+        """Independent copy — cached totals (core/snapshot.py memos) hand
+        these out so callers can keep mutating with += / -=."""
+        return PodResources(self.cpu, self.memory, dict(self.extended) if self.extended else None)
+
     def _ext_add(self, other: "PodResources", sign: int) -> None:
         if other.extended:
             if self.extended is None:

@@ -17,7 +17,7 @@ from itertools import chain
 from typing import Callable, Sequence
 
 from ..api.objects import LabelSelectorRequirement, Node, Pod, full_name, total_pod_resources
-from .snapshot import ClusterSnapshot, node_allocatable, node_used_resources
+from .snapshot import ClusterSnapshot, node_net_available
 
 __all__ = [
     "InvalidNodeReason",
@@ -71,8 +71,7 @@ def pod_fits_resources(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> bool:
     fits iff request.cpu ≤ available.cpu AND request.memory ≤ available.memory.
     A node with no allocatable has zero available (only zero-request pods fit).
     """
-    available = node_allocatable(node)
-    available -= node_used_resources(snapshot, node.name)
+    available = node_net_available(snapshot, node)
     req = total_pod_resources(pod)
     return req.fits_in(available)
 

@@ -17,15 +17,26 @@ from typing import Iterable
 from ..api.objects import Node, Pod, PodResources, is_extended_resource, is_pod_bound, total_pod_resources
 from ..api.quantity import cpu_to_millis, memory_to_bytes
 
-__all__ = ["ClusterSnapshot", "node_allocatable", "node_used_resources"]
+__all__ = ["ClusterSnapshot", "node_allocatable", "node_net_available", "node_used_resources"]
 
 
-def node_allocatable(node: Node) -> PodResources:
+def node_allocatable(node: Node, snapshot: "ClusterSnapshot | None" = None) -> PodResources:
     """Allocatable (cpu millicores, memory bytes) of a node.
 
     Matches reference semantics (``src/predicates.rs:28-32``): a node without
     ``status.allocatable`` has zero allocatable of both resources.
+
+    With ``snapshot``, the quantity parsing memoizes on it (snapshots are
+    immutable): the host scalar paths call this per (pod, node) candidate —
+    11M+ re-parses of the same quantity strings per 10k-pod constrained
+    cycle before the cache (measured ~40 s of a 480 s host phase).  Returns
+    a fresh copy either way; callers mutate the result with -=.
     """
+    if snapshot is not None:
+        cached = snapshot._alloc_cache.get(node.name)
+        if cached is None:
+            snapshot._alloc_cache[node.name] = cached = node_allocatable(node)
+        return cached.copy()
     out = PodResources()
     if node.status is not None and node.status.allocatable is not None:
         alloc = node.status.allocatable
@@ -56,6 +67,12 @@ class ClusterSnapshot:
     nodes: tuple[Node, ...]
     pods: tuple[Pod, ...]
     _pods_by_node: dict[str, list[Pod]] = field(default_factory=dict, compare=False, repr=False)
+    # Lazy per-node memos (snapshots are immutable once built): parsed
+    # allocatable quantities and summed bound-pod usage — see
+    # node_allocatable / node_used_resources.
+    _alloc_cache: dict[str, PodResources] = field(default_factory=dict, compare=False, repr=False)
+    _used_cache: dict[str, PodResources] = field(default_factory=dict, compare=False, repr=False)
+    _net_cache: dict[str, PodResources] = field(default_factory=dict, compare=False, repr=False)
     # Caches for the affinity predicates (built once; snapshots are immutable):
     # all (pod, node) placements, and the subset whose pod carries
     # anti-affinity terms (the direction-B forbidders).
@@ -97,9 +114,29 @@ class ClusterSnapshot:
         return [p for p in self.pods if p.status.phase == "Pending" and not is_pod_bound(p)]
 
 
+def node_net_available(snapshot: ClusterSnapshot, node: Node) -> PodResources:
+    """allocatable − Σ bound-pod requests, memoized per snapshot (both
+    inputs are snapshot-constant); returns a fresh copy — in-cycle callers
+    subtract their assumed-resources ledger from it."""
+    cached = snapshot._net_cache.get(node.name)
+    if cached is None:
+        net = node_allocatable(node, snapshot)
+        net -= node_used_resources(snapshot, node.name)
+        snapshot._net_cache[node.name] = cached = net
+    return cached.copy()
+
+
 def node_used_resources(snapshot: ClusterSnapshot, node_name: str) -> PodResources:
-    """Sum of resource requests of pods bound to ``node_name``."""
-    used = PodResources()
-    for p in snapshot.pods_on_node(node_name):
-        used += total_pod_resources(p)
-    return used
+    """Sum of resource requests of pods bound to ``node_name``.
+
+    Memoized on the (immutable) snapshot — the host scalar paths call this
+    per (pod, node) candidate, re-summing the same bound pods' requests
+    (34M ``total_pod_resources`` calls per 10k-pod constrained cycle before
+    the cache).  Returns a fresh copy; callers mutate with += / -=."""
+    cached = snapshot._used_cache.get(node_name)
+    if cached is None:
+        used = PodResources()
+        for p in snapshot.pods_on_node(node_name):
+            used += total_pod_resources(p)
+        snapshot._used_cache[node_name] = cached = used
+    return cached.copy()

@@ -100,13 +100,19 @@ __all__ = [
 
 RANK_INF = np.float32(3.0e38)
 
-# Default budgets (padded): tuned so [T,N]/[S,D] state stays a rounding error
-# next to the [block,N] choose tile at north-star scale.  Per-app selectors
-# (one term per deployment) are the common shape, so T/S budgets are sized
-# for dozens of distinct groups.
-MAX_AA_TERMS = 128
-MAX_SPREAD = 64
-MAX_COARSE_DOMAINS = 128
+# Default budgets (padded): sized so the per-term state ([T,N]/[S,D], ~10 MB
+# at 256×10k) and the pod-side bitmaps ([P,T] etc., ~110 MB each at 100k×256)
+# stay well under HBM at north-star scale while admitting realistic
+# vocabularies — per-app selectors (one term per deployment) are the common
+# shape, and a 50-deployment cluster with two skew levels already needs ~100
+# spread terms.  History: the original 128/64 budgets silently routed the
+# CLI's own mixed workload to the exact-but-glacial host sequential phase
+# (UntensorizableConstraints fallback — measured 482 s for ONE 10k×1k cycle
+# vs ~1 s on the tensor path), so the defaults now match what the hardware
+# comfortably holds, and the controller exposes them as knobs.
+MAX_AA_TERMS = 256
+MAX_SPREAD = 256
+MAX_COARSE_DOMAINS = 256
 
 # Fast-path budget for the within-round filter/commit: below this terms×D
 # product, "who came earlier into my cell" is computed DENSELY — a [P,T,D]

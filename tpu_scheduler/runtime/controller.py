@@ -51,7 +51,7 @@ from ..core.predicates import (
     term_matches,
     topology_spread_ok,
 )
-from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
+from ..core.snapshot import ClusterSnapshot, node_allocatable, node_net_available, node_used_resources
 from ..errors import BackendUnavailable, CreateBindingFailed, NoNodeFound, SchedulerError
 from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
 from ..ops.pack import extend_node_vocabs, pack_snapshot, repack_incremental
@@ -108,6 +108,7 @@ class Scheduler:
         identity: str | None = None,
         lease_name: str = "tpu-scheduler",
         lease_duration: float = 15.0,
+        constraint_budgets: dict | None = None,
     ):
         if policy not in ("batch", "sample"):
             raise ValueError(f"unknown policy {policy!r} (expected 'batch' or 'sample')")
@@ -123,6 +124,17 @@ class Scheduler:
         self.pod_block = pod_block
         self.node_block = node_block
         self.pipeline = pipeline
+        # Overrides for ops/constraints.py tensor budgets (max_aa_terms /
+        # max_spread / max_coarse_domains).  Exceeding a budget routes the
+        # cycle to the exact host sequential phase — orders of magnitude
+        # slower at scale — so clusters with unusually rich constraint
+        # structure should raise these rather than fall back.  Validated
+        # here: a typo'd key would otherwise surface as a TypeError in the
+        # middle of the first constrained cycle.
+        self.constraint_budgets = dict(constraint_budgets or {})
+        unknown = set(self.constraint_budgets) - {"max_aa_terms", "max_spread", "max_coarse_domains"}
+        if unknown:
+            raise ValueError(f"unknown constraint_budgets keys: {sorted(unknown)}")
         self.reflector = ClusterReflector(api, clock=clock)
         self.metrics = MetricsRegistry()
         self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
@@ -400,6 +412,7 @@ class Scheduler:
         weights,
         soft_spread_penalty: float = 0.0,
         preferred_pod_score: float = 0.0,
+        req: PodResources | None = None,
     ) -> float:
         """LeastRequested + BalancedAllocation + soft terms for one
         (pod, node) — the scalar twin of ops/score.py (without the tie-break
@@ -408,12 +421,13 @@ class Scheduler:
         Soft terms mirror the tensor path weight-for-weight: preferred node
         affinity (+w₃), PreferNoSchedule taints (−w₄), and the caller-supplied
         ScheduleAnyway spread penalty (−w₅, from make_soft_spread_scorer)."""
-        alloc = node_allocatable(node)
+        alloc = node_allocatable(node, snapshot)
         used = node_used_resources(snapshot, node.name)
         assumed = ledger.get(node.name)
         if assumed is not None:
             used += assumed
-        req = total_pod_resources(pod)
+        if req is None:
+            req = total_pod_resources(pod)
         fc = (used.cpu + req.cpu) / alloc.cpu if alloc.cpu > 0 else 1.0
         fm = (used.memory + req.memory) / alloc.memory if alloc.memory > 0 else 1.0
         lr = ((1.0 - fc) + (1.0 - fm)) * 50.0
@@ -456,17 +470,18 @@ class Scheduler:
             spread_checker = make_spread_checker(pod, snapshot, placed)
             soft_spread = make_soft_spread_scorer(pod, snapshot, placed)
             ppa_scorer = make_preferred_pod_affinity_scorer(pod, snapshot, placed)
+            req = total_pod_resources(pod)  # hoisted: O(1) per candidate below
             best: Node | None = None
             best_score = 0.0
             for node in snapshot.nodes:
                 reason = self._check_with_ledger(
                     pod, node, snapshot, ledger, placed,
                     affinity_checker=affinity_checker, spread_checker=spread_checker,
-                    pod_affinity_checker=pod_affinity_checker,
+                    pod_affinity_checker=pod_affinity_checker, req=req,
                 )
                 if reason is not None:
                     continue
-                score = self._scalar_score(pod, node, snapshot, ledger, weights, soft_spread(node), ppa_scorer(node))
+                score = self._scalar_score(pod, node, snapshot, ledger, weights, soft_spread(node), ppa_scorer(node), req=req)
                 if best is None or score > best_score:
                     best, best_score = node, score
             if best is None:
@@ -804,6 +819,7 @@ class Scheduler:
                     packed.node_names,
                     packed.padded_nodes,
                     match_memo=self._cons_memo,
+                    **self.constraint_budgets,
                 )
                 if cons is not None:
                     # Attached to a per-cycle copy only: the cached pack is
@@ -1131,8 +1147,7 @@ class Scheduler:
                     continue
                 if not aa_checker(node) or not pa_checker(node) or not sp_checker(node):
                     continue
-                avail = node_allocatable(node)
-                avail -= node_used_resources(snapshot, node.name)
+                avail = node_net_available(snapshot, node)
                 if node.name in extra_used:
                     avail -= extra_used[node.name]
                 if node.name in freed:
@@ -1262,6 +1277,7 @@ class Scheduler:
         affinity_checker=None,
         spread_checker=None,
         pod_affinity_checker=None,
+        req: PodResources | None = None,
     ) -> InvalidNodeReason | None:
         """Full predicate chain vs snapshot + this-cycle commitments: the
         assumed-resources ledger (closing the reference's TOCTOU race) and
@@ -1269,15 +1285,16 @@ class Scheduler:
 
         A caller looping over many nodes for one pod passes prebuilt
         ``affinity_checker``/``spread_checker`` (make_affinity_checker /
-        make_spread_checker over the same snapshot+placed) to amortise the
-        placement scans; semantics are identical either way.
+        make_spread_checker over the same snapshot+placed) and the pod's
+        summed ``req`` to amortise the per-node work; semantics are
+        identical either way.
         """
-        available = node_allocatable(node)
-        available -= node_used_resources(snapshot, node.name)
+        available = node_net_available(snapshot, node)
         assumed = ledger.get(node.name)
         if assumed is not None:
             available -= assumed
-        req = total_pod_resources(pod)
+        if req is None:
+            req = total_pod_resources(pod)
         if not req.fits_in(available):
             return InvalidNodeReason.NOT_ENOUGH_RESOURCES
         for reason, pred in NODE_LOCAL_PREDICATES:
@@ -1470,6 +1487,14 @@ class Scheduler:
             pack_seconds=durations.get("pack", 0.0),
             solve_seconds=durations.get("solve", 0.0),
             bind_seconds=durations.get("bind", 0.0),
+            sync_seconds=durations.get("sync", 0.0),
+            mopup_seconds=durations.get("mopup", 0.0),
+            # Everything not in the five named phases (gang bookkeeping,
+            # eviction scans, the host constrained segments, …).  Spans can
+            # nest, so this subtracts only the disjoint top-level five.
+            other_seconds=round(
+                max(0.0, wall - sum(durations.get(k, 0.0) for k in ("pack", "solve", "bind", "sync", "mopup"))), 6
+            ),
         )
         self.metrics.observe_cycle(m)
         return m

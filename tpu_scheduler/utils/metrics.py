@@ -24,6 +24,11 @@ class CycleMetrics:
     pack_seconds: float = 0.0
     solve_seconds: float = 0.0
     bind_seconds: float = 0.0
+    # Host-side phases that can dominate constrained cycles at scale —
+    # surfaced so a slow cycle is attributable from the JSON line alone.
+    sync_seconds: float = 0.0
+    mopup_seconds: float = 0.0
+    other_seconds: float = 0.0  # wall minus every attributed phase
 
     @property
     def pods_per_second(self) -> float:
