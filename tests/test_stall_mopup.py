@@ -139,3 +139,21 @@ def test_mopup_covers_matched_only_pods():
         for p in api.list_pods()
     }
     assert len(placed_zones) == 2  # anti-affinity respected: different zones
+
+
+def test_prefilter_zero_extended_request_matches_fits_in():
+    """A zero-valued extended request against a cluster where NO node
+    carries the resource is vacuous in fits_in (0 > missing->0 is False);
+    the host phase's vectorized prefilter must agree — the pod still
+    schedules (review regression: the prefilter returned no candidates)."""
+    from tpu_scheduler.api.objects import PodAntiAffinityTerm
+
+    nodes = [make_node("n0", cpu="4", memory="8Gi", labels={"name": "n0"})]
+    term = [PodAntiAffinityTerm(match_labels={"app": "w"}, topology_key="name")]
+    pod = make_pod("p0", cpu="1", memory="1Gi", labels={"app": "w"}, anti_affinity=term,
+                   extended={"google.com/tpu": "0"})
+    api = FakeApiServer()
+    api.load(nodes=nodes, pods=[pod])
+    s = Scheduler(api, NativeBackend(), constraint_budgets={"max_aa_terms": 0})  # force host phase
+    m = s.run_cycle()
+    assert m.bound == 1, "zero-valued extended request must not block scheduling"
