@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Round-5 diagnostic: the constrained flagship residue, classified.
+
+BENCH_r04 showed the constrained 100k x 10k row stopping at the 64-round cap
+with 81,768 bound — is the 18k residue genuinely infeasible (capacity /
+constraint saturation) or cap-truncated?  This runs the bench's exact
+constrained shape, prints the accepts-per-round histogram, re-runs at a much
+higher cap, and replays the residue through the NATIVE sequential oracle to
+count how many of the unbound pods any sequential scheduler could still
+place.
+
+Usage: python scripts/diag_constrained_residue.py [pods] [nodes] [seed]
+"""
+import os
+import sys
+import time
+from collections import Counter
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def hist_str(acc_round):
+    hist = Counter(int(x) for x in acc_round if x >= 0)
+    return " ".join(f"{k}:{hist[k]}" for k in sorted(hist))
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    from tpu_scheduler.backends.native import NativeBackend
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    profile = PROFILES["throughput"].with_(pod_block=8192, max_rounds=64)
+    snap = synth_cluster(
+        n_nodes=nodes, n_pending=pods, n_bound=2 * nodes, seed=seed,
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+    )
+    packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+    cons = pack_constraints(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    packed = replace(packed, constraints=cons)
+    print(f"shape: {packed.num_pods}x{len(packed.node_names)} padded {packed.padded_pods}x{packed.padded_nodes}", flush=True)
+    print(f"vocab: T={cons.n_terms} Ta={cons.n_pa_terms} Tp={cons.n_ppa_terms} S={cons.n_spread} Ss={cons.n_spread_soft}", flush=True)
+
+    backend = TpuBackend()
+    r = backend.schedule(packed, profile)  # warm/compile
+    t0 = time.perf_counter()
+    r = backend.schedule(packed, profile)
+    dt = time.perf_counter() - t0
+    print(f"cap=64: {dt:.3f}s bound={len(r.bindings)}/{packed.num_pods} rounds={r.rounds}", flush=True)
+    print(f"  accepts/round: {hist_str(r.stats['acc_round'])}", flush=True)
+
+    # Higher cap: does the auction keep finding placements past 64 rounds?
+    prof256 = profile.with_(max_rounds=256)
+    r256 = backend.schedule(packed, prof256)  # warm/compile
+    t0 = time.perf_counter()
+    r256 = backend.schedule(packed, prof256)
+    dt256 = time.perf_counter() - t0
+    print(f"cap=256: {dt256:.3f}s bound={len(r256.bindings)}/{packed.num_pods} rounds={r256.rounds}", flush=True)
+    print(f"  accepts/round tail (>=60): {hist_str([x for x in r256.stats['acc_round'] if x >= 60])}", flush=True)
+
+    # Residue oracle: rebuild a snapshot where the auction's placements are
+    # BOUND, then ask the exact native sequential engine to place the
+    # residue.  Anything it binds was cap/structure-truncated; the rest is
+    # genuinely infeasible for any greedy sequential scheduler.
+    import dataclasses
+
+    from tpu_scheduler.api.objects import full_name
+    from tpu_scheduler.core.snapshot import ClusterSnapshot
+
+    bound_map = dict(r.bindings)
+    print(f"residue after cap=64: {packed.num_pods - len(bound_map)} pods", flush=True)
+    t0 = time.perf_counter()
+    pods2 = [
+        dataclasses.replace(p, spec=dataclasses.replace(p.spec, node_name=bound_map[full_name(p)]))
+        if full_name(p) in bound_map and p.spec is not None and p.spec.node_name is None
+        else p
+        for p in snap.pods
+    ]
+    snap2 = ClusterSnapshot.build(snap.nodes, pods2)
+    packed2 = pack_snapshot(snap2, pod_block=4096, node_block=128)
+    cons2 = pack_constraints(
+        snap2, snap2.pending_pods(), packed2.padded_pods, packed2.node_names, packed2.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    if cons2 is not None:
+        packed2 = replace(packed2, constraints=cons2)
+    rn = NativeBackend().schedule(packed2, profile.with_(max_rounds=256))
+    print(f"native oracle over residue: bound {len(rn.bindings)}/{packed2.num_pods} in {time.perf_counter()-t0:.1f}s", flush=True)
+    print(f"=> genuinely infeasible: {packed2.num_pods - len(rn.bindings)}; cap/structure-truncated: {len(rn.bindings)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

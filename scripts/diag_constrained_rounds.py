@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Fetch-fenced round attribution for the CONSTRAINED flagship cycle.
+
+Times assign_cycle at the bench's constrained 100k x 10k shape for a ladder
+of max_rounds values — the cumulative-time curve localizes where the 1.6 s
+goes (big full-size rounds vs the long small-size tail).
+
+Usage: python scripts/diag_constrained_rounds.py [pods] [nodes]
+"""
+import os
+import sys
+import time
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    from tpu_scheduler.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    profile = PROFILES["throughput"].with_(pod_block=8192)
+    snap = synth_cluster(
+        n_nodes=nodes, n_pending=pods, n_bound=2 * nodes, seed=0,
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+    )
+    packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+    cons = pack_constraints(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    packed = replace(packed, constraints=cons)
+    s_pad, d_pad = cons.pod_sp_declares.shape[1], cons.node_dom_c.shape[1]
+    t_pad = cons.pod_aa_carries.shape[1]
+    print(
+        f"padded {packed.padded_pods}x{packed.padded_nodes}; T={t_pad} S={s_pad} D={d_pad}"
+        f"  t*d={t_pad*d_pad} s*d={s_pad*d_pad} (DENSE_CELLS gate: 1024)",
+        flush=True,
+    )
+
+    backend = TpuBackend()
+    prev = 0.0
+    for mr in (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64):
+        prof = profile.with_(max_rounds=mr)
+        backend.schedule(packed, prof)  # compile/warm
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            r = backend.schedule(packed, prof)
+            times.append(time.perf_counter() - t0)
+        dt = min(times)
+        print(
+            f"max_rounds={mr:3d}: {dt:7.3f}s  (+{dt-prev:6.3f})  bound={len(r.bindings)}  rounds={r.rounds}",
+            flush=True,
+        )
+        prev = dt
+
+
+if __name__ == "__main__":
+    main()

@@ -140,6 +140,153 @@ def test_kubeconfig_errors(tmp_path):
         load_kubeconfig(str(cfg2))
 
 
+def _write_exec_plugin(tmp_path, body: str):
+    """A fake credential-helper binary emitting ``body`` via a shell script."""
+    import stat
+
+    plugin = tmp_path / "fake-auth-plugin"
+    plugin.write_text("#!/bin/sh\n" + body)
+    plugin.chmod(plugin.stat().st_mode | stat.S_IXUSR)
+    return str(plugin)
+
+
+def test_exec_plugin_opt_in_and_token(tmp_path):
+    """exec: plugins run only behind allow_exec=True; the emitted token
+    flows through as a provider and is cached until its expiry."""
+    import json
+
+    cred = {
+        "apiVersion": "client.authentication.k8s.io/v1beta1",
+        "kind": "ExecCredential",
+        "status": {"token": "exec-tok", "expirationTimestamp": "2999-01-01T00:00:00Z"},
+    }
+    count_file = tmp_path / "count"
+    plugin = _write_exec_plugin(
+        tmp_path, f"echo x >> {count_file}\ncat <<'EOF'\n{json.dumps(cred)}\nEOF\n"
+    )
+    cfg = _write_kubeconfig(
+        tmp_path / "config", "http://127.0.0.1:1",
+        extra_user={"exec": {"apiVersion": cred["apiVersion"], "command": plugin}},
+    )
+    # Default: refused with the opt-in hint (the round-4 documented refusal).
+    with pytest.raises(KubeconfigError, match="allow-exec-auth"):
+        load_kubeconfig(str(cfg))
+    _, token, _, _ = load_kubeconfig(str(cfg), allow_exec=True)
+    assert callable(token)
+    assert token() == "exec-tok"
+    assert token() == "exec-tok"  # unexpired -> cached, plugin not re-run
+    assert count_file.read_text().count("x") == 1
+
+
+def test_exec_plugin_expiry_triggers_rerun(tmp_path):
+    import json
+
+    cred = {
+        "apiVersion": "client.authentication.k8s.io/v1beta1",
+        "kind": "ExecCredential",
+        "status": {"token": "t", "expirationTimestamp": "2001-01-01T00:00:00Z"},
+    }
+    count_file = tmp_path / "count"
+    plugin = _write_exec_plugin(tmp_path, f"echo x >> {count_file}\ncat <<'EOF'\n{json.dumps(cred)}\nEOF\n")
+    cfg = _write_kubeconfig(
+        tmp_path / "config", "http://127.0.0.1:1", extra_user={"exec": {"command": plugin}}
+    )
+    _, token, _, _ = load_kubeconfig(str(cfg), allow_exec=True)
+    assert token() == "t" and token() == "t"
+    assert count_file.read_text().count("x") == 2  # expired credential -> re-exec each use
+
+
+def test_exec_plugin_shadowed_by_static_token(tmp_path):
+    """A static token wins over the exec block (client-go precedence) — a
+    missing helper binary must not abort a config that never invokes it."""
+    cfg = _write_kubeconfig(
+        tmp_path / "config", "http://127.0.0.1:1", token="static",
+        extra_user={"exec": {"command": "definitely-not-installed-helper"}},
+    )
+    _, token, _, _ = load_kubeconfig(str(cfg))  # no opt-in needed either
+    assert token == "static"
+    _, token2, _, _ = load_kubeconfig(str(cfg), allow_exec=True)
+    assert token2 == "static"
+
+
+def test_exec_plugin_error_paths(tmp_path):
+    import tpu_scheduler.runtime.kubeconfig as kc
+
+    # interactiveMode Always: a daemon has no TTY.
+    with pytest.raises(KubeconfigError, match="TTY"):
+        kc._exec_token_provider({"command": "x", "interactiveMode": "Always"}, str(tmp_path), {})
+    # Missing binary.
+    with pytest.raises(KubeconfigError, match="not found"):
+        kc._exec_token_provider({"command": "definitely-not-a-real-binary-xyz"}, str(tmp_path), {})
+    # Non-zero exit surfaces the installHint.
+    plugin = _write_exec_plugin(tmp_path, "exit 3\n")
+    p = kc._exec_token_provider({"command": plugin, "installHint": "install me"}, str(tmp_path), {})
+    with pytest.raises(KubeconfigError, match="install me"):
+        p()
+    # Certificate-emitting plugins are rejected.
+    import json
+
+    cred = {"kind": "ExecCredential", "status": {"clientCertificateData": "PEM", "clientKeyData": "PEM"}}
+    plugin2 = _write_exec_plugin(tmp_path, f"cat <<'EOF'\n{json.dumps(cred)}\nEOF\n")
+    p2 = kc._exec_token_provider({"command": plugin2}, str(tmp_path), {})
+    with pytest.raises(KubeconfigError, match="client certificates"):
+        p2()
+    # Bad JSON.
+    plugin3 = _write_exec_plugin(tmp_path, "echo not-json\n")
+    p3 = kc._exec_token_provider({"command": plugin3}, str(tmp_path), {})
+    with pytest.raises(KubeconfigError, match="invalid JSON"):
+        p3()
+
+
+def test_exec_plugin_cluster_info_env(tmp_path):
+    """provideClusterInfo ships the cluster block in KUBERNETES_EXEC_INFO;
+    env entries overlay the inherited environment."""
+    import json
+
+    out_file = tmp_path / "seen-env"
+    body = (
+        f'echo "$KUBERNETES_EXEC_INFO" > {out_file}\n'
+        f'echo "$EXTRA_VAR" >> {out_file}\n'
+        'cat <<\'EOF\'\n'
+        '{"kind": "ExecCredential", "status": {"token": "t"}}\n'
+        "EOF\n"
+    )
+    plugin = _write_exec_plugin(tmp_path, body)
+    import tpu_scheduler.runtime.kubeconfig as kc
+
+    p = kc._exec_token_provider(
+        {"command": plugin, "provideClusterInfo": True, "env": [{"name": "EXTRA_VAR", "value": "overlay"}]},
+        str(tmp_path),
+        {"server": "https://api.example:6443", "certificate-authority-data": "Q0E="},
+    )
+    assert p() == "t"
+    info_line, extra_line = out_file.read_text().splitlines()[:2]
+    info = json.loads(info_line)
+    assert info["kind"] == "ExecCredential" and info["spec"]["cluster"]["server"] == "https://api.example:6443"
+    assert info["spec"]["cluster"]["certificate-authority-data"] == "Q0E="
+    assert extra_line == "overlay"
+
+
+def test_exec_plugin_end_to_end_requests(tmp_path):
+    """A kubeconfig with an exec plugin drives real HTTP requests with the
+    plugin-minted bearer token attached."""
+    import json
+
+    api = FakeApiServer()
+    api.create_node(make_node("n1", cpu="4", memory="8Gi"))
+    server = HttpApiServer(api).start()
+    try:
+        cred = {"kind": "ExecCredential", "status": {"token": "minted"}}
+        plugin = _write_exec_plugin(tmp_path, f"cat <<'EOF'\n{json.dumps(cred)}\nEOF\n")
+        cfg = _write_kubeconfig(
+            tmp_path / "config", server.base_url, extra_user={"exec": {"command": plugin}}
+        )
+        client = client_from_kubeconfig(str(cfg), allow_exec=True)
+        assert [n.metadata.name for n in client.list_nodes()] == ["n1"]
+    finally:
+        server.stop()
+
+
 def test_cli_kubeconfig_flag(tmp_path, capsys):
     """--kubeconfig drives the whole CLI against the HTTP boundary."""
     from tpu_scheduler.cli import main

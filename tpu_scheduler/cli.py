@@ -99,6 +99,11 @@ def build_parser() -> argparse.ArgumentParser:
         const="",
     )
     p.add_argument("--kube-context", default=None, help="kubeconfig context to use (default: current-context)")
+    p.add_argument(
+        "--allow-exec-auth",
+        action="store_true",
+        help="allow kubeconfig exec: credential plugins (spawns the configured helper binary; off by default)",
+    )
     return p
 
 
@@ -117,7 +122,9 @@ def main(argv: list[str] | None = None) -> int:
         from .runtime.http_api import RemoteApiAdapter
         from .runtime.kubeconfig import client_from_kubeconfig
 
-        api = RemoteApiAdapter(client_from_kubeconfig(args.kubeconfig or None, context=args.kube_context))
+        api = RemoteApiAdapter(
+            client_from_kubeconfig(args.kubeconfig or None, context=args.kube_context, allow_exec=args.allow_exec_auth)
+        )
     elif args.api_server:
         from .runtime.http_api import KubeApiClient, RemoteApiAdapter
 

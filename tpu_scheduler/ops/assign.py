@@ -447,10 +447,10 @@ def assign_cycle(
             break
         sizes.append(nxt)
 
-    def make_cond(next_size):
+    def make_cond(next_size, done):
         def cond(state):
             _, _, n_active, rounds, cst = state
-            go = (rounds < max_rounds) & (n_active > 0)
+            go = (rounds < max_rounds) & (n_active > 0) & ~done
             if cmeta is not None:
                 go = go & (cst["stall"] < STALL_ROUNDS)
             if next_size:
@@ -466,17 +466,33 @@ def assign_cycle(
     n_active = ps["active"].sum(dtype=jnp.int32)
     rounds = jnp.int32(0)
     cst = cstate
+    # Terminal-exit latch: the stage-transition slice below is only safe
+    # because a stage that exits via the round cap / stall / drained-pool
+    # conditions (rather than the size handoff) guarantees every LATER stage
+    # runs zero rounds — the slice may drop rows that are still active, and
+    # the pre-slice fold preserves their unassigned state only if nothing
+    # ever touches them again.  That used to be an implicit cross-stage
+    # invariant riding on later conds re-checking the same rounds/stall
+    # terms; ``done`` makes it explicit and robust against future per-stage
+    # cond changes (e.g. resetting stall between stages).
+    done = jnp.bool_(False)
     for i, size in enumerate(sizes):
         if i > 0:
-            # Fold the rows about to be dropped (all inactive — actives sit
-            # in the compacted prefix and fit ``size``), then slice.
+            # Fold the rows about to be dropped (all inactive when the
+            # previous stage exited via the size handoff — actives sit in
+            # the compacted prefix and fit ``size``; on a terminal exit the
+            # ``done`` latch keeps this stage at zero rounds), then slice.
             assigned_rank = assigned_rank.at[ps["ranks"]].set(ps["assigned"])
             acc_round_rank = acc_round_rank.at[ps["ranks"]].set(ps["acc_round"])
             ps = {k: v[:size] for k, v in ps.items()}
         next_size = sizes[i + 1] if i + 1 < len(sizes) else 0
         avail, ps, n_active, rounds, cst = lax.while_loop(
-            make_cond(next_size), body, (avail, ps, n_active, rounds, cst)
+            make_cond(next_size, done), body, (avail, ps, n_active, rounds, cst)
         )
+        terminal = (rounds >= max_rounds) | (n_active <= 0)
+        if cmeta is not None:
+            terminal = terminal | (cst["stall"] >= STALL_ROUNDS)
+        done = done | terminal
 
     # Undo compaction (rank space), then the priority permutation (original
     # pod order), dropping block padding.

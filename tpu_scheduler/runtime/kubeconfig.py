@@ -15,10 +15,15 @@ stdlib + PyYAML:
 
 Supported auth: bearer ``token`` / ``tokenFile``, client certificates
 (``client-certificate(-data)`` + ``client-key(-data)``), cluster CA
-(``certificate-authority(-data)``), ``insecure-skip-tls-verify``.
-Exec-plugin credential helpers are out of scope (raise with a clear
-message) — they spawn arbitrary binaries, which a scheduler sidecar should
-not do implicitly.
+(``certificate-authority(-data)``), ``insecure-skip-tls-verify``, and —
+behind an explicit ``allow_exec=True`` opt-in (CLI ``--allow-exec-auth``) —
+``exec:`` credential plugins (client.authentication.k8s.io ExecCredential:
+the aws/gke/azure token-helper shape).  Exec plugins spawn arbitrary
+binaries, which a scheduler sidecar should not do implicitly, so without
+the opt-in they raise with a clear message instead.  Token-emitting
+plugins are fully supported (incl. expirationTimestamp-driven refresh);
+plugins that emit client certificates are rejected — rotating a TLS
+context mid-daemon is not supported.
 """
 
 from __future__ import annotations
@@ -60,12 +65,14 @@ def _material(entry: dict, key: str, tmpdir: list) -> str | None:
     return entry.get(key)
 
 
-def load_kubeconfig(path: str, context: str | None = None):
+def load_kubeconfig(path: str, context: str | None = None, allow_exec: bool = False):
     """Parse ``path`` and resolve ``context`` (default: current-context).
 
     Returns (server_url, token, ssl_context_or_None, keepalive) —
     ``keepalive`` holds the tempdir backing any inline cert material and
-    must stay referenced while the connection is in use."""
+    must stay referenced while the connection is in use.  ``allow_exec``
+    opts in to running the user's ``exec:`` credential plugin (see module
+    docstring)."""
     import yaml
 
     try:
@@ -84,12 +91,19 @@ def load_kubeconfig(path: str, context: str | None = None):
     server = cluster.get("server")
     if not server:
         raise KubeconfigError(f"cluster {ctx.get('cluster')!r} has no server URL")
-    if "exec" in user:
-        raise KubeconfigError("exec credential plugins are not supported; use a token or client certificate")
-
     token = user.get("token")
     token_provider = None
-    if not token and user.get("tokenFile"):
+    if "exec" in user and not token:
+        # A static token shadows the exec block (client-go precedence), so a
+        # missing/broken helper binary must not abort a config that would
+        # never invoke it.
+        if not allow_exec:
+            raise KubeconfigError(
+                "exec credential plugins are disabled by default (they spawn arbitrary binaries); "
+                "pass --allow-exec-auth / allow_exec=True to opt in, or use a token or client certificate"
+            )
+        token_provider = _exec_token_provider(user["exec"], os.path.dirname(os.path.abspath(path)), cluster)
+    if not token and token_provider is None and user.get("tokenFile"):
         # Re-read per use: bound serviceaccount tokens rotate (~1 h); a
         # static copy turns into permanent 401s in a daemon.
         token_provider = _file_token_provider(user["tokenFile"])
@@ -135,6 +149,102 @@ def _file_token_provider(path: str):
     return provider
 
 
+def _exec_token_provider(exec_spec: dict, kubeconfig_dir: str, cluster: dict):
+    """() -> bearer token via the kubeconfig ``exec:`` credential plugin
+    (client.authentication.k8s.io ExecCredential — the mechanism behind
+    ``aws eks get-token`` / ``gke-gcloud-auth-plugin``; reference inherits
+    it from client-go via ``Client::try_default()``, ``main.rs:130``).
+
+    Spawns the plugin on first use and again once the returned credential's
+    ``expirationTimestamp`` passes (no expiry → cached for the process).
+    client-go semantics honored: relative ``command`` paths resolve against
+    the kubeconfig's directory; ``env`` entries overlay the inherited
+    environment; ``provideClusterInfo`` ships the cluster block in
+    ``KUBERNETES_EXEC_INFO``; ``interactiveMode: Always`` is refused (a
+    scheduler daemon has no TTY).  Certificate-emitting plugins are
+    rejected — rotating a TLS context mid-daemon is out of scope."""
+    import json
+    import shutil
+    import subprocess
+
+    command = exec_spec.get("command")
+    if not command:
+        raise KubeconfigError("exec credential plugin has no command")
+    if exec_spec.get("interactiveMode") == "Always":
+        raise KubeconfigError("exec credential plugin requires a TTY (interactiveMode: Always); a scheduler daemon has none")
+    api_version = exec_spec.get("apiVersion") or "client.authentication.k8s.io/v1beta1"
+    # client-go: a command with a path separator resolves relative to the
+    # kubeconfig's directory; a bare name resolves via PATH.
+    if os.sep in command and not os.path.isabs(command):
+        command = os.path.normpath(os.path.join(kubeconfig_dir, command))
+    elif os.sep not in command and shutil.which(command) is None:
+        raise KubeconfigError(f"exec credential plugin {command!r} not found on PATH")
+
+    env = dict(os.environ)
+    for entry in exec_spec.get("env") or []:
+        env[entry.get("name", "")] = entry.get("value", "")
+    if exec_spec.get("provideClusterInfo"):
+        cluster_info = {"server": cluster.get("server")}
+        if cluster.get("certificate-authority-data"):
+            cluster_info["certificate-authority-data"] = cluster["certificate-authority-data"]
+        env["KUBERNETES_EXEC_INFO"] = json.dumps(
+            {"apiVersion": api_version, "kind": "ExecCredential", "spec": {"interactive": False, "cluster": cluster_info}}
+        )
+
+    state = {"token": None, "expires": None}
+
+    def _expired() -> bool:
+        if state["token"] is None:
+            return True
+        if state["expires"] is None:
+            return False
+        import datetime
+
+        return datetime.datetime.now(datetime.timezone.utc) >= state["expires"]
+
+    def provider():
+        if not _expired():
+            return state["token"]
+        argv = [command] + list(exec_spec.get("args") or [])
+        try:
+            out = subprocess.run(argv, env=env, capture_output=True, text=True, timeout=60)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise KubeconfigError(f"exec credential plugin {command!r} failed to run: {e}") from e
+        if out.returncode != 0:
+            hint = exec_spec.get("installHint") or out.stderr.strip()[:200]
+            raise KubeconfigError(f"exec credential plugin {command!r} exited {out.returncode}: {hint}")
+        try:
+            cred = json.loads(out.stdout)
+        except ValueError as e:
+            raise KubeconfigError(f"exec credential plugin {command!r} emitted invalid JSON: {e}") from e
+        if cred.get("kind") != "ExecCredential":
+            raise KubeconfigError(f"exec credential plugin {command!r} emitted kind {cred.get('kind')!r}, want ExecCredential")
+        status = cred.get("status") or {}
+        if status.get("clientCertificateData") or status.get("clientKeyData"):
+            raise KubeconfigError(
+                f"exec credential plugin {command!r} emitted client certificates, which are not supported; "
+                "use a token-emitting plugin"
+            )
+        token = status.get("token")
+        if not token:
+            raise KubeconfigError(f"exec credential plugin {command!r} emitted no status.token")
+        expires = None
+        ts = status.get("expirationTimestamp")
+        if ts:
+            import datetime
+
+            try:
+                expires = datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
+                if expires.tzinfo is None:
+                    expires = expires.replace(tzinfo=datetime.timezone.utc)
+            except ValueError:
+                expires = None  # unparsable expiry → treat as non-expiring
+        state["token"], state["expires"] = token, expires
+        return token
+
+    return provider
+
+
 def _in_cluster():
     """Serviceaccount fallback (the pod-mounted credentials kube injects).
     The token is a rotating projected token — re-read, never cached
@@ -151,10 +261,13 @@ def _in_cluster():
     return f"https://{host}:{port}", _file_token_provider(token_path), ssl_ctx, []
 
 
-def client_from_kubeconfig(path: str | None = None, context: str | None = None, timeout: float = 10.0):
+def client_from_kubeconfig(
+    path: str | None = None, context: str | None = None, timeout: float = 10.0, allow_exec: bool = False
+):
     """``Client::try_default()`` (reference ``main.rs:130``): explicit path →
     $KUBECONFIG → ~/.kube/config → in-cluster serviceaccount.  Returns a
-    ready :class:`KubeApiClient`."""
+    ready :class:`KubeApiClient`.  ``allow_exec`` opts in to ``exec:``
+    credential plugins (see :func:`load_kubeconfig`)."""
     import http.client
     from urllib.parse import urlparse
 
@@ -171,7 +284,7 @@ def client_from_kubeconfig(path: str | None = None, context: str | None = None, 
         candidates = [c for c in env.split(os.pathsep) if c] + [os.path.expanduser("~/.kube/config")]
     for cand in candidates:
         if cand and os.path.exists(cand):
-            resolved = load_kubeconfig(cand, context)
+            resolved = load_kubeconfig(cand, context, allow_exec=allow_exec)
             break
     if resolved is None and not path:
         resolved = _in_cluster()
