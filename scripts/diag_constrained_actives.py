@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Per-round active/accept trajectory of the constrained flagship cycle.
+
+Drives ONE auction round at a time (ops/assign._make_round_body jitted at
+full size) and fetches n_active after each round — slow (64 host syncs) but
+shows exactly which rounds keep how many pods active, i.e. whether the
+eventual residue pins the size chain at large stages.
+
+Usage: python scripts/diag_constrained_actives.py [pods] [nodes] [rounds]
+"""
+import os
+import sys
+import time
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    nodes_n = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    max_rounds = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    from tpu_scheduler.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops import assign as A
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    profile = PROFILES["throughput"].with_(pod_block=8192)
+    snap = synth_cluster(
+        n_nodes=nodes_n, n_pending=pods, n_bound=2 * nodes_n, seed=0,
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+    )
+    packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+    cons = pack_constraints(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    packed = replace(packed, constraints=cons)
+    arrays = {k: jax.device_put(v) for k, v in packed.device_arrays().items()}
+    nodes, ps = A.split_device_arrays(arrays)
+    ps.update({k: jax.device_put(v) for k, v in cons.pod_arrays().items()})
+    cmeta = {k: jax.device_put(v) for k, v in cons.meta_arrays().items()}
+    cstate = {k: jax.device_put(v) for k, v in cons.state_arrays().items()}
+    cstate = {**cstate, "stall": jnp.int32(0)}
+    weights = jax.device_put(profile.weights())
+
+    soft_spread = cons.n_spread_soft > 0
+    soft_pa = cons.n_ppa_terms > 0
+    hard_pa = cons.n_pa_terms > 0
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("block",))
+    def prelude(nodes, ps, block):
+        perm, out = A._prepare_pods(ps, block)
+        return perm, out, nodes["node_avail"]
+
+    body_fn = A._make_round_body(nodes, weights, profile.pod_block, False, False, cmeta, soft_spread, soft_pa, hard_pa)
+
+    @jax.jit
+    def one_round(avail, ps, n_active, rounds, cst):
+        return body_fn((avail, ps, n_active, rounds, cst))
+
+    perm, ps, avail = prelude(nodes, ps, profile.pod_block)
+    n_active = ps["active"].sum(dtype=jnp.int32)
+    rounds = jnp.int32(0)
+    prev_assigned = (ps["assigned"] >= 0).sum()
+    print(f"start: active={int(n_active)}", flush=True)
+    t_all = time.perf_counter()
+    prev_active = int(n_active)
+    for r in range(max_rounds):
+        t0 = time.perf_counter()
+        avail, ps, n_active, rounds, cstate = one_round(avail, ps, n_active, rounds, cstate)
+        na = int(n_active)  # sync
+        dt = time.perf_counter() - t0
+        assigned_now = int((ps["assigned"] >= 0).sum())
+        acc = assigned_now - int(prev_assigned)
+        dropped = prev_active - na - acc
+        prev_assigned = assigned_now
+        prev_active = na
+        print(
+            f"round {r:3d}: active={na:6d} accepted={acc:6d} dropped={dropped:6d} stall={int(cstate['stall'])} {dt*1e3:7.1f}ms",
+            flush=True,
+        )
+        if na == 0 or int(cstate["stall"]) >= 6:
+            break
+    print(f"total {time.perf_counter()-t_all:.1f}s (incl. sync overhead)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

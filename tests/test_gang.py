@@ -200,6 +200,98 @@ def test_gang_refused_by_host_constrained_fallback():
     assert "g-ok" not in placed and "g-big" not in placed  # atomicity held
 
 
+def test_constrained_gang_binds_in_host_phase():
+    """Round-5 (VERDICT r4 #4): a CONSTRAINED gang in an untensorizable
+    cluster used to requeue forever (the host phase refused gangs); the
+    host phase now trial-places the gang's members through the sequential
+    chain and commits all-or-nothing."""
+    from tpu_scheduler.api.objects import PodAntiAffinityTerm
+
+    nodes = [make_node(f"n{i}", cpu="8", memory="32Gi", labels={"name": f"n{i}"}) for i in range(4)]
+    pods = []
+    for i in range(8):  # untensorizable vocabulary (budget knob below)
+        term = [PodAntiAffinityTerm(match_labels={"app": f"a{i}"}, topology_key="name")]
+        pods.append(make_pod(f"c{i}", cpu="100m", memory="64Mi", labels={"app": f"a{i}"}, anti_affinity=term))
+    # The gang itself is constrained: members repel each other, one per node.
+    gterm = [PodAntiAffinityTerm(match_labels={"job": "g"}, topology_key="name")]
+    for i in range(3):
+        pods.append(make_pod(f"g{i}", cpu="1", memory="1Gi", labels={"job": "g"}, anti_affinity=gterm, gang="j"))
+    api = FakeApiServer()
+    api.load(nodes, pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, constraint_budgets={"max_aa_terms": 4})
+    m = sched.run_cycle()
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_constraint_host_fallbacks_total", 0) >= 1  # really the host phase
+    placed = {p.metadata.name: p.spec.node_name for p in api.list_pods() if p.spec.node_name}
+    assert {"g0", "g1", "g2"} <= set(placed), placed
+    assert len({placed[f"g{i}"] for i in range(3)}) == 3  # anti-affinity honored
+    assert m.bound == 11  # everything placed, gang included
+    assert counters.get("scheduler_gangs_admitted_total", 0) == 1
+
+
+def test_constrained_gang_rejects_whole_in_host_phase():
+    """Trial placement fails for one member -> the whole gang requeues, with
+    the dedicated rejection metric (never a silent per-pod refusal)."""
+    from tpu_scheduler.api.objects import PodAntiAffinityTerm
+
+    nodes = [make_node(f"n{i}", cpu="8", memory="32Gi", labels={"name": f"n{i}"}) for i in range(2)]
+    pods = []
+    for i in range(8):
+        term = [PodAntiAffinityTerm(match_labels={"app": f"a{i}"}, topology_key="name")]
+        pods.append(make_pod(f"c{i}", cpu="100m", memory="64Mi", labels={"app": f"a{i}"}, anti_affinity=term))
+    gterm = [PodAntiAffinityTerm(match_labels={"job": "g"}, topology_key="name")]
+    for i in range(3):  # 3 mutually-repelling members, 2 nodes -> impossible
+        pods.append(make_pod(f"g{i}", cpu="1", memory="1Gi", labels={"job": "g"}, anti_affinity=gterm, gang="j"))
+    api = FakeApiServer()
+    api.load(nodes, pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0, constraint_budgets={"max_aa_terms": 4})
+    sched.run_cycle()
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_gang_host_rejections_total", 0) == 1
+    assert all(p.spec.node_name is None for p in api.list_pods() if p.metadata.name.startswith("g"))
+
+
+def test_split_constrained_gang_refused_with_metric():
+    """A gang with members outside the host phase's view (one member in
+    requeue backoff) cannot be admitted atomically by that scope: its local
+    members refuse, counted in scheduler_gang_host_refusals_total."""
+    from tpu_scheduler.api.objects import PodAntiAffinityTerm
+
+    nodes = [make_node(f"n{i}", cpu="8", memory="32Gi", labels={"name": f"n{i}"}) for i in range(4)]
+    pods = []
+    for i in range(8):
+        term = [PodAntiAffinityTerm(match_labels={"app": f"a{i}"}, topology_key="name")]
+        pods.append(make_pod(f"c{i}", cpu="100m", memory="64Mi", labels={"app": f"a{i}"}, anti_affinity=term))
+    gterm = [PodAntiAffinityTerm(match_labels={"job": "g"}, topology_key="name")]
+    for i in range(2):
+        pods.append(make_pod(f"g{i}", cpu="1", memory="1Gi", labels={"job": "g"}, anti_affinity=gterm, gang="j"))
+    api = FakeApiServer()
+    api.load(nodes, pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=3600.0, constraint_budgets={"max_aa_terms": 4})
+    # Put a third member into a long backoff before it ever becomes
+    # schedulable: create it, fail it once via zero capacity… simpler: mark
+    # the requeue ledger directly (the unit under test is the scope check).
+    api.create_pod(make_pod("g-late", cpu="1", memory="1Gi", labels={"job": "g"}, anti_affinity=gterm, gang="j"))
+    import time as _time
+
+    sched.requeue_at["default/g-late"] = _time.monotonic() + 3600.0
+    sched.run_cycle()
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_gang_host_refusals_total", 0) == 1
+    assert all(p.spec.node_name is None for p in api.list_pods() if p.metadata.name.startswith("g"))
+
+
+def test_gang_sample_policy_refusal_counted():
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu="8", memory="32Gi")],
+        pods=[make_pod(f"w{i}", cpu="1", memory="1Gi", gang="j") for i in range(3)],
+    )
+    sched = Scheduler(api, NativeBackend(), policy="sample", requeue_seconds=0.0)
+    sched.run_cycle()
+    assert sched.metrics.snapshot().get("scheduler_gang_sample_refusals_total", 0) == 1  # once per gang, not per pod
+
+
 def test_split_gang_rejection_counted_once_per_cycle():
     from tpu_scheduler.models.profiles import DEFAULT_PROFILE
 

@@ -207,6 +207,26 @@ def test_exec_plugin_shadowed_by_static_token(tmp_path):
     assert token == "static"
     _, token2, _, _ = load_kubeconfig(str(cfg), allow_exec=True)
     assert token2 == "static"
+    # tokenFile shadows exec too (client-go: the bearer round-tripper covers
+    # BearerTokenFile and is applied outermost).
+    tok = tmp_path / "tok"
+    tok.write_text("from-file")
+    cfg2 = _write_kubeconfig(
+        tmp_path / "config2", "http://127.0.0.1:1",
+        extra_user={"tokenFile": str(tok), "exec": {"command": "definitely-not-installed-helper"}},
+    )
+    _, token3, _, _ = load_kubeconfig(str(cfg2), allow_exec=True)
+    assert callable(token3) and token3() == "from-file"
+
+
+def test_exec_plugin_not_found_surfaces_install_hint(tmp_path):
+    import tpu_scheduler.runtime.kubeconfig as kc
+
+    with pytest.raises(KubeconfigError, match="gcloud components install"):
+        kc._exec_token_provider(
+            {"command": "gke-gcloud-auth-plugin-not-here", "installHint": "Install via gcloud components install ..."},
+            str(tmp_path), {},
+        )
 
 
 def test_exec_plugin_error_paths(tmp_path):

@@ -522,35 +522,28 @@ class Scheduler:
         bound = 0
         unschedulable = 0
         order = sorted(constrained, key=lambda p: -_pod_priority(p))
+        segment_gangs: dict[str, list[Pod]] = {}
         for pod in order:
             if pod.spec is not None and pod.spec.gang:
-                # The sequential host phase cannot express all-or-nothing
-                # admission (same as the sample policy): refuse — the gang's
-                # other scopes see it incomplete and the whole gang requeues.
-                self._requeue(full_name(pod), "gang pods not supported in the host constrained fallback")
-                unschedulable += 1
-                continue
-            # Precompute the pod's affinity/spread state once — the node loop
-            # is then O(1) per candidate instead of re-scanning all placements.
-            affinity_checker = make_affinity_checker(pod, snapshot, placed)
-            pod_affinity_checker = make_pod_affinity_checker(pod, snapshot, placed)
-            spread_checker = make_spread_checker(pod, snapshot, placed)
-            soft_spread = make_soft_spread_scorer(pod, snapshot, placed)
-            ppa_scorer = make_preferred_pod_affinity_scorer(pod, snapshot, placed)
-            req = total_pod_resources(pod)  # hoisted: O(1) per candidate below
-            best: Node | None = None
-            best_score = 0.0
-            for node in prefilter.fitting_nodes(req):
-                reason = self._check_with_ledger(
-                    pod, node, snapshot, ledger, placed,
-                    affinity_checker=affinity_checker, spread_checker=spread_checker,
-                    pod_affinity_checker=pod_affinity_checker, req=req,
-                )
-                if reason is not None:
+                segment_gangs.setdefault(pod.spec.gang, []).append(pod)
+        handled_gangs: set[str] = set()
+        for pod in order:
+            gang = pod.spec.gang if pod.spec is not None else None
+            if gang:
+                # All-or-nothing gang admission in the host phase: trial-
+                # place every member through the sequential chain against
+                # scratch state, then commit whole or requeue whole (closes
+                # the round-4 silent-livelock: a constrained gang in an
+                # untensorizable cluster used to requeue forever).
+                if gang in handled_gangs:
                     continue
-                score = self._scalar_score(pod, node, snapshot, ledger, weights, soft_spread(node), ppa_scorer(node), req=req)
-                if best is None or score > best_score:
-                    best, best_score = node, score
+                handled_gangs.add(gang)
+                b, u = self._admit_gang_host(snapshot, gang, segment_gangs[gang], placed, ledger, prefilter, weights)
+                bound += b
+                unschedulable += u
+                continue
+            req = total_pod_resources(pod)  # hoisted: O(1) per candidate below
+            best = self._choose_constrained_node(pod, snapshot, ledger, placed, prefilter, weights, req)
             if best is None:
                 self._mark_unschedulable(full_name(pod))
                 unschedulable += 1
@@ -563,6 +556,110 @@ class Scheduler:
                 self._cycle_placed.append((pod, best))
                 prefilter.commit(best.name, req)
         return bound, unschedulable
+
+    def _choose_constrained_node(
+        self, pod: Pod, snapshot: ClusterSnapshot, ledger: dict, placed: list, prefilter, weights, req: PodResources
+    ) -> Node | None:
+        """Best-scoring feasible node for one pod through the exact scalar
+        chain (exhaustive over the prefilter's fitting nodes).  ``ledger``
+        and ``placed`` are whatever state the caller is working against —
+        the phase's real state, or a gang trial's scratch copies; the
+        prefilter may lag a scratch ledger (it only prunes — the ledger-
+        aware scalar chain re-checks resources exactly)."""
+        # Precompute the pod's affinity/spread state once — the node loop
+        # is then O(1) per candidate instead of re-scanning all placements.
+        affinity_checker = make_affinity_checker(pod, snapshot, placed)
+        pod_affinity_checker = make_pod_affinity_checker(pod, snapshot, placed)
+        spread_checker = make_spread_checker(pod, snapshot, placed)
+        soft_spread = make_soft_spread_scorer(pod, snapshot, placed)
+        ppa_scorer = make_preferred_pod_affinity_scorer(pod, snapshot, placed)
+        best: Node | None = None
+        best_score = 0.0
+        for node in prefilter.fitting_nodes(req):
+            reason = self._check_with_ledger(
+                pod, node, snapshot, ledger, placed,
+                affinity_checker=affinity_checker, spread_checker=spread_checker,
+                pod_affinity_checker=pod_affinity_checker, req=req,
+            )
+            if reason is not None:
+                continue
+            score = self._scalar_score(pod, node, snapshot, ledger, weights, soft_spread(node), ppa_scorer(node), req=req)
+            if best is None or score > best_score:
+                best, best_score = node, score
+        return best
+
+    def _admit_gang_host(
+        self,
+        snapshot: ClusterSnapshot,
+        gang: str,
+        members_here: list[Pod],
+        placed: list,
+        ledger: dict,
+        prefilter,
+        weights,
+    ) -> tuple[int, int]:
+        """All-or-nothing admission of one gang inside the host constrained
+        phase: trial-place the members through the sequential chain against
+        SCRATCH ledger/placement state, commit every placement only if all
+        of them succeed, roll back (requeue whole) on any miss.
+
+        Only admits when this phase sees the gang's full remaining
+        membership (cycle-wide members either placed earlier this cycle or
+        present here): a gang split across scheduling scopes cannot be
+        admitted atomically by one scope, so its local share refuses —
+        counted in ``scheduler_gang_host_refusals_total`` and logged once
+        per gang per cycle, never silently."""
+        here_names = {full_name(p) for p in members_here}
+        cycle_members = self._cycle_gangs.get(gang, here_names)
+        placed_names = {full_name(q) for q, _ in self._cycle_placed}
+        missing = cycle_members - here_names - placed_names
+        if missing:
+            self.metrics.inc("scheduler_gang_host_refusals_total")
+            logger.info(
+                "gang %s: %d member(s) outside the host constrained phase; refusing its %d local member(s) whole",
+                gang, len(missing), len(members_here),
+            )
+            for p in members_here:
+                self._requeue(full_name(p), "gang split across scheduling scopes; retry as a unit")
+            return 0, len(members_here)
+        # Trial pass against scratch state (PodResources is mutated with +=,
+        # so the ledger copy must be value-deep).
+        trial_ledger = {k: v.copy() for k, v in ledger.items()}
+        trial_placed = list(placed)
+        chosen: list[tuple[Pod, Node, PodResources]] = []
+        failed_at: str | None = None
+        for pod in sorted(members_here, key=_pod_priority, reverse=True):
+            req = total_pod_resources(pod)
+            best = self._choose_constrained_node(pod, snapshot, trial_ledger, trial_placed, prefilter, weights, req)
+            if best is None:
+                failed_at = full_name(pod)
+                break
+            committed = trial_ledger.setdefault(best.name, PodResources())
+            committed += req
+            trial_placed.append((pod, best))
+            chosen.append((pod, best, req))
+        if failed_at is not None:
+            self.metrics.inc("scheduler_gang_host_rejections_total")
+            logger.info(
+                "gang %s: trial placement found no node for %s; rejecting whole (%d member(s) requeue)",
+                gang, failed_at, len(members_here),
+            )
+            for p in members_here:
+                self._requeue(full_name(p), "gang trial placement incomplete; retry as a unit")
+            return 0, len(members_here)
+        bound = 0
+        for pod, node, req in chosen:
+            # A per-member bind failure here is the same admission-vs-bind
+            # window the gang engine documents (kube coscheduling has it
+            # too): atomicity is admission-time.
+            if self._bind(pod.metadata.namespace or "default", pod.metadata.name, node.name):
+                bound += 1
+                committed = ledger.setdefault(node.name, PodResources())
+                committed += req
+                placed.append((pod, node))
+                self._cycle_placed.append((pod, node))
+                prefilter.commit(node.name, req)
+        return bound, 0
 
     @staticmethod
     def _bound_clone(pod: Pod, node: Node) -> Pod:
@@ -1403,10 +1500,21 @@ class Scheduler:
         placed: list[tuple[Pod, Node]] = []
         bound = 0
         unschedulable = 0
+        refused_gangs: set[str] = set()
         for pod in pending:
             if pod.spec is not None and pod.spec.gang:
                 # The per-pod sample policy cannot express all-or-nothing
                 # admission; refusing beats silently binding half a gang.
+                # Counted + logged once per gang per cycle — a permanent
+                # config mismatch (gangs under --policy sample) must be
+                # visible in /metrics, not only as eternal requeues.
+                if pod.spec.gang not in refused_gangs:
+                    refused_gangs.add(pod.spec.gang)
+                    self.metrics.inc("scheduler_gang_sample_refusals_total")
+                    logger.warning(
+                        "gang %s requires the batch policy; its pods requeue every cycle under --policy sample",
+                        pod.spec.gang,
+                    )
                 self._requeue(full_name(pod), "gang pods require the batch policy")
                 unschedulable += 1
                 continue

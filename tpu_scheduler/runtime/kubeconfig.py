@@ -93,10 +93,11 @@ def load_kubeconfig(path: str, context: str | None = None, allow_exec: bool = Fa
         raise KubeconfigError(f"cluster {ctx.get('cluster')!r} has no server URL")
     token = user.get("token")
     token_provider = None
-    if "exec" in user and not token:
-        # A static token shadows the exec block (client-go precedence), so a
-        # missing/broken helper binary must not abort a config that would
-        # never invoke it.
+    if "exec" in user and not token and not user.get("tokenFile"):
+        # A static token OR tokenFile shadows the exec block (client-go
+        # precedence: the bearer round-tripper covers both and is applied
+        # outermost), so a missing/broken helper binary must not abort a
+        # config that would never invoke it.
         if not allow_exec:
             raise KubeconfigError(
                 "exec credential plugins are disabled by default (they spawn arbitrary binaries); "
@@ -173,12 +174,19 @@ def _exec_token_provider(exec_spec: dict, kubeconfig_dir: str, cluster: dict):
     if exec_spec.get("interactiveMode") == "Always":
         raise KubeconfigError("exec credential plugin requires a TTY (interactiveMode: Always); a scheduler daemon has none")
     api_version = exec_spec.get("apiVersion") or "client.authentication.k8s.io/v1beta1"
+
+    def _hint() -> str:
+        # client-go appends installHint exactly on plugin-not-found errors —
+        # it is the one message telling the operator how to fix the setup.
+        h = exec_spec.get("installHint")
+        return f"; {h}" if h else ""
+
     # client-go: a command with a path separator resolves relative to the
     # kubeconfig's directory; a bare name resolves via PATH.
     if os.sep in command and not os.path.isabs(command):
         command = os.path.normpath(os.path.join(kubeconfig_dir, command))
     elif os.sep not in command and shutil.which(command) is None:
-        raise KubeconfigError(f"exec credential plugin {command!r} not found on PATH")
+        raise KubeconfigError(f"exec credential plugin {command!r} not found on PATH{_hint()}")
 
     env = dict(os.environ)
     for entry in exec_spec.get("env") or []:
@@ -209,7 +217,7 @@ def _exec_token_provider(exec_spec: dict, kubeconfig_dir: str, cluster: dict):
         try:
             out = subprocess.run(argv, env=env, capture_output=True, text=True, timeout=60)
         except (OSError, subprocess.TimeoutExpired) as e:
-            raise KubeconfigError(f"exec credential plugin {command!r} failed to run: {e}") from e
+            raise KubeconfigError(f"exec credential plugin {command!r} failed to run: {e}{_hint()}") from e
         if out.returncode != 0:
             hint = exec_spec.get("installHint") or out.stderr.strip()[:200]
             raise KubeconfigError(f"exec credential plugin {command!r} exited {out.returncode}: {hint}")
