@@ -19,8 +19,12 @@ test:
 native:
 	$(MAKE) -C native
 
+# Regression-gated: fails (exit 2) when the flagship min-of-repeats exceeds
+# the previous round's recorded number by >1.3x.  The driver's end-of-round
+# run calls bench.py directly without the gate — a regressed number on
+# record still beats none.
 bench:
-	$(PY) bench.py
+	$(PY) bench.py --fail-regression-threshold 1.3
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

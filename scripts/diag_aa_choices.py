@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Why don't the tail's AA claimants spread?  At the round-20 state, compute
+the actual choose (score+jitter+mask argmax) for the claimants of a few AA
+terms and report: distinct chosen nodes, the score landscape's width (#nodes
+within jitter amplitude of each pod's top), and the capacity-accept +
+AA-filter outcome — pinpointing which stage serializes the tail.
+
+Usage: python scripts/diag_aa_choices.py [pods] [nodes] [warm_rounds]
+"""
+import os
+import sys
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    nodes_n = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    warm = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    from tpu_scheduler.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops import assign as A
+    from tpu_scheduler.ops import constraints as C
+    from tpu_scheduler.ops.masks import feasibility_block
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.ops.score import score_block
+    from tpu_scheduler.testing import synth_cluster
+
+    profile = PROFILES["throughput"].with_(pod_block=8192)
+    snap = synth_cluster(
+        n_nodes=nodes_n, n_pending=pods, n_bound=2 * nodes_n, seed=0,
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+    )
+    packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+    cons = C.pack_constraints(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    packed = replace(packed, constraints=cons)
+    arrays = {k: jax.device_put(v) for k, v in packed.device_arrays().items()}
+    nodes, ps = A.split_device_arrays(arrays)
+    ps.update({k: jax.device_put(v) for k, v in cons.pod_arrays().items()})
+    cmeta = {k: jax.device_put(v) for k, v in cons.meta_arrays().items()}
+    cstate = {k: jax.device_put(v) for k, v in cons.state_arrays().items()}
+    cstate = {**cstate, "stall": jnp.int32(0)}
+    weights = jax.device_put(profile.weights())
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("block",))
+    def prelude(nodes, ps, block):
+        perm, out = A._prepare_pods(ps, block)
+        return perm, out, nodes["node_avail"]
+
+    body_fn = A._make_round_body(nodes, weights, profile.pod_block, False, False, cmeta, True, True, True)
+    one_round = jax.jit(lambda s: body_fn(s))
+    perm, ps, avail = prelude(nodes, ps, profile.pod_block)
+    state = (avail, ps, ps["active"].sum(dtype=jnp.int32), jnp.int32(0), cstate)
+    for _ in range(warm):
+        state = one_round(state)
+    avail, ps, n_active, rounds, cstate = state
+
+    h = {k: np.asarray(v) for k, v in ps.items()}
+    hn = {k: np.asarray(v) for k, v in nodes.items()}
+    hmeta = {k: np.asarray(v) for k, v in cmeta.items()}
+    hstate = {k: np.asarray(v) for k, v in cstate.items() if k != "stall"}
+    havail = np.asarray(avail)
+    w = np.asarray(weights)
+    act = h["active"].astype(bool)
+
+    masks = C.round_blocked_masks(np, hstate, hmeta, soft_spread=True, soft_pa=True, hard_pa=True)
+    m = feasibility_block(
+        np, h["pod_req"], h["pod_sel"], h["pod_sel_count"], h["active"], havail,
+        hn["node_labels"], hn["node_valid"], h["pod_ntol"], hn["node_taints"],
+        h["pod_aff"], h["pod_has_aff"], hn["node_aff"],
+    )
+    feas = m & ~C.blocked_block(np, h, masks)
+    has = feas.any(axis=1)
+
+    # Pick the 3 largest AA terms among active claimants
+    carr = h["pod_aa_carries"][act & has]
+    sizes = carr.sum(axis=0)
+    top_terms = np.argsort(-sizes)[:3]
+    node_idx = np.arange(havail.shape[0], dtype=np.uint32)
+    for t in top_terms:
+        sel = act & has & (h["pod_aa_carries"][:, t] > 0)
+        cnt = sel.sum()
+        if cnt == 0:
+            continue
+        rows = np.flatnonzero(sel)[:2000]
+        sc = score_block(
+            np, h["pod_req"][rows], hn["node_alloc"], havail, w, h["ranks"][rows], node_idx,
+            pod_pref_w=h["pod_pref_w"][rows], node_pref=hn["node_pref"],
+            pod_ntol_soft=h["pod_ntol_soft"][rows], node_taints_soft=hn["node_taints_soft"],
+            pod_sps_declares=h["pod_sps_declares"][rows], sp_penalty_node=masks["sp_penalty_node"],
+            pod_ppa_w=h["pod_ppa_w"][rows], ppa_cnt_node=masks["ppa_cnt_node"],
+            salt=int(rounds),
+        )
+        sc = np.where(feas[rows], sc, -np.inf)
+        choice = sc.argmax(axis=1)
+        distinct = len(set(choice.tolist()))
+        feas_counts = feas[rows].sum(axis=1)
+        top = sc.max(axis=1)
+        # width: nodes within the 32-point jitter amplitude of this pod's top
+        width = (sc >= (top[:, None] - 32.0)).sum(axis=1)
+        print(
+            f"term {t}: claimants={cnt} distinct_choice={distinct} "
+            f"feasible/pod med={np.median(feas_counts):.0f} "
+            f"nodes-within-32pts med={np.median(width):.0f} min={width.min()} max={width.max()}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

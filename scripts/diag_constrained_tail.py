@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Composition of the constrained cycle's permanent-active tail.
+
+Runs N rounds of the constrained flagship auction, then dissects the still-
+active pods: who is blocked-everywhere-but-kept (positive-affinity hope),
+who is a spread claimant, how much open spread quota exists vs how many
+cells the claimants actually chose — the data that decides whether the tail
+needs cheaper rounds, claimant spreading, or early termination.
+
+Usage: python scripts/diag_constrained_tail.py [pods] [nodes] [warm_rounds]
+"""
+import os
+import sys
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    nodes_n = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    warm = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    from tpu_scheduler.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops import assign as A
+    from tpu_scheduler.ops import constraints as C
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.masks import feasibility_block
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    profile = PROFILES["throughput"].with_(pod_block=8192)
+    snap = synth_cluster(
+        n_nodes=nodes_n, n_pending=pods, n_bound=2 * nodes_n, seed=0,
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+    )
+    packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+    cons = pack_constraints(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    packed = replace(packed, constraints=cons)
+    arrays = {k: jax.device_put(v) for k, v in packed.device_arrays().items()}
+    nodes, ps = A.split_device_arrays(arrays)
+    ps.update({k: jax.device_put(v) for k, v in cons.pod_arrays().items()})
+    cmeta = {k: jax.device_put(v) for k, v in cons.meta_arrays().items()}
+    cstate = {k: jax.device_put(v) for k, v in cons.state_arrays().items()}
+    cstate = {**cstate, "stall": jnp.int32(0)}
+    weights = jax.device_put(profile.weights())
+    soft_spread, soft_pa, hard_pa = cons.n_spread_soft > 0, cons.n_ppa_terms > 0, cons.n_pa_terms > 0
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("block",))
+    def prelude(nodes, ps, block):
+        perm, out = A._prepare_pods(ps, block)
+        return perm, out, nodes["node_avail"]
+
+    body_fn = A._make_round_body(nodes, weights, profile.pod_block, False, False, cmeta, soft_spread, soft_pa, hard_pa)
+    one_round = jax.jit(lambda s: body_fn(s))
+
+    perm, ps, avail = prelude(nodes, ps, profile.pod_block)
+    n_active = ps["active"].sum(dtype=jnp.int32)
+    rounds = jnp.int32(0)
+    state = (avail, ps, n_active, rounds, cstate)
+    for _ in range(warm):
+        state = one_round(state)
+    avail, ps, n_active, rounds, cstate = state
+    print(f"after {warm} rounds: active={int(n_active)}", flush=True)
+
+    # --- dissect on host -------------------------------------------------
+    h = {k: np.asarray(v) for k, v in ps.items()}
+    hmeta = {k: np.asarray(v) for k, v in cmeta.items()}
+    hstate = {k: np.asarray(v) for k, v in cstate.items() if k != "stall"}
+    havail = np.asarray(avail)
+    act = h["active"].astype(bool)
+    na = act.sum()
+
+    masks = C.round_blocked_masks(np, hstate, hmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
+    hn = {k: np.asarray(v) for k, v in nodes.items()}
+    m = feasibility_block(
+        np, h["pod_req"], h["pod_sel"], h["pod_sel_count"], h["active"], havail,
+        hn["node_labels"], hn["node_valid"], h["pod_ntol"], hn["node_taints"],
+        h["pod_aff"], h["pod_has_aff"], hn["node_aff"],
+    )
+    blocked = C.blocked_block(np, h, masks)
+    feas = m & ~blocked
+    has = feas.any(axis=1)
+    print(f"actives with a feasible node (claimants): {(act & has).sum()} / {na}")
+    print(f"actives blocked everywhere (kept by pa_hope): {(act & ~has).sum()}")
+    pa_declares = h["pod_pa_declares"].sum(axis=1) > 0
+    sp_declares = h["pod_sp_declares"].sum(axis=1) > 0
+    aa_carries = (h["pod_aa_carries"].sum(axis=1) > 0) | (h["pod_aa_matched"].sum(axis=1) > 0)
+    print(f"  of blocked-everywhere: pa_declarers={(act & ~has & pa_declares).sum()}")
+    print(f"  of claimants: sp_declarers={(act & has & sp_declares).sum()} pa={(act & has & pa_declares).sum()} aa={(act & has & aa_carries).sum()} plain={(act & has & ~sp_declares & ~pa_declares & ~aa_carries).sum()}")
+
+    # Spread quota structure at this state
+    uses_sp, skew, counts = hmeta["sp_uses_dom"], hmeta["sp_skew"], hstate["sp_counts"]
+    lo = np.min(np.where(uses_sp > 0, counts, C.RANK_INF), axis=1)
+    lo = np.where(lo >= C.RANK_INF, 0.0, lo)
+    q = np.maximum(0.0, (skew + lo)[:, None] - counts) * uses_sp
+    open_cells = (q >= 1.0).sum()
+    print(f"spread: open (s,d) cells={open_cells}, total quota={q.sum():.0f}, constraints with any open cell={(q.max(axis=1) >= 1).sum()}/{int((uses_sp.sum(axis=1) > 0).sum())}")
+    # Where do spread claimants actually point? Their best feasible node's cell.
+    clam = act & has & sp_declares
+    if clam.any():
+        # crude: first feasible node per claimant (choose uses scores; this
+        # approximates the chosen-cell spread structure)
+        first_node = feas[clam].argmax(axis=1)
+        ndc = hmeta["node_dom_c"]
+        cell_hit = ndc[first_node]  # [C, D]
+        decl = h["pod_sp_declares"][clam]  # [C, S]
+        chosen_cells = set()
+        for s in range(uses_sp.shape[0]):
+            sel = decl[:, s] > 0
+            if sel.any():
+                doms = cell_hit[sel].argmax(axis=1)
+                for d in np.unique(doms):
+                    chosen_cells.add((s, int(d)))
+        print(f"spread claimants' (first-feasible) distinct target cells: {len(chosen_cells)}")
+    # Capacity left
+    print(f"nodes with any remaining cpu: {(havail[:, 0] > 0).sum()}/{havail.shape[0]}; total cpu left={havail[:, 0].sum()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Jitter-amplitude sweep on the constrained flagship cycle.
+
+Hypothesis (diag_constrained_tail): the 64-round tail is anti-affinity
+HERDING — each app's ~200 mutually-repelling pods pick the same near-tied
+best node, and the AA within-round filter admits one per (term, node) per
+round.  If so, a larger tie-break amplitude should collapse rounds/time.
+
+Usage: python scripts/diag_jitter_sweep.py [pods] [nodes]
+"""
+import os
+import sys
+import time
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    from tpu_scheduler.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    base = PROFILES["throughput"].with_(pod_block=8192, max_rounds=64)
+    snap = synth_cluster(
+        n_nodes=nodes, n_pending=pods, n_bound=2 * nodes, seed=0,
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+    )
+    packed = pack_snapshot(snap, pod_block=base.pod_block, node_block=128)
+    cons = pack_constraints(
+        snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+        max_aa_terms=256, max_spread=256,
+    )
+    packed = replace(packed, constraints=cons)
+    backend = TpuBackend()
+    for amp in (0.5, 2.0, 8.0, 32.0):
+        prof = base.with_(spread_jitter=amp)
+        r = backend.schedule(packed, prof)  # warm (weights are operands: no recompile)
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            r = backend.schedule(packed, prof)
+            times.append(time.perf_counter() - t0)
+        print(f"jitter={amp:5.1f}: {min(times):.3f}s bound={len(r.bindings)}/{packed.num_pods} rounds={r.rounds}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

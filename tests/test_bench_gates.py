@@ -37,7 +37,7 @@ def test_fresh_probe_failure_goes_straight_to_cpu(tmp_path):
     status = tmp_path / "status.json"
     status.write_text(json.dumps({"ok": False, "error": "UNAVAILABLE", "ts": time.time()}))
     out = _run(
-        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row"],
+        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row", "--no-e2e-row"],
         {"BENCH_PROBE_STATUS": str(status)},
     )
     assert out.returncode == 0, out.stderr[-800:]
@@ -51,7 +51,7 @@ def test_exhausted_wall_budget_goes_straight_to_cpu(tmp_path):
     failed init must fall back to CPU before ever touching the device."""
     status = tmp_path / "missing.json"  # no probe report
     out = _run(
-        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row"],
+        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row", "--no-e2e-row"],
         {"BENCH_PROBE_STATUS": str(status), "BENCH_MAX_TOTAL_SECONDS": "60"},
     )
     assert out.returncode == 0, out.stderr[-800:]
@@ -68,7 +68,7 @@ def test_stale_probe_failure_does_not_gate(tmp_path):
     status = tmp_path / "status.json"
     status.write_text(json.dumps({"ok": False, "error": "UNAVAILABLE", "ts": time.time() - 9999}))
     out = _run(
-        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row"],
+        ["--pods", "1500", "--nodes", "150", "--repeats", "1", "--no-sharded-row", "--no-constrained-row", "--no-e2e-row"],
         {"BENCH_PROBE_STATUS": str(status), "BENCH_MAX_TOTAL_SECONDS": "60"},
     )
     assert out.returncode == 0, out.stderr[-800:]
@@ -77,13 +77,61 @@ def test_stale_probe_failure_does_not_gate(tmp_path):
     assert _parse(out)["platform"] == "cpu"
 
 
+def _bench_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_record(path, n, metric, value, value_min=None):
+    parsed = {"metric": metric, "platform": "tpu", "value": value}
+    if value_min is not None:
+        parsed["value_min"] = value_min
+    path.joinpath(f"BENCH_r{n:02d}.json").write_text(json.dumps({"n": n, "parsed": parsed}))
+
+
+def test_regression_baseline_picks_newest_matching_round(tmp_path):
+    bench = _bench_module()
+    m = "sched_cycle_seconds_100000x10000"
+    _write_record(tmp_path, 3, m, 0.40)
+    _write_record(tmp_path, 4, m, 0.30, value_min=0.25)  # newest: min preferred
+    _write_record(tmp_path, 5, "sched_cycle_seconds_25000x5000", 0.1)  # other metric: ignored
+    val, src = bench.previous_round_value(str(tmp_path), m)
+    assert val == 0.25 and src == "BENCH_r04.json"
+    assert bench.previous_round_value(str(tmp_path), "nope") is None
+
+
+def test_regression_gate_fires_and_annotates(tmp_path):
+    bench = _bench_module()
+    m = "sched_cycle_seconds_100000x10000"
+    _write_record(tmp_path, 4, m, 0.30, value_min=0.25)
+    # Within the gate: annotated, not failed.
+    out = {"metric": m, "value": 0.30, "value_min": 0.28}
+    assert bench.apply_regression_check(out, "tpu", str(tmp_path), 1.3) is False
+    assert out["regression_vs_prev"] == round(0.28 / 0.25, 3) and out["prev_round_source"] == "BENCH_r04.json"
+    # Over the gate: fails.
+    out2 = {"metric": m, "value": 0.40, "value_min": 0.40}
+    assert bench.apply_regression_check(out2, "tpu", str(tmp_path), 1.3) is True
+    # CPU-degraded rows never compare against a TPU record.
+    out3 = {"metric": m, "value": 9.9, "value_min": 9.9}
+    assert bench.apply_regression_check(out3, "cpu", str(tmp_path), 1.3) is False
+    assert "regression_vs_prev" not in out3
+    # No threshold (driver run): annotate only, never fail.
+    out4 = {"metric": m, "value": 0.40, "value_min": 0.40}
+    assert bench.apply_regression_check(out4, "tpu", str(tmp_path), None) is False
+    assert out4["regression_vs_prev"] > 1.3
+
+
 def test_cpu_fallback_row_shape(tmp_path):
     """The degraded row carries the honesty fields the judge reads:
     platform, pallas, downscaled_from (at flagship request), budget."""
     status = tmp_path / "status.json"
     status.write_text(json.dumps({"ok": False, "error": "UNAVAILABLE", "ts": time.time()}))
     out = _run(
-        ["--repeats", "1", "--no-sharded-row", "--no-constrained-row"],  # default flagship 100k request
+        ["--repeats", "1", "--no-sharded-row", "--no-constrained-row", "--no-e2e-row"],  # default flagship 100k request
         {"BENCH_PROBE_STATUS": str(status)},
         timeout=1200,
     )

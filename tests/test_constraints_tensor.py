@@ -484,3 +484,31 @@ def test_rich_spread_vocab_rides_tensor_path():
     counters = sched.metrics.snapshot()
     assert counters.get("scheduler_constraint_host_fallbacks_total", 0) == 0, counters
     assert counters.get("scheduler_constraint_tensor_cycles_total", 0) == 1, counters
+
+
+def test_cell_rank_scan_chunked_equals_oneshot(monkeypatch):
+    """The spread filter's chunked [P,S,D] passes (byte-budget form, BOTH
+    backends) must be bitwise equal to the one-shot form — cross-backend/
+    stage parity depends on it (round-5 review finding: the budget must
+    bind numpy too, not only the jit path)."""
+    import numpy as np
+
+    import tpu_scheduler.ops.constraints as C
+
+    rng = np.random.default_rng(0)
+    P, S, D = 533, 7, 5
+    mass = (rng.random((P, S)) < 0.3).astype(np.float32)
+    nd = np.zeros((P, D), np.float32)
+    nd[np.arange(P), rng.integers(0, D, P)] = 1.0
+    uses = (rng.random((S, D)) < 0.7).astype(np.float32)
+    base = rng.integers(0, 5, (S, D)).astype(np.float32)
+    ref_pre = C._cell_rank_prefix(np, mass, nd, uses)
+    ref_lvl = C._cell_rank_min_level(np, mass, nd, uses, base)
+    monkeypatch.setattr(C, "DENSE_TENSOR_BYTES", 64 * S * D * 4)  # force 64-pod chunks
+    assert (C._cell_rank_prefix(np, mass, nd, uses) == ref_pre).all()
+    assert (C._cell_rank_min_level(np, mass, nd, uses, base) == ref_lvl).all()
+    import jax.numpy as jnp
+
+    jp = np.asarray(C._cell_rank_prefix(jnp, jnp.asarray(mass), jnp.asarray(nd), jnp.asarray(uses)))
+    jl = np.asarray(C._cell_rank_min_level(jnp, jnp.asarray(mass), jnp.asarray(nd), jnp.asarray(uses), jnp.asarray(base)))
+    assert (jp == ref_pre).all() and (jl == ref_lvl).all()

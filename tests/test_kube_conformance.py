@@ -1,0 +1,330 @@
+"""Real-wire kube-apiserver conformance (VERDICT r4 #3).
+
+The in-repo HttpApiServer proves only SELF-conformance; a real
+kube-apiserver frames things differently.  These tests drive
+KubeApiClient / HttpWatch against a socket server replaying BYTE-EXACT
+response fixtures hand-written from the Kubernetes API conventions:
+
+  * chunked Transfer-Encoding on lists AND watch streams, with chunk
+    boundaries mid-JSON (the apiserver streams frames as they happen);
+  * watch events with STRING resourceVersions and NO bookmark unless
+    ``allowWatchBookmarks=true`` was requested — and only best-effort then;
+  * resourceVersion expiry as an HTTP-200 stream carrying an in-stream
+    ``ERROR`` event whose object is a ``Status`` with code 410 (the real
+    shape) as well as the plain HTTP 410 + Status body form;
+  * ``Status`` error documents for plain API errors (403 etc.);
+  * Lease create/update conflicts as 409 + Status (client-go CAS shape).
+
+Anchor: the reference links the real kube client and its only integration
+path is a real cluster via kubeconfig (``src/main.rs:130-143``).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+
+import pytest
+
+from tpu_scheduler.runtime.fake_api import ApiError
+from tpu_scheduler.runtime.http_api import HttpWatch, KubeApiClient
+
+
+def _chunked(*parts: bytes) -> bytes:
+    """HTTP/1.1 chunked body: each part becomes one chunk, then the
+    terminal 0-chunk — byte-exact apiserver framing."""
+    out = b""
+    for p in parts:
+        out += f"{len(p):x}\r\n".encode() + p + b"\r\n"
+    return out + b"0\r\n\r\n"
+
+
+def _resp_chunked(status: str, body: bytes) -> bytes:
+    return (
+        f"HTTP/1.1 {status}\r\n"
+        "Content-Type: application/json\r\n"
+        "Transfer-Encoding: chunked\r\n"
+        "\r\n"
+    ).encode() + body
+
+
+def _resp_plain(status: str, body: bytes) -> bytes:
+    return (
+        f"HTTP/1.1 {status}\r\n"
+        "Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        "\r\n"
+    ).encode() + body
+
+
+class FixtureServer:
+    """Replays canned responses byte-for-byte over a real socket, recording
+    each request line + headers for assertions.  Keep-alive: one connection
+    serves the whole scripted sequence (the client's persistent-connection
+    behavior is part of what is under test)."""
+
+    def __init__(self, responses: list[bytes]):
+        self._responses = list(responses)
+        self.requests: list[bytes] = []
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(4)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        try:
+            while self._responses:
+                conn, _ = self._sock.accept()
+                conn.settimeout(10.0)
+                with conn:
+                    while self._responses:
+                        req = self._read_request(conn)
+                        if req is None:
+                            break  # client closed/reconnected
+                        self.requests.append(req)
+                        conn.sendall(self._responses.pop(0))
+        except OSError:
+            pass
+
+    @staticmethod
+    def _read_request(conn) -> bytes | None:
+        data = b""
+        while b"\r\n\r\n" not in data:
+            try:
+                got = conn.recv(65536)
+            except OSError:
+                return None
+            if not got:
+                return None
+            data += got
+        head, _, rest = data.partition(b"\r\n\r\n")
+        # Drain a body if Content-Length says there is one (POST/PUT).
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                n = int(line.split(b":")[1])
+                while len(rest) < n:
+                    rest += conn.recv(65536)
+        return head
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _client(server: FixtureServer) -> KubeApiClient:
+    return KubeApiClient(f"http://127.0.0.1:{server.port}", timeout=5.0)
+
+
+def _pod_doc(name: str, rv: str, phase: str = "Pending", node: str | None = None) -> dict:
+    spec: dict = {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m", "memory": "64Mi"}}}]}
+    if node:
+        spec["nodeName"] = node
+    return {
+        "kind": "Pod",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": "default", "resourceVersion": rv, "uid": f"uid-{name}"},
+        "spec": spec,
+        "status": {"phase": phase},
+    }
+
+
+def test_chunked_list_with_string_resource_versions():
+    """A PodList streamed as chunked with boundaries MID-JSON and string
+    resourceVersions must parse identically to a plain response."""
+    body = json.dumps(
+        {
+            "kind": "PodList",
+            "apiVersion": "v1",
+            "metadata": {"resourceVersion": "1000045"},
+            "items": [_pod_doc("a", "1000001"), _pod_doc("b", "1000002", phase="Running", node="n1")],
+        }
+    ).encode()
+    cut1, cut2 = len(body) // 3, 2 * len(body) // 3  # boundaries mid-document
+    srv = FixtureServer([_resp_chunked("200 OK", _chunked(body[:cut1], body[cut1:cut2], body[cut2:]))])
+    try:
+        pods, rv = _client(srv).list_pods(with_rv=True)
+        assert [p.metadata.name for p in pods] == ["a", "b"]
+        assert rv == 1000045
+        assert pods[1].spec.node_name == "n1"
+    finally:
+        srv.close()
+
+
+def test_watch_stream_without_bookmark_and_request_opt_in():
+    """Watch frames streamed chunk-by-chunk (one event per chunk, real
+    apiserver cadence), NO bookmark: the client must fall back to the last
+    event's resourceVersion — and must have REQUESTED bookmarks
+    (allowWatchBookmarks=true) since servers only send them on opt-in."""
+    ev1 = (json.dumps({"type": "ADDED", "object": _pod_doc("w1", "2000001")}) + "\n").encode()
+    ev2 = (json.dumps({"type": "MODIFIED", "object": _pod_doc("w1", "2000007", phase="Running", node="n1")}) + "\n").encode()
+    srv = FixtureServer([_resp_chunked("200 OK", _chunked(ev1, ev2))])
+    try:
+        events, new_rv = _client(srv).watch_pods_since(2000000)
+        assert [e.type for e in events] == ["ADDED", "MODIFIED"]
+        assert new_rv == 2000007  # no bookmark -> last event rv
+        req = srv.requests[0].decode()
+        assert "watch=true" in req and "allowWatchBookmarks=true" in req
+        assert "resourceVersion=2000000" in req
+    finally:
+        srv.close()
+
+
+def test_watch_bookmark_advances_rv():
+    """With bookmarks granted, the trailing BOOKMARK's (string) rv wins even
+    past the last event's."""
+    ev = (json.dumps({"type": "ADDED", "object": _pod_doc("w1", "3000001")}) + "\n").encode()
+    bm = (
+        json.dumps({"type": "BOOKMARK", "object": {"kind": "Pod", "apiVersion": "v1", "metadata": {"resourceVersion": "3000050"}}})
+        + "\n"
+    ).encode()
+    srv = FixtureServer([_resp_chunked("200 OK", _chunked(ev, bm))])
+    try:
+        events, new_rv = _client(srv).watch_pods_since(3000000)
+        assert len(events) == 1 and new_rv == 3000050
+    finally:
+        srv.close()
+
+
+_STATUS_410 = {
+    "kind": "Status",
+    "apiVersion": "v1",
+    "status": "Failure",
+    "message": "too old resource version: 1 (4000000)",
+    "reason": "Expired",
+    "code": 410,
+}
+
+
+def test_watch_expiry_as_in_stream_error_event_triggers_relist():
+    """THE real-apiserver expiry shape: HTTP 200 whose stream carries an
+    ERROR event with a 410 Status object.  HttpWatch must resync via relist
+    and keep functioning (kube reflector contract)."""
+    err = (json.dumps({"type": "ERROR", "object": _STATUS_410}) + "\n").encode()
+    relist = json.dumps(
+        {
+            "kind": "PodList",
+            "apiVersion": "v1",
+            "metadata": {"resourceVersion": "4000010"},
+            "items": [_pod_doc("p1", "4000003")],
+        }
+    ).encode()
+    follow_up = (json.dumps({"type": "ADDED", "object": _pod_doc("p2", "4000011")}) + "\n").encode()
+    srv = FixtureServer(
+        [
+            _resp_chunked("200 OK", _chunked(err)),  # watch -> in-stream 410
+            _resp_chunked("200 OK", _chunked(relist)),  # relist
+            _resp_chunked("200 OK", _chunked(follow_up)),  # watch resumes from 4000010
+        ]
+    )
+    try:
+        client = _client(srv)
+        w = HttpWatch(
+            lambda: client.list_pods(with_rv=True),
+            client.watch_pods_since,
+            key_fn=lambda p: (p.metadata.namespace, p.metadata.name),
+        )
+        w._rv = 1  # pretend we had watched before; first poll hits the expired watch
+        events = w.poll()
+        assert [e.object.metadata.name for e in events] == ["p1"]  # resynced via relist
+        events2 = w.poll()
+        assert [e.object.metadata.name for e in events2] == ["p2"]
+        assert "resourceVersion=4000010" in srv.requests[2].decode()  # resumed from the relist rv
+    finally:
+        srv.close()
+
+
+def test_watch_expiry_as_http_410_triggers_relist():
+    """The plain HTTP 410 + Status body form must resync identically."""
+    relist = json.dumps(
+        {"kind": "PodList", "apiVersion": "v1", "metadata": {"resourceVersion": "5000000"}, "items": []}
+    ).encode()
+    srv = FixtureServer(
+        [
+            _resp_plain("410 Gone", json.dumps(_STATUS_410).encode()),
+            _resp_chunked("200 OK", _chunked(relist)),
+        ]
+    )
+    try:
+        client = _client(srv)
+        w = HttpWatch(
+            lambda: client.list_pods(with_rv=True),
+            client.watch_pods_since,
+            key_fn=lambda p: (p.metadata.namespace, p.metadata.name),
+        )
+        w._rv = 1
+        assert w.poll() == []  # relist of an empty cluster
+        assert w._rv == 5000000
+    finally:
+        srv.close()
+
+
+def test_status_error_body_surfaces_message():
+    """Plain API errors arrive as Status documents; the client must surface
+    code + message, not choke on the envelope."""
+    status = {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "message": 'pods is forbidden: User "system:anonymous" cannot list resource "pods"',
+        "reason": "Forbidden",
+        "code": 403,
+    }
+    srv = FixtureServer([_resp_plain("403 Forbidden", json.dumps(status).encode())])
+    try:
+        with pytest.raises(ApiError) as ei:
+            _client(srv).list_pods()
+        assert ei.value.code == 403 and "forbidden" in str(ei.value)
+    finally:
+        srv.close()
+
+
+def test_lease_update_conflict_409_status():
+    """A Lease CAS losing the race gets 409 + Status (client-go shape); the
+    client must report failure (False), not raise or claim the lease."""
+    conflict = {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "message": 'Operation cannot be fulfilled on leases.coordination.k8s.io "sched": '
+        "the object has been modified; please apply your changes to the latest version and try again",
+        "reason": "Conflict",
+        "code": 409,
+    }
+    srv = FixtureServer([_resp_plain("409 Conflict", json.dumps(conflict).encode())])
+    try:
+        ok = _client(srv)._update_lease(
+            "kube-system",
+            "sched",
+            {"metadata": {"name": "sched", "namespace": "kube-system", "resourceVersion": "7"}, "spec": {}},
+        )
+        assert ok is False
+    finally:
+        srv.close()
+
+
+def test_binding_create_conflict_409_status():
+    """Binding an already-bound pod: 409 + Status — must raise ApiError(409)
+    (the reconciler's await_change skip path, main.rs:74-76)."""
+    from tpu_scheduler.api.objects import ObjectReference
+
+    conflict = {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "message": 'pods "p" already assigned to node "n1"',
+        "reason": "Conflict",
+        "code": 409,
+    }
+    srv = FixtureServer([_resp_plain("409 Conflict", json.dumps(conflict).encode())])
+    try:
+        with pytest.raises(ApiError) as ei:
+            _client(srv).create_binding("default", "p", ObjectReference(name="n1"))
+        assert ei.value.code == 409
+    finally:
+        srv.close()

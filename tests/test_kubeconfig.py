@@ -325,3 +325,29 @@ def test_cli_kubeconfig_flag(tmp_path, capsys):
         assert '"bound": 3' in out
     finally:
         server.stop()
+
+
+def test_exec_plugin_transient_failure_is_oserror_with_stale_grace(tmp_path):
+    """Request-time helper failures must surface as OSError subclasses (the
+    runtime's transient-fault handlers back off instead of crashing the
+    daemon), and a provider holding a last-good token serves it through a
+    transient refresh failure."""
+    import json
+
+    import tpu_scheduler.runtime.kubeconfig as kc
+    from tpu_scheduler.runtime.kubeconfig import ExecCredentialError
+
+    assert issubclass(ExecCredentialError, OSError) and issubclass(ExecCredentialError, KubeconfigError)
+    flag = tmp_path / "fail"
+    cred = {"kind": "ExecCredential", "status": {"token": "t1", "expirationTimestamp": "2001-01-01T00:00:00Z"}}
+    plugin = _write_exec_plugin(
+        tmp_path, f"if [ -e {flag} ]; then exit 3; fi\ncat <<'EOF2'\n{json.dumps(cred)}\nEOF2\n"
+    )
+    p = kc._exec_token_provider({"command": plugin}, str(tmp_path), {})
+    assert p() == "t1"
+    flag.write_text("x")  # helper now fails; token is expired -> refresh attempt
+    assert p() == "t1"  # stale grace: last-good token served, no raise
+    # a fresh provider with no prior token must raise the transient error
+    p2 = kc._exec_token_provider({"command": plugin}, str(tmp_path), {})
+    with pytest.raises(ExecCredentialError):
+        p2()

@@ -181,3 +181,54 @@ def test_dev_cache_capped_under_churn():
     assert id(hot) in b._dev_cache, "recently-touched entry must survive the cap"
     # every evicted entry's finalizer was detached; survivors' are alive
     assert all(ent[2].alive for ent in b._dev_cache.values())
+
+
+def test_incremental_snapshot_equivalence():
+    """ClusterReflector.snapshot() (incremental by-node index, round 5) must
+    equal ClusterSnapshot.build over the reflector stores — placements,
+    by-node lists, pending sets — through create/bind/delete churn, and
+    return the SAME object when nothing changed."""
+    from tpu_scheduler.api.objects import ObjectReference, PodAntiAffinityTerm
+    from tpu_scheduler.core.snapshot import ClusterSnapshot
+    from tpu_scheduler.runtime.reflector import ClusterReflector
+
+    api = FakeApiServer()
+    for i in range(6):
+        api.create_node(make_node(f"n{i}", cpu="8", memory="32Gi", labels={"zone": f"z{i%2}"}))
+    term = [PodAntiAffinityTerm(match_labels={"app": "a"}, topology_key="zone")]
+    for i in range(10):
+        api.create_pod(make_pod(f"b{i}", cpu="1", memory="1Gi", node_name=f"n{i % 6}",
+                                labels={"app": "a"} if i % 3 == 0 else None,
+                                anti_affinity=term if i % 3 == 0 else None, phase="Running"))
+    for i in range(8):
+        api.create_pod(make_pod(f"p{i}", cpu="1", memory="1Gi"))
+    refl = ClusterReflector(api)
+    refl.sync()
+
+    def check():
+        inc = refl.snapshot()
+        ref = ClusterSnapshot.build(refl.nodes.state(), refl.pods.state())
+        assert {p.metadata.name for p in inc.pods} == {p.metadata.name for p in ref.pods}
+        for n in ref.nodes:
+            assert [id(p) for p in inc.pods_on_node(n.name)] == [id(p) for p in ref.pods_on_node(n.name)]
+        assert {(id(p), n.name) for p, n in inc.placed_pods()} == {(id(p), n.name) for p, n in ref.placed_pods()}
+        assert {(id(p), n.name) for p, n in inc.placed_pods_with_terms()} == {
+            (id(p), n.name) for p, n in ref.placed_pods_with_terms()
+        }
+        assert [p.metadata.name for p in inc.pending_pods()] == [p.metadata.name for p in ref.pending_pods()]
+        return inc
+
+    s1 = check()
+    assert refl.snapshot() is s1  # no events -> same (cached) snapshot
+    # churn: bind two, delete one bound + one pending, add one
+    api.create_binding("default", "p0", ObjectReference(name="n3"))
+    api.create_binding("default", "p1", ObjectReference(name="n3"))
+    api.delete_pod("default", "b0")
+    api.delete_pod("default", "p2")
+    api.create_pod(make_pod("fresh", cpu="1", memory="1Gi"))
+    refl.sync()
+    s2 = check()
+    assert s2 is not s1
+    # the OLD snapshot must be untouched by later churn (copy-on-write)
+    assert any(p.metadata.name == "b0" for p in s1.pods_on_node("n0"))
+    assert all(p.metadata.name != "p0" for p in s1.pods_on_node("n3"))

@@ -93,6 +93,8 @@ class NativeBackend(SchedulingBackend):
                     pod_ntol_soft=ntol_soft[lo:hi], node_taints_soft=node_taints_soft,
                     pod_sps_declares=cpods["pod_sps_declares"][lo:hi] if soft_spread else None,
                     sp_penalty_node=round_masks["sp_penalty_node"] if soft_spread else None,
+                    pod_sp_declares=cpods["pod_sp_declares"][lo:hi] if round_masks is not None else None,
+                    sp_level_node=round_masks["sp_level_node"] if round_masks is not None else None,
                     pod_ppa_w=cpods["pod_ppa_w"][lo:hi] if soft_pa else None,
                     ppa_cnt_node=round_masks["ppa_cnt_node"] if soft_pa else None,
                     salt=rounds,

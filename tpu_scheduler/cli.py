@@ -111,6 +111,23 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     configure_logging(args.log_level)
 
+    from .utils.gc_tuning import enable_daemon_gc_tuning
+
+    enable_daemon_gc_tuning()
+
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Honor an explicit CPU pin even where a platform plugin's
+        # sitecustomize force-registers itself ahead of the env var (the
+        # axon TPU tunnel does): flipping jax.config before any device use
+        # is the only reliable off-switch.  Without this, test-suite CLI
+        # subprocesses quietly ran on the real chip — and hung for ~25 min
+        # whenever the tunnel was wedged.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     if args.backend in ("tpu", "tpu-sharded"):
         from .utils.compile_cache import enable_compilation_cache
 

@@ -78,6 +78,10 @@ class ClusterSnapshot:
     # anti-affinity terms (the direction-B forbidders).
     _placed: list = field(default_factory=list, compare=False, repr=False)
     _placed_with_terms: list = field(default_factory=list, compare=False, repr=False)
+    # Lazy pending-pod memo (immutable snapshot, so one scan suffices): the
+    # controller consults the pending list several times per cycle — at
+    # flagship scale each uncached scan walks 200k+ pods.
+    _pending: list | None = field(default=None, compare=False, repr=False)
 
     @staticmethod
     def build(nodes: Iterable[Node], pods: Iterable[Pod]) -> "ClusterSnapshot":
@@ -110,8 +114,14 @@ class ClusterSnapshot:
         """Pods the controller schedules: phase Pending and not yet bound
         (reference filters the watch to ``status.phase=Pending`` at
         ``src/main.rs:141-142`` and skips bound pods at ``src/main.rs:74-76``).
+        Memoized (snapshots are immutable); callers must not mutate the
+        returned list.
         """
-        return [p for p in self.pods if p.status.phase == "Pending" and not is_pod_bound(p)]
+        if self._pending is None:
+            object.__setattr__(
+                self, "_pending", [p for p in self.pods if p.status.phase == "Pending" and not is_pod_bound(p)]
+            )
+        return self._pending
 
 
 def node_net_available(snapshot: ClusterSnapshot, node: Node) -> PodResources:

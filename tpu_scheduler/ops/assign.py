@@ -173,6 +173,7 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         m = m & ~blocked_block(jnp, blk, round_masks)
     soft_sp = round_masks is not None and "sp_penalty_node" in round_masks
     soft_pa = round_masks is not None and "ppa_cnt_node" in round_masks
+    steer_sp = round_masks is not None and "sp_level_node" in round_masks
     sc = score_block(
         jnp,
         blk["pod_req"],
@@ -187,6 +188,8 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         node_taints_soft=nodes["node_taints_soft"],
         pod_sps_declares=blk["pod_sps_declares"] if soft_sp else None,
         sp_penalty_node=round_masks["sp_penalty_node"] if soft_sp else None,
+        pod_sp_declares=blk["pod_sp_declares"] if steer_sp else None,
+        sp_level_node=round_masks["sp_level_node"] if steer_sp else None,
         pod_ppa_w=blk["pod_ppa_w"] if soft_pa else None,
         ppa_cnt_node=round_masks["ppa_cnt_node"] if soft_pa else None,
         salt=salt,

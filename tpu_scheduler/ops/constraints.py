@@ -40,30 +40,27 @@ by rank (the auction's priority order):
     out-ranks every accepted carrier in the cell and vice versa (exact
     min-rank rule; at worst it defers a pod the greedy oracle would accept
     by one round — never admits a violation).
-  • Spread: per (constraint, domain) cell, a *water-filling* quota is
-    computed (8-step fixpoint of q = max_skew + lo − counts with lo the
-    rising min across the key's domains) and the cell keeps its quota's
-    worth of lowest-rank claimants — mass spread workloads commit whole
-    waves per round instead of one pod per domain.  The quota denominator
-    deliberately overcounts (all capacity-accepted matched mass) while the
-    water line lo counts only mass *certain* to commit this round; see the
-    inline soundness note in constraint_filter.
+  • Spread: rank-prefix admission.  A declarer on a keyed node is kept iff
+    ``count(cell) + prefix(p) + 1 ≤ max_skew + lo_p`` where ``prefix(p)``
+    is the matched CANDIDATE mass of lower rank in its cell and ``lo_p``
+    the per-pod water line — the round-start minimum lifted by the
+    COMMITTED lower-rank fills of SPREAD_CASCADE in-round sweeps.  All
+    in-round matched mass rides the rank prefix (not a static denominator),
+    so two same-selector constraints can no longer mutually freeze each
+    other's quotas, and whole multi-level waves admit per round.
 Deferred pods stay active and retry next round against the committed state;
-the round-start choose mask already blocks saturated domains, so every kept
-set is violation-free and the loop strictly progresses.
+the round-start choose mask blocks domains beyond the cascade's reach, so
+claimants target cells the filter can actually admit.
 
 Validity is *order-witnessed*: each round's kept set admits a sequential
-order in which every placement passes the scalar chain — rank order for
-anti-affinity (no conflicting pair survives the filter at all), ascending
-fill-height (c0 + position-in-cell) for spread waves: a height-h placement
-sees min-fill ≥ min(h, lo_fixpoint), so ``count+1−min ≤ max_skew`` holds at
-its turn (tests/test_constraints_tensor.py replays this certificate through
-core/predicates.py).  Caveat: a pod declaring *multiple* spread constraints
-joins each constraint's witness order; the per-constraint quotas are each
-respected but a single interleaving witnessing all of them simultaneously is
-not constructed — multi-constraint pods are conservative-safe per
-constraint, and the certificate test covers the (dominant) one-constraint
-shape.
+order in which every placement passes the scalar chain — ASCENDING RANK for
+both predicates: no conflicting AA pair survives at all, and a kept spread
+declarer at its turn sees cell count ≤ count0 + prefix(p) (kept ⊆
+candidates) and min ≥ lo_p (its cascade fills are lower-rank commits,
+placed before it), so ``count+1−min ≤ max_skew`` holds at its turn
+(tests/test_constraints_tensor.py replays this certificate through
+core/predicates.py).  This holds uniformly for multi-constraint declarers —
+admission requires every declared constraint's bound at the same rank turn.
 
 Everything is written against an ``xp`` namespace (numpy | jax.numpy) so the
 native and TPU backends share one expression tree — the same bit-parity
@@ -114,18 +111,47 @@ MAX_AA_TERMS = 256
 MAX_SPREAD = 256
 MAX_COARSE_DOMAINS = 256
 
-# Fast-path budget for the within-round filter/commit: below this terms×D
-# product, "who came earlier into my cell" is computed DENSELY — a [P,T,D]
-# exclusive cumsum along the (rank-ordered) pod axis — instead of the
-# sort/scatter formulation.  On TPU through the tunnel the difference is
-# stark (measured at 53k pods: scalar scatter_min ~43 ms and the [S·P]
-# stable sort ~47 ms per round, vs ~2-3 ms for the cumsum 3-tensor and
-# ~free [N,·] row scatters), because XLA lowers arbitrary-index scalar
+# Fast-path budget for the ANTI-AFFINITY within-round filter: below this
+# terms×D product, "who came earlier into my cell" is computed DENSELY — a
+# [P,T,D] exclusive cumsum along the (rank-ordered) pod axis — instead of
+# the scatter-min formulation.  On TPU through the tunnel the difference is
+# stark (measured at 53k pods: scalar scatter_min ~43 ms per round vs ~2-3
+# ms for the cumsum 3-tensor), because XLA lowers arbitrary-index scalar
 # scatters near-serially while cumsums ride the parallel prefix path.
-# Above the budget the 3-tensor would dominate HBM traffic, so the
-# sort/scatter path takes over (bit-identical results either way — counts
-# are small exact f32 integers and array order IS rank order).
+# Bit-identical results either way — counts are small exact f32 integers
+# and array order IS rank order.  (The SPREAD filter has no such split any
+# more: its rank-prefix admission always uses the cell formulation, chunked
+# along the pod axis when the byte budget below demands — see
+# _cell_rank_prefix.)
 DENSE_CELLS = 1024
+# The cells product alone does not bound the 3-tensor: its bytes scale with
+# the POD axis too (round-4 advisor finding — at 128k padded pods a
+# threshold-sized [P,T,D] is ~0.5 GB, and several temporaries live inside
+# the jit round body at once).  The dense path therefore also requires
+# p·t·d·4 ≤ this per-tensor byte budget; the flagship constrained shape
+# (106k × 832 spread cells ≈ 354 MB, measured fast and well inside v5e-1's
+# 16 GB HBM) stays dense, while larger pod axes degrade to the sort/scatter
+# formulation — same results, bounded memory.  The in-jit size chain
+# (ops/assign.py) re-evaluates the predicate per stage, so shrunk tail
+# stages can re-enter the dense path even when the full-size stage could not.
+DENSE_TENSOR_BYTES = 400 * 1024 * 1024
+
+
+def _dense_ok(p: int, cells: int) -> bool:
+    return cells <= DENSE_CELLS and p * cells * 4 <= DENSE_TENSOR_BYTES
+
+
+# Within-round water-line sweeps of the spread admission filter
+# (constraint_filter) — each sweep can lift a constraint's certain minimum
+# one level, so a round admits up to this many fill levels at once; the
+# choose-time mask (round_blocked_masks) offers declarers domains within the
+# same reach.  4 sweeps measured best on the flagship constrained row: each
+# sweep only lifts levels whose fills come from LOWER-RANK commits, and
+# cross-cell rank interleaving caps the useful depth — 8 sweeps bound no
+# more pods and cost ~0.3 s/cycle more ([P,S,D] cumsum per sweep).  MUST be
+# a global constant: a size-dependent sweep count would make admission
+# depend on the stage shape and break native↔TPU bit-parity.
+SPREAD_CASCADE = 4
 
 
 class UntensorizableConstraints(Exception):
@@ -696,9 +722,27 @@ def round_blocked_masks(
     counts = state["sp_counts"]
     lo = xp.min(xp.where(uses > 0, counts, RANK_INF), axis=1)
     lo = xp.where(lo >= RANK_INF, 0.0, lo)
-    blockcell = uses * (counts >= (meta["sp_skew"] + lo)[:, None])
+    # Choose-time slack of CASCADE levels: the within-round admission filter
+    # (constraint_filter) can raise the water line by up to CASCADE levels,
+    # so domains within that reach are offered to declarers — otherwise the
+    # whole herd targets only the min-count domains (few nodes), starving
+    # the capacity prefix.  The filter remains the exact gate; the mask is
+    # only a targeting hint.
+    blockcell = uses * (counts >= (meta["sp_skew"] + lo + SPREAD_CASCADE)[:, None])
     sp_node = _clip01(xp, blockcell @ ndc_t)
-    masks = {"aa_m_node": aa_m_node, "aa_c_node": aa_c_node, "sp_node": sp_node}
+    # Per-level steering for hard-spread DECLARERS (score side): each node's
+    # domain height above the constraint's water line.  score_block charges
+    # 2x the tie-break amplitude per level, so a declarer prefers min-count
+    # domains outright (a lone straggler goes where admission will accept
+    # it) while same-level domains stay jitter-spread — the slack mask above
+    # offers the reachable levels, the steering orders them.
+    sp_level_node = ((counts - lo[:, None]) * uses) @ ndc_t
+    masks = {
+        "aa_m_node": aa_m_node,
+        "aa_c_node": aa_c_node,
+        "sp_node": sp_node,
+        "sp_level_node": sp_level_node,
+    }
     if hard_pa:
         masks["pa_unmatched_node"] = pa_unmatched_node
         masks["pa_inactive"] = pa_inactive.astype(xp.float32)
@@ -783,6 +827,71 @@ def _cummax(xp, a):
     return lax.cummax(a, axis=0)
 
 
+def _cell_chunk(p: int, cells: int) -> int:
+    """Pod-axis chunk length keeping one [chunk, S, D] tile inside the byte
+    budget (0 = no chunking needed — the full tensor fits)."""
+    if p * cells * 4 <= DENSE_TENSOR_BYTES:
+        return 0
+    return max(256, DENSE_TENSOR_BYTES // (cells * 4))
+
+
+def _cell_rank_scan(xp, mass, nd, uses, out_fn):
+    """Shared chunked driver for the spread filter's exclusive-by-rank cell
+    passes: feeds ``out_fn(ec3, m3)`` — ``ec3`` the [·,S,D] exclusive
+    cumulative cell mass including all lower-rank pods, ``m3`` the same
+    rows' own-cell one-hots — per pod-axis chunk and concatenates the [·,S]
+    outputs.  One-shot when [P,S,D] fits the byte budget; otherwise chunks
+    with an [S,D] carry (``lax.scan`` under jit, a plain loop in numpy —
+    the budget applies to BOTH backends, round-5 review finding).  Exact
+    small-integer sums, so chunked and one-shot results are bitwise equal —
+    cross-backend/stage parity depends on that."""
+    p, s = mass.shape
+    d = nd.shape[1]
+
+    def step(carry, mch, ndch):
+        m3 = ndch[:, None, :] * uses[None, :, :]  # [·,S,D]
+        c3 = mch[:, :, None] * m3
+        ec3 = carry[None, :, :] + xp.cumsum(c3, axis=0) - c3
+        return carry + c3.sum(axis=0), out_fn(ec3, m3)
+
+    chunk = _cell_chunk(p, s * d)
+    if chunk == 0:
+        return step(xp.zeros((s, d), xp.float32), mass, nd)[1]
+    pad = (-p) % chunk
+    mass_c = xp.pad(mass, ((0, pad), (0, 0))).reshape(-1, chunk, s)
+    nd_c = xp.pad(nd, ((0, pad), (0, 0))).reshape(-1, chunk, d)
+    if xp is np:
+        carry = np.zeros((s, d), np.float32)
+        outs = []
+        for k in range(mass_c.shape[0]):
+            carry, out = step(carry, mass_c[k], nd_c[k])
+            outs.append(out)
+        return np.concatenate(outs, axis=0)[:p]
+    from jax import lax
+
+    _, outs = lax.scan(lambda c, inp: step(c, *inp), xp.zeros((s, d), xp.float32), (mass_c, nd_c))
+    return outs.reshape(-1, s)[:p]
+
+
+def _cell_rank_prefix(xp, mass, nd, uses):
+    """[P,S] exclusive-by-rank (array order) mass before each pod in its own
+    (s, domain) cell — the quota prefix."""
+    return _cell_rank_scan(xp, mass, nd, uses, lambda ec3, m3: (ec3 * m3).sum(axis=2))
+
+
+def _cell_rank_min_level(xp, mass, nd, uses, base):
+    """[P,S] per-pod water line: min over the constraint's used domains of
+    ``base`` plus the exclusive-by-rank fill of ``mass`` — the cascade's
+    lower bound on the minimum count at each pod's witness-order turn."""
+
+    def out_fn(ec3, m3):
+        lvl = xp.where(uses[None, :, :] > 0, base[None, :, :] + ec3, RANK_INF)
+        lo = xp.min(lvl, axis=2)
+        return xp.where(lo >= RANK_INF, 0.0, lo)
+
+    return _cell_rank_scan(xp, mass, nd, uses, out_fn)
+
+
 def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: dict, hard_pa: bool = True) -> object:
     """Within-round conflict resolution — returns the surviving subset of
     ``accepted`` (see module docstring for the rank rules)."""
@@ -806,7 +915,7 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     has_c = nd @ uses.T  # [P, T] 1 if the chosen node has the term's coarse key
     carr = ps["pod_aa_carries"] * accf[:, None]
     matc = ps["pod_aa_matched"] * accf[:, None]
-    if t * d <= DENSE_CELLS:
+    if _dense_ok(nd.shape[0], t * d):
         m3 = nd[:, None, :] * uses[None, :, :]  # [P,T,D] one-hot coarse cell under t
 
         def _earlier_in_cell(v):  # [P,T] 0/1 → [P,T] "an earlier v-pod shares my coarse cell"
@@ -855,108 +964,69 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
         bad_pa = (waived > 0) & keep[:, None] & (rank_f[:, None] > min_match_rank[None, :])
         keep = keep & ~bad_pa.any(axis=1)
 
-    # ---- topology spread (vectorized over S) ------------------------------
+    # ---- topology spread (rank-prefix admission + in-round cascade) -------
+    # The scalar rule (core/predicates.make_spread_checker): placing a
+    # DECLARER on a keyed node requires count(domain) + 1 − min(counts) ≤
+    # max_skew at its turn.  The witness order for this round's kept set is
+    # simply ASCENDING RANK, so for pod p the domain count at its turn is
+    # bounded by the round-start count plus the matched CANDIDATE mass of
+    # lower rank in its cell (kept ⊆ candidates), and the min is bounded
+    # below by the round-start min plus lower-rank COMMITTED fills.
+    # Admission is therefore
+    #     c_at(p) + pre_all(p) + 1 ≤ skew + lo_p
+    # with pre_all the exclusive-by-rank candidate-mass prefix in p's cell
+    # and lo_p the per-pod water line.  History: round 4 charged all
+    # capacity-accepted matching-only mass to a STATIC denominator instead
+    # of the rank prefix; two same-selector constraints (e.g. the mixed
+    # workload's two skew levels per app) then mutually inflated each
+    # other's minimum cells every round, pinning every quota at zero and
+    # serializing the tail to ~1 accept/constraint/round (the measured
+    # 64-round cap: scripts/diag_round_kills.py printed "quota sum=0, open
+    # cells=0" for all eight fixpoint iterations).  The rank prefix breaks
+    # the deadlock structurally: the lowest-rank candidate of an open cell
+    # always admits.
     uses_sp = meta["sp_uses_dom"]  # [S, D]
-    s_axis = uses_sp.shape[0]
     skew = meta["sp_skew"]  # [S]
     declares, matched = ps["pod_sp_declares"], ps["pod_sp_matched"]
     in_cell = nd @ uses_sp.T  # [P, S] 1 iff chosen node carries the key
-    # Claimant mass (dm/dn) is based on ``keep`` — the survivors of the
-    # anti-affinity and positive-affinity filters above — NOT on the raw
-    # capacity accept: a pod those filters already dropped can never commit
-    # this round, so counting it would (a) waste quota slots in the rank
-    # prefix (a dead claimant at prefix 0 steals the slot from a live one,
-    # deferring it a round for nothing) and (b) taint its cell's certainty
-    # mass below, freezing the water line at one level per round — measured
-    # as the 64-round tail at 50k x 5k with 10% AA/spread overlap
-    # (scripts/bench_constrained.py).
     keep_f = keep.astype(xp.float32)
-    dm = keep_f[:, None] * declares * matched * in_cell  # declaring+matching
-    mo = accf[:, None] * (1.0 - declares) * matched  # matching-only (keyless→0 via matmul)
-    dn = keep_f[:, None] * declares * (1.0 - matched) * in_cell  # declaring-only
-    # Two count bases, deliberately different (soundness, not sloppiness):
-    #   c0 — the quota DENOMINATOR — overcounts matching-only mass: every
-    #     capacity-accepted NON-declaring matched pod is in, even ones a
-    #     later constraint's quota drops.  Overcount only shrinks quota
-    #     (conservative), and it is *required* for cross-constraint
-    #     soundness: a pod kept by its own constraint's quota may land in
-    #     this constraint's domain, so its mass must be assumed present at
-    #     the declarer's turn in the witness order.  (Declaring claimants of
-    #     THIS constraint need no such caution: their fate is decided by
-    #     this constraint's own quota below.)
-    #   c0_cert — the water-line (lo) base — counts only mass CERTAIN to
-    #     place this round: round-start state plus post-anti-affinity
-    #     survivors that declare no spread constraint (nothing after this
-    #     filter can drop those).  Deriving lo from uncertain mass admitted
-    #     real violations: pods capacity-accepted into other domains but
-    #     deferred by their own skew quota inflated the min, opening quota
-    #     here (caught by the replay certificate at synth seed 4).
-    declares_n = declares.sum(axis=1)  # [P]
-    declares_any = xp.minimum(declares_n, 1.0)
-    certain = keep_f[:, None] * (1.0 - declares_any)[:, None] * matched
-    c0 = state["sp_counts"] + (mo.T @ nd) * uses_sp  # [S, D]
-    c0_cert = state["sp_counts"] + (certain.T @ nd) * uses_sp
-    dem = (dm.T @ nd) * uses_sp  # [S, D]
-    # A quota-kept claimant is certain iff nothing later can drop it: it
-    # survived the filters above and this is its only spread constraint.
-    # Cells containing any uncertain claimant contribute no fill to the
-    # water line (an uncertain pod can hold a quota slot and then drop).
-    dm_cert = dm * (declares_n == 1.0).astype(xp.float32)[:, None]
-    dem_unc = dem - (dm_cert.T @ nd) * uses_sp  # [S, D] uncertain demand
+    # Candidate matched mass: every post-AA/PA survivor whose chosen node
+    # carries the key and whose labels match the selector — non-declarers
+    # (they commit unconditionally; nothing after this filter drops them)
+    # and declarers (they commit iff admitted below) ride ONE prefix.
+    cand_m = keep_f[:, None] * matched * in_cell  # [P, S]
+    decl_cell = keep_f[:, None] * declares * in_cell  # declarers on keyed nodes
+    sp0 = state["sp_counts"] * uses_sp  # round-start counts (padded cols zeroed)
+    c_at = nd @ sp0.T  # [P, S] own-cell round-start count
 
-    def _masked_lo(c):
-        lo = xp.min(xp.where(uses_sp > 0, c, RANK_INF), axis=1)
-        return xp.where(lo >= RANK_INF, 0.0, lo)
+    lo0 = xp.min(xp.where(uses_sp > 0, sp0, RANK_INF), axis=1)
+    lo0 = xp.where(lo0 >= RANK_INF, 0.0, lo0)  # [S] round-start water line
 
-    def _fills(q):
-        return xp.where(dem_unc == 0, xp.minimum(dem, q), 0.0)
+    # ONE spread formulation for every size: the [P,S,D] cell passes run
+    # one-shot when they fit the byte budget and pod-axis CHUNKED otherwise
+    # (exact small-integer sums — bitwise identical either way).  No
+    # pod-count- or backend-dependent branch: the jit size chain runs this
+    # filter at several static pod sizes and the native backend at one, so
+    # any shape-dependent semantic would break cross-backend bit-parity.
+    pre_all = _cell_rank_prefix(xp, cand_m, nd, uses_sp)  # [P,S] mass before p in own cell
 
-    lo = _masked_lo(c0_cert)
-    for _ in range(8):  # water-filling fixpoint (lo is nondecreasing)
-        q = xp.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp
-        lo = _masked_lo(c0_cert + _fills(q))
-    q_final = xp.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp  # [S, D]
-
-    # Rank-prefix of each declaring+matching pod within its (s, domain) cell
-    # (array order == rank order among this round's claimants).  Dense path:
-    # exclusive cumsum of the [P,S,D] claimant one-hot along the pod axis,
-    # gathered at each pod's own cell — exact small-integer f32 counts.
-    # Fallback for huge S·D: flatten (s, p) s-major so a stable sort by cell
-    # id groups cells while preserving rank order, then position-in-segment
-    # via a cummax of segment starts.
-    if s_axis * d <= DENSE_CELLS:
-        m3_sp = nd[:, None, :] * uses_sp[None, :, :]  # [P,S,D] claimant cell one-hot
-        c3 = dm[:, :, None] * m3_sp
-        ec3 = xp.cumsum(c3, axis=0) - c3  # exclusive
-        prefix = (ec3 * m3_sp).sum(axis=2)  # [P, S]
-    else:
-        p_axis = nd.shape[0]
-        dom_ids = xp.arange(d, dtype=xp.float32)
-        cc_sp = nd @ (uses_sp * dom_ids[None, :]).T  # [P, S] coarse cell id
-        cells_sp = d + 1
-        sentinel = xp.float32(d)
-        cell_sp = xp.where(dm > 0, cc_sp, sentinel)  # non-claimants → shared sentinel cell
-        g_sp = (xp.arange(s_axis, dtype=xp.float32)[None, :] * cells_sp + cell_sp).T.reshape(-1)  # [S*P]
-        order = _argsort_stable(xp, g_sp)
-        g_sorted = g_sp[order]
-        idx = xp.arange(s_axis * p_axis, dtype=xp.float32)
-        is_start = xp.concatenate([xp.ones((1,), dtype=bool), g_sorted[1:] != g_sorted[:-1]])
-        seg_start = _cummax(xp, xp.where(is_start, idx, 0.0))
-        pos_sorted = idx - seg_start
-        if xp is np:
-            pos_flat = np.empty_like(pos_sorted)
-            pos_flat[order] = pos_sorted
-        else:
-            pos_flat = xp.zeros_like(pos_sorted).at[order].set(pos_sorted)
-        prefix = pos_flat.reshape(s_axis, p_axis).T  # [P, S]
-
-    q_at = nd @ q_final.T  # [P, S] quota of own cell (0 where keyless)
-    keep_dm = prefix < q_at
-    c_final = c0 + xp.minimum(dem, q_final)  # inflated (conservative) counts
-    lo_final = _masked_lo(c0_cert + _fills(q_final))  # certain water line
-    c_at = nd @ c_final.T  # [P, S]
-    keep_dn = (c_at + 1.0) <= (skew + lo_final)[None, :]
-    bad_sp = ((dm > 0) & ~keep_dm) | ((dn > 0) & ~keep_dn)
+    bound = c_at + pre_all + 1.0  # [P, S] count-after-placement upper bound
+    lo_p = xp.zeros_like(c_at) + lo0[None, :]
+    admit = bound <= (skew[None, :] + lo_p)
+    # In-round water-line cascade.  Each sweep recomputes, per pod, the min
+    # over the constraint's domains of round-start counts plus the COMMITTED
+    # fills of lower rank — commits from the previous sweep's admissions,
+    # which only grow (admit is OR-accumulated), so every sweep is sound: a
+    # kept pod's witness-order turn really does see those lower-rank commits
+    # placed.  One sweep lifts the line one level; SPREAD_CASCADE sweeps
+    # admit a whole multi-level wave per round instead of one level per
+    # ROUND.
+    for _ in range(SPREAD_CASCADE):
+        rejected = ((decl_cell > 0) & ~admit).any(axis=1)
+        committed_pod = keep_f * (1.0 - rejected.astype(xp.float32))  # [P]
+        lo_p = _cell_rank_min_level(xp, cand_m * committed_pod[:, None], nd, uses_sp, sp0)
+        admit = admit | (bound <= (skew[None, :] + lo_p))
+    bad_sp = (decl_cell > 0) & ~admit
     return keep & ~bad_sp.any(axis=1)
 
 

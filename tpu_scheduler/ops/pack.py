@@ -572,7 +572,7 @@ def pack_snapshot(
                         raise PackingError(f"taint {(t.key, t.value, t.effect)} missing from supplied soft_taint_vocab")
                     node_taints_soft[i, j] = 1.0
 
-    pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad, res_vocab)
+    pod_tensors = _pack_pods(pending, vocab, p_pad, l_pad, res_vocab, res_memo)
     pod_req64 = pod_tensors.pop("pod_req64")
     res_scales = _fit_scales(alloc64, pod_req64)
     pod_tensors["pod_req"] = _req_i32(pod_req64, res_scales)
@@ -609,8 +609,15 @@ def pack_snapshot(
     )
 
 
-def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int, res_vocab: tuple[str, ...] = ("cpu", "memory")) -> dict:
-    """Pod-side tensors (the part that changes every cycle as pods bind)."""
+def _pack_pods(
+    pending: list[Pod], vocab: dict, p_pad: int, l_pad: int,
+    res_vocab: tuple[str, ...] = ("cpu", "memory"), res_memo: dict | None = None,
+) -> dict:
+    """Pod-side tensors (the part that changes every cycle as pods bind).
+    ``res_memo`` is the shared identity-keyed request-sum memo (same contract
+    as resource_vocab's) — without it each cycle re-sums every pod's
+    container requests a second time (measured ~1.3 s of a flagship e2e
+    cycle's pack)."""
     from ..api.objects import full_name
 
     pod_req64 = np.zeros((p_pad, len(res_vocab)), dtype=np.int64)
@@ -621,7 +628,15 @@ def _pack_pods(pending: list[Pod], vocab: dict, p_pad: int, l_pad: int, res_voca
     pod_names = []
 
     for i, pod in enumerate(pending):
-        res = total_pod_resources(pod)
+        if res_memo is not None:
+            hit = res_memo.get(id(pod))
+            if hit is not None and hit[0] is pod:
+                res = hit[1]
+            else:
+                res = total_pod_resources(pod)
+                res_memo[id(pod)] = (pod, res)
+        else:
+            res = total_pod_resources(pod)
         pod_req64[i, CPU] = res.cpu
         pod_req64[i, MEM] = res.memory  # raw bytes; caller ceils by res_scales
         if res.extended and len(res_vocab) > 2:
@@ -881,7 +896,7 @@ def repack_incremental(
         fp = [pending[i] for i in fresh_idx]
         fi = np.asarray(fresh_idx, dtype=np.intp)
         n_f = len(fp)
-        sub = _pack_pods(fp, packed.vocab, n_f, l_w, packed.res_vocab)
+        sub = _pack_pods(fp, packed.vocab, n_f, l_w, packed.res_vocab, res_memo)
         sc = np.asarray(packed.res_scales, dtype=np.int64)
         # Extended columns only (a full pack re-derives those divisors and
         # cures the raise); cpu/memory scales are FIXED, so an oversized

@@ -134,17 +134,22 @@ def constrained_kernel_node_operands(pods: dict, masks: dict, n_nodes: int):
     spspen = masks.get("sp_penalty_node")
     if spspen is None:
         spspen = jnp.zeros((pods["pod_sps_declares"].shape[1], n_nodes), f32)
+    splevel = masks.get("sp_level_node")
+    if splevel is None:
+        splevel = jnp.zeros((pods["pod_sp_declares"].shape[1], n_nodes), f32)
     ppacnt = masks.get("ppa_cnt_node")
     if ppacnt is None:
         ppacnt = jnp.zeros((pods["pod_ppa_w"].shape[1], n_nodes), f32)
-    return (masks["aa_m_node"], masks["aa_c_node"], masks["sp_node"], paun, spspen, ppacnt), pa_inactive
+    return (masks["aa_m_node"], masks["aa_c_node"], masks["sp_node"], paun, spspen, splevel, ppacnt), pa_inactive
 
 
 def constrained_kernel_pod_operands(blk: dict, pa_inactive):
-    """Six pod-side kernel operands for one pod block.  The positive-
+    """Seven pod-side kernel operands for one pod block.  The positive-
     affinity bootstrap gate (a self-matching declarer of a globally-inactive
     term drops the term for this round — ops/constraints.blocked_block) is
-    applied HERE, pod-side, so the kernel's matmul sees the gated bitmap."""
+    applied HERE, pod-side, so the kernel's matmul sees the gated bitmap.
+    ``pod_sp_declares`` appears twice: once in the blocked band, once
+    unbanded for the hard-spread level-steering score matmul."""
     gated = blk["pod_pa_declares"] * (1.0 - blk["pod_pa_matched"] * pa_inactive[None, :])
     return (
         blk["pod_aa_carries"],
@@ -152,6 +157,7 @@ def constrained_kernel_pod_operands(blk: dict, pa_inactive):
         blk["pod_sp_declares"],
         gated,
         blk["pod_sps_declares"],
+        blk["pod_sp_declares"],
         blk["pod_ppa_w"],
     )
 
@@ -184,9 +190,10 @@ def _make_choose_kernel(constrained: bool):
             (
                 blk_ref,  # [BP, 2Tc+S+Ta] f32  banded [aa_carries | aa_matched | sp_declares | gated_pa]
                 sps_ref,  # [BP, Ss] f32  (pod declares soft spread constraint)
+                spd_ref,  # [BP, S] f32  (pod declares HARD spread — level steering)
                 ppaw_ref,  # [BP, Tp] f32  (signed preferred inter-pod weights)
-            ) = refs[k : k + 3]
-            k += 3
+            ) = refs[k : k + 4]
+            k += 4
         (
             act_ref,  # [BP, 1] i32
             idx_ref,  # [BP, 1] u32  (priority ranks, jitter hash input)
@@ -200,9 +207,10 @@ def _make_choose_kernel(constrained: bool):
             (
                 blk_t_ref,  # [2Tc+S+Ta, TN] f32  banded [aa_m_node; aa_c_node; sp_node; pa_unmatched]
                 spspen_ref,  # [Ss, TN] f32  (soft-spread penalty counts)
+                splevel_ref,  # [S, TN] f32  (hard-spread domain height above water line)
                 ppacnt_ref,  # [Tp, TN] f32  (preferred inter-pod match counts)
-            ) = refs[k : k + 3]
-            k += 3
+            ) = refs[k : k + 4]
+            k += 4
         (
             choice_ref,  # [BP, 1] i32 out
             has_ref,  # [BP, 1] i32 out
@@ -291,13 +299,22 @@ def _make_choose_kernel(constrained: bool):
         h = idx_ref[:].astype(u32) * u32(2654435761) + node_idx * u32(2246822519) + salt * u32(3266489917)
         h = (h ^ (h >> u32(15))) & u32(0xFFFF)
         # Mosaic lacks a direct uint32→f32 cast; h < 2^16 so int32 is exact.
-        score = score + weights_ref[0, 2] * (h.astype(jnp.int32).astype(f32) / f32(65536.0))
+        # Bucket-quantized tie-break — identical op order to ops/score.py.
+        jw = weights_ref[0, 2]
+        safe = jnp.where(jw > 0, jw, f32(1.0))
+        score = jnp.where(jw > 0, jnp.floor(score / safe) * safe, score) + jw * (
+            h.astype(jnp.int32).astype(f32) / f32(65536.0)
+        )
 
         if constrained:
             # Soft constraint scores AFTER the jitter — ops/score.py order:
-            # −w₅ · ScheduleAnyway penalty, then +signed preferred counts.
+            # −w₅ · ScheduleAnyway penalty, then −2·w₂ per hard-spread level
+            # above the water line (declarer steering), then +signed
+            # preferred counts.
             spspen = jnp.dot(sps_ref[:], spspen_ref[:], preferred_element_type=f32)
             score = score - weights_ref[0, 5] * spspen
+            splevel = jnp.dot(spd_ref[:], splevel_ref[:], preferred_element_type=f32)
+            score = score - (f32(2.0) * weights_ref[0, 2]) * splevel
             score = score + jnp.dot(ppaw_ref[:], ppacnt_ref[:], preferred_element_type=f32)
 
         sc = jnp.where(mask, score.astype(f32), NEG_INF)
@@ -454,8 +471,13 @@ def choose_block_pallas(
         # decomposition, no scaling); soft operands stay separate.
         blk_band = jnp.concatenate([v.astype(f32) for v in cons_pod[:4]], axis=1)
         blk_band_t = jnp.concatenate([v.astype(f32) for v in cons_node[:4]], axis=0)
-        in_specs += [pod_row(blk_band.shape[1]), pod_row(cons_pod[4].shape[1]), pod_row(cons_pod[5].shape[1])]
-        operands += [blk_band, cons_pod[4].astype(f32), cons_pod[5].astype(f32)]
+        in_specs += [
+            pod_row(blk_band.shape[1]),
+            pod_row(cons_pod[4].shape[1]),
+            pod_row(cons_pod[5].shape[1]),
+            pod_row(cons_pod[6].shape[1]),
+        ]
+        operands += [blk_band, cons_pod[4].astype(f32), cons_pod[5].astype(f32), cons_pod[6].astype(f32)]
     in_specs += [
         pod_row(1),
         pod_row(1),
@@ -473,8 +495,13 @@ def choose_block_pallas(
         taints_soft_t,
     ]
     if constrained:
-        in_specs += [node_row(blk_band_t.shape[0]), node_row(cons_node[4].shape[0]), node_row(cons_node[5].shape[0])]
-        operands += [blk_band_t, cons_node[4].astype(f32), cons_node[5].astype(f32)]
+        in_specs += [
+            node_row(blk_band_t.shape[0]),
+            node_row(cons_node[4].shape[0]),
+            node_row(cons_node[5].shape[0]),
+            node_row(cons_node[6].shape[0]),
+        ]
+        operands += [blk_band_t, cons_node[4].astype(f32), cons_node[5].astype(f32), cons_node[6].astype(f32)]
 
     grid = (pb, nbt)
     choice, has, best = pl.pallas_call(

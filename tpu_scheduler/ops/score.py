@@ -43,6 +43,8 @@ def score_block(
     node_taints_soft=None,
     pod_sps_declares=None,
     sp_penalty_node=None,
+    pod_sp_declares=None,
+    sp_level_node=None,
     pod_ppa_w=None,
     ppa_cnt_node=None,
     salt=None,
@@ -99,9 +101,29 @@ def score_block(
             # NumPy and XLA (uint32), so cross-backend parity is preserved.
             h = h + xp.asarray(salt).astype(u32) * u32(3266489917)
         h = (h ^ (h >> u32(15))) & u32(0xFFFF)
-        score = score + weights[2] * (h.astype(f32) / f32(65536.0))
+        # BUCKET-QUANTIZED tie-break: scores within one jitter-amplitude
+        # bucket are treated as exact ties and ordered by the hash alone, so
+        # claimants spread UNIFORMLY across the whole near-tied band instead
+        # of clustering around its additive-jitter-weighted top.  Measured
+        # motivation (round 5, scripts/diag_round_kills.py): with additive
+        # jitter the flagship constrained tail's ~16k claimants chose only
+        # ~11 distinct nodes per term — a few leader nodes sat just above
+        # the ±32-point band and the capacity prefix killed 15k claimants a
+        # round.  Same floor/div ops in numpy and XLA → parity holds; w₂=0
+        # keeps the raw score (jitter off).
+        jw = weights[2]
+        safe = xp.where(jw > 0, jw, f32(1.0))
+        score = xp.where(jw > 0, xp.floor(score / safe) * safe, score) + jw * (h.astype(f32) / f32(65536.0))
     if pod_sps_declares is not None and sp_penalty_node is not None:
         score = score - weights[5] * (pod_sps_declares @ sp_penalty_node)
+    if pod_sp_declares is not None and sp_level_node is not None:
+        # HARD-spread declarer steering: −2·jitter-amplitude per level the
+        # node's domain sits above the constraint's water line
+        # (ops/constraints.round_blocked_masks ``sp_level_node``).  Levels
+        # dominate the ±jitter tie-break, so declarers target the domains
+        # the admission filter can actually accept; nodes within one level
+        # stay jitter-spread.  Score-neutral for everyone else.
+        score = score - (f32(2.0) * weights[2]) * (pod_sp_declares @ sp_level_node)
     if pod_ppa_w is not None and ppa_cnt_node is not None:
         score = score + pod_ppa_w @ ppa_cnt_node
     return score.astype(f32)

@@ -60,14 +60,16 @@ __all__ = ["sharded_assign_cycle", "ShardedBackend", "IN_SPECS", "CONSTRAINT_KEY
 def _local_choose(
     avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels, node_taints,
     node_aff, node_valid, node_pref, node_taints_soft, weights, pod_idx, node_idx,
-    blocked=None, sps_declares=None, sp_penalty=None, ppa_w=None, ppa_cnt=None, salt=None,
+    blocked=None, sps_declares=None, sp_penalty=None, spd_declares=None, sp_level=None,
+    ppa_w=None, ppa_cnt=None, salt=None,
 ):
     """Best local node per pod of this shard: (best_score, local idx, has).
 
     ``pod_idx``/``node_idx`` are *global* (rank-space) indices so the score
     jitter hash matches the single-device path exactly.  ``blocked`` is the
     constraint-blocked [p_local, n_local] mask (constrained cycles only);
-    ``sps_declares``/``sp_penalty`` the ScheduleAnyway scoring operands."""
+    ``sps_declares``/``sp_penalty`` the ScheduleAnyway scoring operands;
+    ``spd_declares``/``sp_level`` the hard-spread level-steering pair."""
     m = feasibility_block(
         jnp, req, sel, selc, active, avail, node_labels, node_valid, ntol, node_taints, aff, has_aff, node_aff
     )
@@ -77,6 +79,7 @@ def _local_choose(
         jnp, req, node_alloc, avail, weights, pod_idx, node_idx,
         pod_pref_w=pref_w, node_pref=node_pref, pod_ntol_soft=ntol_soft, node_taints_soft=node_taints_soft,
         pod_sps_declares=sps_declares, sp_penalty_node=sp_penalty,
+        pod_sp_declares=spd_declares, sp_level_node=sp_level,
         pod_ppa_w=ppa_w, ppa_cnt_node=ppa_cnt, salt=salt,
     )
     sc = jnp.where(m, sc, -jnp.inf)
@@ -204,6 +207,7 @@ def _build_shard_map(
             # 1. choose: local tile (with the constraint-blocked columns of
             # this shard when constrained), then argmax across the tp axis.
             blocked_l = sps_dec_l = sp_pen_l = ppa_w_l = ppa_cnt_l = None
+            spd_dec_l = sp_lvl_l = None
             cons_pod_l = cons_node_l = None
             if constrained:
                 masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)  # [·, n_tot]
@@ -229,6 +233,8 @@ def _build_shard_map(
                     if soft_spread:
                         sps_dec_l = blk_l["pod_sps_declares"]
                         sp_pen_l = lm["sp_penalty_node"]
+                    spd_dec_l = blk_l["pod_sp_declares"]
+                    sp_lvl_l = lm["sp_level_node"]
                     if soft_pa:
                         ppa_w_l = blk_l["pod_ppa_w"]
                         ppa_cnt_l = lm["ppa_cnt_node"]
@@ -247,6 +253,7 @@ def _build_shard_map(
                     avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels,
                     node_taints, node_aff, node_valid, node_pref, node_taints_soft, w, g_pod_idx, g_node_idx,
                     blocked=blocked_l, sps_declares=sps_dec_l, sp_penalty=sp_pen_l,
+                    spd_declares=spd_dec_l, sp_level=sp_lvl_l,
                     ppa_w=ppa_w_l, ppa_cnt=ppa_cnt_l, salt=rounds,
                 )
             bests = lax.all_gather(best_l, "tp")  # [tp, p_local]

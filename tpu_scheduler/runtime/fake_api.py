@@ -44,6 +44,18 @@ class WatchEvent:
     object: Pod | Node
 
 
+def _evolve(obj, **changes):
+    """``dataclasses.replace`` for the binding hot path: a shallow
+    ``__dict__`` copy plus the changed fields — same replace-don't-mutate
+    result (a NEW object, the old one untouched) without re-walking every
+    field through getattr/__init__.  Safe here because these API objects
+    are plain dataclasses with no __post_init__/InitVar logic."""
+    new = object.__new__(type(obj))
+    new.__dict__.update(obj.__dict__)
+    new.__dict__.update(changes)
+    return new
+
+
 def _field_selector_fn(selector: str | None) -> Callable[[Pod | Node], bool]:
     """Supports the two k8s field-selector shapes the reference uses."""
     if not selector:
@@ -270,7 +282,13 @@ class FakeApiServer:
     # -- binding subresource (main.rs:94-109) ------------------------------
 
     def create_binding(self, namespace: str, pod_name: str, target: ObjectReference) -> None:
-        """POST /api/v1/namespaces/{ns}/pods/{name}/binding."""
+        """POST /api/v1/namespaces/{ns}/pods/{name}/binding.
+
+        Hot path of the e2e cycle: a 100k-pod wave issues 100k of these, so
+        the object evolution uses ``_evolve`` (a ``__dict__``-copy twin of
+        ``dataclasses.replace``, ~10x faster — replace re-walks every field
+        via getattr) while keeping the replace-don't-mutate contract the
+        identity-keyed pack memos rely on."""
         with self._lock:
             self.binding_count += 1
             if self.fail_next_bindings > 0:
@@ -283,12 +301,13 @@ class FakeApiServer:
                 raise ApiError(409, f"pod {namespace}/{pod_name} already bound")
             if target.name not in self._nodes:
                 raise ApiError(404, f"node {target.name} not found")
-            new_spec = replace(pod.spec, node_name=target.name) if pod.spec is not None else None
-            if new_spec is None:
+            if pod.spec is not None:
+                new_spec = _evolve(pod.spec, node_name=target.name)
+            else:
                 from ..api.objects import PodSpec
 
                 new_spec = PodSpec(node_name=target.name)
-            bound = replace(pod, spec=new_spec, status=replace(pod.status, phase="Running"))
+            bound = _evolve(pod, spec=new_spec, status=_evolve(pod.status, phase="Running"))
             self._bump(bound)
             self._pods[(namespace, pod_name)] = bound
             self._emit("Pod", WatchEvent("MODIFIED", bound), prev=pod)

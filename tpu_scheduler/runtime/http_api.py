@@ -411,7 +411,11 @@ class KubeApiClient:
         event's own rv for servers that don't send bookmarks)."""
         from urllib.parse import quote
 
-        q = f"?watch=true&resourceVersion={rv}"
+        # allowWatchBookmarks is a REQUEST (kube semantics): a real
+        # apiserver sends BOOKMARK events only when asked, and even then
+        # only best-effort — the parse below tolerates their absence by
+        # falling back to event resourceVersions.
+        q = f"?watch=true&resourceVersion={rv}&allowWatchBookmarks=true"
         if timeout_seconds:
             q += f"&timeoutSeconds={timeout_seconds:g}"
         if field_selector:
@@ -434,6 +438,13 @@ class KubeApiClient:
             if doc.get("type") == "BOOKMARK":
                 new_rv = int(doc.get("object", {}).get("metadata", {}).get("resourceVersion", new_rv) or new_rv)
                 continue
+            if doc.get("type") == "ERROR":
+                # Real-apiserver expiry shape: HTTP 200 with an in-stream
+                # ERROR event whose object is a Status (code 410 Gone for an
+                # evicted resourceVersion) — NOT an HTTP 410.  Surface it as
+                # the same ApiError so HttpWatch's relist resync fires.
+                status = doc.get("object", {}) or {}
+                raise ApiError(int(status.get("code", 500) or 500), status.get("message", "watch error event"))
             obj = from_dict(doc.get("object", {}))
             events.append(WatchEvent(doc.get("type", "MODIFIED"), obj))
             new_rv = max(new_rv, obj.metadata.resource_version or 0)

@@ -33,13 +33,22 @@ import os
 import ssl
 import tempfile
 
-__all__ = ["KubeconfigError", "load_kubeconfig", "client_from_kubeconfig"]
+__all__ = ["KubeconfigError", "ExecCredentialError", "load_kubeconfig", "client_from_kubeconfig"]
 
 SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
 class KubeconfigError(Exception):
     """Unusable kubeconfig: missing file, unknown context, bad references."""
+
+
+class ExecCredentialError(KubeconfigError, OSError):
+    """Exec credential-plugin failure AT REQUEST TIME (helper crashed,
+    timed out, emitted garbage).  Inherits OSError so the runtime's
+    transient-fault handlers (reflector backoff, per-pod bind requeue,
+    lease fail-safe — all catch OSError) back off and retry instead of
+    treating a helper's network blip as a fatal programming error; a
+    tokenFile read failure surfaces as OSError the same way."""
 
 
 def _named(seq, name: str, what: str) -> dict:
@@ -213,29 +222,44 @@ def _exec_token_provider(exec_spec: dict, kubeconfig_dir: str, cluster: dict):
     def provider():
         if not _expired():
             return state["token"]
+        try:
+            return _mint()
+        except ExecCredentialError:
+            if state["token"] is not None:
+                # Serve the last-good (possibly just-expired) token on a
+                # transient helper failure — the apiserver 401s if it is
+                # truly dead, which the request layer already treats as a
+                # retryable ApiError (same grace _file_token_provider gives
+                # a transiently-unreadable tokenFile).
+                return state["token"]
+            raise
+
+    def _mint():
         argv = [command] + list(exec_spec.get("args") or [])
         try:
             out = subprocess.run(argv, env=env, capture_output=True, text=True, timeout=60)
         except (OSError, subprocess.TimeoutExpired) as e:
-            raise KubeconfigError(f"exec credential plugin {command!r} failed to run: {e}{_hint()}") from e
+            raise ExecCredentialError(f"exec credential plugin {command!r} failed to run: {e}{_hint()}") from e
         if out.returncode != 0:
             hint = exec_spec.get("installHint") or out.stderr.strip()[:200]
-            raise KubeconfigError(f"exec credential plugin {command!r} exited {out.returncode}: {hint}")
+            raise ExecCredentialError(f"exec credential plugin {command!r} exited {out.returncode}: {hint}")
         try:
             cred = json.loads(out.stdout)
         except ValueError as e:
-            raise KubeconfigError(f"exec credential plugin {command!r} emitted invalid JSON: {e}") from e
+            raise ExecCredentialError(f"exec credential plugin {command!r} emitted invalid JSON: {e}") from e
         if cred.get("kind") != "ExecCredential":
-            raise KubeconfigError(f"exec credential plugin {command!r} emitted kind {cred.get('kind')!r}, want ExecCredential")
+            raise ExecCredentialError(
+                f"exec credential plugin {command!r} emitted kind {cred.get('kind')!r}, want ExecCredential"
+            )
         status = cred.get("status") or {}
         if status.get("clientCertificateData") or status.get("clientKeyData"):
-            raise KubeconfigError(
+            raise ExecCredentialError(
                 f"exec credential plugin {command!r} emitted client certificates, which are not supported; "
                 "use a token-emitting plugin"
             )
         token = status.get("token")
         if not token:
-            raise KubeconfigError(f"exec credential plugin {command!r} emitted no status.token")
+            raise ExecCredentialError(f"exec credential plugin {command!r} emitted no status.token")
         expires = None
         ts = status.get("expirationTimestamp")
         if ts:
