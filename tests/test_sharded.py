@@ -150,3 +150,24 @@ def test_sharded_pallas_constrained_parity():
     # the order-witness replay in test_constraints_tensor covers validity).
     assert (native.assigned == sharded.assigned).all(), np.flatnonzero(native.assigned != sharded.assigned)[:10]
     assert native.rounds == sharded.rounds
+
+
+def test_sharded_parity_fuzz_large_non_dividing_shapes():
+    """VERDICT r4 #6: shard-boundary bugs (tile-edge tie-breaks, gather
+    ordering) only appear at larger P/N and UNEVEN shards.  The shared
+    scenario (testing.uneven_shard_scenario) keeps the padded axes at
+    1003 x 257 — odd/prime, indivisible by every dp/tp here, so the shard
+    padding paths genuinely run — both mesh factorizations, constrained
+    included, vs the single-device oracle."""
+    from tpu_scheduler.testing import uneven_shard_scenario
+
+    packed, cpacked = uneven_shard_scenario()
+    oracle_plain = NativeBackend().schedule(packed)
+    oracle_cons = NativeBackend().schedule(cpacked)
+    for tp in (2, 4):
+        sb = ShardedBackend(tp=tp)
+        rs = sb.schedule(packed)
+        assert (rs.assigned == oracle_plain.assigned).all(), f"plain tp={tp} diverged at 1003x257"
+        rc = sb.schedule(cpacked)
+        assert (rc.assigned == oracle_cons.assigned).all(), f"constrained tp={tp} diverged at 1003x257"
+    assert len(oracle_cons.bindings) > 800  # the shape actually schedules at scale

@@ -346,3 +346,31 @@ def synth_cluster(
         pods.append(pod)
 
     return ClusterSnapshot.build(nodes, pods)
+
+
+def uneven_shard_scenario():
+    """Shared at-scale parity scenario for the multi-chip dryrun and the
+    sharded fuzz test (ONE home so the two cannot diverge): ~1k pods x 257
+    nodes packed with block=1 so the padded axes stay 1003 x 257 — odd and
+    prime, hence INDIVISIBLE by every dp/tp in {2, 4, 8} — forcing the
+    shard-boundary padding paths (pod dp-padding, node tp-round-up) that
+    even-padded shapes never exercise.  Returns (packed, constrained_packed);
+    the caller asserts its backends against the NativeBackend oracle."""
+    from dataclasses import replace as _replace
+
+    from .ops.constraints import pack_constraints
+    from .ops.pack import pack_snapshot
+
+    snap = synth_cluster(
+        n_nodes=257, n_pending=1003, n_bound=301, seed=29,
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.05, preferred_pod_affinity_fraction=0.1,
+        tainted_fraction=0.1, cordoned_fraction=0.05, extended_fraction=0.1,
+    )
+    packed = pack_snapshot(snap, pod_block=1, node_block=1)
+    assert packed.padded_pods % 2 == 1 and packed.padded_nodes % 2 == 1, (
+        "scenario regressed: padded axes must stay indivisible by dp/tp"
+    )
+    cons = pack_constraints(snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes)
+    assert cons is not None, "scenario regressed: constraints no longer pack"
+    return packed, _replace(packed, constraints=cons)
