@@ -147,41 +147,6 @@ def main():
     keep_sp = C.constraint_filter(np, accepted, choice, h["ranks"], h_sp, st, meta, hard_pa=True)
     print(f"killed by spread quota: {accepted.sum() - keep_sp.sum()}", flush=True)
 
-    # Fixpoint trace: does the in-round water line actually cascade?
-    uses_sp, skew = meta["sp_uses_dom"], meta["sp_skew"]
-    ndc = meta["node_dom_c"]
-    nd_ = ndc[choice]
-    accf = accepted.astype(np.float32)
-    keep_f = keep_aa.astype(np.float32)  # post-AA approximation of the filter's keep
-    declares, matched = h["pod_sp_declares"], h["pod_sp_matched"]
-    in_cell = nd_ @ uses_sp.T
-    dm = keep_f[:, None] * declares * matched * in_cell
-    mo = accf[:, None] * (1.0 - declares) * matched
-    declares_n = declares.sum(axis=1)
-    certain = keep_f[:, None] * (1.0 - np.minimum(declares_n, 1.0))[:, None] * matched
-    c0 = st["sp_counts"] + (mo.T @ nd_) * uses_sp
-    c0_cert = st["sp_counts"] + (certain.T @ nd_) * uses_sp
-    dm_cert = dm * (declares_n == 1.0).astype(np.float32)[:, None]
-    m3_sp = nd_[:, None, :] * uses_sp[None, :, :]
-    c3 = dm[:, :, None] * m3_sp
-    prefix_sp = ((np.cumsum(c3, axis=0) - c3) * m3_sp).sum(axis=2)
-
-    def masked_lo(c):
-        lo = np.min(np.where(uses_sp > 0, c, C.RANK_INF), axis=1)
-        return np.where(lo >= C.RANK_INF, 0.0, lo)
-
-    lo = masked_lo(c0_cert)
-    print(f"fixpoint: lo0 sum={lo.sum():.0f}  (claimant mass dm={dm.sum():.0f}, certain={dm_cert.sum():.0f})", flush=True)
-    for it in range(8):
-        q = np.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp
-        q_at_p = nd_ @ q.T
-        win = dm_cert * (prefix_sp < q_at_p)
-        fills = (win.T @ nd_) * uses_sp
-        lo = masked_lo(c0_cert + fills)
-        print(f"  iter {it}: quota sum={q.sum():.0f} open cells={(q >= 1).sum()} certain wins={win.sum():.0f} lo sum={lo.sum():.0f}", flush=True)
-    qf = np.maximum(0.0, (skew + lo)[:, None] - c0) * uses_sp
-    print(f"q_final: sum={qf.sum():.0f}; mo-mass inflating c0: {(c0 - st['sp_counts']).sum():.0f}; c0-c0_cert gap={np.sum(c0 - c0_cert):.0f}", flush=True)
-
     # who are the survivors of capacity but killed overall?
     killed = cap_accepted & ~keep1
     sp_dec = h["pod_sp_declares"].sum(axis=1) > 0
