@@ -813,20 +813,6 @@ def _row_scatter_add_t(xp, state_tn, idx, vals):
     return state_tn.T.at[idx].add(vals).T
 
 
-def _argsort_stable(xp, a):
-    if xp is np:
-        return np.argsort(a, kind="stable")
-    return xp.argsort(a, stable=True)
-
-
-def _cummax(xp, a):
-    if xp is np:
-        return np.maximum.accumulate(a)
-    from jax import lax
-
-    return lax.cummax(a, axis=0)
-
-
 def _cell_chunk(p: int, cells: int) -> int:
     """Pod-axis chunk length keeping one [chunk, S, D] tile inside the byte
     budget (0 = no chunking needed — the full tensor fits)."""

@@ -438,6 +438,11 @@ def _alloc_and_used64(
     # with the reference held so an id can never alias) amortizes the
     # request summation across cycles: bound pods dominate the cluster and
     # their objects only change on watch events.
+    # Batched accumulation (round 5): per-pod scalar += ran ~8 µs/pod over
+    # 200k+ bound pods per flagship e2e cycle; gather (node, res) pairs then
+    # scatter-add whole columns in exact int64.
+    idxs: list[int] = []
+    reslist = []
     for pod in snapshot.pods:
         if pod.spec is not None and pod.spec.node_name is not None:
             i = node_index.get(pod.spec.node_name)
@@ -452,13 +457,21 @@ def _alloc_and_used64(
                     res_memo[id(pod)] = (pod, res)
             else:
                 res = total_pod_resources(pod)
-            used64[i, CPU] += res.cpu
-            used64[i, MEM] += res.memory
-            if res.extended and len(res_vocab) > 2:
-                for j, name in enumerate(res_vocab[2:], start=2):
-                    v = res.extended.get(name)
-                    if v:
-                        used64[i, j] += v
+            idxs.append(i)
+            reslist.append(res)
+    if idxs:
+        idx_arr = np.asarray(idxs, dtype=np.int64)
+        m = len(idxs)
+        np.add.at(used64[:, CPU], idx_arr, np.fromiter((r.cpu for r in reslist), np.int64, m))
+        np.add.at(used64[:, MEM], idx_arr, np.fromiter((r.memory for r in reslist), np.int64, m))
+        if len(res_vocab) > 2:
+            ext_col = {name: j for j, name in enumerate(res_vocab[2:], start=2)}
+            for i, res in zip(idxs, reslist):
+                if res.extended:
+                    for name, v in res.extended.items():
+                        j = ext_col.get(name)
+                        if j is not None and v:
+                            used64[i, j] += v
     return alloc64, used64, node_index
 
 
@@ -625,36 +638,54 @@ def _pack_pods(
     pod_sel_count = np.zeros((p_pad,), dtype=np.float32)
     pod_prio = np.zeros((p_pad,), dtype=np.int32)
     pod_valid = np.zeros((p_pad,), dtype=bool)
-    pod_names = []
 
-    for i, pod in enumerate(pending):
+    # Batched row fill (round 5): per-pod scalar numpy stores ran ~20 µs/pod
+    # — ~2 s of a flagship e2e cycle's pack for 100k fresh rows.  Gather the
+    # python-side values first, then store whole columns; COO-scatter the
+    # sparse selector bitmap.  Raw bytes in MEM; caller ceils by res_scales.
+    n = len(pending)
+    reslist = []
+    for pod in pending:
         if res_memo is not None:
             hit = res_memo.get(id(pod))
             if hit is not None and hit[0] is pod:
-                res = hit[1]
-            else:
-                res = total_pod_resources(pod)
-                res_memo[id(pod)] = (pod, res)
-        else:
+                reslist.append(hit[1])
+                continue
             res = total_pod_resources(pod)
-        pod_req64[i, CPU] = res.cpu
-        pod_req64[i, MEM] = res.memory  # raw bytes; caller ceils by res_scales
-        if res.extended and len(res_vocab) > 2:
-            for j, name in enumerate(res_vocab[2:], start=2):
-                v = res.extended.get(name)
-                if v:
-                    pod_req64[i, j] = v
-        pod_valid[i] = True
-        pod_names.append(full_name(pod))
-        if pod.spec is not None:
-            pod_prio[i] = pod.spec.priority
-            if pod.spec.node_selector:
-                for kv in pod.spec.node_selector.items():
-                    j = vocab.get(kv)
-                    if j is None:
-                        raise PackingError(f"selector pair {kv} missing from supplied vocab")
-                    pod_sel[i, j] = 1.0
-                pod_sel_count[i] = len(pod.spec.node_selector)
+            res_memo[id(pod)] = (pod, res)
+            reslist.append(res)
+        else:
+            reslist.append(total_pod_resources(pod))
+    if n:
+        pod_req64[:n, CPU] = np.fromiter((r.cpu for r in reslist), np.int64, n)
+        pod_req64[:n, MEM] = np.fromiter((r.memory for r in reslist), np.int64, n)
+        pod_prio[:n] = np.fromiter(
+            ((p.spec.priority if p.spec is not None else 0) for p in pending), np.int32, n
+        )
+        pod_valid[:n] = True
+    if len(res_vocab) > 2:
+        ext_col = {name: j for j, name in enumerate(res_vocab[2:], start=2)}
+        for i, res in enumerate(reslist):
+            if res.extended:
+                for name, v in res.extended.items():
+                    j = ext_col.get(name)
+                    if j is not None and v:
+                        pod_req64[i, j] = v
+    pod_names = [full_name(p) for p in pending]
+    sel_i: list[int] = []
+    sel_j: list[int] = []
+    for i, pod in enumerate(pending):
+        spec = pod.spec
+        if spec is not None and spec.node_selector:
+            for kv in spec.node_selector.items():
+                j = vocab.get(kv)
+                if j is None:
+                    raise PackingError(f"selector pair {kv} missing from supplied vocab")
+                sel_i.append(i)
+                sel_j.append(j)
+            pod_sel_count[i] = len(spec.node_selector)
+    if sel_i:
+        pod_sel[sel_i, sel_j] = 1.0
 
     return dict(
         pod_req64=pod_req64,
