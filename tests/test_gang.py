@@ -396,3 +396,28 @@ def test_gang_resolve_budget_not_counted_on_normal_rejection():
     counters = sched.metrics.snapshot()
     assert "scheduler_gang_resolve_budget_exhausted_total" not in counters
     assert m.bound == 1  # the loner takes the reallocated capacity
+
+
+def test_gang_with_pod_affinity_chain_binds():
+    """Round-5 review repro: a gang whose members form a multi-hop HARD
+    pod-affinity chain (A needs B's label placed, B needs C's) must still
+    bind — the PA-hope rule has to keep A alive until B's placement
+    activates its term (the gang mop-up exclusion means a dropped gang
+    member would livelock the whole gang forever)."""
+    from tpu_scheduler.api.objects import PodAntiAffinityTerm
+
+    nodes = [make_node(f"n{i}", cpu="8", memory="32Gi", labels={"zone": f"z{i % 2}"}) for i in range(4)]
+    chain_a = [PodAntiAffinityTerm(match_labels={"role": "b"}, topology_key="zone")]
+    chain_b = [PodAntiAffinityTerm(match_labels={"role": "c"}, topology_key="zone")]
+    pods = [
+        make_pod("a", cpu="1", memory="1Gi", labels={"role": "a"}, pod_affinity=chain_a, gang="j"),
+        make_pod("b", cpu="1", memory="1Gi", labels={"role": "b"}, pod_affinity=chain_b, gang="j"),
+        make_pod("c", cpu="1", memory="1Gi", labels={"role": "c"}, gang="j"),
+    ]
+    api = FakeApiServer()
+    api.load(nodes, pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    sched.run(until_settled=True, max_cycles=4)
+    placed = {p.metadata.name: p.spec.node_name for p in api.list_pods() if p.spec.node_name}
+    assert {"a", "b", "c"} <= set(placed), placed
+    assert sched.metrics.snapshot().get("scheduler_gangs_admitted_total", 0) == 1

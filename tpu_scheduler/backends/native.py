@@ -138,9 +138,10 @@ class NativeBackend(SchedulingBackend):
             active = cand & ~accepted
             if cons is not None and hard_pa:
                 # Positive-affinity declarers blocked everywhere stay active
-                # while the round placed anyone — a same-round placement can
-                # activate their term (mirrors ops/assign.py exactly).
-                pa_hope = (cpods["pod_pa_declares"].sum(axis=1) > 0) & accepted.any()
+                # while ANY pending PA term gained a match this round
+                # (mirrors ops/assign.py exactly — see its rationale).
+                new_match = (cpods["pod_pa_matched"] * accepted[:, None].astype(np.float32)).sum(axis=0) > 0
+                pa_hope = (cpods["pod_pa_declares"].sum(axis=1) > 0) & new_match.any()
                 active = active | (was_active & ~has & pa_hope)
             rounds += 1
 

@@ -377,10 +377,18 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
             # Positive affinity breaks the "feasibility only shrinks" rule
             # the no-feasible-node drop-out relies on: a pod placed THIS
             # round can activate a declarer's term and open nodes for it.
-            # Keep blocked-everywhere PA declarers active while the round
-            # placed anyone (state changed → re-evaluate); a round that
-            # places nobody freezes the state, so stragglers drop then.
-            pa_hope = (ps["pod_pa_declares"].sum(axis=1) > 0) & accepted.any()
+            # Keep blocked-everywhere PA declarers active while ANY pending
+            # PA term gained a match this round: activations cascade (a
+            # multi-hop chain A->B->C inside a GANG needs A alive until B
+            # places — and the gang mop-up exclusion means a dropped gang
+            # member livelocks, round-5 review finding), but a round where
+            # NO term progressed cannot open anyone's nodes (AA masks only
+            # grow, capacity only shrinks), so the hopeless stragglers that
+            # round 4's any-pod-placed rule pinned through the whole
+            # flagship tail (diag_constrained_tail: ~1.3k pods blocking the
+            # size chain) drain as soon as PA progress stops.
+            new_match = (ps["pod_pa_matched"] * accepted[:, None].astype(jnp.float32)).sum(axis=0) > 0  # [Ta]
+            pa_hope = (ps["pod_pa_declares"].sum(axis=1) > 0) & new_match.any()
             ps["active"] = ps["active"] | (was_active & ~has & pa_hope)
         ps = _compact(ps)
         return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1, cst

@@ -307,10 +307,13 @@ def _build_shard_map(
             was_active = active  # round-start actives (not yet rebound)
             new_active = cand & ~acc_local
             if constrained and hard_pa:
-                # PA declarers blocked everywhere stay active while the round
-                # placed anyone (see ops/assign.py) — `accepted` is global
-                # and replicated, so every device computes the same flag.
-                pa_hope = (blk_l["pod_pa_declares"].sum(axis=1) > 0) & accepted.any()
+                # PA declarers blocked everywhere stay active while ANY
+                # pending PA term gained a match this round (see
+                # ops/assign.py).  `accepted` and the pod bitmaps (cpods)
+                # are global and replicated, so every device computes the
+                # same flag; the per-pod gate uses this dp shard's rows.
+                new_match = (cpods["pod_pa_matched"] * accepted[:, None].astype(jnp.float32)).sum(axis=0) > 0
+                pa_hope = (blk_l["pod_pa_declares"].sum(axis=1) > 0) & new_match.any()
                 new_active = new_active | (was_active & ~has & pa_hope)
             active = new_active
             n_active = lax.psum(active.sum(), "dp")
