@@ -194,3 +194,21 @@ def test_epoch_driver_matches_monolithic_constrained():
         assert rn.bindings == rt.bindings, driver
         assert rn.rounds == rt.rounds, driver
         assert (rn.stats["acc_round"] == rt.stats["acc_round"]).all(), driver
+
+
+def test_throughput_profile_round_count_stays_low():
+    """Round-5 regression guard: bucket-quantized tie-breaking spreads the
+    claimant herd across the whole near-tie band, collapsing the flagship
+    auction from 9 rounds to 2.  Pin the effect at a moderate shape — a
+    tie-break regression (e.g. reverting to additive jitter) re-herds the
+    claims and pushes the round count back up."""
+    from tpu_scheduler.backends.native import NativeBackend
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    snap = synth_cluster(n_nodes=1000, n_pending=10_000, n_bound=2_000, seed=0)
+    packed = pack_snapshot(snap, pod_block=4096, node_block=128)
+    r = NativeBackend().schedule(packed, PROFILES["throughput"])
+    assert len(r.bindings) == 10_000
+    assert r.rounds <= 4, f"tie-break regression: {r.rounds} rounds at 10k x 1k"
