@@ -328,3 +328,32 @@ def test_binding_create_conflict_409_status():
         assert ei.value.code == 409
     finally:
         srv.close()
+
+
+def test_in_repo_server_sends_bookmark_only_on_opt_in():
+    """The in-repo HttpApiServer must follow the same contract the client is
+    written against: BOOKMARK events only when allowWatchBookmarks=true was
+    requested (round-4 verdict: the unconditional bookmark made the client's
+    no-bookmark fallback untestable against our own server)."""
+    import json as _json
+    import urllib.request
+
+    from tpu_scheduler.runtime.fake_api import FakeApiServer
+    from tpu_scheduler.runtime.http_api import HttpApiServer
+    from tpu_scheduler.testing import make_node
+
+    api = FakeApiServer()
+    api.create_node(make_node("n1", cpu="4", memory="8Gi"))
+    server = HttpApiServer(api).start()
+    try:
+        base = server.base_url
+        raw = urllib.request.urlopen(f"{base}/api/v1/nodes?watch=true&resourceVersion=0").read()
+        types = [_json.loads(ln)["type"] for ln in raw.splitlines() if ln.strip()]
+        assert "BOOKMARK" not in types, types  # no opt-in -> no bookmark
+        raw2 = urllib.request.urlopen(
+            f"{base}/api/v1/nodes?watch=true&resourceVersion=0&allowWatchBookmarks=true"
+        ).read()
+        types2 = [_json.loads(ln)["type"] for ln in raw2.splitlines() if ln.strip()]
+        assert types2 and types2[-1] == "BOOKMARK", types2
+    finally:
+        server.stop()
