@@ -18,6 +18,10 @@ linters the image cannot run):
   DEAD  a non-underscore symbol in a module's ``__all__`` that no other file
         in the package, tests, bench, or entry scripts references (the
         round-2 'three dead soft scorers' class)
+  METR  a ``scheduler_*`` metric-name literal used anywhere in the package
+        that does not appear in the README metric catalogue — the docs
+        drift gate for the Observability section (a metric added without
+        cataloguing it would otherwise rot the docs silently)
   W291  trailing whitespace / W191 tabs in indentation
   E999  syntax errors (via ast.parse)
 
@@ -271,6 +275,21 @@ def main(argv: list[str]) -> int:
                     refs += hits
             if refs == 0:
                 errors.append(f"{f.relative_to(ROOT)}:1: DEAD export '{name}' is referenced nowhere")
+
+    # METR: every scheduler_* metric name used in the package must be
+    # catalogued in the README Observability section.
+    metric_re = re.compile(r'"(scheduler_[a-z0-9_]+)"')
+    readme = (ROOT / "README.md").read_text() if (ROOT / "README.md").exists() else ""
+    metric_names: set[str] = set()
+    for f, text in sources.items():
+        rel = f.relative_to(ROOT)
+        if rel.parts[:1] == ("tpu_scheduler",):
+            metric_names.update(metric_re.findall(text))
+    for name in sorted(metric_names):
+        if name not in readme:
+            errors.append(
+                f"README.md:1: METR metric '{name}' is used in tpu_scheduler/ but missing from the README metric catalogue"
+            )
 
     for e in sorted(errors):
         print(e)

@@ -195,3 +195,38 @@ def test_cli_driver_and_max_rounds_flags():
         assert summary["bound_total"] == 50
         runs[driver] = summary["counters"]["scheduler_cycles_total"]
     assert runs["monolithic"] == runs["epochs"]
+
+
+def test_backend_fallback_annotates_cycle_record():
+    api = make_cluster_api(6, 20)
+    sched = Scheduler(api, ExplodingBackend(), fallback_backend=NativeBackend())
+    sched.run_cycle()
+    rec = sched.recorder.cycles(1)[0]
+    assert any("backend-fallback" in note for note in rec.get("notes", []))
+
+
+def test_gang_refusal_recorded_on_timelines():
+    api = FakeApiServer()
+    api.load(
+        nodes=[make_node("n1", cpu=2, memory="4Gi")],
+        pods=[make_pod(f"w{i}", cpu="1", memory="1Gi", gang="job-1") for i in range(4)],
+    )
+    sched = Scheduler(api, NativeBackend())
+    m = sched.run_cycle()
+    assert m.bound == 0  # capacity for 2 of 4: all-or-nothing refuses whole
+    tl = sched.recorder.timeline("default/w0")
+    assert "gang-refused" in [e["kind"] for e in tl]
+    assert sched.metrics.snapshot()["scheduler_gang_rejections_total"] == 1
+
+
+def test_requeue_reason_classification():
+    assert Scheduler._requeue_reason_class("api-error: 503 boom") == "api-error"
+    assert Scheduler._requeue_reason_class("network-error: BrokenPipeError: x") == "network-error"
+    assert Scheduler._requeue_reason_class("async-bind-failed: ApiError: x") == "binding-failed"
+    assert Scheduler._requeue_reason_class("create-binding-failed: node gone") == "binding-failed"
+    assert Scheduler._requeue_reason_class("gang split across scheduling scopes; retry as a unit") == "gang"
+    from tpu_scheduler.errors import CreateBindingFailed, NoNodeFound
+
+    assert Scheduler._requeue_reason_class(NoNodeFound("none")) == "no-node"
+    assert Scheduler._requeue_reason_class(CreateBindingFailed("x")) == "binding-failed"
+    assert Scheduler._requeue_reason_class("something else") == "other"

@@ -1,0 +1,153 @@
+"""End-to-end acceptance for the flight-recorder debug surface (ISSUE 1):
+a real Scheduler drives a cluster, the HttpApiServer serves its recorder
+over real sockets, and the /debug routes + labeled /metrics agree with the
+cycle's verdicts."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_scheduler.api.objects import Taint
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.runtime.http_api import HttpApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+
+@pytest.fixture()
+def stack():
+    """Scheduler + live HTTP server over a cluster with one bindable pod,
+    one resource-starved pod, and one taint-blocked pod."""
+    api = FakeApiServer()
+    api.load(
+        nodes=[
+            make_node("n1", cpu=4, memory="8Gi"),
+            make_node("tainted", cpu=64, memory="64Gi", taints=[Taint(key="k", value="v", effect="NoSchedule")]),
+        ],
+        pods=[make_pod("ok", cpu="1"), make_pod("big", cpu="32")],
+    )
+    sched = Scheduler(api, NativeBackend())
+    server = HttpApiServer(api, metrics=sched.metrics, recorder=sched.recorder).start()
+    yield api, sched, server
+    server.stop()
+
+
+def get_json(url):
+    with urllib.request.urlopen(url) as r:
+        assert r.status == 200
+        return json.load(r)
+
+
+def test_why_pending_end_to_end(stack):
+    """Acceptance: an unschedulable pod's timeline ends with a typed
+    InvalidNodeReason + per-reason candidate counts, and /metrics shows the
+    matching labeled increment — over the real HTTP server."""
+    _, sched, server = stack
+    m = sched.run_cycle()
+    assert m.bound == 1 and m.unschedulable == 1
+    d = get_json(server.base_url + "/debug/pods/default/big")
+    kinds = [e["kind"] for e in d["timeline"]]
+    assert kinds[0] == "seen-pending" and "packed" in kinds
+    unsched = [e for e in d["timeline"] if e["kind"] == "unschedulable"][-1]
+    assert unsched["reason"] == "NotEnoughResources"
+    # Per-reason candidate-node counts: n1 too small, tainted untolerated.
+    assert unsched["candidate_counts"] == {"NotEnoughResources": 1, "TaintNotTolerated": 1}
+    # Live why-pending breakdown agrees.
+    why = d["why_pending"]
+    assert why["reasons"] == {"NotEnoughResources": 1, "TaintNotTolerated": 1}
+    assert why["feasible_nodes"] == 0 and why["nodes_total"] == 2
+    assert "0/2 nodes are available" in why["message"]
+    # The labeled counter matches the verdict, scraped over the same server.
+    with urllib.request.urlopen(server.base_url + "/metrics") as r:
+        text = r.read().decode()
+    assert 'scheduler_unschedulable_total{reason="NotEnoughResources"} 1' in text
+    assert 'scheduler_requeues_by_reason_total{reason="no-node"} 1' in text
+    # The bound pod's timeline carries its placement.
+    d_ok = get_json(server.base_url + "/debug/pods/default/ok")
+    assert d_ok["timeline"][-1]["kind"] == "bound"
+    assert d_ok["timeline"][-1]["node"] == "n1"
+    assert d_ok["why_pending"] is None  # bound pods have nothing pending
+
+
+def test_debug_trace_is_valid_chrome_trace(stack):
+    """Acceptance: /debug/trace?cycles=1 loads as Chrome trace-event JSON
+    with at least the pack/solve/bind/sync spans of the last cycle."""
+    _, sched, server = stack
+    sched.run_cycle()
+    with urllib.request.urlopen(server.base_url + "/debug/trace?cycles=1") as r:
+        assert r.status == 200
+        trace = json.loads(r.read().decode())
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    complete = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in complete}
+    assert {"pack", "solve", "bind", "sync"} <= names
+    for e in complete:
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+        assert isinstance(e["ts"], (int, float)) and e["dur"] >= 0
+
+
+def test_debug_cycles_ring(stack):
+    _, sched, server = stack
+    sched.run_cycle()
+    sched.run_cycle()
+    d = get_json(server.base_url + "/debug/cycles?n=1")
+    assert len(d["cycles"]) == 1
+    rec = d["cycles"][0]
+    assert rec["metrics"]["cycle"] == 2
+    assert any(s["name"] == "sync" for s in rec["spans"])
+    d_all = get_json(server.base_url + "/debug/cycles")
+    assert [c["metrics"]["cycle"] for c in d_all["cycles"]] == [1, 2]
+
+
+def test_debug_pod_unknown_404(stack):
+    _, sched, server = stack
+    sched.run_cycle()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(server.base_url + "/debug/pods/default/nope")
+    assert ei.value.code == 404
+
+
+def test_debug_routes_404_without_recorder():
+    api = FakeApiServer()
+    server = HttpApiServer(api).start()
+    try:
+        for path in ("/debug/cycles", "/debug/trace", "/debug/pods/default/x"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(server.base_url + path)
+            assert ei.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_events_buffer_zero_disables_recording():
+    api = FakeApiServer()
+    api.load(nodes=[make_node("n1")], pods=[make_pod("a")])
+    sched = Scheduler(api, NativeBackend(), events_buffer=0)
+    sched.run_cycle()
+    assert not sched.recorder.enabled
+    assert sched.recorder.tracked_pods() == []
+    # Labeled metrics still work with recording off.
+    assert sched.metrics.snapshot()["scheduler_bindings_total"] == 1
+
+
+def test_unknown_reason_beyond_explain_budget():
+    """A pod marked unschedulable past the per-cycle explain budget still
+    counts — labeled Unknown — and /debug computes its breakdown live."""
+    api = FakeApiServer()
+    api.load(nodes=[make_node("n1", cpu=1, memory="1Gi")], pods=[make_pod("big", cpu="8")])
+    sched = Scheduler(api, NativeBackend())
+    sched.EXPLAIN_WORK = 0  # starve the budget
+    server = HttpApiServer(api, metrics=sched.metrics, recorder=sched.recorder).start()
+    try:
+        sched.run_cycle()
+        d = get_json(server.base_url + "/debug/pods/default/big")
+        unsched = [e for e in d["timeline"] if e["kind"] == "unschedulable"][-1]
+        assert unsched["reason"] == "Unknown" and "candidate_counts" not in unsched
+        assert d["why_pending"]["reasons"] == {"NotEnoughResources": 1}  # live, on request
+        with urllib.request.urlopen(server.base_url + "/metrics") as r:
+            assert 'scheduler_unschedulable_total{reason="Unknown"} 1' in r.read().decode()
+    finally:
+        server.stop()

@@ -289,3 +289,26 @@ def test_http_watch_long_poll_outlives_client_socket_timeout(served):
     _, rv = client.list_nodes(with_rv=True)
     events, new_rv = client.watch_nodes_since(rv, timeout_seconds=1.5)  # > socket timeout
     assert events == [] and new_rv == rv  # timed out server-side, cleanly
+
+
+def test_metrics_only_server_serves_recorded_timelines():
+    """A scheduler pointed at a REMOTE cluster serves /debug from its own
+    recorder (api=None): timelines answer, the live why-pending breakdown —
+    which needs cluster state — is absent, and unknown pods 404."""
+    import urllib.error
+
+    from tpu_scheduler.utils.events import FlightRecorder
+
+    recorder = FlightRecorder()
+    recorder.record("default/p", "unschedulable", 3, reason="TaintNotTolerated")
+    server = HttpApiServer(None, metrics=MetricsRegistry(), recorder=recorder).start()
+    try:
+        with urllib.request.urlopen(server.base_url + "/debug/pods/default/p") as r:
+            d = json.load(r)
+        assert d["timeline"][0]["reason"] == "TaintNotTolerated"
+        assert d["why_pending"] is None
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server.base_url + "/debug/pods/default/unknown")
+        assert ei.value.code == 404
+    finally:
+        server.stop()

@@ -133,3 +133,38 @@ def test_pending_pods_filter():
     s = ClusterSnapshot.build([make_node("n1")], [bound, pending, bound_pending])
     assert s.pending_pods() == [pending]
     assert {p.name for p in s.pods_on_node("n1")} == {"b", "bp"}
+
+
+def test_unschedulable_reason_counts_first_fail_attribution():
+    """Each node is charged to the FIRST failing predicate in chain order —
+    kube's '0/N nodes are available: ...' breakdown."""
+    from tpu_scheduler.api.objects import Taint
+    from tpu_scheduler.core.predicates import dominant_reason, unschedulable_reason_counts
+
+    nodes = [
+        make_node("small", cpu=1, memory="1Gi"),
+        make_node("tainted", cpu=64, memory="64Gi", taints=[Taint(key="k", value="v", effect="NoSchedule")]),
+        make_node("cordoned", cpu=64, memory="64Gi", unschedulable=True),
+        make_node("wrong-zone", cpu=64, memory="64Gi", labels={"zone": "b"}),
+    ]
+    pod = make_pod("p", cpu="8", memory="8Gi", node_selector={"zone": "a"})
+    snap = ClusterSnapshot.build(nodes, [pod])
+    counts, feasible, total = unschedulable_reason_counts(pod, snap)
+    assert feasible == 0 and total == 4
+    # small fails resources FIRST (chain order), the others fail selector
+    # before their taint/cordon would even be consulted except where the
+    # selector passes.
+    assert counts["NotEnoughResources"] == 1
+    assert counts["NodeSelectorMismatch"] == 3  # tainted+cordoned lack zone=a too
+    assert sum(counts.values()) == 4
+    assert dominant_reason(counts, feasible) == "NodeSelectorMismatch"
+
+
+def test_dominant_reason_contention_falls_back_to_resources():
+    from tpu_scheduler.core.predicates import dominant_reason
+
+    # Some node WAS feasible pre-cycle: contention is a resource shortfall.
+    assert dominant_reason({"TaintNotTolerated": 5}, feasible=2) == "NotEnoughResources"
+    assert dominant_reason({}, feasible=0) == "NotEnoughResources"
+    # Deterministic tie-break: lexicographically first among max counts.
+    assert dominant_reason({"TaintNotTolerated": 3, "NodeSelectorMismatch": 3}, 0) == "NodeSelectorMismatch"

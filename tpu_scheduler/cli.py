@@ -85,6 +85,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--requeue-seconds", type=float, default=REQUEUE_SECONDS, help="failed-pod requeue delay")
     p.add_argument("--no-fallback", action="store_true", help="disable tpu->native failure fallback")
     p.add_argument("--log-level", default="INFO")
+    p.add_argument(
+        "--log-format",
+        choices=["text", "json"],
+        default="text",
+        help="log line format: 'json' emits one machine-parseable JSON object per line (ts, level, logger, msg, cycle)",
+    )
+    p.add_argument(
+        "--events-buffer",
+        type=int,
+        default=4096,
+        help="flight recorder capacity (max pod timelines retained for the /debug routes); 0 disables recording",
+    )
     p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace of the cycles here")
     p.add_argument("--checkpoint-dir", default=None, help="restore scheduler state from here at startup, save at exit")
     p.add_argument("--http-port", type=int, default=None, help="serve /metrics, /healthz and the k8s REST surface on this port")
@@ -109,7 +121,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    configure_logging(args.log_level)
+    configure_logging(args.log_level, args.log_format)
 
     from .utils.gc_tuning import enable_daemon_gc_tuning
 
@@ -214,7 +226,12 @@ def main(argv: list[str] | None = None) -> int:
         identity=args.identity,
         lease_name=args.lease_name,
         lease_duration=args.lease_duration,
+        events_buffer=args.events_buffer,
     )
+    if args.profile_dir:
+        # Link the device trace from /debug/trace's Chrome-trace JSON so the
+        # host and device timelines open side by side in Perfetto.
+        sched.recorder.device_trace_dir = args.profile_dir
 
     if args.checkpoint_dir:
         from .runtime.checkpoint import restore_scheduler
@@ -231,7 +248,9 @@ def main(argv: list[str] | None = None) -> int:
         # Against a remote cluster we serve metrics/health only — the remote
         # API server owns the cluster state.
         local_api = None if (args.api_server or args.kubeconfig is not None) else api
-        http_server = HttpApiServer(local_api, metrics=sched.metrics, port=args.http_port).start()
+        http_server = HttpApiServer(
+            local_api, metrics=sched.metrics, recorder=sched.recorder, port=args.http_port
+        ).start()
         print(json.dumps({"http": True, "url": http_server.base_url}), file=sys.stderr)
 
     from .utils.tracing import device_profile

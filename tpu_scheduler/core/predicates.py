@@ -43,6 +43,8 @@ __all__ = [
     "make_soft_spread_scorer",
     "make_preferred_pod_affinity_scorer",
     "check_node_validity",
+    "unschedulable_reason_counts",
+    "dominant_reason",
     "PREDICATE_CHAIN",
     "NODE_LOCAL_PREDICATES",
 ]
@@ -539,3 +541,32 @@ def check_node_validity(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> Inva
         if not pred(pod, node, snapshot):
             return reason
     return None
+
+
+def unschedulable_reason_counts(pod: Pod, snapshot: ClusterSnapshot) -> tuple[dict[str, int], int, int]:
+    """Per-reason candidate-node rejection counts for one pod — kube's
+    "0/N nodes are available: 3 Insufficient cpu, ..." breakdown: each node
+    is charged to the FIRST failing predicate in chain order.  Returns
+    ``(counts-by-reason-value, feasible_nodes, nodes_total)`` — the payload
+    of the flight recorder's "unschedulable" event and the /debug why-pending
+    route (utils/events.py, runtime/http_api.py).  O(nodes) host work per
+    pod: callers on the cycle path budget it (Scheduler.EXPLAIN_WORK)."""
+    counts: dict[str, int] = {}
+    feasible = 0
+    for node in snapshot.nodes:
+        reason = check_node_validity(pod, node, snapshot)
+        if reason is None:
+            feasible += 1
+        else:
+            counts[reason.value] = counts.get(reason.value, 0) + 1
+    return counts, feasible, len(snapshot.nodes)
+
+
+def dominant_reason(counts: dict[str, int], feasible: int) -> str:
+    """The one typed reason a timeline entry carries: the predicate that
+    rejected the most nodes — or NotEnoughResources when some node WAS
+    feasible against the pre-cycle snapshot (the capacity went to other pods
+    in the same cycle: scheduling contention is a resource shortfall)."""
+    if feasible > 0 or not counts:
+        return InvalidNodeReason.NOT_ENOUGH_RESOURCES.value
+    return max(sorted(counts), key=lambda k: counts[k])

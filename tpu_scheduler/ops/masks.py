@@ -17,7 +17,48 @@ construction (tests/test_backends_parity.py).
 
 from __future__ import annotations
 
-__all__ = ["feasibility_block"]
+__all__ = ["feasibility_block", "feasibility_breakdown", "reason_rejection_counts"]
+
+
+def feasibility_breakdown(
+    xp,
+    pod_req,
+    pod_sel,
+    pod_sel_count,
+    node_avail,
+    node_labels,
+    pod_ntol=None,
+    node_taints=None,
+    pod_aff=None,
+    pod_has_aff=None,
+    node_aff=None,
+):
+    """The predicate masks feasibility_block ANDs together, EXPOSED per
+    reason: ``{InvalidNodeReason value -> [B, N] pass-mask}`` (True = the
+    node passes that predicate for that pod).  These intermediates were
+    always computed — surfacing them named is what the flight recorder's
+    per-reason candidate counts and the why-pending debug route build on
+    (utils/events.py; ISSUE: per-reason mask counts already computed).
+    Keys follow ``core.predicates.InvalidNodeReason`` values so tensor and
+    scalar breakdowns are interchangeable downstream.
+    """
+    out = {}
+    out["NotEnoughResources"] = (pod_req[:, None, :] <= node_avail[None, :, :]).all(-1)
+    # Selector-pair counting: matches iff the node carries every selector pair.
+    # Counts are tiny integers — exact even through a bf16 MXU pass.
+    counts = pod_sel @ node_labels.T
+    out["NodeSelectorMismatch"] = counts == pod_sel_count[:, None]
+    if pod_ntol is not None and node_taints is not None:
+        # Untolerated-taint counting: schedulable iff zero of the node's hard
+        # taints land in the pod's not-tolerated set.
+        untol = pod_ntol @ node_taints.T
+        out["TaintNotTolerated"] = untol == 0
+    if pod_aff is not None and node_aff is not None and pod_has_aff is not None:
+        # Node affinity: terms are ORed — eligible iff the pod has no
+        # affinity, or the node satisfies at least one of its terms.
+        aff_hits = pod_aff @ node_aff.T
+        out["NodeAffinityMismatch"] = (aff_hits > 0) | (pod_has_aff[:, None] == 0)
+    return out
 
 
 def feasibility_block(
@@ -42,20 +83,23 @@ def feasibility_block(
     node_valid [N] bool, pod_ntol [B,T] f32 / node_taints [N,T] f32
     (optional together — omitted means no taints in the cluster).
     """
-    fit = (pod_req[:, None, :] <= node_avail[None, :, :]).all(-1)
-    # Selector-pair counting: matches iff the node carries every selector pair.
-    # Counts are tiny integers — exact even through a bf16 MXU pass.
-    counts = pod_sel @ node_labels.T
-    sel = counts == pod_sel_count[:, None]
-    mask = fit & sel & node_valid[None, :] & pod_active[:, None]
-    if pod_ntol is not None and node_taints is not None:
-        # Untolerated-taint counting: schedulable iff zero of the node's hard
-        # taints land in the pod's not-tolerated set.
-        untol = pod_ntol @ node_taints.T
-        mask = mask & (untol == 0)
-    if pod_aff is not None and node_aff is not None and pod_has_aff is not None:
-        # Node affinity: terms are ORed — eligible iff the pod has no
-        # affinity, or the node satisfies at least one of its terms.
-        aff_hits = pod_aff @ node_aff.T
-        mask = mask & ((aff_hits > 0) | (pod_has_aff[:, None] == 0))
+    parts = feasibility_breakdown(
+        xp, pod_req, pod_sel, pod_sel_count, node_avail, node_labels,
+        pod_ntol, node_taints, pod_aff, pod_has_aff, node_aff,
+    )
+    mask = node_valid[None, :] & pod_active[:, None]
+    for part in parts.values():
+        mask = mask & part
     return mask
+
+
+def reason_rejection_counts(xp, breakdown, node_valid):
+    """Per-pod candidate-node rejection counts from a breakdown:
+    ``{reason -> [B] number of otherwise-valid nodes failing that
+    predicate}`` (non-exclusive — a node can fail several predicates; the
+    scalar first-fail attribution lives in
+    ``core.predicates.unschedulable_reason_counts``)."""
+    return {
+        reason: (node_valid[None, :] & ~part).sum(-1)
+        for reason, part in breakdown.items()
+    }

@@ -9,7 +9,12 @@ sides of that boundary for this framework:
     Kubernetes REST surface the scheduler consumes (list nodes/pods with
     field selectors, the pods/binding subresource) plus the observability
     routes the reference lacks (``/metrics`` Prometheus text, ``/healthz``,
-    ``/readyz``) — SURVEY.md §5.
+    ``/readyz``) — SURVEY.md §5 — and the flight-recorder debug surface:
+    ``/debug/pods/<ns>/<name>`` (why-pending: the pod's decision timeline
+    plus a live per-predicate rejection breakdown), ``/debug/cycles`` (ring
+    buffer of recent cycle metrics + span summaries), and
+    ``/debug/trace?cycles=N`` (recorded spans as Chrome trace-event JSON,
+    loadable in Perfetto).
   • ``KubeApiClient`` — stdlib-only (http.client) client for that surface;
     pointed at a real kube-apiserver (with a bearer token) it is the
     real-cluster edge adapter SURVEY.md §7 step 5 calls for.
@@ -49,9 +54,12 @@ class HttpApiServer:
     it has no cluster state of its own to serve); the cluster routes answer
     503."""
 
-    def __init__(self, api: FakeApiServer | None, metrics=None, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, api: FakeApiServer | None, metrics=None, recorder=None, host: str = "127.0.0.1", port: int = 0
+    ):
         self.api = api
         self.metrics = metrics
+        self.recorder = recorder  # utils/events.FlightRecorder (the /debug routes)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -91,6 +99,44 @@ class HttpApiServer:
                     lines.append(json.dumps({"type": "BOOKMARK", "object": {"metadata": {"resourceVersion": new_rv}}}))
                 self._send(200, "\n".join(lines).encode(), "application/json; stream=watch")
 
+            # -- flight-recorder debug surface (utils/events.py) ----------
+
+            def _send_debug_pod(self, ns: str, name: str):
+                """Why-pending: the pod's recorded decision timeline plus a
+                LIVE per-predicate rejection breakdown against the current
+                cluster state (kube's "0/N nodes are available: ..." message,
+                computed on request so it is fresh even for pods whose
+                in-cycle explanation was beyond the budget)."""
+                full = f"{ns}/{name}"
+                timeline = outer.recorder.timeline(full)
+                why = None
+                if outer.api is not None:
+                    from ..api.objects import full_name, is_pod_bound
+                    from ..core.predicates import dominant_reason, unschedulable_reason_counts
+                    from ..core.snapshot import ClusterSnapshot
+
+                    pods = outer.api.list_pods()
+                    pod = next((p for p in pods if full_name(p) == full), None)
+                    if pod is None and not timeline:
+                        self._send_json(404, {"message": f"pod {full} not found and no recorded timeline"})
+                        return
+                    if pod is not None and not is_pod_bound(pod) and pod.status.phase == "Pending":
+                        snap = ClusterSnapshot.build(outer.api.list_nodes(), pods)
+                        counts, feasible, total = unschedulable_reason_counts(pod, snap)
+                        parts = ", ".join(f"{v} {k}" for k, v in sorted(counts.items()))
+                        why = {
+                            "reasons": counts,
+                            "dominant_reason": dominant_reason(counts, feasible) if feasible == 0 else None,
+                            "feasible_nodes": feasible,
+                            "nodes_total": total,
+                            "message": f"{feasible}/{total} nodes are available"
+                            + (f": {parts}" if parts else ""),
+                        }
+                elif not timeline:
+                    self._send_json(404, {"message": f"no recorded timeline for pod {full}"})
+                    return
+                self._send_json(200, {"pod": full, "timeline": timeline, "why_pending": why})
+
             def do_GET(self):
                 parsed = urlparse(self.path)
                 q = parse_qs(parsed.query)
@@ -102,6 +148,25 @@ class HttpApiServer:
                     elif parsed.path == "/metrics":
                         text = outer.metrics.to_prometheus() if outer.metrics is not None else ""
                         self._send(200, text.encode(), "text/plain; version=0.0.4")
+                    elif parsed.path.startswith("/debug/") and outer.recorder is None:
+                        self._send_json(404, {"message": "flight recorder not attached (events buffer disabled)"})
+                    elif parsed.path == "/debug/cycles":
+                        try:
+                            n = int(q.get("n", ["64"])[0])
+                        except ValueError as e:
+                            raise ApiError(400, f"malformed n: {e}") from e
+                        self._send_json(200, {"cycles": outer.recorder.cycles(n)})
+                    elif parsed.path == "/debug/trace":
+                        try:
+                            n = int(q.get("cycles", ["16"])[0])
+                        except ValueError as e:
+                            raise ApiError(400, f"malformed cycles: {e}") from e
+                        self._send_json(200, outer.recorder.chrome_trace(n))
+                    elif (
+                        len(dparts := parsed.path.strip("/").split("/")) == 4
+                        and dparts[:2] == ["debug", "pods"]
+                    ):
+                        self._send_debug_pod(dparts[2], dparts[3])
                     elif outer.api is None and parsed.path.startswith("/api/"):
                         self._send_json(503, {"message": "metrics-only server: no cluster state here"})
                     elif parsed.path == "/api/v1/nodes" and watching:

@@ -1,0 +1,238 @@
+"""Scheduling flight recorder — bounded per-pod decision timelines plus a
+ring buffer of recent cycles, the in-process answer to "why is this pod
+Pending?" and "what did cycle N spend its time on?" without re-running
+bench.py (VERDICT round 5: classify and surface unschedulable pods as a
+product feature, not a bench field).
+
+Every verdict the controller reaches about a pod — seen-pending, packed,
+gang-admitted/refused, bound, requeued, unschedulable (with its typed
+``InvalidNodeReason`` and per-reason candidate-node counts) — lands here as
+one timeline entry.  The recorder is strictly bounded in three dimensions
+(tracked pods, events per pod, retained cycles) so a daemon observing
+unbounded churn holds constant memory; overflow evicts the least-recently
+updated timeline (the pods an operator debugs are the ones still acting)
+and is counted, never silent.
+
+``chrome_trace`` renders the recorded per-cycle span intervals as Chrome
+trace-event JSON (the ``{"traceEvents": [...]}`` object form) loadable in
+Perfetto / chrome://tracing, with the device-trace directory linked when
+``--profile-dir`` is set.  Served by ``runtime/http_api.py`` under
+``/debug/pods/<ns>/<name>``, ``/debug/cycles`` and ``/debug/trace``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+__all__ = ["FlightRecorder", "EVENT_KINDS"]
+
+# The closed vocabulary of per-pod verdicts (one place, so the debug API and
+# tests can validate timelines against it).
+EVENT_KINDS = (
+    "seen-pending",
+    "packed",
+    "gang-admitted",
+    "gang-refused",
+    "backend-fallback",
+    "bound",
+    "requeued",
+    "unschedulable",
+    "preempted",
+    "evicted",
+)
+
+
+class FlightRecorder:
+    """Bounded in-memory recorder of scheduling decisions.
+
+    ``max_pods`` timelines of at most ``per_pod`` events each, plus
+    ``max_cycles`` cycle records (CycleMetrics + span intervals).  All
+    methods are thread-safe: the pipelined bind worker records bound/requeue
+    outcomes while the HTTP debug routes read concurrently.  ``max_pods=0``
+    disables recording entirely (every call is a cheap no-op) — the
+    ``--events-buffer 0`` escape hatch for benchmark runs."""
+
+    def __init__(self, max_pods: int = 4096, per_pod: int = 64, max_cycles: int = 256):
+        self.max_pods = max_pods
+        self.per_pod = per_pod
+        self.max_cycles = max_cycles
+        self._lock = threading.Lock()
+        self._timelines: OrderedDict[str, deque] = OrderedDict()
+        self._cycles: deque = deque(maxlen=max(1, max_cycles))
+        self.evicted_timelines = 0  # LRU overflow — visible, never silent
+        # Set by the CLI when --profile-dir is active so chrome_trace can
+        # link the device trace next to the host spans.
+        self.device_trace_dir: str | None = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_pods > 0
+
+    # -- per-pod timelines --------------------------------------------------
+
+    def record(
+        self,
+        pod_full: str,
+        kind: str,
+        cycle: int,
+        *,
+        node: str | None = None,
+        reason: str | None = None,
+        counts: dict[str, int] | None = None,
+        detail: str | None = None,
+    ) -> None:
+        """Append one verdict to a pod's timeline (creating it if needed,
+        evicting the least-recently-updated timeline at capacity)."""
+        if not self.enabled:
+            return
+        ev: dict = {"ts": time.time(), "cycle": cycle, "kind": kind}
+        if node is not None:
+            ev["node"] = node
+        if reason is not None:
+            ev["reason"] = reason
+        if counts:
+            ev["candidate_counts"] = dict(counts)
+        if detail is not None:
+            ev["detail"] = detail
+        with self._lock:
+            tl = self._timelines.get(pod_full)
+            if tl is None:
+                while len(self._timelines) >= self.max_pods:
+                    self._timelines.popitem(last=False)
+                    self.evicted_timelines += 1
+                tl = self._timelines[pod_full] = deque(maxlen=self.per_pod)
+            else:
+                self._timelines.move_to_end(pod_full)
+            tl.append(ev)
+
+    def seen(self, pod_full: str, cycle: int) -> None:
+        """Record ``seen-pending`` once — only for pods with no timeline yet
+        (O(1) dict probe; called for every pending pod every cycle)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            known = pod_full in self._timelines
+        if not known:
+            self.record(pod_full, "seen-pending", cycle)
+
+    def seen_many(self, pod_fulls, cycle: int) -> None:
+        """Batch ``seen``: ONE lock hold for a whole cycle's pending set —
+        the controller calls this with up to 100k names per cycle, and a
+        per-name lock acquisition would tax the hot loop measurably."""
+        if not self.enabled:
+            return
+        now = time.time()
+        with self._lock:
+            for pf in pod_fulls:
+                if pf in self._timelines:
+                    continue
+                while len(self._timelines) >= self.max_pods:
+                    self._timelines.popitem(last=False)
+                    self.evicted_timelines += 1
+                tl = self._timelines[pf] = deque(maxlen=self.per_pod)
+                tl.append({"ts": now, "cycle": cycle, "kind": "seen-pending"})
+
+    def record_packed(self, pod_fulls, cycle: int, backend: str) -> None:
+        """Record ``packed`` for ALREADY-TRACKED pods only — the batch path
+        packs 100k pods per cycle, and growing timelines here would churn
+        the LRU; a pod enters via ``seen`` and keeps its batch membership
+        from then on."""
+        if not self.enabled:
+            return
+        ev_base = {"ts": time.time(), "cycle": cycle, "kind": "packed", "detail": backend}
+        with self._lock:
+            for pf in pod_fulls:
+                tl = self._timelines.get(pf)
+                if tl is not None:
+                    tl.append(dict(ev_base))
+
+    def timeline(self, pod_full: str) -> list[dict]:
+        with self._lock:
+            tl = self._timelines.get(pod_full)
+            return [dict(ev) for ev in tl] if tl is not None else []
+
+    def tracked_pods(self) -> list[str]:
+        with self._lock:
+            return list(self._timelines)
+
+    # -- per-cycle records ---------------------------------------------------
+
+    def record_cycle(self, metrics: dict, spans: list[tuple[str, float, float]], notes: list[str] | None = None) -> None:
+        """Retain one cycle: its CycleMetrics dict, its span INTERVALS
+        (name, wall_start, wall_end — the chrome_trace source), and any
+        cycle-level annotations (backend-fallback etc.)."""
+        if not self.enabled:
+            return
+        rec = {
+            "wall_end": time.time(),
+            "metrics": dict(metrics),
+            "spans": [(name, t0, t1) for name, t0, t1 in spans],
+        }
+        if notes:
+            rec["notes"] = list(notes)
+        with self._lock:
+            self._cycles.append(rec)
+
+    def cycles(self, n: int | None = None) -> list[dict]:
+        with self._lock:
+            out = list(self._cycles)
+        if n is not None:
+            out = out[-n:]
+        return [
+            {**rec, "spans": [{"name": s[0], "start": s[1], "end": s[2]} for s in rec["spans"]]}
+            for rec in out
+        ]
+
+    # -- Chrome trace-event export ------------------------------------------
+
+    def chrome_trace(self, n_cycles: int | None = None) -> dict:
+        """The recorded cycles as a Chrome trace-event JSON object
+        (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+        — ``ph: "X"`` complete events in microseconds, one per recorded span,
+        loadable in Perfetto or chrome://tracing.  When a device trace was
+        captured (``--profile-dir``), its directory is linked in
+        ``otherData`` so the host and device timelines can be opened side by
+        side."""
+        with self._lock:
+            recs = list(self._cycles)
+        if n_cycles is not None:
+            recs = recs[-n_cycles:]
+        events: list[dict] = []
+        for rec in recs:
+            cycle = rec["metrics"].get("cycle")
+            for name, t0, t1 in rec["spans"]:
+                events.append(
+                    {
+                        "name": name,
+                        "cat": "scheduler",
+                        "ph": "X",
+                        "ts": round(t0 * 1e6, 3),
+                        "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+                        "pid": 1,
+                        "tid": 1,
+                        "args": {"cycle": cycle},
+                    }
+                )
+            # One instant event marking the cycle boundary keeps cycles
+            # countable even when a cycle recorded no spans (idle standby).
+            events.append(
+                {
+                    "name": f"cycle {cycle}",
+                    "cat": "scheduler",
+                    "ph": "i",
+                    "ts": round(rec.get("wall_end", 0.0) * 1e6, 3),
+                    "pid": 1,
+                    "tid": 1,
+                    "s": "g",
+                }
+            )
+        trace = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"recorded_cycles": len(recs)},
+        }
+        if self.device_trace_dir:
+            trace["otherData"]["device_trace_dir"] = self.device_trace_dir
+        return trace

@@ -1,15 +1,77 @@
 """Cycle metrics — pods-bound/sec and cycle wall-clock are the north-star
 numbers (BASELINE.md); the reference exposes no metrics at all (SURVEY.md §5).
+
+The registry is a real (if minimal) Prometheus-style registry: unlabeled and
+LABELED counters, bucketed histograms (phase latencies, binding latency,
+rounds-per-cycle), and last-cycle gauges, exported in valid text exposition
+(version 0.0.4) by ``to_prometheus``.  Labeled counters live in the same
+``counters`` dict as unlabeled ones under pre-formatted
+``name{label="value"}`` keys — one flat dict keeps the checkpoint format
+(runtime/checkpoint.py persists ``counters`` verbatim) and the CLI summary
+line unchanged while the exposition groups series into families.
+
+Thread-safety contract: every mutation AND every read path goes through
+``_lock``; ``to_prometheus`` is derived strictly from one locked
+``_snapshot_full()`` so a worker-thread ``inc`` can never race the /metrics
+scrape mid-iteration (dict-resize under iteration was a real crash class).
 """
 
 from __future__ import annotations
 
+import bisect
 import json
 import threading
 import time
 from dataclasses import dataclass, field
 
-__all__ = ["CycleMetrics", "MetricsRegistry"]
+__all__ = ["CycleMetrics", "MetricsRegistry", "format_labels", "escape_label_value"]
+
+# Latency buckets (seconds): sub-ms host phases through multi-second
+# constrained cycles at flagship shapes.
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# Auction rounds per cycle: the round-5 work holds the flagship at 2.
+ROUNDS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+# Histogram name -> bucket bounds; the one registration point the README
+# drift gate (scripts/lint.py) and to_prometheus share.
+HISTOGRAM_BUCKETS = {
+    "scheduler_cycle_seconds": LATENCY_BUCKETS,
+    "scheduler_phase_seconds": LATENCY_BUCKETS,
+    "scheduler_binding_seconds": LATENCY_BUCKETS,
+    "scheduler_cycle_rounds": ROUNDS_BUCKETS,
+}
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def format_labels(labels: dict[str, str] | None) -> str:
+    """``{a="x",b="y"}`` (sorted, escaped) — "" for no labels."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Histogram:
+    """One histogram series: cumulative-at-export bucket counts, sum, count."""
+
+    __slots__ = ("bounds", "counts", "sum")
+
+    def __init__(self, bounds: tuple[float, ...]):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # last slot = +Inf
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.sum += value
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts)
 
 
 @dataclass
@@ -42,54 +104,131 @@ class CycleMetrics:
 
 @dataclass
 class MetricsRegistry:
-    """Process counters (Prometheus-style names, in-memory registry).
-    ``inc`` is locked: the routed cycle's pool shards (and backend
+    """Process counters + histograms (Prometheus-style, in-memory).
+    Everything is locked: the routed cycle's pool shards (and backend
     fallbacks inside them) increment from worker threads, and the /metrics
     HTTP server reads concurrently."""
 
     counters: dict[str, int] = field(default_factory=dict)
     cycles: list[CycleMetrics] = field(default_factory=list)
     started_at: float = field(default_factory=time.time)
+    _histograms: dict[str, dict[str, _Histogram]] = field(default_factory=dict, repr=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
-    def inc(self, name: str, value: int = 1) -> None:
+    # -- writes (all under _lock) -----------------------------------------
+
+    def _inc(self, name: str, value: int, labels: dict[str, str] | None) -> None:
+        key = name + format_labels(labels)
+        self.counters[key] = self.counters.get(key, 0) + value
+
+    def inc(self, name: str, value: int = 1, labels: dict[str, str] | None = None) -> None:
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + value
+            self._inc(name, value, labels)
+
+    def _observe(self, name: str, value: float, labels: dict[str, str] | None) -> None:
+        per = self._histograms.setdefault(name, {})
+        ls = format_labels(labels)
+        h = per.get(ls)
+        if h is None:
+            h = per[ls] = _Histogram(HISTOGRAM_BUCKETS.get(name, LATENCY_BUCKETS))
+        h.observe(value)
+
+    def observe(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
+        """Record one histogram observation (bucket bounds come from
+        HISTOGRAM_BUCKETS, defaulting to the latency bounds)."""
+        with self._lock:
+            self._observe(name, value, labels)
 
     def observe_cycle(self, m: CycleMetrics) -> None:
-        self.cycles.append(m)
-        if len(self.cycles) > 1024:
-            del self.cycles[0]  # bounded — a daemon observes unbounded cycles
-        self.inc("scheduler_cycles_total")
-        self.inc("scheduler_pods_bound_total", m.bound)
-        self.inc("scheduler_pods_unschedulable_total", m.unschedulable)
+        with self._lock:
+            self.cycles.append(m)
+            if len(self.cycles) > 1024:
+                del self.cycles[0]  # bounded — a daemon observes unbounded cycles
+            self._inc("scheduler_cycles_total", 1, None)
+            self._inc("scheduler_pods_bound_total", m.bound, None)
+            self._inc("scheduler_pods_unschedulable_total", m.unschedulable, None)
+            self._observe("scheduler_cycle_seconds", m.wall_seconds, None)
+            self._observe("scheduler_cycle_rounds", float(m.rounds), None)
+            for phase, seconds in (
+                ("sync", m.sync_seconds),
+                ("pack", m.pack_seconds),
+                ("solve", m.solve_seconds),
+                ("bind", m.bind_seconds),
+                ("mopup", m.mopup_seconds),
+                ("other", m.other_seconds),
+            ):
+                if seconds > 0:
+                    self._observe("scheduler_phase_seconds", seconds, {"phase": phase})
+            if m.bind_seconds > 0:
+                self._observe("scheduler_binding_seconds", m.bind_seconds, None)
+
+    # -- reads (one locked snapshot; no iteration over live state) ---------
+
+    def _snapshot_full(self) -> dict:
+        """Everything the exposition needs, copied under ONE lock hold."""
+        with self._lock:
+            counters = dict(self.counters)
+            hists = {
+                name: {ls: (h.bounds, list(h.counts), h.sum) for ls, h in per.items()}
+                for name, per in self._histograms.items()
+            }
+            last = self.cycles[-1] if self.cycles else None
+        gauges: dict[str, float] = {}
+        if last is not None:
+            gauges["scheduler_last_cycle_seconds"] = last.wall_seconds
+            gauges["scheduler_last_pods_per_second"] = last.pods_per_second
+            gauges["scheduler_last_cycle_pending"] = float(last.pending)
+            gauges["scheduler_last_cycle_rounds"] = float(last.rounds)
+        return {"counters": counters, "histograms": hists, "gauges": gauges}
 
     def snapshot(self) -> dict:
-        with self._lock:  # /metrics reader vs worker-thread inc (dict-resize race)
-            out = dict(self.counters)
-        if self.cycles:
-            last = self.cycles[-1]
-            out["scheduler_last_cycle_seconds"] = last.wall_seconds
-            out["scheduler_last_pods_per_second"] = last.pods_per_second
+        """Flat name -> value view (labeled counters under their formatted
+        keys) — the CLI summary / checkpoint-delta surface."""
+        full = self._snapshot_full()
+        out = dict(full["counters"])
+        for k in ("scheduler_last_cycle_seconds", "scheduler_last_pods_per_second"):
+            if k in full["gauges"]:
+                out[k] = full["gauges"][k]
         return out
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition (version 0.0.4) of the registry —
-        counters, last-cycle gauges, and process uptime.  The reference has
-        no metrics endpoint at all (SURVEY.md §5); this feeds the
-        /metrics route of runtime/http_api.py.  Derived from ``snapshot()``
-        so there is one source of truth for exported values."""
-        snap = self.snapshot()
-        gauges = {k: v for k, v in snap.items() if k not in self.counters}
+        """Prometheus text exposition (version 0.0.4) — counters (series
+        grouped into families, one TYPE line each), histograms with
+        cumulative ``_bucket``/``_sum``/``_count``, last-cycle gauges, and
+        process uptime.  Derived strictly from one locked
+        ``_snapshot_full()`` so a concurrent ``inc`` can never race the
+        scrape (SURVEY.md §5: the reference has no metrics endpoint at
+        all; this feeds the /metrics route of runtime/http_api.py)."""
+        full = self._snapshot_full()
+        gauges = dict(full["gauges"])
         gauges["scheduler_uptime_seconds"] = time.time() - self.started_at
-        if self.cycles:
-            last = self.cycles[-1]
-            gauges["scheduler_last_cycle_pending"] = float(last.pending)
-            gauges["scheduler_last_cycle_rounds"] = float(last.rounds)
-        lines = []
-        for name in sorted(self.counters):
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {self.counters[name]}")
+
+        # Group counter series into families: "name{...}" -> family "name".
+        families: dict[str, list[tuple[str, int]]] = {}
+        for key in sorted(full["counters"]):
+            fam = key.split("{", 1)[0]
+            families.setdefault(fam, []).append((key, full["counters"][key]))
+        lines: list[str] = []
+        for fam in sorted(families):
+            lines.append(f"# TYPE {fam} counter")
+            for key, value in families[fam]:
+                lines.append(f"{key} {value}")
+        for name in sorted(full["histograms"]):
+            lines.append(f"# TYPE {name} histogram")
+            for ls in sorted(full["histograms"][name]):
+                bounds, counts, total = full["histograms"][name][ls]
+                # ls is "" or '{a="b"}'; merge the le label into it.
+                base = ls[1:-1] if ls else ""
+                cum = 0
+                for bound, c in zip(bounds, counts):
+                    cum += c
+                    merged = ",".join(x for x in (base, f'le="{bound:g}"') if x)
+                    lines.append(f"{name}_bucket{{{merged}}} {cum}")
+                cum += counts[-1]
+                merged = ",".join(x for x in (base, 'le="+Inf"') if x)
+                lines.append(f"{name}_bucket{{{merged}}} {cum}")
+                lines.append(f"{name}_sum{ls} {total}")
+                lines.append(f"{name}_count{ls} {cum}")
         for name in sorted(gauges):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {gauges[name]}")

@@ -2,45 +2,118 @@
 ``tracing_subscriber::fmt()`` INFO logging (``src/main.rs:129``; SURVEY.md §5
 calls for per-cycle spans + optional device profiler traces).
 
-``span("name")`` times a block, logs it, and records the duration into the
-active ``Trace`` (if any).  ``device_profile(dir)`` wraps ``jax.profiler`` for
-TPU traces of the scoring step; it is a no-op if profiling can't start.
+``span("name")`` times a block, logs it, and records the duration AND the
+wall-clock interval into the active ``Trace`` (if any) — the intervals feed
+the flight recorder's Chrome trace export (utils/events.py).
+``device_profile(dir)`` wraps ``jax.profiler`` for TPU traces of the scoring
+step; it is a no-op if profiling can't start.  ``configure_logging`` grows a
+``--log-format json`` path: one JSON object per line (ts, level, logger,
+msg, cycle) so the daemon's logs are machine-parseable; ``set_log_cycle``
+tags every line emitted during a cycle with its number.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
 import time
 from collections import defaultdict
 
 logger = logging.getLogger("tpu_scheduler")
 
-__all__ = ["span", "Trace", "current_trace", "device_profile", "configure_logging"]
+__all__ = [
+    "span",
+    "Trace",
+    "current_trace",
+    "device_profile",
+    "configure_logging",
+    "JsonLogFormatter",
+    "set_log_cycle",
+]
 
 _active: list["Trace"] = []
 
+# The cycle number logs emitted "now" belong to — set by the controller at
+# the top of each cycle so the JSON formatter can stamp every line without
+# threading `extra=` through every logging call site.  A plain mutable cell:
+# one scheduler loop per process owns the write side.
+_log_cycle: list[int | None] = [None]
 
-def configure_logging(level: str = "INFO") -> None:
+
+def set_log_cycle(cycle: int | None) -> None:
+    _log_cycle[0] = cycle
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line: ts (epoch seconds), level, logger, msg,
+    and the current scheduling cycle when one is active (``set_log_cycle``).
+    A record carrying its own ``cycle`` attribute (``extra={"cycle": n}``)
+    wins over the ambient one."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj: dict = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        cycle = getattr(record, "cycle", None)
+        if cycle is None:
+            cycle = _log_cycle[0]
+        if cycle is not None:
+            obj["cycle"] = cycle
+        if record.exc_info:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj)
+
+
+def configure_logging(level: str = "INFO", fmt: str = "text") -> None:
     """Process-wide log init (the main.rs:129 equivalent), level configurable
-    — the reference hard-codes INFO."""
-    logging.basicConfig(
-        level=getattr(logging, level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    — the reference hard-codes both level and format.  ``fmt="json"`` emits
+    one JSON object per line for log pipelines; ``"text"`` keeps the
+    human-readable default."""
+    lvl = getattr(logging, level.upper(), logging.INFO)
+    if fmt == "json":
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonLogFormatter())
+        logging.basicConfig(level=lvl, handlers=[handler], force=True)
+    elif fmt == "text":
+        logging.basicConfig(
+            level=lvl,
+            format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        )
+    else:
+        raise ValueError(f"unknown log format {fmt!r} (expected 'text' or 'json')")
 
 
 class Trace:
     """Accumulates named span durations (seconds) for one scope (e.g. one
-    scheduling cycle)."""
+    scheduling cycle), plus the span INTERVALS in wall-clock time — the
+    flight recorder's Chrome-trace source.  Intervals are derived from
+    perf_counter deltas re-anchored to wall time at construction, so they
+    are monotonic within the trace and meaningful across cycles."""
 
     def __init__(self):
         self.durations: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self.events: list[tuple[str, float, float]] = []  # (name, wall_start, wall_end)
+        self._wall0 = time.time()
+        self._perf0 = time.perf_counter()
 
-    def record(self, name: str, seconds: float) -> None:
+    def _wall(self, perf_t: float) -> float:
+        return self._wall0 + (perf_t - self._perf0)
+
+    def record(self, name: str, seconds: float, perf_start: float | None = None) -> None:
+        """Record a span.  ``perf_start`` (a perf_counter stamp) gives the
+        exact interval; without it the interval is synthesized as ending now
+        — the overlapped-bind drain knows only its duration, and an
+        approximate box in the trace beats an invisible one."""
         self.durations[name] += seconds
         self.counts[name] += 1
+        end = time.perf_counter() if perf_start is None else perf_start + seconds
+        start = end - seconds
+        self.events.append((name, self._wall(start), self._wall(end)))
 
     def __enter__(self) -> "Trace":
         _active.append(self)
@@ -66,7 +139,7 @@ def span(name: str):
         dt = time.perf_counter() - t0
         tr = current_trace()
         if tr is not None:
-            tr.record(name, dt)
+            tr.record(name, dt, perf_start=t0)
         logger.debug("span %s took %.6fs", name, dt)
 
 
