@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: check lint test native bench clean
+.PHONY: check lint test native bench sim-smoke clean
 
 check: lint test
 
@@ -14,6 +14,12 @@ lint:
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# The tier-1 simulation gate: one seeded scenario (~2k pods × 200 nodes,
+# node churn + an api-brownout window) must finish green on CPU — the same
+# contract tests/test_sim.py pins, runnable standalone for a quick verdict.
+sim-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tpu_scheduler.cli sim --scenario sim-smoke --seed 0
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

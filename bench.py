@@ -543,11 +543,75 @@ print(json.dumps({{"cpu1_seconds": round(one, 4), "cpu_dp4tp2_seconds": round(ei
         return {}
 
 
-def previous_round_value(repo_dir: str, metric: str) -> tuple[float, str] | None:
+def provenance(platform: str) -> dict:
+    """Provenance stamped into EVERY bench output row: the platform that
+    actually ran, the jax version, and the git sha — so two artifacts can
+    never be compared apples-to-oranges without it showing (the BENCH_r05
+    CPU-vs-TPU ambiguity VERDICT.md calls out)."""
+    import subprocess
+
+    out = {"platform": platform}
+    try:
+        import jax
+
+        out["jax_version"] = jax.__version__
+    except Exception:  # noqa: BLE001 — provenance is best-effort, never fatal
+        out["jax_version"] = None
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+        out["git_sha"] = sha or None
+    except Exception:  # noqa: BLE001
+        out["git_sha"] = None
+    return out
+
+
+def sim_row(seed: int) -> dict:
+    """End-to-end SIMULATION mode (tpu_scheduler/sim): the sim-smoke
+    scenario — ~2k pods over 200 churning nodes through an api-brownout —
+    run to its scorecard.  Virtual-time SLOs are the evidence (p99
+    time-to-bind under chaos); ``sim_wall_seconds`` is the harness cost.
+    Deterministic in the seed, so this row is bit-reproducible."""
+    import time as _time
+
+    try:
+        from tpu_scheduler.sim import run_scenario
+
+        t0 = _time.perf_counter()
+        card = run_scenario("sim-smoke", seed=seed)
+        wall = _time.perf_counter() - t0
+        log(
+            f"sim-smoke (seed {seed}): {wall:.1f}s wall for {card['virtual_seconds']}s virtual, "
+            f"{card['pods']['bound_total']} bound, p99 ttb {card['slo']['p99_time_to_bind_s']}s, pass={card['pass']}"
+        )
+        return {
+            "sim_scenario": card["scenario"],
+            "sim_pass": card["pass"],
+            "sim_wall_seconds": round(wall, 2),
+            "sim_virtual_seconds": card["virtual_seconds"],
+            "sim_cycles": card["cycles"],
+            "sim_bound": card["pods"]["bound_total"],
+            "sim_p50_ttb_s": card["slo"]["p50_time_to_bind_s"],
+            "sim_p99_ttb_s": card["slo"]["p99_time_to_bind_s"],
+            "sim_fingerprint": card["fingerprint"][:16],
+        }
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"sim row skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
+def previous_round_value(repo_dir: str, metric: str, platform: str) -> tuple[float, str] | None:
     """(value, source-file) of the newest BENCH_r*.json carrying the same
-    metric on the TPU platform — the cross-round regression baseline
+    metric on the SAME platform — the cross-round regression baseline
     (VERDICT r4 #7: a 10-15% regression is invisible inside ±25% tunnel
-    noise without an explicit cross-round comparison)."""
+    noise without an explicit cross-round comparison).  Platform-mismatched
+    records are never comparable (a CPU-degraded row vs a TPU record is
+    apples/oranges — the BENCH_r05 ambiguity), so they are skipped."""
     import glob
     import re
 
@@ -561,7 +625,7 @@ def previous_round_value(repo_dir: str, metric: str) -> tuple[float, str] | None
                 parsed = json.load(f).get("parsed") or {}
         except (OSError, ValueError):
             continue
-        if parsed.get("metric") != metric or parsed.get("platform") != "tpu":
+        if parsed.get("metric") != metric or parsed.get("platform") != platform:
             continue
         n = int(m.group(1))
         # Prefer the min stat when the prior round recorded one.
@@ -574,11 +638,11 @@ def previous_round_value(repo_dir: str, metric: str) -> tuple[float, str] | None
 def apply_regression_check(out: dict, platform: str, repo_dir: str, threshold: float | None) -> bool:
     """Fold the cross-round comparison fields into ``out``; True when the
     gate (``threshold``, make bench's 1.3x) fires.  Compared on the
-    min-of-repeats — the median carries the tunnel's ±25% noise — and only
-    for on-chip runs (a CPU-degraded row vs a TPU record is apples/oranges)."""
-    if platform != "tpu":
-        return False
-    prev = previous_round_value(repo_dir, out["metric"])
+    min-of-repeats — the median carries the tunnel's ±25% noise — and
+    STRICTLY same-platform: ``previous_round_value`` refuses records whose
+    stamped platform differs from this run's, so ``regression_vs_prev``
+    can never silently compare a CPU-degraded row against a TPU record."""
+    prev = previous_round_value(repo_dir, out["metric"], platform)
     if prev is None:
         return False
     prev_val, prev_src = prev
@@ -586,6 +650,7 @@ def apply_regression_check(out: dict, platform: str, repo_dir: str, threshold: f
     ratio = val / prev_val if prev_val > 0 else 0.0
     out["prev_round_value"] = prev_val
     out["prev_round_source"] = prev_src
+    out["prev_round_platform"] = platform
     out["regression_vs_prev"] = round(ratio, 3)
     if threshold is not None and ratio > threshold:
         log(f"REGRESSION: value_min {val}s is {ratio:.2f}x the {prev_src} record ({prev_val}s), over the {threshold}x gate")
@@ -614,6 +679,7 @@ def main() -> int:
     ap.add_argument("--no-sharded-row", action="store_true")
     ap.add_argument("--no-constrained-row", action="store_true")
     ap.add_argument("--no-e2e-row", action="store_true")
+    ap.add_argument("--no-sim-row", action="store_true")
     ap.add_argument("--force-cpu", action="store_true", help="testing: skip the TPU entirely")
     ap.add_argument(
         "--fail-regression-threshold",
@@ -683,7 +749,7 @@ def main() -> int:
         "value": round(value, 4),
         "unit": "s",
         "vs_baseline": round(args.target_seconds / value, 2),
-        "platform": platform,
+        **provenance(platform),
         # Honest flag: the kernel must have EXECUTED (first-use guard may
         # downgrade to jnp while use_pallas is still armed).
         "pallas": bool(getattr(backend, "_pallas_proven", False)),
@@ -710,6 +776,10 @@ def main() -> int:
     if not args.no_e2e_row and _remaining() > (500 if platform == "tpu" else 120):
         ep, en = (used_pods, used_nodes) if platform == "tpu" else (min(used_pods, 10_000), min(used_nodes, 1_000))
         out.update(e2e_row(backend, profile, ep, en, args.seed))
+    # Simulation mode (sim-smoke scenario): chaos-resilience SLOs in virtual
+    # time — cheap (seconds of wall), deterministic in the seed.
+    if not args.no_sim_row and _remaining() > 120:
+        out.update(sim_row(args.seed))
     if not args.no_sharded_row and _remaining() > 120:
         row = sharded_scaling_row(8192, 512, args.seed)
         if row:

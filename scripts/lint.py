@@ -22,6 +22,12 @@ linters the image cannot run):
         that does not appear in the README metric catalogue — the docs
         drift gate for the Observability section (a metric added without
         cataloguing it would otherwise rot the docs silently)
+  SIMC  simulator catalogue drift (same pattern as METR, for the
+        "Simulation & chaos" README section): every registered scenario
+        name (``Scenario(name=...)`` in sim/scenarios.py), every chaos knob
+        (``ChaosConfig``/``ChaosWindow`` dataclass field), and every
+        scorecard top-level field (``SCORECARD_FIELDS``) must appear in
+        README.md
   W291  trailing whitespace / W191 tabs in indentation
   E999  syntax errors (via ast.parse)
 
@@ -289,6 +295,43 @@ def main(argv: list[str]) -> int:
         if name not in readme:
             errors.append(
                 f"README.md:1: METR metric '{name}' is used in tpu_scheduler/ but missing from the README metric catalogue"
+            )
+
+    # SIMC: the simulator's scenario registry, chaos knobs, and scorecard
+    # schema must be catalogued in the README "Simulation & chaos" section.
+    sim_catalogue: list[tuple[str, str]] = []  # (kind, name)
+    for f, tree in trees.items():
+        rel = f.relative_to(ROOT)
+        if rel.parts[:2] != ("tpu_scheduler", "sim"):
+            continue
+        if f.name == "scenarios.py":
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "Scenario"
+                ):
+                    for kw in node.keywords:
+                        if kw.arg == "name" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                            sim_catalogue.append(("scenario", kw.value.value))
+        elif f.name == "chaos.py":
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef) and node.name in ("ChaosConfig", "ChaosWindow"):
+                    for stmt in node.body:
+                        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                            sim_catalogue.append(("chaos knob", stmt.target.id))
+        elif f.name == "scorecard.py":
+            for node in tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "SCORECARD_FIELDS" and isinstance(node.value, (ast.Tuple, ast.List)):
+                            for e in node.value.elts:
+                                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                                    sim_catalogue.append(("scorecard field", e.value))
+    for kind, name in sorted(set(sim_catalogue)):
+        if name not in readme:
+            errors.append(
+                f"README.md:1: SIMC {kind} '{name}' exists in tpu_scheduler/sim/ but is missing from the README \"Simulation & chaos\" catalogue"
             )
 
     for e in sorted(errors):

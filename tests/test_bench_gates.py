@@ -99,9 +99,11 @@ def test_regression_baseline_picks_newest_matching_round(tmp_path):
     _write_record(tmp_path, 3, m, 0.40)
     _write_record(tmp_path, 4, m, 0.30, value_min=0.25)  # newest: min preferred
     _write_record(tmp_path, 5, "sched_cycle_seconds_25000x5000", 0.1)  # other metric: ignored
-    val, src = bench.previous_round_value(str(tmp_path), m)
+    val, src = bench.previous_round_value(str(tmp_path), m, "tpu")
     assert val == 0.25 and src == "BENCH_r04.json"
-    assert bench.previous_round_value(str(tmp_path), "nope") is None
+    assert bench.previous_round_value(str(tmp_path), "nope", "tpu") is None
+    # Same metric, mismatched platform: never comparable (BENCH_r05 lesson).
+    assert bench.previous_round_value(str(tmp_path), m, "cpu") is None
 
 
 def test_regression_gate_fires_and_annotates(tmp_path):

@@ -75,3 +75,98 @@ def test_binding_fault_injection():
     # Next attempt succeeds.
     api.create_binding("default", "p1", ObjectReference(name="n1"))
     assert api.binding_count == 2
+
+
+# --- watch_since under churn: history overflow -> 410 -> relist --------------
+#
+# The sim's node-flap scenario leans on this path: a client that falls
+# behind a churn storm must get a CLEAN 410, relist, and end up with the
+# exact server state — no missed bindings, no duplicated binding events.
+
+
+class _RelistingClient:
+    """Minimal kube-reflector client over watch_since + list_pods_with_rv —
+    the same contract runtime/http_api.py's HttpWatch implements."""
+
+    def __init__(self, api):
+        self.api = api
+        self.store = {}
+        self.rv = None
+        self.relists = 0
+        self.binding_events = []  # pod names whose bind arrived as MODIFIED
+
+    def sync(self):
+        if self.rv is None:
+            pods, self.rv = self.api.list_pods_with_rv()
+            self.store = {p.metadata.name: p for p in pods}
+            self.relists += 1
+            return
+        try:
+            events, self.rv = self.api.watch_since("Pod", self.rv)
+        except ApiError as e:
+            assert e.code == 410, f"expected a clean 410, got {e}"
+            self.rv = None
+            return self.sync()
+        for ev in events:
+            name = ev.object.metadata.name
+            if ev.type == "DELETED":
+                self.store.pop(name, None)
+                continue
+            prev = self.store.get(name)
+            newly_bound = (
+                ev.object.spec is not None
+                and ev.object.spec.node_name
+                and (prev is None or prev.spec is None or not prev.spec.node_name)
+            )
+            if ev.type == "MODIFIED" and newly_bound:
+                self.binding_events.append(name)
+            self.store[name] = ev.object
+
+
+def test_watch_since_overflow_mid_watch_relists_cleanly():
+    """Overflow watch_history between polls; the client must see 410 →
+    relist → exact final state, with every binding observed exactly once
+    (via event or relist), never duplicated."""
+    api = FakeApiServer(watch_history=16)  # tiny: overflows fast
+    for i in range(4):
+        api.create_node(make_node(f"n{i}", cpu=64, memory="256Gi"))
+    client = _RelistingClient(api)
+    client.sync()  # initial list at rv
+
+    seq = 0
+    for wave in range(6):
+        # Churn far past the retained history between client polls.
+        created = []
+        for _ in range(40):
+            name = f"p{seq}"
+            seq += 1
+            api.create_pod(make_pod(name))
+            created.append(name)
+        for name in created[::2]:
+            api.create_binding("default", name, ObjectReference(name=f"n{wave % 4}"))
+        for name in created[1::4]:
+            api.delete_pod("default", name)
+        client.sync()
+
+    assert client.relists >= 2  # the overflow really forced 410 relists
+    # No missed state: the client's view IS the server's view.
+    server = {p.metadata.name: (p.spec.node_name if p.spec else None) for p in api.list_pods()}
+    client_view = {name: (p.spec.node_name if p.spec else None) for name, p in client.store.items()}
+    assert client_view == server
+    # No duplicated bindings: a pod's bind arrives as at most ONE event.
+    assert len(client.binding_events) == len(set(client.binding_events))
+
+
+def test_watch_since_boundary_rv_exact_oldest():
+    """A client exactly at the trim boundary (rv == oldest retained - 1)
+    still gets the full retained suffix, not a 410."""
+    api = FakeApiServer(watch_history=8)
+    api.create_node(make_node("n1"))
+    for i in range(40):
+        api.create_pod(make_pod(f"q{i}"))
+    oldest = api._events_log[0][0]
+    events, rv = api.watch_since("Pod", oldest - 1)
+    assert rv == api.latest_rv
+    assert len(events) == len(api._events_log)
+    with pytest.raises(ApiError, match="410"):
+        api.watch_since("Pod", oldest - 2)

@@ -186,10 +186,15 @@ def test_daemon_mode_stop_event():
 def test_until_settled_does_not_settle_on_unhealthy_watch():
     """A transient watch outage at startup must not produce a silent
     'settled, bound nothing' exit-0 — the loop rides out the backoff and
-    schedules once the watch recovers."""
-    api = FakeApiServer()
+    schedules once the watch recovers.  Backoff waits ride the sim's
+    VirtualClock (clock + sleep injected), so the 0.5 s initial watch
+    backoff costs zero wall time and the assertions stay exact."""
+    from tpu_scheduler.sim import VirtualClock
+
+    clock = VirtualClock()
+    api = FakeApiServer(clock=clock)
     api.load(nodes=[make_node("n1")], pods=[make_pod("p1")])
-    sched = Scheduler(api, NativeBackend())
+    sched = Scheduler(api, NativeBackend(), clock=clock)
     real_watch = sched.reflector.pods._watch
     state = {"fails": 2}
 
@@ -201,10 +206,9 @@ def test_until_settled_does_not_settle_on_unhealthy_watch():
             return real_watch.poll()
 
     sched.reflector.pods._watch = Flaky()
-    # Fake sleep that advances the reflector's real monotonic clock cannot
-    # work here; instead rely on the short default backoff (0.5s initial).
-    out = sched.run(until_settled=True)
+    out = sched.run(until_settled=True, sleep=clock.sleep)
     assert sum(m.bound for m in out) == 1  # p1 scheduled after recovery
+    assert clock.now > 0.0  # the backoff windows were ridden out virtually
 
 
 def test_until_settled_raises_on_persistent_outage():
@@ -241,13 +245,19 @@ def test_daemon_history_bounded():
 def test_scheduler_survives_api_server_restart():
     """Kill the HTTP server under a live scheduler; it must keep cycling on
     last-known state (watch errors → metrics), then resume binding when a
-    server comes back on the same port."""
+    server comes back on the same port.  The scheduler runs on a
+    VirtualClock, so the reflector backoff windows between cycles are
+    advanced virtually instead of slept (was ~0.4 s + up to 2.5 s of real
+    sleeps riding out real backoff)."""
+    from tpu_scheduler.sim import VirtualClock
+
+    clock = VirtualClock()
     api = FakeApiServer()
     api.load(nodes=[make_node("n1", cpu=32, memory="64Gi")], pods=[make_pod("p1")])
     server = HttpApiServer(api).start()
     host, port = server.address
     client = KubeApiClient(server.base_url)
-    sched = Scheduler(RemoteApiAdapter(client), NativeBackend())
+    sched = Scheduler(RemoteApiAdapter(client), NativeBackend(), clock=clock)
 
     m1 = sched.run_cycle()
     assert m1.bound == 1
@@ -261,25 +271,22 @@ def test_scheduler_survives_api_server_restart():
     # one cycle must record an error.)
     for _ in range(3):
         sched.run_cycle()
-        import time
-
-        time.sleep(0.12)
+        clock.advance(1.0)  # let the backoff window open virtually
     assert sched.metrics.snapshot().get("scheduler_watch_errors_total", 0) >= 1
 
     # Server returns on the same port with the (shared) cluster state.
     server2 = HttpApiServer(api, port=port).start()
     try:
-        # Backoff window may still be open; give it a couple of attempts.
+        # Backoff grows toward backoff_max (30 s virtual); advancing a
+        # virtual second per cycle guarantees a retry within the budget.
         deadline_cycles = 50
         bound = 0
-        import time
-
         for _ in range(deadline_cycles):
             m = sched.run_cycle()
             bound += m.bound
             if bound:
                 break
-            time.sleep(0.05)
+            clock.advance(1.0)
         assert bound == 1  # p2 got bound after recovery
         assert {p.spec.node_name for p in api.list_pods() if p.spec.node_name} == {"n1"}
     finally:

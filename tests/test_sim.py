@@ -1,0 +1,232 @@
+"""Deterministic cluster simulator + chaos harness (tpu_scheduler/sim/).
+
+Pins the subsystem's four contracts:
+  • determinism — same (scenario, seed) → identical binding sequence and
+    byte-identical scorecard JSON, in-process and across CLI subprocesses
+  • record/replay — a recorded trace replays bit-identically (fingerprint)
+  • chaos recovery — injected faults delay work, never lose it
+  • the sim-smoke gate — ~2k pods × 200 nodes with node churn AND an
+    api-brownout window finishes green with invariants I1–I4 passing and
+    zero pods lost or double-bound (the tier-1 acceptance scenario)
+"""
+
+import json
+import logging
+
+import pytest
+
+from tpu_scheduler.sim import (
+    ChaosApiServer,
+    ChaosConfig,
+    ChaosWindow,
+    Scenario,
+    VirtualClock,
+    WorkloadSpec,
+    run_scenario,
+)
+from tpu_scheduler.sim.harness import ReplayMismatchError
+from tpu_scheduler.sim.scenarios import SCENARIOS
+from tpu_scheduler.sim.scorecard import SCORECARD_FIELDS
+from tpu_scheduler.sim.workload import generate_events
+
+logging.getLogger("tpu_scheduler").setLevel(logging.ERROR)
+
+
+# A tiny scenario for the fast contract tests (unregistered on purpose —
+# the registry is the documented catalogue; tests may run ad-hoc shapes).
+def _mini(chaos: ChaosConfig = ChaosConfig(), **wl) -> Scenario:
+    spec = dict(initial_nodes=6, arrival_rate=4.0, lifetime_mean_s=6.0, gang_fraction=0.2)
+    spec.update(wl)
+    return Scenario(name="mini", description="test-only", duration=12.0, workload=WorkloadSpec(**spec), chaos=chaos)
+
+
+# --- VirtualClock ------------------------------------------------------------
+
+
+def test_virtual_clock_fires_events_in_order():
+    clock = VirtualClock()
+    fired = []
+    clock.schedule(5.0, lambda: fired.append(("b", clock.now)))
+    clock.schedule(2.0, lambda: fired.append(("a", clock.now)))
+    clock.schedule(2.0, lambda: fired.append(("a2", clock.now)))  # FIFO tie-break
+    clock.advance(4.0)
+    assert fired == [("a", 2.0), ("a2", 2.0)]
+    assert clock() == 4.0
+    clock.sleep(10.0)
+    assert fired[-1] == ("b", 5.0)
+    assert clock.now == 14.0
+
+
+def test_virtual_clock_callbacks_can_reschedule():
+    clock = VirtualClock()
+    fired = []
+
+    def tick():
+        fired.append(clock.now)
+        if clock.now < 3.0:
+            clock.schedule_in(1.0, tick)
+
+    clock.schedule(1.0, tick)
+    clock.advance_to(10.0)
+    assert fired == [1.0, 2.0, 3.0]
+    with pytest.raises(ValueError):
+        clock.advance_to(5.0)  # time never moves backwards
+
+
+# --- chaos layer -------------------------------------------------------------
+
+
+def test_chaos_binding_errors_delay_but_never_lose_pods():
+    chaos = ChaosConfig(windows=(ChaosWindow(start=0.0, end=6.0, binding_error_rate=0.6),))
+    card = run_scenario(_mini(chaos, gang_fraction=0.0), seed=3)
+    assert card["pass"], card["invariants"]
+    assert card["chaos_injected"].get("bind-500", 0) > 0
+    assert card["pods"]["lost"] == 0
+    assert card["pods"]["bound_total"] == card["pods"]["arrived"]  # all eventually bound
+    assert card["slo"]["requeues"] > 0  # the 500s really cost retries
+
+
+def test_chaos_watch_faults_surface_as_watch_errors():
+    chaos = ChaosConfig(watch_drop_rate=0.4, watch_gone_rate=0.2)
+    card = run_scenario(_mini(chaos), seed=4)
+    assert card["pass"], card["invariants"]
+    assert card["slo"]["watch_errors"] > 0
+    drops = sum(v for k, v in card["chaos_injected"].items() if k.startswith("watch-"))
+    assert drops > 0
+
+
+def test_chaos_window_rates_override_base():
+    cfg = ChaosConfig(binding_error_rate=0.1, windows=(ChaosWindow(start=10.0, end=20.0, binding_error_rate=0.9),))
+    assert cfg.rate("binding_error_rate", 5.0) == 0.1
+    assert cfg.rate("binding_error_rate", 15.0) == 0.9
+    assert cfg.rate("binding_error_rate", 20.0) == 0.1  # end-exclusive
+
+
+def test_chaos_proxy_is_transparent_for_unfaulted_calls():
+    from tpu_scheduler.runtime.fake_api import FakeApiServer
+    from tpu_scheduler.testing import make_node
+
+    inner = FakeApiServer()
+    chaos = ChaosApiServer(inner)
+    chaos.create_node(make_node("n1"))
+    assert [n.name for n in chaos.list_nodes()] == ["n1"]
+    assert chaos.latest_rv == inner.latest_rv
+
+
+# --- determinism -------------------------------------------------------------
+
+
+def test_same_seed_same_scorecard_and_fingerprint():
+    sc = _mini(ChaosConfig(watch_drop_rate=0.1, windows=(ChaosWindow(start=3.0, end=8.0, binding_error_rate=0.4),)),
+               node_flap_rate=0.1, node_fail_rate=0.05)
+    c1 = run_scenario(sc, seed=1)
+    c2 = run_scenario(sc, seed=1)
+    assert json.dumps(c1, sort_keys=True) == json.dumps(c2, sort_keys=True)
+    c3 = run_scenario(sc, seed=2)
+    assert c3["fingerprint"] != c1["fingerprint"]  # the seed is the address
+
+
+def test_workload_generation_is_pure_in_seed():
+    import random
+
+    spec = WorkloadSpec(arrival_rate=5.0, gang_fraction=0.3, node_flap_rate=0.2, bursts=((3.0, 10),))
+    e1 = generate_events(spec, 20.0, random.Random("s"))
+    e2 = generate_events(spec, 20.0, random.Random("s"))
+    assert e1 == e2
+    assert any(ev.kind == "pods" for ev in e1)
+    assert all(e1[i].t <= e1[i + 1].t for i in range(len(e1) - 1))
+
+
+# --- record / replay ---------------------------------------------------------
+
+
+def test_record_then_replay_is_bit_identical(tmp_path):
+    # binding_latency_s matters here: latency advances the clock mid-cycle,
+    # so replay only stays aligned if trace timestamps are exact floats
+    # (a rounded-up action time defers the op a whole cycle and diverges).
+    sc = _mini(ChaosConfig(watch_drop_rate=0.1, binding_latency_s=0.002,
+                           windows=(ChaosWindow(start=3.0, end=8.0, binding_error_rate=0.4),)),
+               node_flap_rate=0.1)
+    path = str(tmp_path / "trace.jsonl")
+    registered = SCENARIOS.setdefault("mini", sc)  # replay resolves via the registry
+    try:
+        c1 = run_scenario(sc, seed=5, record=path)
+        c2 = run_scenario(None, replay=path)  # raises ReplayMismatchError on divergence
+    finally:
+        if registered is sc:
+            del SCENARIOS["mini"]
+    assert c2["mode"] == "replay" and c1["mode"] == "live"
+    assert c1["fingerprint"] == c2["fingerprint"]
+    d1 = {k: v for k, v in c1.items() if k != "mode"}
+    d2 = {k: v for k, v in c2.items() if k != "mode"}
+    assert json.dumps(d1, sort_keys=True) == json.dumps(d2, sort_keys=True)
+    # The trace carries the full stream: header, actions, chaos, footer.
+    kinds = {json.loads(ln)["type"] for ln in open(path)}
+    assert kinds == {"header", "action", "chaos", "cycle", "footer"}
+
+
+def test_replay_detects_tampered_trace(tmp_path):
+    sc = _mini()
+    path = str(tmp_path / "trace.jsonl")
+    registered = SCENARIOS.setdefault("mini", sc)
+    try:
+        run_scenario(sc, seed=6, record=path)
+        lines = open(path).read().splitlines()
+        # Drop one recorded pod arrival: the replayed run must not silently
+        # produce a different world that still "passes".
+        victim = next(i for i, ln in enumerate(lines) if '"create_pod"' in ln)
+        del lines[victim]
+        open(path, "w").write("\n".join(lines) + "\n")
+        with pytest.raises((ReplayMismatchError, RuntimeError)):
+            run_scenario(None, replay=path)
+    finally:
+        if registered is sc:
+            del SCENARIOS["mini"]
+
+
+# --- the tier-1 acceptance scenario -----------------------------------------
+
+
+def test_sim_smoke_green_with_churn_and_brownout():
+    """ISSUE acceptance: sim-smoke (~2k pods × 200 nodes, node churn + an
+    api-brownout window) finishes with I1–I4 passing and zero pods lost or
+    double-bound."""
+    card = run_scenario("sim-smoke", seed=0)
+    assert tuple(card) == SCORECARD_FIELDS
+    assert card["pass"], json.dumps(card["invariants"], indent=2)
+    assert card["pods"]["arrived"] >= 2000
+    assert card["pods"]["lost"] == 0 and card["pods"]["double_bound"] == 0
+    inv = card["invariants"]
+    assert inv["capacity"]["ok"] and inv["predicates"]["ok"] and inv["gangs"]["ok"] and inv["selectors"]["ok"]
+    # The chaos window and the churn both actually happened.
+    assert card["chaos_injected"].get("bind-500", 0) > 0
+    assert card["pods"]["churn_recreated"] > 0
+    assert card["slo"]["p99_time_to_bind_s"] >= card["slo"]["p50_time_to_bind_s"] > 0
+
+
+def test_scenario_registry_complete():
+    expected = {"steady-state", "burst-storm", "node-flap", "api-brownout", "gang-heavy", "sim-smoke"}
+    assert expected <= set(SCENARIOS)
+    for sc in SCENARIOS.values():
+        assert sc.duration > 0 and sc.cycle_interval > 0 and sc.description
+
+
+def test_cli_sim_subcommand(capsys):
+    from tpu_scheduler.cli import main
+
+    rc = main(["sim", "--scenario", "sim-smoke", "--seed", "0"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    card = json.loads(out)
+    assert rc == 0 and card["pass"] and card["scenario"] == "sim-smoke"
+
+
+# --- long scenarios (excluded from tier-1) -----------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["steady-state", "burst-storm", "node-flap", "api-brownout", "gang-heavy"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_all_scenarios_pass(name, seed):
+    card = run_scenario(name, seed=seed)
+    assert card["pass"], f"{name} seed {seed}: {json.dumps(card['invariants'])}"
+    assert card["pods"]["lost"] == 0 and card["pods"]["double_bound"] == 0

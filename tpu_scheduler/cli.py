@@ -120,6 +120,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "sim":
+        # Deterministic cluster simulator + chaos harness (sim/):
+        #   python -m tpu_scheduler.cli sim --scenario burst-storm --seed 3
+        from .sim.cli import main as sim_main
+
+        return sim_main(argv[1:])
     args = build_parser().parse_args(argv)
     configure_logging(args.log_level, args.log_format)
 

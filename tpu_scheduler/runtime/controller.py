@@ -197,7 +197,10 @@ class Scheduler:
         unknown = set(self.constraint_budgets) - {"max_aa_terms", "max_spread", "max_coarse_domains"}
         if unknown:
             raise ValueError(f"unknown constraint_budgets keys: {sorted(unknown)}")
-        self.reflector = ClusterReflector(api, clock=clock)
+        # The scheduler rng also seeds the reflectors' backoff jitter: one
+        # seed makes a whole run (sample draws + watch-recovery timing)
+        # reproducible — the simulator's determinism contract (sim/).
+        self.reflector = ClusterReflector(api, clock=clock, rng=self.rng)
         self.metrics = MetricsRegistry()
         # Flight recorder (utils/events.py): bounded per-pod decision
         # timelines + cycle ring, served by /debug; events_buffer=0 disables.

@@ -133,13 +133,17 @@ def _node_content_signature(node: Node) -> int:
 class ClusterReflector:
     """Node + pod reflectors combined into cycle snapshots."""
 
-    def __init__(self, api, clock=time.monotonic):
+    def __init__(self, api, clock=time.monotonic, rng: random.Random | None = None):
+        # ``rng`` seeds the backoff jitter of both reflectors — injectable so
+        # a simulated run (tpu_scheduler/sim) replays watch-failure recovery
+        # bit-identically; None keeps the decorrelated default.
         self.api = api
-        self.nodes = Reflector(api.watch_nodes(), key_fn=lambda n: n.name, clock=clock, on_event=self._node_event)
+        self.nodes = Reflector(api.watch_nodes(), key_fn=lambda n: n.name, clock=clock, rng=rng, on_event=self._node_event)
         self.pods = Reflector(
             api.watch_pods(),
             key_fn=lambda p: (p.metadata.namespace, p.metadata.name),
             clock=clock,
+            rng=rng,
             on_event=self._pod_event,
         )
         # name -> (node_obj, content_sig): per-object memo for the rv-less

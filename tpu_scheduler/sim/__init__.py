@@ -1,0 +1,46 @@
+"""Deterministic cluster simulator + chaos harness.
+
+The reference scheduler was built to be DRIVEN by a cluster simulator
+(acrlabs wrote it as the reference pod scheduler for SimKube-style
+experiments); this package delivers that substrate in-process: a seeded
+discrete-event simulation layered on the injectable-clock seams the runtime
+already carries (``FakeApiServer(clock=...)``, ``Scheduler(clock=...)``).
+
+Modules:
+  • ``clock``     — ``VirtualClock``: virtual time that advances to the next
+                    scheduled event instead of sleeping
+  • ``workload``  — seeded workload generator: Poisson/burst pod arrivals,
+                    gang jobs, priority tiers, pod lifetimes, node churn
+                    (add / drain / fail / flap), all from ONE rng seed
+  • ``chaos``     — ``ChaosApiServer``: a programmable fault layer wrapping
+                    ``FakeApiServer`` (binding 500s, binding latency, API
+                    errors, watch drops, 410 Gone storms, timed fault
+                    windows) — the generalization of the one-off
+                    ``fail_next_bindings`` hook and the tests' ``FlakyWatch``
+  • ``trace``     — JSONL record/replay of the applied event stream plus the
+                    chaos decision schedule (bit-identical replays)
+  • ``scorecard`` — the global invariants I1–I4 (tests/test_stress.py) plus
+                    virtual-time SLOs, emitted as one JSON verdict
+  • ``scenarios`` — the named scenario registry (steady-state, burst-storm,
+                    node-flap, api-brownout, gang-heavy, sim-smoke)
+  • ``harness``   — the discrete-event loop wiring all of the above around a
+                    real ``Scheduler``
+  • ``cli``       — ``python -m tpu_scheduler.cli sim --scenario X --seed N``
+"""
+
+from .chaos import ChaosApiServer, ChaosConfig, ChaosWindow
+from .clock import VirtualClock
+from .harness import run_scenario
+from .scenarios import SCENARIOS, Scenario
+from .workload import WorkloadSpec
+
+__all__ = [
+    "ChaosApiServer",
+    "ChaosConfig",
+    "ChaosWindow",
+    "VirtualClock",
+    "run_scenario",
+    "SCENARIOS",
+    "Scenario",
+    "WorkloadSpec",
+]

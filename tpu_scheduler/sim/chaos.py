@@ -1,0 +1,194 @@
+"""Programmable chaos layer over ``FakeApiServer``.
+
+Generalizes the runtime's one-off fault hooks — ``fail_next_bindings``
+(runtime/fake_api.py) and the tests' hand-rolled ``FlakyWatch`` — into one
+declarative, SEEDED fault surface the simulator (and any test) can drive:
+
+  • binding 500s (``CreateBindingFailed``) at a configurable rate
+  • virtual binding latency (advances a ``VirtualClock`` per POST — the
+    in-process twin of a slow apiserver)
+  • generic API errors on the scheduler-facing mutation/read endpoints
+    (``delete_pod`` evictions, ``list_pdbs``)
+  • watch drops (``ConnectionError``) and 410 Gone storms (``ApiError(410)``)
+    raised from ``poll()`` — events stay queued, exactly the FlakyWatch
+    contract, so the reflector's backoff-and-retry path is what recovers
+  • timed fault WINDOWS overriding any base rate over a virtual interval
+    (an api-brownout is one window; a flap storm is several)
+
+Every injection decision is drawn from one dedicated RNG in call order, and
+every decision is exposed through ``decision_log`` so a trace can replay
+the exact fault schedule bit-identically (sim/trace.py).  The wrapper is a
+transparent proxy (``__getattr__``) for everything it does not fault, so it
+drops into ``Scheduler(api=...)`` unchanged.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from ..errors import CreateBindingFailed
+from ..runtime.fake_api import ApiError, FakeApiServer
+
+__all__ = ["ChaosConfig", "ChaosWindow", "ChaosApiServer", "ChaosWatch"]
+
+
+@dataclass(frozen=True)
+class ChaosWindow:
+    """Rate overrides active during ``[start, end)`` virtual seconds; a
+    ``None`` field inherits the base ``ChaosConfig`` rate.  Later windows in
+    the tuple win where they overlap."""
+
+    start: float
+    end: float
+    binding_error_rate: float | None = None
+    binding_latency_s: float | None = None
+    api_error_rate: float | None = None
+    watch_drop_rate: float | None = None
+    watch_gone_rate: float | None = None
+
+
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Base fault rates (probability per call; latency in virtual seconds)."""
+
+    binding_error_rate: float = 0.0  # CreateBindingFailed per binding POST
+    binding_latency_s: float = 0.0  # virtual seconds added per successful POST
+    api_error_rate: float = 0.0  # ApiError(500) on delete_pod / list_pdbs
+    watch_drop_rate: float = 0.0  # poll() raises ConnectionError
+    watch_gone_rate: float = 0.0  # poll() raises ApiError(410) — Gone storm
+    windows: tuple[ChaosWindow, ...] = ()
+
+    def rate(self, name: str, t: float) -> float:
+        value = getattr(self, name)
+        for w in self.windows:
+            if w.start <= t < w.end:
+                override = getattr(w, name)
+                if override is not None:
+                    value = override
+        return value
+
+    @property
+    def any_faults(self) -> bool:
+        base = any(
+            getattr(self, f) > 0
+            for f in ("binding_error_rate", "binding_latency_s", "api_error_rate", "watch_drop_rate", "watch_gone_rate")
+        )
+        return base or bool(self.windows)
+
+
+class ChaosWatch:
+    """Watch proxy whose ``poll()`` may raise per the chaos schedule.  A
+    faulted poll leaves the underlying queue untouched (events are delayed,
+    never lost) — the same contract as the resilience tests' FlakyWatch,
+    which is what makes the reflector's backoff the recovery path."""
+
+    def __init__(self, chaos: "ChaosApiServer", inner, kind: str):
+        self._chaos = chaos
+        self._inner = inner
+        self._kind = kind
+
+    def poll(self):
+        if self._chaos._decide("watch_drop_rate", f"watch-drop:{self._kind}"):
+            raise ConnectionError(f"chaos: {self._kind} watch dropped")
+        if self._chaos._decide("watch_gone_rate", f"watch-gone:{self._kind}"):
+            raise ApiError(410, f"chaos: {self._kind} watch resourceVersion too old")
+        return self._inner.poll()
+
+    def close(self):
+        return self._inner.close()
+
+
+class ChaosApiServer:
+    """Fault-injecting proxy around a ``FakeApiServer`` (or compatible).
+
+    ``replay_decisions`` switches the layer from drawing its RNG to replaying
+    a recorded decision sequence verbatim (sim/trace.py) — the schedule is
+    then part of the trace, not a function of the config."""
+
+    def __init__(
+        self,
+        inner: FakeApiServer,
+        config: ChaosConfig | None = None,
+        rng: random.Random | None = None,
+        clock=None,
+        replay_decisions: list | None = None,
+    ):
+        self.inner = inner
+        self.config = config or ChaosConfig()
+        self.rng = rng or random.Random(0)
+        self.clock = clock or getattr(inner, "_clock", None) or (lambda: 0.0)
+        # Injection counters by kind — the scorecard's chaos evidence.
+        self.injected: dict[str, int] = {}
+        # Every rate draw, in call order: (endpoint, injected, latency).
+        self.decision_log: list[tuple[str, bool, float]] = []
+        self._replay = list(replay_decisions) if replay_decisions is not None else None
+        self._replay_pos = 0
+        # Deterministic observation stream: (virtual t, pod_full, node) per
+        # CONFIRMED binding — the harness's time-to-bind source and the
+        # run's determinism fingerprint material.
+        self.bind_log: list[tuple[float, str, str]] = []
+        # Scheduler-driven pod deletions that succeeded (preemption victims,
+        # NoExecute evictions) — sanctioned removals, not lost pods.
+        self.evict_log: list[tuple[float, str]] = []
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    # -- decisions ----------------------------------------------------------
+
+    def _decide(self, rate_name: str, endpoint: str) -> bool:
+        rate = self.config.rate(rate_name, self.clock())
+        if self._replay is not None:
+            if rate <= 0:
+                return False  # no draw happened at record time either
+            if self._replay_pos >= len(self._replay):
+                raise RuntimeError(f"chaos replay exhausted at {endpoint} (trace/config mismatch)")
+            ep, inject, _lat = self._replay[self._replay_pos]
+            if ep != endpoint:
+                raise RuntimeError(f"chaos replay diverged: expected {ep!r}, got {endpoint!r}")
+            self._replay_pos += 1
+            if inject:
+                self.injected[endpoint] = self.injected.get(endpoint, 0) + 1
+            return inject
+        if rate <= 0:
+            return False
+        inject = self.rng.random() < rate
+        self.decision_log.append((endpoint, inject, 0.0))
+        if inject:
+            self.injected[endpoint] = self.injected.get(endpoint, 0) + 1
+        return inject
+
+    def _latency(self) -> float:
+        return self.config.rate("binding_latency_s", self.clock())
+
+    # -- faulted endpoints --------------------------------------------------
+
+    def watch_nodes(self, *args, **kwargs) -> ChaosWatch:
+        return ChaosWatch(self, self.inner.watch_nodes(*args, **kwargs), "Node")
+
+    def watch_pods(self, *args, **kwargs) -> ChaosWatch:
+        return ChaosWatch(self, self.inner.watch_pods(*args, **kwargs), "Pod")
+
+    def create_binding(self, namespace: str, pod_name: str, target) -> None:
+        if self._decide("binding_error_rate", "bind-500"):
+            raise CreateBindingFailed(f"chaos: injected apiserver 500 binding {namespace}/{pod_name}")
+        lat = self._latency()
+        if lat > 0 and hasattr(self.clock, "advance"):
+            # Virtual POST latency: the cycle's own clock moves, so requeue
+            # deadlines and workload arrivals feel the slow apiserver.
+            self.clock.advance(lat)
+            self.injected["bind-latency"] = self.injected.get("bind-latency", 0) + 1
+        self.inner.create_binding(namespace, pod_name, target)
+        self.bind_log.append((round(self.clock(), 9), f"{namespace}/{pod_name}", target.name))
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        if self._decide("api_error_rate", "delete-500"):
+            raise ApiError(500, f"chaos: injected apiserver 500 deleting {namespace}/{name}")
+        self.inner.delete_pod(namespace, name)
+        self.evict_log.append((round(self.clock(), 9), f"{namespace}/{name}"))
+
+    def list_pdbs(self) -> list:
+        if self._decide("api_error_rate", "list-pdbs-500"):
+            raise ApiError(500, "chaos: injected apiserver 500 listing PDBs")
+        return self.inner.list_pdbs()

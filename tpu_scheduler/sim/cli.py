@@ -1,0 +1,71 @@
+"""``python -m tpu_scheduler.cli sim`` — the simulator's command surface.
+
+Runs one named scenario to its scorecard JSON (stdout, one line).  Exit
+codes: 0 = verdict passed, 1 = verdict failed (invariant violation, lost or
+double-bound pods), 3 = a ``--replay`` run diverged from its recorded
+fingerprint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..utils.tracing import configure_logging
+from .harness import ReplayMismatchError, run_scenario
+from .scenarios import SCENARIOS
+
+__all__ = ["main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-scheduler sim", description=__doc__)
+    p.add_argument("--scenario", default="sim-smoke", choices=sorted(SCENARIOS), help="named scenario (see --list)")
+    p.add_argument("--seed", type=int, default=0, help="the ONE seed every random choice derives from")
+    p.add_argument("--record", default=None, metavar="PATH", help="persist the run as a JSONL trace")
+    p.add_argument("--replay", default=None, metavar="PATH", help="re-run a recorded trace and verify bit-identity")
+    p.add_argument("--backend", choices=["native", "tpu"], default="native", help="scheduling backend under test")
+    p.add_argument("--events-buffer", type=int, default=4096, help="flight recorder capacity during the run")
+    p.add_argument("--log-level", default="WARNING")
+    p.add_argument("--list", action="store_true", help="list scenarios and exit")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    configure_logging(args.log_level, "text")
+    if args.list:
+        for name in sorted(SCENARIOS):
+            sc = SCENARIOS[name]
+            print(json.dumps({"scenario": name, "duration_s": sc.duration, "description": sc.description}))
+        return 0
+    if args.record and args.replay:
+        print("--record and --replay are mutually exclusive", file=sys.stderr)
+        return 2
+    if args.backend == "tpu":
+        from ..backends.tpu import TpuBackend
+
+        backend = TpuBackend()
+    else:
+        from ..backends.native import NativeBackend
+
+        backend = NativeBackend()
+    try:
+        card = run_scenario(
+            args.scenario,
+            seed=args.seed,
+            backend=backend,
+            record=args.record,
+            replay=args.replay,
+            events_buffer=args.events_buffer,
+        )
+    except ReplayMismatchError as e:
+        print(json.dumps({"replay_mismatch": True, "expected": e.expected, "got": e.got}))
+        return 3
+    print(json.dumps(card, sort_keys=True))
+    return 0 if card["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,407 @@
+"""The simulation harness — a real ``Scheduler`` driven by virtual time.
+
+One ``run_scenario`` call wires together:
+
+  ``VirtualClock``  →  ``FakeApiServer(clock=...)``  →  ``ChaosApiServer``
+                    →  ``Scheduler(clock=..., rng=seeded)``
+
+and runs the discrete-event loop: apply every workload op due at the
+current virtual time, run one scheduling cycle, fold the confirmed bindings
+(time-to-bind, completion scheduling), advance the clock one cycle
+interval.  Nothing sleeps; a 2-minute scenario with thousands of pods costs
+seconds of wall clock.
+
+Determinism: every randomness source is derived from the ONE scenario seed
+(workload, chaos, scheduler/reflector jitter), all bookkeeping iterates in
+sorted or insertion order, and the scorecard contains only virtual-time
+quantities — the same ``--scenario --seed`` pair produces an identical
+binding sequence and byte-identical scorecard JSON on every run.  With
+``record=...`` the resolved op stream + chaos decision schedule persist to
+JSONL (sim/trace.py); ``replay=...`` feeds them back and verifies the
+fingerprint bit-matches the recorded footer.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+
+from ..api.objects import is_pod_bound
+from ..backends.native import NativeBackend
+from ..models.profiles import DEFAULT_PROFILE
+from ..runtime.controller import Scheduler
+from ..runtime.fake_api import FakeApiServer
+from ..testing import make_node, make_pod
+from .chaos import ChaosApiServer
+from .clock import VirtualClock
+from .scenarios import SCENARIOS, Scenario
+from .scorecard import build_scorecard, check_invariants, fingerprint
+from .trace import TraceWriter, load_trace
+from .workload import generate_events, initial_nodes
+
+__all__ = ["run_scenario", "ReplayMismatchError"]
+
+
+class ReplayMismatchError(RuntimeError):
+    """A --replay run's fingerprint differs from the recorded footer."""
+
+    def __init__(self, expected: str, got: str):
+        super().__init__(f"replay fingerprint mismatch: recorded {expected[:16]}…, replayed {got[:16]}…")
+        self.expected = expected
+        self.got = got
+
+
+class _SimState:
+    """Run bookkeeping shared by record and replay paths."""
+
+    def __init__(self):
+        self.nodes: dict[str, dict] = {}  # live node name -> payload
+        self.arrival_t: dict[str, float] = {}
+        self.lifetime: dict[str, float] = {}
+        self.live: set[str] = set()  # created, not deleted
+        self.bound_live: set[str] = set()
+        self.bind_epoch: dict[str, int] = {}
+        self.gangs: dict[str, set[str]] = {}
+        self.disturbed_pods: set[str] = set()
+        self.disturbed_nodes: set[str] = set()
+        self.scheduled_names: set[str] = set()
+        self.counts = {"arrived": 0, "churn_recreated": 0, "completed": 0, "evicted": 0}
+        self.ttb: list[float] = []
+        self.double_bound = 0
+
+
+def _resolve_scenario(scenario: Scenario | str) -> Scenario:
+    if isinstance(scenario, Scenario):
+        return scenario
+    try:
+        return SCENARIOS[scenario]
+    except KeyError:
+        raise ValueError(f"unknown scenario {scenario!r} (known: {', '.join(sorted(SCENARIOS))})") from None
+
+
+def _node_obj(payload: dict, unschedulable: bool = False):
+    return make_node(
+        payload["name"],
+        cpu=payload["cpu"],
+        memory=f"{payload['mem_gi']}Gi",
+        labels={"zone": payload["zone"], "name": payload["name"]},
+        unschedulable=unschedulable,
+    )
+
+
+def _pod_obj(payload: dict):
+    return make_pod(
+        payload["name"],
+        cpu=f"{payload['cpu_m']}m",
+        memory=f"{payload['mem_mi']}Mi",
+        priority=payload.get("priority", 0),
+        labels={"app": payload.get("app", "app-0")},
+        node_selector={"zone": payload["zone"]} if payload.get("zone") else None,
+        gang=payload.get("gang"),
+    )
+
+
+def run_scenario(
+    scenario: Scenario | str,
+    seed: int = 0,
+    backend=None,
+    record: str | None = None,
+    replay: str | None = None,
+    events_buffer: int = 4096,
+) -> dict:
+    """Run one scenario to its verdict; returns the scorecard dict.
+
+    ``record`` persists the run as a JSONL trace; ``replay`` re-runs a trace
+    (its header names the scenario) and raises ``ReplayMismatchError`` if
+    the replayed fingerprint differs from the recorded one."""
+    replay_data = load_trace(replay) if replay else None
+    if replay_data is not None:
+        sc = _resolve_scenario(replay_data["header"]["scenario"])
+        seed = int(replay_data["header"]["seed"])
+    else:
+        sc = _resolve_scenario(scenario)
+
+    clock = VirtualClock()
+    inner = FakeApiServer(watch_history=sc.watch_history, clock=clock)
+    chaos = ChaosApiServer(
+        inner,
+        sc.chaos,
+        rng=random.Random(f"{seed}:chaos"),
+        clock=clock,
+        replay_decisions=replay_data["chaos"] if replay_data else None,
+    )
+    backend = backend or NativeBackend()
+    profile = DEFAULT_PROFILE.with_(preemption=True) if sc.preemption else DEFAULT_PROFILE
+    sched = Scheduler(
+        chaos,
+        backend,
+        profile=profile,
+        requeue_seconds=sc.requeue_seconds,
+        clock=clock,
+        rng=random.Random(f"{seed}:sched"),
+        events_buffer=events_buffer,
+    )
+
+    writer = TraceWriter(record) if record else None
+    if writer:
+        writer.header(sc.name, seed, backend.name)
+
+    st = _SimState()
+    # Timed internal ops (record mode only): completions + flap returns.
+    future: list[tuple[float, int, dict]] = []
+    fseq = 0
+
+    def push_future(t: float, op: dict) -> None:
+        nonlocal fseq
+        fseq += 1
+        heapq.heappush(future, (t, fseq, op))
+
+    # -- op application (the ONE mutation path; every applied op is traced) --
+
+    def apply_op(op: dict) -> None:
+        kind = op["op"]
+        now = clock.now
+        if kind == "create_pod":
+            p = op["pod"]
+            name = p["name"]
+            inner.create_pod(_pod_obj(p))
+            st.live.add(name)
+            st.scheduled_names.add(name)
+            # SLO clock starts at the event's nominal arrival ("at"), not at
+            # application: a pod arriving between cycles queues until the
+            # next one, and that queueing delay is real time-to-bind.
+            st.arrival_t[name] = float(op.get("at", now))
+            if p.get("lifetime_s"):
+                st.lifetime[name] = float(p["lifetime_s"])
+            if p.get("gang"):
+                st.gangs.setdefault(p["gang"], set()).add(name)
+            if op.get("churned"):
+                st.counts["churn_recreated"] += 1
+                st.disturbed_pods.add(name)
+            else:
+                st.counts["arrived"] += 1
+        elif kind == "delete_pod":
+            name = op["name"]
+            inner.delete_pod("default", name)
+            st.live.discard(name)
+            st.bound_live.discard(name)
+            if op.get("reason") == "completed":
+                st.counts["completed"] += 1
+        elif kind == "create_node":
+            payload = op["node"]
+            inner.create_node(_node_obj(payload))
+            st.nodes[payload["name"]] = payload
+        elif kind == "delete_node":
+            inner.delete_node(op["name"])
+            st.nodes.pop(op["name"], None)
+            st.disturbed_nodes.add(op["name"])
+        elif kind == "cordon":
+            payload = st.nodes[op["name"]]
+            inner.update_node(_node_obj(payload, unschedulable=True))
+            st.disturbed_nodes.add(op["name"])
+        else:
+            raise ValueError(f"unknown sim op {kind!r}")
+        if writer:
+            writer.action(now, op)
+
+    def evict_node_pods(node_name: str, recreate: bool) -> None:
+        """Delete every pod bound to the node; optionally re-arrive them as
+        fresh Pending pods (the ReplicaSet stand-in).  Sorted for
+        determinism; bindings in flight are impossible (single-threaded)."""
+        from ..api.objects import total_pod_resources
+
+        for pod in sorted(inner.list_pods(f"spec.nodeName={node_name}"), key=lambda p: p.metadata.name):
+            name = pod.metadata.name
+            req = total_pod_resources(pod)
+            spec = {
+                "name": name,
+                "cpu_m": int(req.cpu),  # PodResources carries millicores
+                "mem_mi": int(req.memory // (1 << 20)),
+                "priority": pod.spec.priority if pod.spec else 0,
+                "app": (pod.metadata.labels or {}).get("app", "app-0"),
+            }
+            if pod.spec is not None and pod.spec.gang:
+                spec["gang"] = pod.spec.gang
+            if pod.spec is not None and pod.spec.node_selector:
+                spec["zone"] = pod.spec.node_selector.get("zone")
+            if name in st.lifetime:
+                spec["lifetime_s"] = st.lifetime[name]
+            apply_op({"op": "delete_pod", "name": name, "reason": "churn"})
+            st.disturbed_pods.add(name)
+            if recreate:
+                apply_op({"op": "create_pod", "pod": spec, "churned": True})
+
+    def resolve_event(ev) -> None:
+        """Turn one generated workload event into concrete ops (record mode)."""
+        if ev.kind == "pods":
+            for p in ev.payload["pods"]:
+                apply_op({"op": "create_pod", "pod": p, "at": ev.t})
+            return
+        if ev.kind == "node-add":
+            if ev.payload["name"] not in st.nodes:
+                apply_op({"op": "create_node", "node": dict(ev.payload)})
+            return
+        # Node-targeting events resolve "pick" against the sorted live fleet.
+        names = sorted(st.nodes)
+        if not names:
+            return
+        target = names[int(ev.payload["pick"] * len(names)) % len(names)]
+        if ev.kind == "node-drain":
+            evict_node_pods(target, recreate=True)
+            apply_op({"op": "cordon", "name": target})
+        elif ev.kind == "node-fail":
+            evict_node_pods(target, recreate=True)
+            apply_op({"op": "delete_node", "name": target})
+        elif ev.kind == "node-flap":
+            payload = st.nodes[target]
+            evict_node_pods(target, recreate=True)
+            apply_op({"op": "delete_node", "name": target})
+            push_future(clock.now + float(ev.payload["down_s"]), {"op": "create_node", "node": payload})
+        else:
+            raise ValueError(f"unknown workload event {ev.kind!r}")
+
+    # -- initial fleet + event stream ---------------------------------------
+
+    if replay_data is not None:
+        actions = replay_data["actions"]
+        events = []
+    else:
+        actions = []
+        events = generate_events(sc.workload, sc.duration, random.Random(f"{seed}:workload"))
+        for payload in initial_nodes(sc.workload):
+            apply_op({"op": "create_node", "node": payload})
+    ai = ei = 0  # replay: actions (incl. the t=0 fleet) apply in the loop
+
+    # -- bind folding --------------------------------------------------------
+
+    bind_cursor = 0
+    evict_cursor = 0
+
+    def fold_outcomes() -> int:
+        """Fold chaos logs since the last cycle: time-to-bind, completion
+        scheduling, double-bind detection, sanctioned evictions."""
+        nonlocal bind_cursor, evict_cursor
+        new_binds = 0
+        for t, pod_full, _node in chaos.bind_log[bind_cursor:]:
+            name = pod_full.rpartition("/")[2]
+            if name in st.bound_live:
+                st.double_bound += 1
+            st.bound_live.add(name)
+            st.bind_epoch[name] = st.bind_epoch.get(name, 0) + 1
+            if name in st.arrival_t:
+                st.ttb.append(round(t - st.arrival_t[name], 9))
+            if replay_data is None and name in st.lifetime:
+                epoch = st.bind_epoch[name]
+                push_future(t + st.lifetime[name], {"op": "delete_pod", "name": name, "reason": "completed", "_epoch": epoch})
+            new_binds += 1
+        bind_cursor = len(chaos.bind_log)
+        for _t, pod_full in chaos.evict_log[evict_cursor:]:
+            name = pod_full.rpartition("/")[2]
+            if name in st.live:
+                st.live.discard(name)
+                st.bound_live.discard(name)
+                st.disturbed_pods.add(name)
+                st.counts["evicted"] += 1
+        evict_cursor = len(chaos.evict_log)
+        return new_binds
+
+    # -- the discrete-event loop --------------------------------------------
+
+    cycles = 0
+    no_progress = 0
+    hard_cap = int(3 * sc.duration / sc.cycle_interval) + 400
+    while True:
+        now = clock.now
+        if replay_data is not None:
+            while ai < len(actions) and actions[ai][0] <= now:
+                try:
+                    apply_op(actions[ai][1])
+                except Exception as e:
+                    # A recorded op that no longer applies means the trace is
+                    # corrupt or the world diverged — name it, don't 404.
+                    raise RuntimeError(
+                        f"trace replay diverged applying action {ai} ({actions[ai][1].get('op')!r}): {e}"
+                    ) from e
+                ai += 1
+        else:
+            while future and future[0][0] <= now:
+                _t, _s, op = heapq.heappop(future)
+                epoch = op.pop("_epoch", None)
+                if op["op"] == "delete_pod":
+                    name = op["name"]
+                    # A completion for an earlier life of the pod (churn
+                    # recreated it since) or a pod evicted meanwhile: skip.
+                    if name not in st.bound_live or (epoch is not None and st.bind_epoch.get(name) != epoch):
+                        continue
+                elif op["op"] == "create_node" and op["node"]["name"] in st.nodes:
+                    continue  # flap return raced a node-add; keep the live one
+                apply_op(op)
+            while ei < len(events) and events[ei].t <= now:
+                resolve_event(events[ei])
+                ei += 1
+
+        sched.run_cycle()
+        cycles += 1
+        new_binds = fold_outcomes()
+        pending = len(inner.list_pods("status.phase=Pending"))
+        if writer:
+            writer.cycle(clock.now, cycles, new_binds, pending)
+        no_progress = 0 if (new_binds or pending == 0) else no_progress + 1
+        if clock.now >= sc.duration:
+            events_done = (ai >= len(actions)) if replay_data is not None else (ei >= len(events))
+            if events_done and (pending == 0 or no_progress >= sc.drain_grace_cycles):
+                break
+        if cycles >= hard_cap:
+            break
+        clock.advance(sc.cycle_interval)
+
+    # -- verdict -------------------------------------------------------------
+
+    end_t = clock.now
+    api_pods = {p.metadata.name: p for p in inner.list_pods()}
+    lost = sorted(name for name in st.live if name not in api_pods)
+    pending_final = [p for p in api_pods.values() if p.status.phase == "Pending" and not is_pod_bound(p)]
+    backlog = sum(end_t - st.arrival_t[p.metadata.name] for p in pending_final if p.metadata.name in st.arrival_t)
+    pod_counts = {
+        **st.counts,
+        "bound_total": len(st.ttb),
+        "pending_final": len(pending_final),
+        "running_final": sum(1 for p in api_pods.values() if is_pod_bound(p)),
+        "lost": len(lost),
+        "lost_names": lost[:20],
+        "double_bound": st.double_bound,
+    }
+    invariants = check_invariants(inner, st.scheduled_names, st.disturbed_pods, st.disturbed_nodes, st.gangs)
+    placements = [
+        (p.metadata.name, p.spec.node_name) for p in api_pods.values() if p.spec is not None and p.spec.node_name
+    ]
+    fp = fingerprint(chaos.bind_log, placements)
+    card = build_scorecard(
+        scenario=sc.name,
+        seed=seed,
+        mode="replay" if replay_data is not None else "live",
+        virtual_seconds=end_t,
+        cycles=cycles,
+        pod_counts=pod_counts,
+        ttb=st.ttb,
+        backlog_pod_seconds=backlog,
+        metrics_snapshot=sched.metrics.snapshot(),
+        invariants=invariants,
+        chaos_injected=chaos.injected,
+        recorder_stats={
+            "tracked_pods": len(sched.recorder.tracked_pods()),
+            "evicted_timelines": sched.recorder.evicted_timelines,
+            "recorded_cycles": len(sched.recorder.cycles()),
+        },
+        fp=fp,
+    )
+    if writer:
+        for ep, inject, lat in chaos.decision_log:
+            writer.chaos(ep, inject, lat)
+        writer.footer(fp, card)
+        writer.close()
+    if replay_data is not None and replay_data.get("footer"):
+        expected = replay_data["footer"]["fingerprint"]
+        if expected != fp:
+            raise ReplayMismatchError(expected, fp)
+    return card

@@ -1,0 +1,156 @@
+"""Named scenario registry — the seed-addressable robustness surface.
+
+Every scenario is a complete experiment definition: workload shape, chaos
+schedule, cadence, and policy knobs.  ``--scenario NAME --seed N`` fully
+determines a run; the registry below is drift-gated against the README
+"Simulation & chaos" catalogue by ``scripts/lint.py`` (SIMC, the METR-gate
+pattern), so a scenario cannot ship undocumented.
+
+All durations/rates are VIRTUAL seconds — a 2-minute scenario costs wall
+clock proportional to the scheduling work, not the simulated time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .chaos import ChaosConfig, ChaosWindow
+from .workload import WorkloadSpec
+
+__all__ = ["Scenario", "SCENARIOS"]
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    description: str
+    duration: float  # virtual seconds of workload generation
+    workload: WorkloadSpec
+    chaos: ChaosConfig = ChaosConfig()
+    cycle_interval: float = 1.0  # virtual seconds between scheduler cycles
+    requeue_seconds: float = 3.0  # failed-pod retry delay (virtual)
+    watch_history: int = 1 << 18  # FakeApiServer retained watch events
+    preemption: bool = False
+    drain_grace_cycles: int = 12  # no-progress cycles after duration before stopping
+
+
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def _register(sc: Scenario) -> Scenario:
+    SCENARIOS[sc.name] = sc
+    return sc
+
+
+_register(
+    Scenario(
+        name="steady-state",
+        description="Poisson arrivals with pod completions at ~70% utilization; the healthy-daemon baseline every other scenario deviates from",
+        duration=120.0,
+        workload=WorkloadSpec(
+            initial_nodes=60,
+            arrival_rate=15.0,
+            lifetime_mean_s=25.0,
+            gang_fraction=0.05,
+            selector_fraction=0.2,
+            priority_tiers=(0, 0, 0, 5, 50),
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="burst-storm",
+        description="Quiet background load punctured by 500-pod storms every 20 virtual seconds — tests backlog drain and time-to-bind tails",
+        duration=100.0,
+        workload=WorkloadSpec(
+            initial_nodes=100,
+            arrival_rate=2.0,
+            bursts=((10.0, 500), (30.0, 500), (50.0, 500), (70.0, 500)),
+            lifetime_mean_s=15.0,
+            gang_fraction=0.1,
+            priority_tiers=(0, 0, 5),
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="node-flap",
+        description="Nodes repeatedly vanish and return (NotReady flaps) plus drains and permanent failures; bound pods re-arrive as Pending",
+        duration=90.0,
+        workload=WorkloadSpec(
+            initial_nodes=40,
+            arrival_rate=8.0,
+            lifetime_mean_s=30.0,
+            node_flap_rate=0.25,
+            node_fail_rate=0.05,
+            node_drain_rate=0.05,
+            node_add_rate=0.05,
+            flap_down_s=4.0,
+        ),
+        # Flapping clusters also stress the watch path: drops force backoff
+        # + queued-event catch-up on top of the object churn.
+        chaos=ChaosConfig(watch_drop_rate=0.05),
+    )
+)
+
+_register(
+    Scenario(
+        name="api-brownout",
+        description="The apiserver browns out mid-run: binding 500s, added binding latency, watch drops and a 410 Gone storm inside timed windows",
+        duration=90.0,
+        workload=WorkloadSpec(initial_nodes=50, arrival_rate=12.0, lifetime_mean_s=25.0),
+        chaos=ChaosConfig(
+            binding_latency_s=0.002,
+            windows=(
+                ChaosWindow(start=20.0, end=45.0, binding_error_rate=0.3, watch_drop_rate=0.3, binding_latency_s=0.02),
+                ChaosWindow(start=55.0, end=65.0, watch_gone_rate=0.5, api_error_rate=0.2),
+            ),
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="gang-heavy",
+        description="40% of arrivals are 2-8 member gangs across priority tiers on an OVERSUBSCRIBED cluster with preemption on — all-or-nothing admission under real contention",
+        duration=80.0,
+        workload=WorkloadSpec(
+            initial_nodes=10,
+            arrival_rate=8.0,
+            lifetime_mean_s=45.0,
+            gang_fraction=0.4,
+            gang_size_max=8,
+            priority_tiers=(0, 1, 5, 50, 100),
+        ),
+        preemption=True,
+        # Oversubscribed by design: the backlog drains only as lifetimes
+        # expire, so give the post-duration drain a longer leash.
+        drain_grace_cycles=25,
+    )
+)
+
+_register(
+    Scenario(
+        name="sim-smoke",
+        description="The tier-1 gate: ~2k pods over 200 nodes with node churn AND an api-brownout window, sized to finish green on CPU in seconds",
+        duration=60.0,
+        workload=WorkloadSpec(
+            initial_nodes=200,
+            arrival_rate=30.0,
+            bursts=((5.0, 200),),
+            lifetime_mean_s=20.0,
+            gang_fraction=0.08,
+            selector_fraction=0.15,
+            priority_tiers=(0, 0, 5, 50),
+            node_flap_rate=0.1,
+            node_fail_rate=0.03,
+            node_add_rate=0.03,
+        ),
+        chaos=ChaosConfig(
+            watch_drop_rate=0.02,
+            windows=(ChaosWindow(start=15.0, end=35.0, binding_error_rate=0.2, watch_drop_rate=0.2, binding_latency_s=0.005),),
+        ),
+    )
+)

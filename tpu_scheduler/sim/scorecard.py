@@ -1,0 +1,199 @@
+"""Scenario scorecard — one JSON verdict per simulated run.
+
+Runs the framework's global invariants I1–I4 (the same checks
+``tests/test_stress.py`` pins, made churn-aware) against the final cluster
+state, plus the virtual-time SLOs a placement system is judged on:
+time-to-bind percentiles, binding throughput per virtual second, the
+pending backlog, and preemption/eviction churn.
+
+Churn-awareness: a placement that was valid when made can look invalid
+against the FINAL state after the node was re-tainted/cordoned or the pod's
+gang was partially killed by a node failure — those placements are
+verifiably disturbed, so I2/I4 skip them (counted, never silent) and I3
+skips gangs with a churn-disturbed member.  Capacity (I1) has no such
+escape: an oversubscribed node is a scheduler bug under any history.
+
+``SCORECARD_FIELDS`` is the closed top-level schema; ``build_scorecard``
+enforces it, and the README "Simulation & chaos" catalogue is drift-gated
+against it by ``scripts/lint.py`` (the METR-gate pattern).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import tpu_scheduler.core.predicates as P
+from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
+
+__all__ = ["SCORECARD_FIELDS", "check_invariants", "build_scorecard", "fingerprint"]
+
+# The closed top-level schema of a scorecard (drift-gated against README.md).
+SCORECARD_FIELDS = (
+    "scenario",
+    "seed",
+    "mode",
+    "pass",
+    "virtual_seconds",
+    "cycles",
+    "pods",
+    "slo",
+    "invariants",
+    "chaos_injected",
+    "flight_recorder",
+    "fingerprint",
+)
+
+
+def fingerprint(bind_log: list[tuple[float, str, str]], placements: list[tuple[str, str]]) -> str:
+    """Determinism fingerprint: sha256 over the confirmed binding sequence
+    (virtual time, pod, node — in POST order) and the final placement set.
+    Two runs agree on this iff they made identical decisions."""
+    h = hashlib.sha256()
+    h.update(json.dumps(bind_log, sort_keys=False).encode())
+    h.update(json.dumps(sorted(placements)).encode())
+    return h.hexdigest()
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (deterministic, no
+    interpolation-mode ambiguity)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def check_invariants(
+    api,
+    scheduled_names: set[str],
+    disturbed_pods: set[str],
+    disturbed_nodes: set[str],
+    gangs: dict[str, set[str]],
+) -> dict:
+    """I1–I4 against the final API state.
+
+    ``scheduled_names`` — pods the SCHEDULER placed (arrivals, not pre-bound
+    seeds); ``disturbed_pods``/``disturbed_nodes`` — churn-touched objects
+    whose placements are excluded from the order-dependent re-checks;
+    ``gangs`` — gang name -> member pod names (full membership ever seen).
+    """
+    final = ClusterSnapshot.build(api.list_nodes(), api.list_pods())
+    node_by = {n.name: n for n in final.nodes}
+    out: dict = {}
+
+    # I1 capacity — exact scalar arithmetic, no exclusions ever.
+    over = [
+        n.name
+        for n in final.nodes
+        if (lambda used, alloc: used.cpu > alloc.cpu or used.memory > alloc.memory)(
+            node_used_resources(final, n.name), node_allocatable(n)
+        )
+    ]
+    out["capacity"] = {"ok": not over, "oversubscribed_nodes": over}
+
+    # I2 predicates — every undisturbed placement passes the order-free
+    # scalar chain vs the final state minus itself (spread excluded: it is
+    # order-dependent by construction; see tests/test_stress.py).
+    order_free = [(r, pred) for r, pred in P.PREDICATE_CHAIN if r != P.InvalidNodeReason.TOPOLOGY_SPREAD_VIOLATION]
+    checked = skipped = 0
+    violations: list[str] = []
+    for pod, node in final.placed_pods():
+        name = pod.metadata.name
+        if name not in scheduled_names:
+            continue
+        if name in disturbed_pods or node.name in disturbed_nodes:
+            skipped += 1
+            continue
+        checked += 1
+        others = ClusterSnapshot.build(final.nodes, [q for q in final.pods if q is not pod])
+        for reason, pred in order_free:
+            if not pred(pod, node_by[node.name], others):
+                violations.append(f"{name} on {node.name}: {reason.name}")
+    out["predicates"] = {"ok": not violations, "checked": checked, "skipped_churned": skipped, "violations": violations[:20]}
+
+    # I3 gang atomicity — an undisturbed gang is never partially ADMITTED:
+    # no mix of bound and still-pending members.  Members that already
+    # COMPLETED (bound, ran their lifetime, deleted) don't break atomicity —
+    # admission was whole; they just finished at different times.
+    placed_names = {p.metadata.name for p in final.pods if p.spec is not None and p.spec.node_name}
+    pending_names = {p.metadata.name for p in final.pods if p.spec is None or not p.spec.node_name}
+    g_checked = g_skipped = 0
+    partial: list[str] = []
+    for g, members in sorted(gangs.items()):
+        if members & disturbed_pods:
+            g_skipped += 1
+            continue
+        g_checked += 1
+        n_placed = len(members & placed_names)
+        n_pending = len(members & pending_names)
+        if n_placed and n_pending:
+            partial.append(f"{g}: {n_placed} bound / {n_pending} pending of {len(members)}")
+    out["gangs"] = {"ok": not partial, "checked": g_checked, "skipped_churned": g_skipped, "partial": partial}
+
+    # I4 selectors — nodeSelector / hard taints / required node affinity /
+    # cordon on undisturbed placements (subsumed by I2; cheap triage).
+    sel_bad: list[str] = []
+    for pod, node in final.placed_pods():
+        name = pod.metadata.name
+        if name not in scheduled_names or name in disturbed_pods or node.name in disturbed_nodes:
+            continue
+        for reason, pred in P.NODE_LOCAL_PREDICATES:
+            if not pred(pod, node_by[node.name], final):
+                sel_bad.append(f"{name} on {node.name}: {reason.name}")
+                break
+    out["selectors"] = {"ok": not sel_bad, "violations": sel_bad[:20]}
+
+    out["ok"] = all(out[k]["ok"] for k in ("capacity", "predicates", "gangs", "selectors"))
+    return out
+
+
+def build_scorecard(
+    *,
+    scenario: str,
+    seed: int,
+    mode: str,
+    virtual_seconds: float,
+    cycles: int,
+    pod_counts: dict,
+    ttb: list[float],
+    backlog_pod_seconds: float,
+    metrics_snapshot: dict,
+    invariants: dict,
+    chaos_injected: dict,
+    recorder_stats: dict,
+    fp: str,
+) -> dict:
+    """Assemble the one-JSON verdict.  Strictly virtual-time quantities —
+    wall clock never appears, so the scorecard is bit-identical across runs
+    and machines (the determinism acceptance criterion)."""
+    ttb_sorted = sorted(ttb)
+    slo = {
+        "p50_time_to_bind_s": round(_percentile(ttb_sorted, 0.50), 6),
+        "p99_time_to_bind_s": round(_percentile(ttb_sorted, 0.99), 6),
+        "max_time_to_bind_s": round(ttb_sorted[-1], 6) if ttb_sorted else 0.0,
+        "bound_per_virtual_second": round(len(ttb) / virtual_seconds, 4) if virtual_seconds > 0 else 0.0,
+        "pending_backlog_pod_seconds": round(backlog_pod_seconds, 4),
+        "preemption_churn": int(metrics_snapshot.get("scheduler_preemption_victims_total", 0))
+        + int(metrics_snapshot.get("scheduler_noexecute_evictions_total", 0)),
+        "requeues": int(metrics_snapshot.get("scheduler_requeues_total", 0)),
+        "watch_errors": int(metrics_snapshot.get("scheduler_watch_errors_total", 0)),
+    }
+    card = {
+        "scenario": scenario,
+        "seed": seed,
+        "mode": mode,
+        "pass": bool(
+            invariants.get("ok") and pod_counts.get("lost", 1) == 0 and pod_counts.get("double_bound", 1) == 0
+        ),
+        "virtual_seconds": round(virtual_seconds, 6),
+        "cycles": cycles,
+        "pods": pod_counts,
+        "slo": slo,
+        "invariants": invariants,
+        "chaos_injected": dict(sorted(chaos_injected.items())),
+        "flight_recorder": recorder_stats,
+        "fingerprint": fp,
+    }
+    assert tuple(card) == SCORECARD_FIELDS, "scorecard schema drifted from SCORECARD_FIELDS"
+    return card
