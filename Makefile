@@ -4,13 +4,18 @@
 
 PY ?= python
 
-.PHONY: check lint test native bench sim-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke clean
 
 check: lint test
 
-lint:
+lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
-	$(PY) scripts/lint.py
+
+# The whole static-analysis policy (scripts/analyze/): ported hygiene rules
+# plus THRD lock discipline, JAXP jit purity, DTRM sim determinism, and the
+# baseline gate (fails on new findings and on stale baseline entries).
+analyze:
+	$(PY) -m scripts.analyze
 
 test:
 	$(PY) -m pytest tests/ -x -q

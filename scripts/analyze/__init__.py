@@ -1,0 +1,24 @@
+"""Invariant-aware static analysis suite — the repo's whole lint policy.
+
+A zero-dependency, stdlib-AST analysis package: one parse per file, shared
+by every pass (the monolithic ``scripts/lint.py`` re-walked nothing but also
+shared nothing — every new rule meant another ad-hoc loop).  ``scripts/
+lint.py`` survives as a thin shim so existing invocations keep working.
+
+Passes (each a module in this package; the rule catalogue is drift-gated
+into README.md by the ANLZ pass):
+
+  hygiene      — E999 W291 W191 E711 E712 B006 F841 F401 F822
+  exports      — DEAD (exported-but-referenced-nowhere symbols)
+  catalogues   — METR SIMC ANLZ (README drift gates)
+  locks        — THRD (lock discipline: ``# guarded-by:`` attributes,
+                 ``# holds-lock:`` contracts, lock-order cycle detection)
+  jitpure      — JAXP (no host syncs / tracer branches inside jit)
+  determinism  — DTRM (sim/ may only consume the clock and seeded rng)
+
+Findings are compared against ``baseline.json`` (pinned pre-existing
+findings, each with a reason); the driver fails on any NEW finding and on
+any STALE baseline entry — the baseline can only shrink.
+"""
+
+from .core import Context, Finding, SourceFile  # noqa: F401 — package surface

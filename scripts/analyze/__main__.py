@@ -1,0 +1,8 @@
+"""``python -m scripts.analyze`` — the analysis suite's entry point."""
+
+import sys
+
+from .driver import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

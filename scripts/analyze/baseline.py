@@ -1,0 +1,78 @@
+"""Baseline handling — pre-existing findings are PINNED, never suppressed.
+
+``baseline.json`` holds one entry per accepted finding: its identity
+``(rule, path, message)`` plus a human reason for deferring the fix.  The
+driver fails on any NEW finding (not in the baseline) and on any STALE
+entry (in the baseline but no longer found) — so the baseline can only
+shrink, and a fix is forced to also retire its pin.  Line numbers are
+deliberately not part of identity: editing an unrelated part of a file
+must not churn the baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from .core import Finding
+
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "baseline.json"
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: pathlib.Path = BASELINE_PATH) -> list[dict]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: unsupported baseline version {data.get('version')!r}")
+    entries = data.get("entries", [])
+    for e in entries:
+        for field in ("rule", "path", "message", "reason"):
+            if not isinstance(e.get(field), str) or not e[field]:
+                raise ValueError(f"{path}: baseline entry missing/empty {field!r}: {e}")
+    return entries
+
+
+def write_baseline(findings: list[Finding], path: pathlib.Path = BASELINE_PATH) -> None:
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+            "reason": "pinned pre-existing finding — review and either fix or justify",
+        }
+        for f in sorted(set(findings), key=lambda f: f.key)
+    ]
+    path.write_text(json.dumps({"version": BASELINE_VERSION, "entries": entries}, indent=2) + "\n")
+
+
+def compare(
+    findings: list[Finding],
+    entries: list[dict],
+    rules: set[str] | None = None,
+    paths: set[str] | None = None,
+) -> tuple[list[Finding], list[dict], list[Finding]]:
+    """Split into (new findings, stale entries, baselined findings).
+
+    ``rules``/``paths`` restrict the comparison scope — a ``--rule`` or
+    explicit-path run must not report out-of-scope baseline entries stale.
+    """
+
+    def in_scope(rule: str, path: str) -> bool:
+        if rules is not None and rule not in rules:
+            return False
+        if paths is not None and path not in paths:
+            return False
+        return True
+
+    pinned = {(e["rule"], e["path"], e["message"]) for e in entries if in_scope(e["rule"], e["path"])}
+    found_keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in pinned]
+    stale = [
+        e
+        for e in entries
+        if in_scope(e["rule"], e["path"]) and (e["rule"], e["path"], e["message"]) not in found_keys
+    ]
+    baselined = [f for f in findings if f.key in pinned]
+    return new, stale, baselined

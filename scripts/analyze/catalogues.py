@@ -1,0 +1,104 @@
+"""README drift gates — a name that exists in code but not in its README
+catalogue fails the build, so the docs cannot rot silently.
+
+METR  — every ``scheduler_*`` metric-name literal used in the package must
+        appear in the README Observability metric catalogue.
+SIMC  — every registered scenario name, chaos knob, and scorecard field in
+        ``tpu_scheduler/sim/`` must appear in the README "Simulation &
+        chaos" catalogue.
+ANLZ  — every rule code this analysis suite registers must appear in the
+        README "Static analysis" catalogue (the gate gating its own docs —
+        same pattern as METR/SIMC).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Context, Finding
+
+CODES = {
+    "METR": "a scheduler_* metric used in the package but missing from the README metric catalogue",
+    "SIMC": "a sim scenario/chaos knob/scorecard field missing from the README simulation catalogue",
+    "ANLZ": "an analysis rule code missing from the README static-analysis catalogue",
+}
+
+_METRIC_RE = re.compile(r'"(scheduler_[a-z0-9_]+)"')
+
+
+def _run_metr(ctx: Context) -> list[Finding]:
+    names: set[str] = set()
+    for f in ctx.files:
+        if f.in_package("tpu_scheduler"):
+            names.update(_METRIC_RE.findall(f.text))
+    return [
+        Finding(
+            "METR",
+            "README.md",
+            1,
+            f"metric '{name}' is used in tpu_scheduler/ but missing from the README metric catalogue",
+        )
+        for name in sorted(names)
+        if name not in ctx.readme
+    ]
+
+
+def _run_simc(ctx: Context) -> list[Finding]:
+    catalogue: list[tuple[str, str]] = []  # (kind, name)
+    for f in ctx.parsed():
+        if not f.in_package("tpu_scheduler", "sim"):
+            continue
+        if f.path.name == "scenarios.py":
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "Scenario":
+                    for kw in node.keywords:
+                        if kw.arg == "name" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                            catalogue.append(("scenario", kw.value.value))
+        elif f.path.name == "chaos.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef) and node.name in ("ChaosConfig", "ChaosWindow"):
+                    for stmt in node.body:
+                        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                            catalogue.append(("chaos knob", stmt.target.id))
+        elif f.path.name == "scorecard.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Name)
+                            and t.id == "SCORECARD_FIELDS"
+                            and isinstance(node.value, (ast.Tuple, ast.List))
+                        ):
+                            for e in node.value.elts:
+                                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                                    catalogue.append(("scorecard field", e.value))
+    return [
+        Finding(
+            "SIMC",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in tpu_scheduler/sim/ but is missing from the README \"Simulation & chaos\" catalogue",
+        )
+        for kind, name in sorted(set(catalogue))
+        if name not in ctx.readme
+    ]
+
+
+def _run_anlz(ctx: Context) -> list[Finding]:
+    from .driver import all_codes  # late import: driver owns the registry
+
+    return [
+        Finding(
+            "ANLZ",
+            "README.md",
+            1,
+            f"analysis rule '{code}' is enforced by scripts/analyze but missing from the README \"Static analysis\" catalogue",
+        )
+        for code in sorted(all_codes())
+        if code not in ctx.readme
+    ]
+
+
+def run(ctx: Context) -> list[Finding]:
+    return _run_metr(ctx) + _run_simc(ctx) + _run_anlz(ctx)

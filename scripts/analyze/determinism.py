@@ -1,0 +1,120 @@
+"""DTRM — simulator determinism: ``tpu_scheduler/sim/`` may only consume
+virtual time and the seeded rng.
+
+The record/replay contract (sim/trace.py) is byte-identity: the same
+scenario + seed must produce the same fingerprint on every run of every
+machine.  One wall-clock read or global-rng draw anywhere in sim/ breaks
+that silently — the replay float-rounding incident took a day to localize
+because nothing pointed at the source.  Forbidden in sim/ modules:
+
+  • ``time.time`` / ``time.monotonic`` / ``time.sleep`` / ``time.perf_counter``
+    (and their ``_ns`` twins) — the VirtualClock is the only time source
+  • module-level ``random.*`` calls — the process-global rng is unseeded
+    shared state; ``random.Random(seed)`` instances are the sanctioned form
+  • ``datetime.now`` / ``datetime.utcnow`` / ``datetime.today`` — wall clock
+  • ``os.urandom`` / ``uuid.uuid4`` — entropy
+  • iterating a ``set`` literal / ``set(...)`` call (for-loops and
+    comprehensions) — set order is hash-seed-dependent, and sim iteration
+    feeds trace lines and scorecard JSON
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, SourceFile
+
+CODES = {
+    "DTRM": "wall clock, global rng, entropy, or set-order iteration in sim/ — breaks record/replay byte-identity",
+}
+
+_TIME_ATTRS = ("time", "monotonic", "sleep", "perf_counter", "time_ns", "monotonic_ns", "perf_counter_ns")
+_DATETIME_ATTRS = ("now", "utcnow", "today")
+
+
+def _check_file(f: SourceFile, findings: list[Finding]) -> None:
+    tree = f.tree
+    assert tree is not None
+    time_aliases: set[str] = set()
+    random_aliases: set[str] = set()
+    from_time: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                if a.name == "time":
+                    time_aliases.add(bound)
+                elif a.name == "random":
+                    random_aliases.add(bound)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                from_time.update((a.asname or a.name) for a in node.names if a.name in _TIME_ATTRS)
+            elif node.module == "random":
+                from_time.update(
+                    (a.asname or a.name) for a in node.names if a.name != "Random"
+                )  # bare draws from the global rng
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+                base, attr = fn.value.id, fn.attr
+                if base in time_aliases and attr in _TIME_ATTRS:
+                    findings.append(
+                        Finding("DTRM", f.rel, node.lineno, f"time.{attr}() in sim/ — use the VirtualClock")
+                    )
+                elif base in random_aliases and attr != "Random":
+                    findings.append(
+                        Finding(
+                            "DTRM",
+                            f.rel,
+                            node.lineno,
+                            f"module-level random.{attr}() in sim/ — draw from a seeded random.Random instance",
+                        )
+                    )
+                elif attr in _DATETIME_ATTRS and base in ("datetime", "date"):
+                    findings.append(
+                        Finding("DTRM", f.rel, node.lineno, f"{base}.{attr}() wall clock in sim/ — use the VirtualClock")
+                    )
+                elif base == "os" and attr == "urandom":
+                    findings.append(
+                        Finding("DTRM", f.rel, node.lineno, "os.urandom() entropy in sim/ — derive from the scenario seed")
+                    )
+                elif base == "uuid" and attr == "uuid4":
+                    findings.append(
+                        Finding("DTRM", f.rel, node.lineno, "uuid.uuid4() entropy in sim/ — derive from the scenario seed")
+                    )
+            elif isinstance(fn, ast.Name) and fn.id in from_time:
+                findings.append(
+                    Finding(
+                        "DTRM",
+                        f.rel,
+                        node.lineno,
+                        f"{fn.id}() (from time/random import) in sim/ — use the VirtualClock / a seeded Random",
+                    )
+                )
+        iters: list[ast.expr] = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            iters.extend(g.iter for g in node.generators)
+        for it in iters:
+            if isinstance(it, ast.Set) or (
+                isinstance(it, ast.Call) and isinstance(it.func, ast.Name) and it.func.id == "set"
+            ):
+                findings.append(
+                    Finding(
+                        "DTRM",
+                        f.rel,
+                        it.lineno,
+                        "iteration over a set in sim/ — order is hash-seed-dependent; sort it first",
+                    )
+                )
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in ctx.parsed():
+        if f.in_package("tpu_scheduler", "sim"):
+            _check_file(f, findings)
+    return findings

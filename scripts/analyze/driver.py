@@ -1,0 +1,150 @@
+"""Single-parse driver: load + parse every file once, run every pass over
+the shared ``Context``, compare against the baseline, report.
+
+Usage (also reachable through the ``scripts/lint.py`` shim):
+
+    python -m scripts.analyze [paths...] [options]
+
+Options:
+    --rule CODE[,CODE]   run only the named rule(s); baseline comparison is
+                         scoped to them
+    --json               machine-readable report on stdout (findings with a
+                         baselined flag, plus new/stale arrays) for CI
+                         annotation
+    --write-baseline     pin the current findings as the new baseline
+                         (reasons start as a review placeholder)
+    --no-baseline        report raw findings, ignore baseline.json
+    --list-rules         print the rule catalogue and exit
+
+Exit status: 0 iff there are no NEW findings and no STALE baseline entries.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from . import catalogues, determinism, exports, hygiene, jitpure, locks
+from .baseline import BASELINE_PATH, compare, load_baseline, write_baseline
+from .core import DEFAULT_PATHS, ROOT, Context, Finding, load_files
+
+# Fixed pass order: cheap mechanical hygiene first, repo-invariant passes
+# last (their reports are the ones a human digs into).
+PASSES = (hygiene, exports, catalogues, locks, jitpure, determinism)
+
+
+def all_codes() -> dict[str, str]:
+    """Every registered rule code -> one-line rationale (the ANLZ surface)."""
+    out: dict[str, str] = {}
+    for p in PASSES:
+        out.update(p.CODES)
+    return out
+
+
+def run_passes(ctx: Context, rules: set[str] | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in ctx.files:
+        if f.tree is None:
+            try:
+                import ast
+
+                ast.parse(f.text, filename=str(f.path))
+            except SyntaxError as e:
+                findings.append(Finding("E999", f.rel, e.lineno or 1, f"syntax error: {e.msg}"))
+    for p in PASSES:
+        if rules is not None and not (set(p.CODES) & rules):
+            continue
+        findings.extend(p.run(ctx))
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def main(argv: list[str]) -> int:
+    args = list(argv)
+    rules: set[str] | None = None
+    as_json = write = no_baseline = False
+    paths: list[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--rule":
+            i += 1
+            if i >= len(args):
+                print("--rule requires a CODE argument", file=sys.stderr)
+                return 2
+            rules = (rules or set()) | {c.strip().upper() for c in args[i].split(",") if c.strip()}
+        elif a.startswith("--rule="):
+            rules = (rules or set()) | {c.strip().upper() for c in a.split("=", 1)[1].split(",") if c.strip()}
+        elif a == "--json":
+            as_json = True
+        elif a == "--write-baseline":
+            write = True
+        elif a == "--no-baseline":
+            no_baseline = True
+        elif a == "--list-rules":
+            for code, rationale in sorted(all_codes().items()):
+                print(f"{code}  {rationale}")
+            return 0
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        elif a.startswith("-"):
+            print(f"unknown option {a!r}", file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+
+    if rules is not None:
+        unknown = rules - set(all_codes())
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))} (see --list-rules)", file=sys.stderr)
+            return 2
+
+    files = load_files(paths or DEFAULT_PATHS)
+    readme = (ROOT / "README.md").read_text() if (ROOT / "README.md").exists() else ""
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    findings = run_passes(ctx, rules)
+
+    if write:
+        write_baseline(findings)
+        print(f"analyze: wrote {len(findings)} baseline entr{'y' if len(findings) == 1 else 'ies'} to {BASELINE_PATH}")
+        return 0
+
+    if no_baseline:
+        entries: list[dict] = []
+    else:
+        entries = load_baseline()
+    # Scope the stale check to the analyzed files (plus README, which the
+    # catalogue gates report against) so a partial run cannot cry stale.
+    scope_paths = {f.rel for f in files} | {"README.md"}
+    new, stale, baselined = compare(findings, entries, rules=rules, paths=scope_paths)
+
+    if as_json:
+        report = {
+            "files": len(files),
+            "findings": [
+                {**f.__dict__, "baselined": f.key in {b.key for b in baselined}} for f in findings
+            ],
+            "new": [f.__dict__ for f in new],
+            "stale": stale,
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(
+                f"{e['path']}:1: STALE baseline entry — {e['rule']} \"{e['message']}\" no longer found; "
+                f"remove it from scripts/analyze/baseline.json (reason was: {e['reason']})"
+            )
+        print(
+            f"analyze: {len(files)} files, {len(findings)} findings "
+            f"({len(baselined)} baselined), {len(new)} new, {len(stale)} stale"
+        )
+    return 1 if new or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,34 @@
+"""DEAD — a non-underscore symbol in a package module's ``__all__`` that no
+other analyzed file references (the round-2 'three dead soft scorers'
+regression class).  Cross-file by construction: runs over the whole
+``Context``, not per module."""
+
+from __future__ import annotations
+
+import re
+
+from .core import Context, Finding, module_all
+
+CODES = {
+    "DEAD": "an __all__ export referenced nowhere else in the repo — API rot the round-2 regression shipped",
+}
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    all_text = {f.rel: f.text for f in ctx.files}
+    for f in ctx.parsed():
+        if "tpu_scheduler" not in f.rel or f.path.name == "__init__.py":
+            continue
+        for name in module_all(f.tree):
+            refs = 0
+            for rel, text in all_text.items():
+                hits = len(re.findall(rf"\b{re.escape(name)}\b", text))
+                if rel == f.rel:
+                    # definition + __all__ entry account for 2 mentions
+                    refs += max(0, hits - 2)
+                else:
+                    refs += hits
+            if refs == 0:
+                findings.append(Finding("DEAD", f.rel, 1, f"export '{name}' is referenced nowhere"))
+    return findings

@@ -1,0 +1,194 @@
+"""Hygiene passes ported from the monolithic ``scripts/lint.py``: the error
+classes a round-2 regression shipped with (stale imports, phantom exports)
+plus basic mechanical hygiene, on the stdlib so the gate runs in the build
+image (which carries no installable linter)."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Context, Finding, SourceFile, module_all, top_level_defs
+
+CODES = {
+    "E999": "syntax errors (ast.parse) — nothing else is checkable past one",
+    "W291": "trailing whitespace — diff noise that masks real changes",
+    "W191": "tabs in indentation — one indentation currency repo-wide",
+    "E711": "comparison to None with ==/!= — use is / is not",
+    "E712": "comparison to True/False with ==/!= — use the value or is",
+    "B006": "mutable default argument — shared across calls, a classic aliasing bug",
+    "F841": "local assigned once and never read — dead stores hide logic errors",
+    "F401": "imported name never used in the module — stale-import rot",
+    "F822": "__all__ names a symbol the module does not define — phantom export",
+}
+
+
+class _ImportUsage(ast.NodeVisitor):
+    """Collect imported names and every name usage."""
+
+    def __init__(self):
+        self.imports: dict[str, int] = {}  # bound name -> lineno
+        self.used: set[str] = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            self.imports[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return  # future imports act by existing, never by reference
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+
+class _FunctionScopeChecks(ast.NodeVisitor):
+    """Per-function rules: F841 unused locals, B006 mutable defaults."""
+
+    def __init__(self, relpath: str, findings: list[Finding]):
+        self.relpath = relpath
+        self.findings = findings
+
+    def _check_function(self, node):
+        # B006 — mutable literals/constructors as parameter defaults.
+        for default in list(node.args.defaults) + [d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in ("list", "dict", "set")
+            ):
+                self.findings.append(Finding("B006", self.relpath, default.lineno, "mutable default argument"))
+        # F841 — plain-name single assignments never read in the function.
+        # STORES are collected from this function's OWN scope only (nested
+        # function bodies get their own visit — walking them here would
+        # double-report their dead stores against the outer scope); READS
+        # come from the full walk so a closure's use of an outer local still
+        # counts (conservative: an inner local shadowing an outer name can
+        # mask an outer dead store — false negatives over false positives).
+        def own_scope(n):
+            for child in ast.iter_child_nodes(n):
+                # Nested functions/lambdas AND class bodies are their own
+                # scopes — a class attribute is not a function local (it is
+                # read via ast.Attribute, which never registers as a Name
+                # Load, so walking it would hard-fail valid code).
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+                    continue
+                yield child
+                yield from own_scope(child)
+
+        assigned: dict[str, int] = {}
+        read: set[str] = set()
+        exempt: set[str] = set()
+        for sub in ast.walk(node):
+            if sub is node:
+                continue
+            if isinstance(sub, ast.AugAssign) and isinstance(sub.target, ast.Name):
+                # x += v mutates x in place — a use, not a dead store (the
+                # ledger-accumulator pattern).
+                read.add(sub.target.id)
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                read.add(sub.id)
+        for sub in own_scope(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                assigned.setdefault(sub.id, sub.lineno)
+            # global/nonlocal writes are module/outer-scope effects, and
+            # loop induction variables are iteration plumbing (ruff would
+            # file them under B007) — neither is an unused LOCAL.
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                exempt.update(sub.names)
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
+            elif isinstance(sub, ast.comprehension):
+                exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
+            elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                # `with ... as x:` targets are context handles pyflakes/ruff
+                # never file under F841 (e.g. pytest.raises(...) as exc).
+                for item in sub.items:
+                    if item.optional_vars is not None:
+                        exempt.update(n.id for n in ast.walk(item.optional_vars) if isinstance(n, ast.Name))
+            elif isinstance(sub, ast.Assign):
+                # Tuple-unpack targets document structure — exempt them.
+                for t in sub.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        exempt.update(n.id for n in ast.walk(t) if isinstance(n, ast.Name))
+        args = {a.arg for a in node.args.args + node.args.kwonlyargs + node.args.posonlyargs}
+        for name, lineno in sorted(assigned.items(), key=lambda kv: kv[1]):
+            if name in read or name in exempt or name in args or name.startswith("_"):
+                continue
+            if name in ("self", "cls"):
+                continue
+            self.findings.append(Finding("F841", self.relpath, lineno, f"local variable '{name}' assigned but never used"))
+
+    def visit_FunctionDef(self, node):
+        self._check_function(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _comparison_checks(tree: ast.Module, relpath: str, findings: list[Finding]) -> None:
+    """E711 (== None) / E712 (== True/False) — either side of the ==."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        # Operand i of op i is left for i == 0, else comparators[i-1]; check
+        # both sides so Yoda comparisons (None == x) are caught too.
+        operands = [node.left] + list(node.comparators)
+        for i, op in enumerate(node.ops):
+            if not isinstance(op, (ast.Eq, ast.NotEq)):
+                continue
+            for side in (operands[i], operands[i + 1]):
+                if not isinstance(side, ast.Constant):
+                    continue
+                if side.value is None:
+                    findings.append(Finding("E711", relpath, node.lineno, "comparison to None (use 'is'/'is not')"))
+                elif side.value is True or side.value is False:
+                    findings.append(
+                        Finding("E712", relpath, node.lineno, f"comparison to {side.value} (use the value or 'is')")
+                    )
+
+
+def _check_module(f: SourceFile, findings: list[Finding]) -> None:
+    tree = f.tree
+    assert tree is not None
+    exported = set(module_all(tree))
+    usage = _ImportUsage()
+    usage.visit(tree)
+    # Names referenced in string annotations / docstring doctests are out
+    # of scope; __init__ re-exports are legitimate when listed in __all__.
+    is_init = f.path.name == "__init__.py"
+    for name, lineno in usage.imports.items():
+        if name in usage.used or name == "_":
+            continue
+        if is_init or name in exported:
+            continue
+        # A conservative text check catches usage forms the AST visitor
+        # does not model (e.g. inside f-string format specs).
+        if len(re.findall(rf"\b{re.escape(name)}\b", f.text)) > 1:
+            continue
+        findings.append(Finding("F401", f.rel, lineno, f"'{name}' imported but unused"))
+    defined = top_level_defs(tree)
+    for name in exported:
+        if name not in defined:
+            findings.append(Finding("F822", f.rel, 1, f"undefined name '{name}' in __all__"))
+    _FunctionScopeChecks(f.rel, findings).visit(tree)
+    _comparison_checks(tree, f.rel, findings)
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in ctx.files:
+        for i, line in enumerate(f.lines, 1):
+            if line != line.rstrip():
+                findings.append(Finding("W291", f.rel, i, "trailing whitespace"))
+            if line.startswith("\t"):
+                findings.append(Finding("W191", f.rel, i, "tab in indentation"))
+        if f.tree is not None:
+            _check_module(f, findings)
+    return findings

@@ -1,0 +1,289 @@
+"""JAXP — jit purity: no host syncs inside the jitted hot paths.
+
+Every function reached from a ``jax.jit`` root (decorator forms
+``@jax.jit`` / ``@partial(jax.jit, ...)`` / ``@functools.partial(jax.jit,
+...)``, or a ``jax.jit(f)`` call on a named function) is traced code: a
+host sync there either crashes under trace or — worse — silently forces a
+device round-trip per call.  Inside reached functions this pass forbids:
+
+  • ``.item()``                      — the canonical device->host sync
+  • ``float()``/``int()``/``bool()`` on a TRACED expression (see taint)
+  • ``np.asarray`` / ``np.array``    — numpy materialization of a tracer
+  • ``print``                        — host I/O under trace fires per call
+  • ``time.*``                       — wall clock has no meaning in a trace
+  • ``if``/``while`` on a TRACED expression — Python control flow cannot
+    branch on a tracer (use ``lax.cond``/``jnp.where``)
+
+Reachability is a name-resolved transitive closure: bare-name calls and
+bare-name references (functions handed to ``lax.while_loop`` etc.) resolve
+to same-module functions first, then to from-imported functions defined in
+any analyzed module; nested defs of a reached function are reached.
+
+Taint is a per-function forward pass: values returned by ``jnp.*`` /
+``lax.*`` calls are traced; arithmetic/comparison/subscript over traced
+values stays traced; ``.shape``/``.dtype``/``.ndim`` drop taint (static
+under trace).  Function parameters are deliberately NOT tainted — jitted
+helpers thread static config (block sizes, flags) through arguments, and
+flagging every ``if use_pallas:`` would bury the real findings.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, SourceFile
+
+CODES = {
+    "JAXP": "host sync or Python branch on a tracer inside jit-reached code — crashes or hides a device round-trip",
+}
+
+_STATIC_ATTRS = ("shape", "dtype", "ndim", "aval", "size")
+
+
+class _ModuleIndex:
+    """Per-module maps the reachability closure needs."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.functions: dict[str, list[ast.FunctionDef]] = {}  # name -> defs (any nesting)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        self.from_imports: set[str] = set()  # names bound by from-imports
+        self.np_aliases: set[str] = set()
+        self.time_aliases: set[str] = set()
+        self.taint_bases: set[str] = set()  # jnp/lax-style aliases
+        tree = sf.tree
+        assert tree is not None
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        self.np_aliases.add(bound)
+                    elif a.name == "time":
+                        self.time_aliases.add(bound)
+                    elif a.name == "jax.numpy" and a.asname:
+                        self.taint_bases.add(a.asname)
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    bound = a.asname or a.name
+                    self.from_imports.add(bound)
+                    if node.module == "jax" and a.name in ("numpy", "lax"):
+                        self.taint_bases.add(bound)
+                    elif node.module == "time":
+                        self.time_aliases.add(bound)
+
+    def nested_defs(self, fn: ast.AST):
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+def _is_jax_jit_expr(node: ast.expr) -> bool:
+    """``jax.jit`` or bare ``jit``."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jax_jit_expr(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit_expr(dec.func):
+                return True
+            # functools.partial(jax.jit, ...) / partial(jax.jit, ...)
+            fname = dec.func.attr if isinstance(dec.func, ast.Attribute) else getattr(dec.func, "id", None)
+            if fname == "partial" and dec.args and _is_jax_jit_expr(dec.args[0]):
+                return True
+    return False
+
+
+def _collect_roots(idx: _ModuleIndex) -> tuple[set[ast.FunctionDef], set[str]]:
+    """(locally-defined jit roots, root NAMES needing cross-module
+    resolution) for one module."""
+    roots: set[ast.FunctionDef] = set()
+    foreign: set[str] = set()
+    for defs in idx.functions.values():
+        for fn in defs:
+            if _jit_decorated(fn):
+                roots.add(fn)
+    # jax.jit(f) / jax.jit(builder(...)) — mark the named function (or the
+    # builder whose nested defs are the real jitted body).
+    for node in ast.walk(idx.sf.tree):
+        if isinstance(node, ast.Call) and _is_jax_jit_expr(node.func) and node.args:
+            target = node.args[0]
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif isinstance(target, ast.Call):
+                tn = target.func
+                name = tn.id if isinstance(tn, ast.Name) else (tn.attr if isinstance(tn, ast.Attribute) else None)
+            if name is None:
+                continue
+            if name in idx.functions:
+                roots.update(idx.functions[name])
+            elif name in idx.from_imports:
+                foreign.add(name)
+    return roots, foreign
+
+
+def _reachable(indices: list[_ModuleIndex]) -> dict[ast.FunctionDef, _ModuleIndex]:
+    by_name: dict[str, list[tuple[_ModuleIndex, ast.FunctionDef]]] = {}
+    for idx in indices:
+        for name, defs in idx.functions.items():
+            for fn in defs:
+                by_name.setdefault(name, []).append((idx, fn))
+    reached: dict[ast.FunctionDef, _ModuleIndex] = {}
+    work: list[tuple[_ModuleIndex, ast.FunctionDef]] = []
+    for idx in indices:
+        local, foreign = _collect_roots(idx)
+        for fn in local:
+            work.append((idx, fn))
+        for name in foreign:
+            work.extend(by_name.get(name, ()))
+    while work:
+        idx, fn = work.pop()
+        if fn in reached:
+            continue
+        reached[fn] = idx
+        for nested in idx.nested_defs(fn):
+            work.append((idx, nested))
+        # Bare-name references inside the body: same-module functions, else
+        # from-imported functions defined in any analyzed module.
+        local_names = set(idx.functions)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                name = node.id
+                if name in local_names:
+                    for g in idx.functions[name]:
+                        work.append((idx, g))
+                elif name in idx.from_imports:
+                    for other_idx, g in by_name.get(name, ()):
+                        work.append((other_idx, g))
+    return reached
+
+
+def _taint_check(fn: ast.FunctionDef, idx: _ModuleIndex, findings: list[Finding]) -> None:
+    rel = idx.sf.rel
+    tainted: set[str] = set()
+    nested = set(idx.nested_defs(fn))
+
+    def is_tainted(node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                base = f.value
+                if isinstance(base, ast.Name) and base.id in idx.taint_bases:
+                    return True
+                if isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name) and base.value.id in idx.taint_bases:
+                    return True  # lax.linalg.x / jnp.linalg.x style
+                return is_tainted(base)  # method call on a traced value
+            return any(is_tainted(a) for a in node.args)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False  # static under trace
+            return is_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return is_tainted(node.left) or is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return is_tainted(node.left) or any(is_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return is_tainted(node.value)
+        if isinstance(node, ast.IfExp):
+            return is_tainted(node.body) or is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(is_tainted(e) for e in node.elts)
+        return False
+
+    def walk_own(node: ast.AST):
+        """This function's own statements — nested defs are visited as their
+        own reached functions, with their own taint scope."""
+        for child in ast.iter_child_nodes(node):
+            if child in nested or isinstance(child, ast.Lambda):
+                continue
+            yield child
+            yield from walk_own(child)
+
+    for node in [fn, *walk_own(fn)]:
+        if isinstance(node, ast.Assign):
+            if is_tainted(node.value):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Name):
+            if is_tainted(node.value) or node.target.id in tainted:
+                tainted.add(node.target.id)
+        elif isinstance(node, (ast.If, ast.While)):
+            if is_tainted(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(
+                    Finding(
+                        "JAXP",
+                        rel,
+                        node.lineno,
+                        f"Python '{kind}' on a traced expression in jit-reached '{fn.name}' (use lax.cond/jnp.where)",
+                    )
+                )
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "item":
+                    findings.append(
+                        Finding("JAXP", rel, node.lineno, f".item() host sync in jit-reached '{fn.name}'")
+                    )
+                elif (
+                    isinstance(f.value, ast.Name)
+                    and f.value.id in idx.np_aliases
+                    and f.attr in ("asarray", "array")
+                ):
+                    findings.append(
+                        Finding(
+                            "JAXP", rel, node.lineno, f"np.{f.attr}() materializes a tracer in jit-reached '{fn.name}'"
+                        )
+                    )
+                elif isinstance(f.value, ast.Name) and f.value.id in idx.time_aliases:
+                    findings.append(
+                        Finding(
+                            "JAXP", rel, node.lineno, f"time.{f.attr}() wall-clock call in jit-reached '{fn.name}'"
+                        )
+                    )
+            elif isinstance(f, ast.Name):
+                if f.id == "print":
+                    findings.append(
+                        Finding("JAXP", rel, node.lineno, f"print() host I/O in jit-reached '{fn.name}'")
+                    )
+                elif f.id in ("float", "int", "bool") and node.args and is_tainted(node.args[0]):
+                    findings.append(
+                        Finding(
+                            "JAXP",
+                            rel,
+                            node.lineno,
+                            f"{f.id}() on a traced expression in jit-reached '{fn.name}' (host sync)",
+                        )
+                    )
+
+
+def run(ctx: Context) -> list[Finding]:
+    indices = [
+        _ModuleIndex(f)
+        for f in ctx.parsed()
+        if f.in_package("tpu_scheduler") and ("jit" in f.text or "pallas" in f.text)
+    ]
+    findings: list[Finding] = []
+    for fn, idx in sorted(_reachable(indices).items(), key=lambda kv: (kv[1].sf.rel, kv[0].lineno)):
+        _taint_check(fn, idx, findings)
+    return findings

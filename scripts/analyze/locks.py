@@ -1,0 +1,351 @@
+"""THRD — lock discipline over the hand-rolled threaded runtime.
+
+The runtime guards shared state with ``threading.Lock``/``RLock`` by
+convention; nothing checked the convention until now.  The contract this
+pass enforces:
+
+1. **Guarded attributes.**  An instance attribute assigned in ``__init__``
+   with a trailing ``# guarded-by: <lock>`` comment may only be read or
+   written inside a ``with self.<lock>:`` block within that class (or in
+   ``__init__`` itself — construction happens before the object is
+   shared).  ``<lock>`` is a dotted self-attribute path (``_lock``,
+   ``_server._lock``).
+
+2. **Holds-lock methods.**  A method whose ``def`` line carries
+   ``# holds-lock: <lock>`` declares "callers enter with <lock> held":
+   its body counts as guarded, and every ``self.<method>()`` call site in
+   the same class must itself hold the lock.
+
+3. **Aliases.**  ``self.cv = threading.Condition(self.lk)`` makes
+   ``with self.cv:`` acquire ``lk`` — the checker tracks the alias, so
+   condition-variable usage over a shared lock needs no annotation tricks.
+
+4. **Re-entry.**  Acquiring a plain ``threading.Lock`` (not RLock) that is
+   already held — directly, or by calling a same-class method that
+   acquires it — is a guaranteed deadlock, flagged immediately.
+
+5. **Lock-order graph.**  Every ordered acquisition (a ``with`` nested
+   under another, or a call made under lock A into a method of ANY
+   analyzed class that acquires lock B) adds edge A -> B to one
+   cross-module graph; a cycle is a potential deadlock and fails the
+   build.
+
+Soundness stance: lexical and conservative.  Accesses via a non-``self``
+receiver (another object's internals) and calls dispatched through
+variables are not tracked — false negatives over false positives, like the
+rest of this suite.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Context, Finding, SourceFile, self_attr_path
+
+CODES = {
+    "THRD": "a guarded-by attribute touched outside its lock, a plain-Lock re-entry, or a lock-order cycle",
+}
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+class _ClassInfo:
+    def __init__(self, sf: SourceFile, node: ast.ClassDef):
+        self.sf = sf
+        self.node = node
+        self.name = node.name
+        self.guarded: dict[str, str] = {}  # attr -> canonical lock path
+        self.aliases: dict[str, str] = {}  # condition attr -> wrapped lock path
+        self.lock_kinds: dict[str, str] = {}  # lock path -> "Lock" | "RLock" | "Condition"
+        self.holds: dict[str, set[str]] = {}  # method name -> locks callers must hold
+        self.acquires: dict[str, set[str]] = {}  # method name -> locks acquired directly (any depth)
+
+    def canon(self, path: str) -> str:
+        return self.aliases.get(path, path)
+
+    def qual(self, path: str) -> str:
+        return f"{self.name}.{self.canon(path)}"
+
+
+def _line_annotation(sf: SourceFile, lineno: int, rx: re.Pattern) -> str | None:
+    if 1 <= lineno <= len(sf.lines):
+        m = rx.search(sf.lines[lineno - 1])
+        if m:
+            return m.group(1)
+    return None
+
+
+def _threading_ctor(value: ast.expr) -> tuple[str, ast.expr | None] | None:
+    """Match ``threading.Lock()`` / ``Lock()`` / ``threading.Condition(x)``;
+    returns (ctor name, first positional arg or None)."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    name = None
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) and fn.value.id == "threading":
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    if name in ("Lock", "RLock", "Condition"):
+        return name, (value.args[0] if value.args else None)
+    return None
+
+
+def _scan_init(info: _ClassInfo) -> None:
+    # Dataclass-style declarations: class-body ``attr: T = ...`` lines carry
+    # the same annotations; the lock kind comes from the type annotation.
+    for stmt in info.node.body:
+        if not isinstance(stmt, ast.AnnAssign) or not isinstance(stmt.target, ast.Name):
+            continue
+        attr = stmt.target.id
+        ann = stmt.annotation
+        kind = ann.attr if isinstance(ann, ast.Attribute) else (ann.id if isinstance(ann, ast.Name) else None)
+        if kind in ("Lock", "RLock", "Condition"):
+            info.lock_kinds[attr] = kind
+        lock = _line_annotation(info.sf, stmt.lineno, _GUARDED_RE)
+        if lock is not None:
+            info.guarded[attr] = lock
+    init = next(
+        (n for n in info.node.body if isinstance(n, ast.FunctionDef) and n.name == "__init__"),
+        None,
+    )
+    if init is None:
+        return
+    for stmt in ast.walk(init):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target = stmt.targets[0]
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            target = stmt.target
+        else:
+            continue
+        attr = self_attr_path(target)
+        if attr is None or "." in attr:
+            continue
+        ctor = _threading_ctor(stmt.value)
+        if ctor is not None:
+            kind, arg = ctor
+            info.lock_kinds[attr] = kind
+            if kind == "Condition" and arg is not None:
+                wrapped = self_attr_path(arg)
+                if wrapped is not None:
+                    info.aliases[attr] = wrapped
+        lock = _line_annotation(info.sf, stmt.lineno, _GUARDED_RE)
+        if lock is not None:
+            info.guarded[attr] = lock  # canonicalized lazily (aliases may follow)
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """One method body: track the lexically-held lock set, check guarded
+    accesses and holds-lock call sites, record acquisitions and ordered
+    pairs for the global graph."""
+
+    def __init__(self, info: _ClassInfo, method: str, held: frozenset, findings, edges, calls_under):
+        self.info = info
+        self.method = method
+        self.held = held  # frozenset of canonical (unqualified) lock paths
+        self.findings = findings
+        self.edges = edges  # list of (qual_from, qual_to, rel, lineno)
+        self.calls_under = calls_under  # (held quals, callee, recv is self, class info, lineno)
+        self.acquired: set[str] = set()
+
+    # -- with blocks --------------------------------------------------------
+
+    def visit_With(self, node):
+        new = []
+        for item in node.items:
+            path = self_attr_path(item.context_expr)
+            if path is None:
+                continue
+            canon = self.info.canon(path)
+            # Only self-attribute chains that look like locks participate:
+            # a known threading ctor, a lock some attribute declares itself
+            # guarded by, or the naming convention (covers a lock living on
+            # a collaborator, e.g. ``with self._server._lock``).
+            last = canon.rsplit(".", 1)[-1]
+            if not (
+                canon in self.info.lock_kinds
+                or canon in self.info.guarded.values()
+                or "lock" in last
+                or last.endswith("_cv")
+            ):
+                continue
+            if canon in self.held or canon in new:
+                if self.info.lock_kinds.get(canon) == "Lock":
+                    self.findings.append(
+                        Finding(
+                            "THRD",
+                            self.info.sf.rel,
+                            node.lineno,
+                            f"{self.info.name}.{self.method} re-acquires plain Lock '{canon}' already held (deadlock)",
+                        )
+                    )
+                continue  # re-entrant RLock/Condition: no new order edge
+            for h in list(self.held) + new:
+                self.edges.append((self.info.qual(h), self.info.qual(canon), self.info.sf.rel, node.lineno))
+            new.append(canon)
+            self.acquired.add(canon)
+        if new:
+            inner = _MethodVisitor(
+                self.info, self.method, self.held | frozenset(new), self.findings, self.edges, self.calls_under
+            )
+            for child in node.body:
+                inner.visit(child)
+            self.acquired |= inner.acquired
+        else:
+            for child in node.body:
+                self.visit(child)
+
+    visit_AsyncWith = visit_With
+
+    # -- guarded attribute accesses ----------------------------------------
+
+    def visit_Attribute(self, node):
+        attr = self_attr_path(node)
+        if attr is not None and attr in self.info.guarded:
+            lock = self.info.canon(self.info.guarded[attr])
+            if lock not in self.held:
+                self.findings.append(
+                    Finding(
+                        "THRD",
+                        self.info.sf.rel,
+                        node.lineno,
+                        f"{self.info.name}.{self.method} touches '{attr}' (guarded-by {lock}) outside 'with self.{lock}'",
+                    )
+                )
+        self.generic_visit(node)
+
+    # -- calls: holds-lock contracts + cross-class order edges -------------
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            callee = fn.attr
+            recv_self = isinstance(fn.value, ast.Name) and fn.value.id == "self"
+            if recv_self and callee in self.info.holds:
+                for lock in sorted(self.info.holds[callee]):
+                    if self.info.canon(lock) not in self.held:
+                        self.findings.append(
+                            Finding(
+                                "THRD",
+                                self.info.sf.rel,
+                                node.lineno,
+                                f"{self.info.name}.{self.method} calls {callee}() (holds-lock: {lock}) without holding {lock}",
+                            )
+                        )
+            if self.held:
+                quals = frozenset(self.info.qual(h) for h in self.held)
+                self.calls_under.append((quals, callee, recv_self, self.info, node.lineno))
+        self.generic_visit(node)
+
+
+def _analyze_class(info: _ClassInfo, findings, edges, calls_under) -> None:
+    _scan_init(info)
+    # Canonicalize guards declared against a Condition alias.
+    for attr, lock in list(info.guarded.items()):
+        info.guarded[attr] = info.canon(lock)
+    for meth in info.node.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        holds = _line_annotation(info.sf, meth.lineno, _HOLDS_RE)
+        if holds is not None:
+            info.holds[meth.name] = {info.canon(holds)}
+    for meth in info.node.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)) or meth.name == "__init__":
+            continue
+        held = frozenset(info.holds.get(meth.name, ()))
+        v = _MethodVisitor(info, meth.name, held, findings, edges, calls_under)
+        for child in meth.body:
+            v.visit(child)
+        info.acquires[meth.name] = v.acquired
+
+
+def _find_cycles(edges: dict[tuple[str, str], tuple[str, int]]) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: list[list[str]] = []
+    seen_cycles: set[frozenset] = set()
+    state: dict[str, int] = {}  # 0 unvisited / 1 on stack / 2 done
+    stack: list[str] = []
+
+    def dfs(n: str) -> None:
+        state[n] = 1
+        stack.append(n)
+        for m in sorted(graph[n]):
+            if state.get(m, 0) == 0:
+                dfs(m)
+            elif state.get(m) == 1:
+                cyc = stack[stack.index(m):] + [m]
+                if frozenset(cyc) not in seen_cycles:
+                    seen_cycles.add(frozenset(cyc))
+                    cycles.append(cyc)
+        stack.pop()
+        state[n] = 2
+
+    for n in sorted(graph):
+        if state.get(n, 0) == 0:
+            dfs(n)
+    return cycles
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    edges: list[tuple[str, str, str, int]] = []
+    calls_under: list[tuple[frozenset, str, bool, _ClassInfo, int]] = []
+    infos: list[_ClassInfo] = []
+    for f in ctx.parsed():
+        for node in f.tree.body:
+            if isinstance(node, ast.ClassDef):
+                info = _ClassInfo(f, node)
+                infos.append(info)
+                _analyze_class(info, findings, edges, calls_under)
+
+    # Cross-class order edges: a call made under lock A to a method named m
+    # of ANY analyzed class adds A -> each lock m acquires.  Same-class
+    # self-calls resolve exactly; foreign receivers resolve by method name
+    # (conservative over-approximation — it can only ADD order edges).
+    method_locks: dict[str, set[tuple[str, str]]] = {}  # name -> {(class, qual lock)}
+    for info in infos:
+        for m, locks in info.acquires.items():
+            for lk in locks:
+                method_locks.setdefault(m, set()).add((info.name, info.qual(lk)))
+    for held_quals, callee, recv_self, info, lineno in calls_under:
+        targets = method_locks.get(callee, set())
+        if recv_self:
+            targets = {(c, q) for c, q in targets if c == info.name}
+        for _cls, q in sorted(targets):
+            for h in sorted(held_quals):
+                if h == q:
+                    # Re-entry through a call: fatal only for plain Locks.
+                    cls_name, lock_path = q.split(".", 1)
+                    owner = next((i for i in infos if i.name == cls_name), None)
+                    if owner is not None and owner.lock_kinds.get(lock_path) == "Lock" and recv_self:
+                        findings.append(
+                            Finding(
+                                "THRD",
+                                info.sf.rel,
+                                lineno,
+                                f"{info.name} calls {callee}() under plain Lock '{lock_path}' which {callee} re-acquires (deadlock)",
+                            )
+                        )
+                    continue
+                edges.append((h, q, info.sf.rel, lineno))
+
+    edge_map: dict[tuple[str, str], tuple[str, int]] = {}
+    for a, b, rel, lineno in edges:
+        if a != b:
+            edge_map.setdefault((a, b), (rel, lineno))
+    for cyc in _find_cycles(edge_map):
+        rel, lineno = edge_map[(cyc[0], cyc[1])]
+        findings.append(
+            Finding(
+                "THRD",
+                rel,
+                lineno,
+                "lock-acquisition-order cycle (potential deadlock): " + " -> ".join(cyc),
+            )
+        )
+    return findings

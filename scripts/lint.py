@@ -1,344 +1,24 @@
 #!/usr/bin/env python
-"""Zero-dependency lint gate — the error classes a round-2 regression shipped
-with (dead exports, stale imports) plus basic hygiene, implemented on the
-stdlib so the gate runs in the build image (which carries no linter).
+"""Back-compat shim — the lint gate moved to the ``scripts/analyze``
+package (single-parse driver, pluggable passes, baseline gate).
 
-Checks (all hard failures) — the whole lint policy lives HERE; every rule
-named in pyproject.toml executes on every `make check` (no config for
-linters the image cannot run):
-  F401  imported name never used in the module (``__init__.py`` re-exports
-        listed in ``__all__`` are exempt)
-  F822  ``__all__`` names a symbol the module does not define
-  F841  local variable assigned once and never read (conservative: plain
-        name targets only; ``_``-prefixed and tuple-unpacked names exempt —
-        unpacking documents structure)
-  E711  comparison to None with ==/!= (use is / is not)
-  E712  comparison to True/False with ==/!= (use the value or is)
-  B006  mutable default argument (list/dict/set literal or call)
-  DEAD  a non-underscore symbol in a module's ``__all__`` that no other file
-        in the package, tests, bench, or entry scripts references (the
-        round-2 'three dead soft scorers' class)
-  METR  a ``scheduler_*`` metric-name literal used anywhere in the package
-        that does not appear in the README metric catalogue — the docs
-        drift gate for the Observability section (a metric added without
-        cataloguing it would otherwise rot the docs silently)
-  SIMC  simulator catalogue drift (same pattern as METR, for the
-        "Simulation & chaos" README section): every registered scenario
-        name (``Scenario(name=...)`` in sim/scenarios.py), every chaos knob
-        (``ChaosConfig``/``ChaosWindow`` dataclass field), and every
-        scorecard top-level field (``SCORECARD_FIELDS``) must appear in
-        README.md
-  W291  trailing whitespace / W191 tabs in indentation
-  E999  syntax errors (via ast.parse)
-
-Usage: python scripts/lint.py [paths...]   (defaults to the package + tests)
+Every rule the monolithic lint.py enforced (F401/F822/F841/E711/E712/B006/
+DEAD/METR/SIMC/W291/W191/E999) was ported as a pass, joined by the
+repo-invariant analyzers THRD (lock discipline), JAXP (jit purity), and
+DTRM (sim determinism).  This shim execs the new driver with identical
+CLI semantics, so ``python scripts/lint.py [paths...]`` and the
+pre-commit hook keep working unchanged.  Prefer ``python -m
+scripts.analyze`` (it adds ``--rule``, ``--json``, ``--list-rules``).
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
-DEFAULT_PATHS = ["tpu_scheduler", "tests", "bench.py", "__graft_entry__.py", "scripts"]
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-def iter_py(paths: list[str]) -> list[pathlib.Path]:
-    out = []
-    for p in paths:
-        path = ROOT / p
-        if path.is_dir():
-            out.extend(sorted(path.rglob("*.py")))
-        elif path.suffix == ".py":
-            out.append(path)
-    return out
-
-
-class ImportUsage(ast.NodeVisitor):
-    """Collect imported names and every name/attribute usage."""
-
-    def __init__(self):
-        self.imports: dict[str, int] = {}  # bound name -> lineno
-        self.used: set[str] = set()
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = a.asname or a.name.split(".")[0]
-            self.imports[name] = node.lineno
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return  # future imports act by existing, never by reference
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imports[a.asname or a.name] = node.lineno
-
-    def visit_Name(self, node):
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-
-def module_all(tree: ast.Module) -> list[str]:
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__" and isinstance(node.value, (ast.List, ast.Tuple)):
-                    return [e.value for e in node.value.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)]
-    return []
-
-
-def top_level_defs(tree: ast.Module) -> set[str]:
-    names: set[str] = set()
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            names.add(node.name)
-        elif isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    names.add(t.id)
-                elif isinstance(t, ast.Tuple):
-                    names.update(e.id for e in t.elts if isinstance(e, ast.Name))
-        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
-            names.add(node.target.id)
-        elif isinstance(node, (ast.Import, ast.ImportFrom)):
-            for a in node.names:
-                if a.name != "*":
-                    names.add(a.asname or a.name.split(".")[0])
-    return names
-
-
-class FunctionScopeChecks(ast.NodeVisitor):
-    """Per-function rules: F841 unused locals, B006 mutable defaults."""
-
-    def __init__(self, relpath: str, errors: list[str]):
-        self.relpath = relpath
-        self.errors = errors
-
-    def _check_function(self, node):
-        # B006 — mutable literals/constructors as parameter defaults.
-        for default in list(node.args.defaults) + [d for d in node.args.kw_defaults if d is not None]:
-            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
-                isinstance(default, ast.Call)
-                and isinstance(default.func, ast.Name)
-                and default.func.id in ("list", "dict", "set")
-            ):
-                self.errors.append(f"{self.relpath}:{default.lineno}: B006 mutable default argument")
-        # F841 — plain-name single assignments never read in the function.
-        # STORES are collected from this function's OWN scope only (nested
-        # function bodies get their own visit — walking them here would
-        # double-report their dead stores against the outer scope); READS
-        # come from the full walk so a closure's use of an outer local still
-        # counts (conservative: an inner local shadowing an outer name can
-        # mask an outer dead store — false negatives over false positives).
-        def own_scope(n):
-            for child in ast.iter_child_nodes(n):
-                # Nested functions/lambdas AND class bodies are their own
-                # scopes — a class attribute is not a function local (it is
-                # read via ast.Attribute, which never registers as a Name
-                # Load, so walking it would hard-fail valid code).
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
-                    continue
-                yield child
-                yield from own_scope(child)
-
-        assigned: dict[str, int] = {}
-        read: set[str] = set()
-        exempt: set[str] = set()
-        for sub in ast.walk(node):
-            if sub is node:
-                continue
-            if isinstance(sub, ast.AugAssign) and isinstance(sub.target, ast.Name):
-                # x += v mutates x in place — a use, not a dead store (the
-                # ledger-accumulator pattern).
-                read.add(sub.target.id)
-            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
-                read.add(sub.id)
-        for sub in own_scope(node):
-            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
-                assigned.setdefault(sub.id, sub.lineno)
-            # global/nonlocal writes are module/outer-scope effects, and
-            # loop induction variables are iteration plumbing (ruff would
-            # file them under B007) — neither is an unused LOCAL.
-            if isinstance(sub, (ast.Global, ast.Nonlocal)):
-                exempt.update(sub.names)
-            elif isinstance(sub, (ast.For, ast.AsyncFor)):
-                exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
-            elif isinstance(sub, ast.comprehension):
-                exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
-            elif isinstance(sub, (ast.With, ast.AsyncWith)):
-                # `with ... as x:` targets are context handles pyflakes/ruff
-                # never file under F841 (e.g. pytest.raises(...) as exc).
-                for item in sub.items:
-                    if item.optional_vars is not None:
-                        exempt.update(n.id for n in ast.walk(item.optional_vars) if isinstance(n, ast.Name))
-            elif isinstance(sub, ast.Assign):
-                # Tuple-unpack targets document structure — exempt them.
-                for t in sub.targets:
-                    if isinstance(t, (ast.Tuple, ast.List)):
-                        exempt.update(n.id for n in ast.walk(t) if isinstance(n, ast.Name))
-        args = {a.arg for a in node.args.args + node.args.kwonlyargs + node.args.posonlyargs}
-        for name, lineno in sorted(assigned.items(), key=lambda kv: kv[1]):
-            if name in read or name in exempt or name in args or name.startswith("_"):
-                continue
-            if name in ("self", "cls"):
-                continue
-            self.errors.append(f"{self.relpath}:{lineno}: F841 local variable '{name}' assigned but never used")
-
-    def visit_FunctionDef(self, node):
-        self._check_function(node)
-        self.generic_visit(node)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-
-def comparison_checks(tree: ast.Module, relpath: str, errors: list[str]) -> None:
-    """E711 (== None) / E712 (== True/False) — either side of the ==."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Compare):
-            continue
-        # Operand i of op i is left for i == 0, else comparators[i-1]; check
-        # both sides so Yoda comparisons (None == x) are caught too.
-        operands = [node.left] + list(node.comparators)
-        for i, op in enumerate(node.ops):
-            if not isinstance(op, (ast.Eq, ast.NotEq)):
-                continue
-            for side in (operands[i], operands[i + 1]):
-                if not isinstance(side, ast.Constant):
-                    continue
-                if side.value is None:
-                    errors.append(f"{relpath}:{node.lineno}: E711 comparison to None (use 'is'/'is not')")
-                elif side.value is True or side.value is False:
-                    errors.append(f"{relpath}:{node.lineno}: E712 comparison to {side.value} (use the value or 'is')")
-
-
-def main(argv: list[str]) -> int:
-    files = iter_py(argv or DEFAULT_PATHS)
-    errors: list[str] = []
-    sources: dict[pathlib.Path, str] = {}
-    trees: dict[pathlib.Path, ast.Module] = {}
-
-    for f in files:
-        text = f.read_text()
-        sources[f] = text
-        try:
-            trees[f] = ast.parse(text, filename=str(f))
-        except SyntaxError as e:
-            errors.append(f"{f.relative_to(ROOT)}:{e.lineno}: E999 syntax error: {e.msg}")
-            continue
-        for i, line in enumerate(text.splitlines(), 1):
-            if line != line.rstrip():
-                errors.append(f"{f.relative_to(ROOT)}:{i}: W291 trailing whitespace")
-            if line.startswith("\t"):
-                errors.append(f"{f.relative_to(ROOT)}:{i}: W191 tab in indentation")
-
-    # F401 / F822 per module
-    for f, tree in trees.items():
-        exported = set(module_all(tree))
-        usage = ImportUsage()
-        usage.visit(tree)
-        # Names referenced in string annotations / docstring doctests are out
-        # of scope; __init__ re-exports are legitimate when listed in __all__.
-        is_init = f.name == "__init__.py"
-        src = sources[f]
-        for name, lineno in usage.imports.items():
-            if name in usage.used or name == "_":
-                continue
-            if is_init or name in exported:
-                continue
-            # A conservative text check catches usage forms the AST visitor
-            # does not model (e.g. inside f-string format specs).
-            if len(re.findall(rf"\b{re.escape(name)}\b", src)) > 1:
-                continue
-            errors.append(f"{f.relative_to(ROOT)}:{lineno}: F401 '{name}' imported but unused")
-        defined = top_level_defs(tree)
-        for name in exported:
-            if name not in defined:
-                errors.append(f"{f.relative_to(ROOT)}:1: F822 undefined name '{name}' in __all__")
-        relpath = str(f.relative_to(ROOT))
-        FunctionScopeChecks(relpath, errors).visit(tree)
-        comparison_checks(tree, relpath, errors)
-
-    # DEAD: exported but referenced nowhere else in the repo
-    pkg_files = [f for f in files if f.suffix == ".py"]
-    all_text = {f: sources[f] for f in pkg_files if f in sources}
-    for f, tree in trees.items():
-        if "tpu_scheduler" not in str(f) or f.name == "__init__.py":
-            continue
-        for name in module_all(tree):
-            refs = 0
-            for g, text in all_text.items():
-                hits = len(re.findall(rf"\b{re.escape(name)}\b", text))
-                if g == f:
-                    # definition + __all__ entry account for 2 mentions
-                    refs += max(0, hits - 2)
-                else:
-                    refs += hits
-            if refs == 0:
-                errors.append(f"{f.relative_to(ROOT)}:1: DEAD export '{name}' is referenced nowhere")
-
-    # METR: every scheduler_* metric name used in the package must be
-    # catalogued in the README Observability section.
-    metric_re = re.compile(r'"(scheduler_[a-z0-9_]+)"')
-    readme = (ROOT / "README.md").read_text() if (ROOT / "README.md").exists() else ""
-    metric_names: set[str] = set()
-    for f, text in sources.items():
-        rel = f.relative_to(ROOT)
-        if rel.parts[:1] == ("tpu_scheduler",):
-            metric_names.update(metric_re.findall(text))
-    for name in sorted(metric_names):
-        if name not in readme:
-            errors.append(
-                f"README.md:1: METR metric '{name}' is used in tpu_scheduler/ but missing from the README metric catalogue"
-            )
-
-    # SIMC: the simulator's scenario registry, chaos knobs, and scorecard
-    # schema must be catalogued in the README "Simulation & chaos" section.
-    sim_catalogue: list[tuple[str, str]] = []  # (kind, name)
-    for f, tree in trees.items():
-        rel = f.relative_to(ROOT)
-        if rel.parts[:2] != ("tpu_scheduler", "sim"):
-            continue
-        if f.name == "scenarios.py":
-            for node in ast.walk(tree):
-                if (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id == "Scenario"
-                ):
-                    for kw in node.keywords:
-                        if kw.arg == "name" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
-                            sim_catalogue.append(("scenario", kw.value.value))
-        elif f.name == "chaos.py":
-            for node in tree.body:
-                if isinstance(node, ast.ClassDef) and node.name in ("ChaosConfig", "ChaosWindow"):
-                    for stmt in node.body:
-                        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
-                            sim_catalogue.append(("chaos knob", stmt.target.id))
-        elif f.name == "scorecard.py":
-            for node in tree.body:
-                if isinstance(node, ast.Assign):
-                    for t in node.targets:
-                        if isinstance(t, ast.Name) and t.id == "SCORECARD_FIELDS" and isinstance(node.value, (ast.Tuple, ast.List)):
-                            for e in node.value.elts:
-                                if isinstance(e, ast.Constant) and isinstance(e.value, str):
-                                    sim_catalogue.append(("scorecard field", e.value))
-    for kind, name in sorted(set(sim_catalogue)):
-        if name not in readme:
-            errors.append(
-                f"README.md:1: SIMC {kind} '{name}' exists in tpu_scheduler/sim/ but is missing from the README \"Simulation & chaos\" catalogue"
-            )
-
-    for e in sorted(errors):
-        print(e)
-    print(f"lint: {len(files)} files, {len(errors)} errors")
-    return 1 if errors else 0
-
+from scripts.analyze.driver import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,557 @@
+"""Tests for the scripts/analyze static-analysis suite.
+
+Every rule gets at least one true-positive fixture and one
+false-positive-guard fixture; the repo-invariant passes (THRD/JAXP/DTRM)
+additionally prove they catch seeded violations the OLD monolithic lint.py
+(whose rule set survives as the hygiene/exports/catalogues passes) sailed
+past.  The baseline contract is pinned both in-unit and against the real
+tree: baseline.json entries must match current findings exactly — no new
+findings, no stale pins.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from scripts.analyze import catalogues, determinism, exports, hygiene, jitpure, locks  # noqa: E402
+from scripts.analyze.baseline import compare, load_baseline  # noqa: E402
+from scripts.analyze.core import DEFAULT_PATHS, Context, SourceFile, load_files  # noqa: E402
+from scripts.analyze.driver import PASSES, all_codes, run_passes  # noqa: E402
+
+LEGACY_PASSES = (hygiene, exports, catalogues)
+# Exactly the monolithic lint.py's rule codes (ANLZ/THRD/JAXP/DTRM are new).
+LEGACY_RULES = {"E999", "W291", "W191", "E711", "E712", "B006", "F841", "F401", "F822", "DEAD", "METR", "SIMC"}
+
+
+def make_ctx(*files: tuple[str, str], readme: str = "") -> Context:
+    out = []
+    for rel, code in files:
+        try:
+            tree = ast.parse(code)
+        except SyntaxError:
+            tree = None
+        out.append(SourceFile(path=pathlib.Path(rel), rel=rel, text=code, lines=code.splitlines(), tree=tree))
+    return Context(files=out, root=ROOT, readme=readme)
+
+
+def rule_hits(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def legacy_findings(ctx):
+    out = []
+    for p in LEGACY_PASSES:
+        out.extend(f for f in p.run(ctx) if f.rule in LEGACY_RULES)
+    return out
+
+
+# -- hygiene rules: true positive + guard each ------------------------------
+
+
+def test_e999_syntax_error_and_guard():
+    ctx = make_ctx(("tpu_scheduler/bad.py", "def f(:\n"))
+    assert rule_hits(run_passes(ctx), "E999")
+    ctx = make_ctx(("tpu_scheduler/ok.py", "def f():\n    return 1\n"))
+    assert not rule_hits(run_passes(ctx), "E999")
+
+
+def test_w291_trailing_whitespace_and_guard():
+    ctx = make_ctx(("m.py", "x = 1 \n"))
+    assert rule_hits(hygiene.run(ctx), "W291")
+    ctx = make_ctx(("m.py", "x = 1\n"))
+    assert not rule_hits(hygiene.run(ctx), "W291")
+
+
+def test_w191_tab_indentation_and_guard():
+    ctx = make_ctx(("m.py", "if True:\n\tpass\n"))
+    assert rule_hits(hygiene.run(ctx), "W191")
+    ctx = make_ctx(("m.py", "if True:\n    pass\n"))
+    assert not rule_hits(hygiene.run(ctx), "W191")
+
+
+def test_e711_none_comparison_and_guard():
+    ctx = make_ctx(("m.py", "def f(a):\n    return a == None\n"))
+    assert rule_hits(hygiene.run(ctx), "E711")
+    ctx = make_ctx(("m.py", "def f(a):\n    return a is None\n"))
+    assert not rule_hits(hygiene.run(ctx), "E711")
+
+
+def test_e712_bool_comparison_and_guard():
+    ctx = make_ctx(("m.py", "def f(a):\n    return True == a\n"))  # Yoda side too
+    assert rule_hits(hygiene.run(ctx), "E712")
+    ctx = make_ctx(("m.py", "def f(a):\n    return bool(a)\n"))
+    assert not rule_hits(hygiene.run(ctx), "E712")
+
+
+def test_b006_mutable_default_and_guard():
+    ctx = make_ctx(("m.py", "def f(x=[]):\n    return x\n"))
+    assert rule_hits(hygiene.run(ctx), "B006")
+    ctx = make_ctx(("m.py", "def f(x=()):\n    return x\n"))
+    assert not rule_hits(hygiene.run(ctx), "B006")
+
+
+def test_f841_unused_local_and_guard():
+    ctx = make_ctx(("m.py", "def f():\n    unused = 1\n    return 2\n"))
+    assert rule_hits(hygiene.run(ctx), "F841")
+    # Augmented assignment is a use (ledger pattern), not a dead store.
+    ctx = make_ctx(("m.py", "def f(xs):\n    total = 0\n    for x in xs:\n        total += x\n    return total\n"))
+    assert not rule_hits(hygiene.run(ctx), "F841")
+
+
+def test_f401_unused_import_and_guard():
+    ctx = make_ctx(("m.py", "import json\nimport os\n\n\ndef f():\n    return os.getpid()\n"))
+    hits = rule_hits(hygiene.run(ctx), "F401")
+    assert len(hits) == 1 and "'json'" in hits[0].message
+    # __init__.py re-exports are exempt.
+    ctx = make_ctx(("tpu_scheduler/x/__init__.py", "import json\n"))
+    assert not rule_hits(hygiene.run(ctx), "F401")
+
+
+def test_f822_phantom_export_and_guard():
+    ctx = make_ctx(("m.py", '__all__ = ["ghost"]\n'))
+    assert rule_hits(hygiene.run(ctx), "F822")
+    ctx = make_ctx(("m.py", '__all__ = ["real"]\n\n\ndef real():\n    return 1\n'))
+    assert not rule_hits(hygiene.run(ctx), "F822")
+
+
+# -- DEAD -------------------------------------------------------------------
+
+
+def test_dead_export_and_guard():
+    mod = ("tpu_scheduler/widgets.py", '__all__ = ["widget"]\n\n\ndef widget():\n    return 1\n')
+    ctx = make_ctx(mod)
+    assert rule_hits(exports.run(ctx), "DEAD")
+    ctx = make_ctx(mod, ("tests/test_widgets.py", "from tpu_scheduler.widgets import widget\n\nprint(widget())\n"))
+    assert not rule_hits(exports.run(ctx), "DEAD")
+
+
+# -- catalogue drift gates --------------------------------------------------
+
+
+def test_metr_drift_and_guard():
+    mod = ("tpu_scheduler/m.py", 'NAME = "scheduler_phantom_total"\n')
+    assert rule_hits(catalogues.run(make_ctx(mod, readme="")), "METR")
+    assert not rule_hits(catalogues.run(make_ctx(mod, readme="... scheduler_phantom_total ...")), "METR")
+
+
+def test_simc_drift_and_guard():
+    mod = (
+        "tpu_scheduler/sim/scenarios.py",
+        'def _register(s):\n    return s\n\n\n_register(Scenario(name="ghost-scenario"))\n',
+    )
+    assert rule_hits(catalogues.run(make_ctx(mod, readme="")), "SIMC")
+    assert not rule_hits(catalogues.run(make_ctx(mod, readme="| ghost-scenario |")), "SIMC")
+
+
+def test_anlz_drift_and_guard():
+    codes = sorted(all_codes())
+    partial_readme = " ".join(c for c in codes if c != "DTRM")
+    hits = rule_hits(catalogues.run(make_ctx(readme=partial_readme)), "ANLZ")
+    assert len(hits) == 1 and "'DTRM'" in hits[0].message
+    assert not rule_hits(catalogues.run(make_ctx(readme=" ".join(codes))), "ANLZ")
+
+
+# -- THRD lock discipline ---------------------------------------------------
+
+THRD_BAD = """import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # guarded-by: _lock
+
+    def bad(self):
+        self.items.append(2)
+"""
+
+THRD_GOOD = """import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.items = []  # guarded-by: _lock
+
+    def good(self):
+        with self._lock:
+            self.items.append(1)
+
+    def good_via_condition(self):
+        with self._cv:
+            self.items.append(2)
+
+    def helper(self):  # holds-lock: _lock
+        self.items.clear()
+
+    def good_call(self):
+        with self._lock:
+            self.helper()
+"""
+
+
+def test_thrd_unguarded_access_caught_but_old_lint_passed():
+    ctx = make_ctx(("tpu_scheduler/runtime/c.py", THRD_BAD))
+    assert not legacy_findings(ctx), "the old lint.py rule set must pass this snippet"
+    hits = rule_hits(locks.run(ctx), "THRD")
+    assert len(hits) == 1 and "'items'" in hits[0].message and "outside" in hits[0].message
+
+
+def test_thrd_guards_with_block_condition_alias_and_holds_lock():
+    ctx = make_ctx(("tpu_scheduler/runtime/c.py", THRD_GOOD))
+    assert not rule_hits(locks.run(ctx), "THRD")
+
+
+def test_thrd_holds_lock_call_site_check():
+    code = THRD_GOOD + "\n    def bad_call(self):\n        self.helper()\n"
+    hits = rule_hits(locks.run(make_ctx(("tpu_scheduler/runtime/c.py", code))), "THRD")
+    assert len(hits) == 1 and "helper()" in hits[0].message
+
+
+def test_thrd_plain_lock_reentry_is_deadlock():
+    code = (
+        "import threading\n\n\nclass C:\n"
+        "    def __init__(self):\n        self._lock = threading.Lock()\n"
+        "    def boom(self):\n        with self._lock:\n            with self._lock:\n                pass\n"
+    )
+    hits = rule_hits(locks.run(make_ctx(("m.py", code))), "THRD")
+    assert len(hits) == 1 and "deadlock" in hits[0].message
+    # RLock re-entry is legal — the guard case.
+    hits = rule_hits(locks.run(make_ctx(("m.py", code.replace("Lock()", "RLock()")))), "THRD")
+    assert not hits
+
+
+def test_thrd_lock_order_cycle_detection_and_guard():
+    cyclic = """import threading
+
+
+class A:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self.b = None
+
+    def one(self):
+        with self._a_lock:
+            self.b.two()
+
+
+class B:
+    def __init__(self):
+        self._b_lock = threading.Lock()
+        self.a = None
+
+    def two(self):
+        with self._b_lock:
+            pass
+
+    def three(self):
+        with self._b_lock:
+            self.a.one()
+"""
+    hits = rule_hits(locks.run(make_ctx(("m.py", cyclic))), "THRD")
+    assert len(hits) == 1 and "cycle" in hits[0].message
+    # Consistent order (B.three not taking A's lock) — no cycle.
+    acyclic = cyclic.replace("    def three(self):\n        with self._b_lock:\n            self.a.one()\n", "")
+    assert not rule_hits(locks.run(make_ctx(("m.py", acyclic))), "THRD")
+
+
+def test_thrd_dataclass_field_annotations():
+    code = """import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class R:
+    counters: dict = field(default_factory=dict)  # guarded-by: _lock
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def bad(self):
+        return len(self.counters)
+"""
+    hits = rule_hits(locks.run(make_ctx(("m.py", code))), "THRD")
+    assert len(hits) == 1 and "'counters'" in hits[0].message
+
+
+# -- JAXP jit purity --------------------------------------------------------
+
+JAXP_BAD = """import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("n",))
+def root(x, n):
+    y = jnp.sum(x)
+    if y > 0:
+        return helper(y)
+    return y
+
+
+def helper(y):
+    print(y)
+    t = time.monotonic()
+    z = np.asarray(y)
+    return float(jnp.abs(y)) + y.item() + t + z
+"""
+
+JAXP_GOOD = """import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("use_fast", "n"))
+def root(x, use_fast, n):
+    if use_fast:
+        return jnp.sum(x[:n])
+    return jnp.sum(x)
+
+
+def host_driver(x):
+    out = root(x, True, 4)
+    return float(out) + out.item()
+"""
+
+
+def test_jaxp_host_syncs_caught_but_old_lint_passed():
+    ctx = make_ctx(("tpu_scheduler/ops/m.py", JAXP_BAD))
+    assert not legacy_findings(ctx), "the old lint.py rule set must pass this snippet"
+    messages = [f.message for f in rule_hits(jitpure.run(ctx), "JAXP")]
+    assert any("Python 'if' on a traced expression" in m for m in messages)
+    assert any(".item() host sync" in m for m in messages)
+    assert any("print() host I/O" in m for m in messages)
+    assert any("time.monotonic() wall-clock" in m for m in messages)
+    assert any("np.asarray() materializes a tracer" in m for m in messages)
+    assert any("float() on a traced expression" in m for m in messages)
+
+
+def test_jaxp_static_branches_and_host_code_not_flagged():
+    ctx = make_ctx(("tpu_scheduler/ops/m.py", JAXP_GOOD))
+    # Static-arg branches inside jit and syncs in UNreached host code are fine.
+    assert not rule_hits(jitpure.run(ctx), "JAXP")
+
+
+def test_jaxp_reaches_through_jax_jit_call_form():
+    code = """import jax
+
+
+def build():
+    def inner(x):
+        return x.item()
+
+    return inner
+
+
+fn = jax.jit(build())
+"""
+    hits = rule_hits(jitpure.run(make_ctx(("tpu_scheduler/ops/m.py", code))), "JAXP")
+    assert len(hits) == 1 and ".item()" in hits[0].message
+
+
+# -- DTRM sim determinism ---------------------------------------------------
+
+DTRM_BAD = """import random
+import time
+
+
+def f(out):
+    t = time.time()
+    r = random.random()
+    for x in {1, 2, 3}:
+        out.append(x)
+    return t, r
+"""
+
+DTRM_GOOD = """import random
+
+
+def f(clock, rng: random.Random, out):
+    t = clock()
+    r = rng.random()
+    seeded = random.Random(42).random()
+    for x in sorted({1, 2, 3}):
+        out.append(x)
+    return t, r, seeded
+"""
+
+
+def test_dtrm_wall_clock_rng_and_set_iteration_caught_but_old_lint_passed():
+    ctx = make_ctx(("tpu_scheduler/sim/mod.py", DTRM_BAD))
+    assert not legacy_findings(ctx), "the old lint.py rule set must pass this snippet"
+    messages = [f.message for f in rule_hits(determinism.run(ctx), "DTRM")]
+    assert any("time.time()" in m for m in messages)
+    assert any("random.random()" in m for m in messages)
+    assert any("iteration over a set" in m for m in messages)
+    assert len(messages) == 3
+
+
+def test_dtrm_sanctioned_sources_not_flagged():
+    assert not determinism.run(make_ctx(("tpu_scheduler/sim/mod.py", DTRM_GOOD)))
+
+
+def test_dtrm_scoped_to_sim_package():
+    # The same violations OUTSIDE sim/ are not DTRM's business.
+    assert not determinism.run(make_ctx(("tpu_scheduler/runtime/mod.py", DTRM_BAD)))
+
+
+# -- baseline contract ------------------------------------------------------
+
+
+def test_baseline_matches_current_findings_exactly():
+    """baseline.json must pin exactly the findings the tree produces: no new
+    findings, no stale entries — and zero DTRM entries in sim/ (the
+    simulator is held to a clean bill, never a pinned one)."""
+    files = load_files(DEFAULT_PATHS)
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    findings = run_passes(ctx)
+    entries = load_baseline()
+    scope = {f.rel for f in files} | {"README.md"}
+    new, stale, baselined = compare(findings, entries, paths=scope)
+    assert not new, "unpinned findings: " + "; ".join(f.render() for f in new)
+    assert not stale, "stale baseline entries: " + json.dumps(stale)
+    assert len(baselined) == len(findings)
+    assert not [
+        e for e in entries if e["rule"] == "DTRM" and e["path"].startswith("tpu_scheduler/sim/")
+    ], "DTRM findings in sim/ must be fixed, never baselined"
+    for e in entries:
+        assert len(e["reason"]) >= 20, f"baseline reasons must justify, not gesture: {e}"
+
+
+def test_baseline_compare_new_and_stale_detection():
+    from scripts.analyze.core import Finding
+
+    found = [Finding("THRD", "a.py", 3, "msg-a")]
+    entries = [
+        {"rule": "THRD", "path": "a.py", "message": "msg-a", "reason": "pinned"},
+        {"rule": "DTRM", "path": "b.py", "message": "msg-gone", "reason": "pinned"},
+    ]
+    new, stale, baselined = compare(found + [Finding("JAXP", "c.py", 1, "msg-new")], entries)
+    assert [f.rule for f in new] == ["JAXP"]
+    assert [e["rule"] for e in stale] == ["DTRM"]
+    assert [f.rule for f in baselined] == ["THRD"]
+    # Line numbers are not identity: a moved finding stays pinned.
+    new, stale, _ = compare([Finding("THRD", "a.py", 99, "msg-a")], entries[:1])
+    assert not new and not stale
+
+
+# -- driver + shim ----------------------------------------------------------
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, *args], cwd=ROOT, capture_output=True, text=True, timeout=300
+    )
+
+
+def test_driver_exits_zero_on_tree():
+    proc = run_cli("-m", "scripts.analyze")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_shim_still_works():
+    proc = run_cli("scripts/lint.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analyze:" in proc.stdout
+
+
+def test_driver_rule_filter_and_json_output():
+    proc = run_cli("-m", "scripts.analyze", "--rule", "THRD", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert set(report) == {"files", "findings", "new", "stale"}
+    assert report["new"] == [] and report["stale"] == []
+    assert all(f["rule"] == "THRD" for f in report["findings"])
+    assert all(f["baselined"] for f in report["findings"])
+
+
+def test_driver_rejects_unknown_rule():
+    proc = run_cli("-m", "scripts.analyze", "--rule", "NOPE")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_driver_list_rules_covers_every_pass():
+    proc = run_cli("-m", "scripts.analyze", "--list-rules")
+    assert proc.returncode == 0
+    for p in PASSES:
+        for code in p.CODES:
+            assert code in proc.stdout
+
+
+# -- regression tests for the violations the suite surfaced -----------------
+
+
+def test_flight_recorder_seen_is_atomic():
+    """The pre-THRD ``seen`` probed membership under the lock, released it,
+    then recorded — two racing threads could both miss the probe and
+    double-record ``seen-pending``.  Now probe + append share one hold."""
+    from tpu_scheduler.utils.events import FlightRecorder
+
+    rec = FlightRecorder(max_pods=64)
+    barrier = threading.Barrier(8)
+
+    def hammer():
+        barrier.wait()
+        for _ in range(200):
+            rec.seen("default/racer", 1)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tl = rec.timeline("default/racer")
+    assert len(tl) == 1 and tl[0]["kind"] == "seen-pending"
+    # And the single-threaded contract is unchanged: seen is once-only.
+    rec.seen("default/racer", 2)
+    assert len(rec.timeline("default/racer")) == 1
+
+
+def test_tpu_backend_reads_variant_flags_under_guard_lock():
+    """The pre-THRD ``assign`` read the proving/disable flags without the
+    guard lock (a torn read against a concurrent strike).  Now the
+    eligibility decision happens under ``_guard_lock``: a disabled variant
+    is honored atomically, and assign genuinely serializes on the lock."""
+    import types
+
+    from tpu_scheduler.backends.tpu import TpuBackend
+
+    b = TpuBackend(use_pallas=True)
+    b._proven_variants.add(False)
+    b._disabled_variants.add(False)  # proven once, then struck out
+    seen = {}
+
+    def fake_assign_once(packed, profile, use_pallas):
+        seen["use_pallas"] = use_pallas
+        return "ok"
+
+    b._assign_once = fake_assign_once
+    packed = types.SimpleNamespace(constraints=None)
+    assert b.assign(packed, profile=None) == "ok"
+    assert seen["use_pallas"] is False  # the disable was honored
+    # assign must block while another thread holds the guard lock — the
+    # pre-fix code skipped the lock entirely once a variant was proven.
+    results = []
+    assert b._guard_lock.acquire()
+    t = threading.Thread(target=lambda: results.append(b.assign(packed, None)))
+    t.start()
+    t.join(0.3)
+    try:
+        assert t.is_alive(), "assign no longer takes the guard lock"
+    finally:
+        b._guard_lock.release()
+        t.join(10)
+    assert results == ["ok"]

@@ -71,15 +71,18 @@ class TpuBackend(SchedulingBackend):
         # Pallas programs, so a proven flagship kernel says nothing about the
         # constrained one's Mosaic fate — and a constrained-variant failure
         # must not take down a proven flagship kernel.
-        self._pallas_proven = False  # any variant proven (bench honesty flag)
-        self._proven_variants: set[bool] = set()  # {False: plain, True: constrained}
-        self._disabled_variants: set[bool] = set()
-        self._pallas_strikes: dict[bool, int] = {False: 0, True: 0}
+        self._pallas_proven = False  # guarded-by: _guard_lock — any variant proven (bench honesty flag)
+        self._proven_variants: set[bool] = set()  # guarded-by: _guard_lock — {False: plain, True: constrained}
+        self._disabled_variants: set[bool] = set()  # guarded-by: _guard_lock
+        self._pallas_strikes: dict[bool, int] = {False: 0, True: 0}  # guarded-by: _guard_lock
         # Serializes the first-use proving attempt: concurrent routed-shard
         # threads must not double-count strikes on one transient fault (the
         # guard tolerates exactly one) or race the unproven kernel.
         self._guard_lock = threading.Lock()
-        self._shards: dict = {}  # device id -> shard backend (see shard_for)
+        # Written only by shard_for (main-thread-only by routing.py's
+        # contract); read from worker threads by _drop_dev_cache — the two
+        # unlocked touches are pinned in scripts/analyze/baseline.json.
+        self._shards: dict = {}  # guarded-by: _put_lock — device id -> shard backend (see shard_for)
         # Host→device upload cache: the tunnel moves ~100 MB/s, so re-putting
         # an unchanged 21 MB pack costs ~0.25 s/cycle.  Keyed by host-array
         # identity (weakref-validated); safe because pack.py never mutates an
@@ -104,7 +107,7 @@ class TpuBackend(SchedulingBackend):
         # repack (found by a 800-cycle churn soak).  A flagship cycle
         # touches a few dozen arrays; evicting a live entry is always safe
         # (worst case: one re-upload).
-        self._dev_cache: dict[int, tuple[weakref.ref, object, object]] = {}
+        self._dev_cache: dict[int, tuple[weakref.ref, object, object]] = {}  # guarded-by: _put_lock
         self._dev_cache_cap = 512
         self._put_lock = threading.Lock()
 
@@ -208,7 +211,7 @@ class TpuBackend(SchedulingBackend):
         extras = {"acc_round": combined[1], "rank": combined[2]}
         return combined[0], int(combined[3, 0]), extras
 
-    def _variant_enabled(self, variant: bool) -> bool:
+    def _variant_enabled(self, variant: bool) -> bool:  # holds-lock: _guard_lock
         return self.use_pallas and variant not in self._disabled_variants
 
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
@@ -217,13 +220,16 @@ class TpuBackend(SchedulingBackend):
         # penalty masks enter as extra node-side operands (ops/pallas_choose
         # ``cons_pod``/``cons_node``); accept/commit stay jnp.
         variant = packed.constraints is not None
-        if self._variant_enabled(variant) and variant not in self._proven_variants:
-            with self._guard_lock:
+        # Eligibility flags are read under the guard lock, atomically with
+        # the proving/strike state they pair with — a concurrent routed
+        # shard disabling the variant must not be seen half-applied (the old
+        # unlocked reads were a benign-looking race the THRD pass flags).
+        with self._guard_lock:
+            if self._variant_enabled(variant) and variant not in self._proven_variants:
                 return self._assign_proving(packed, profile, variant)
+            use_pallas = self._variant_enabled(variant)
         try:
-            # Re-read eligibility at call time: another thread may have just
-            # disabled this variant under the guard lock.
-            return self._assign_once(packed, profile, use_pallas=self._variant_enabled(variant))
+            return self._assign_once(packed, profile, use_pallas=use_pallas)
         except jax.errors.JaxRuntimeError as e:
             # Device-runtime failure (OOM, device lost, …) — the recovery
             # scenario the native fallback exists for (SURVEY.md §5).  Python
@@ -231,7 +237,7 @@ class TpuBackend(SchedulingBackend):
             self._drop_dev_cache()
             raise BackendUnavailable(f"tpu backend runtime failure: {e}") from e
 
-    def _assign_proving(self, packed: PackedCluster, profile: SchedulingProfile, variant: bool):
+    def _assign_proving(self, packed: PackedCluster, profile: SchedulingProfile, variant: bool):  # holds-lock: _guard_lock
         """First-use pallas attempt for one kernel ``variant`` under the
         guard lock (a second thread re-checks the flags it may have just
         changed).  Failures strike/disable only THIS variant: a constrained-

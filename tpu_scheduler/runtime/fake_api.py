@@ -84,9 +84,9 @@ class Watch:
         self._server = server
         self._kind = kind
         self._match = _field_selector_fn(selector)
-        self._queue: deque[WatchEvent] = deque()
+        self._queue: deque[WatchEvent] = deque()  # guarded-by: _server._lock
 
-    def _offer(self, event: WatchEvent) -> None:
+    def _offer(self, event: WatchEvent) -> None:  # holds-lock: _server._lock
         if self._match(event.object):
             self._queue.append(event)
 
@@ -106,11 +106,11 @@ class FakeApiServer:
     def __init__(self, watch_history: int = 1 << 18, clock=None):
         self._clock = clock or time.monotonic
         self._lock = threading.RLock()
-        self._nodes: dict[str, Node] = {}
-        self._pods: dict[tuple[str, str], Pod] = {}  # (namespace, name)
-        self._pdbs: dict[str, object] = {}  # "ns/name" -> PodDisruptionBudget
-        self._rv = 0
-        self._watches: dict[str, set[Watch]] = {"Node": set(), "Pod": set()}
+        self._nodes: dict[str, Node] = {}  # guarded-by: _lock
+        self._pods: dict[tuple[str, str], Pod] = {}  # guarded-by: _lock — (namespace, name)
+        self._pdbs: dict[str, object] = {}  # guarded-by: _lock — "ns/name" -> PodDisruptionBudget
+        self._rv = 0  # guarded-by: _lock
+        self._watches: dict[str, set[Watch]] = {"Node": set(), "Pod": set()}  # guarded-by: _lock
         # Bounded event history for resourceVersion-based incremental watch
         # (the HTTP boundary's ``?watch=true&resourceVersion=N`` long-poll):
         # (rv, kind, event, prev_object), rv strictly increasing.  A list
@@ -118,7 +118,7 @@ class FakeApiServer:
         # after rv — O(log n + delta) per poll, not O(history).  A client
         # whose rv has been evicted gets 410 Gone and relists — the kube
         # watch-cache contract.
-        self._events_log: list[tuple[int, str, WatchEvent, Pod | Node | None]] = []
+        self._events_log: list[tuple[int, str, WatchEvent, Pod | Node | None]] = []  # guarded-by: _lock
         self._watch_history = watch_history
         self._events_cv = threading.Condition(self._lock)
         # Leader-election Leases (coordination.k8s.io/v1): (namespace, name)
@@ -126,14 +126,14 @@ class FakeApiServer:
         # metadata.resourceVersion; leadership is decided CLIENT-side from
         # spec.renewTime + leaseDurationSeconds (client-go semantics,
         # runtime/lease.py).
-        self._leases: dict[tuple[str, str], dict] = {}
+        self._leases: dict[tuple[str, str], dict] = {}  # guarded-by: _lock
         # Fault injection: number of upcoming binding calls to fail with 500.
         self.fail_next_bindings = 0
         self.binding_count = 0
 
     # -- internals ---------------------------------------------------------
 
-    def _emit(self, kind: str, event: WatchEvent, prev: Pod | Node | None = None, rv: int | None = None) -> None:
+    def _emit(self, kind: str, event: WatchEvent, prev: Pod | Node | None = None, rv: int | None = None) -> None:  # holds-lock: _lock
         if rv is None:
             rv = event.object.metadata.resource_version or self._rv
         self._events_log.append((rv, kind, event, prev))
@@ -144,7 +144,7 @@ class FakeApiServer:
             w._offer(event)
         self._events_cv.notify_all()
 
-    def _bump(self, obj: Pod | Node) -> None:
+    def _bump(self, obj: Pod | Node) -> None:  # holds-lock: _lock
         self._rv += 1
         obj.metadata.resource_version = self._rv
 

@@ -59,9 +59,9 @@ class FlightRecorder:
         self.per_pod = per_pod
         self.max_cycles = max_cycles
         self._lock = threading.Lock()
-        self._timelines: OrderedDict[str, deque] = OrderedDict()
-        self._cycles: deque = deque(maxlen=max(1, max_cycles))
-        self.evicted_timelines = 0  # LRU overflow — visible, never silent
+        self._timelines: OrderedDict[str, deque] = OrderedDict()  # guarded-by: _lock
+        self._cycles: deque = deque(maxlen=max(1, max_cycles))  # guarded-by: _lock
+        self.evicted_timelines = 0  # guarded-by: _lock — LRU overflow count; visible, never silent
         # Set by the CLI when --profile-dir is active so chrome_trace can
         # link the device trace next to the host spans.
         self.device_trace_dir: str | None = None
@@ -109,13 +109,22 @@ class FlightRecorder:
 
     def seen(self, pod_full: str, cycle: int) -> None:
         """Record ``seen-pending`` once — only for pods with no timeline yet
-        (O(1) dict probe; called for every pending pod every cycle)."""
+        (O(1) dict probe; called for every pending pod every cycle).
+
+        One lock hold for probe AND append: the old probe-unlock-record
+        shape was a TOCTOU — two threads racing the same new pod could both
+        miss the probe and double-record ``seen-pending`` (surfaced by the
+        THRD lock-discipline review; regression-pinned in test_analyze)."""
         if not self.enabled:
             return
         with self._lock:
-            known = pod_full in self._timelines
-        if not known:
-            self.record(pod_full, "seen-pending", cycle)
+            if pod_full in self._timelines:
+                return
+            while len(self._timelines) >= self.max_pods:
+                self._timelines.popitem(last=False)
+                self.evicted_timelines += 1
+            tl = self._timelines[pod_full] = deque(maxlen=self.per_pod)
+            tl.append({"ts": time.time(), "cycle": cycle, "kind": "seen-pending"})
 
     def seen_many(self, pod_fulls, cycle: int) -> None:
         """Batch ``seen``: ONE lock hold for a whole cycle's pending set —

@@ -109,15 +109,15 @@ class MetricsRegistry:
     fallbacks inside them) increment from worker threads, and the /metrics
     HTTP server reads concurrently."""
 
-    counters: dict[str, int] = field(default_factory=dict)
-    cycles: list[CycleMetrics] = field(default_factory=list)
+    counters: dict[str, int] = field(default_factory=dict)  # guarded-by: _lock
+    cycles: list[CycleMetrics] = field(default_factory=list)  # guarded-by: _lock
     started_at: float = field(default_factory=time.time)
-    _histograms: dict[str, dict[str, _Histogram]] = field(default_factory=dict, repr=False)
+    _histograms: dict[str, dict[str, _Histogram]] = field(default_factory=dict, repr=False)  # guarded-by: _lock
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     # -- writes (all under _lock) -----------------------------------------
 
-    def _inc(self, name: str, value: int, labels: dict[str, str] | None) -> None:
+    def _inc(self, name: str, value: int, labels: dict[str, str] | None) -> None:  # holds-lock: _lock
         key = name + format_labels(labels)
         self.counters[key] = self.counters.get(key, 0) + value
 
@@ -125,7 +125,7 @@ class MetricsRegistry:
         with self._lock:
             self._inc(name, value, labels)
 
-    def _observe(self, name: str, value: float, labels: dict[str, str] | None) -> None:
+    def _observe(self, name: str, value: float, labels: dict[str, str] | None) -> None:  # holds-lock: _lock
         per = self._histograms.setdefault(name, {})
         ls = format_labels(labels)
         h = per.get(ls)
