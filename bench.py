@@ -605,6 +605,61 @@ def sim_row(seed: int) -> dict:
         return {}
 
 
+def sim_sweep_row(seeds=(0, 1, 2), scenarios=("sim-smoke", "api-brownout-recovery")) -> dict:
+    """Scenario × seed sweep matrix with scorecard aggregation (ROADMAP
+    "scenario sweeps"): robustness regressions show up as NUMBERS — the
+    worst-case SLOs per scenario across seeds — instead of a single lucky
+    seed's verdict.  Per scenario: every seed must pass (including the
+    resilience gate: zero binds through an open breaker), and the p99
+    time-to-bind / backlog / brownout-recovery aggregates are the min /
+    median / max over the seed axis.  Deterministic in the seed list, so
+    two BENCH artifacts diff cleanly."""
+    import statistics as stats
+
+    try:
+        from tpu_scheduler.sim import run_scenario
+
+        t0 = time.perf_counter()
+        matrix: dict[str, dict] = {}
+        for name in scenarios:
+            p99s, backlogs, recoveries = [], [], []
+            passes, opened, while_open = [], 0, 0
+            for seed in seeds:
+                card = run_scenario(name, seed=seed)
+                passes.append(bool(card["pass"]))
+                p99s.append(card["slo"]["p99_time_to_bind_s"])
+                r = card["resilience"]
+                backlogs.append(r["max_pending_backlog"])
+                opened += r["breaker_opened"]
+                while_open += r["binds_while_open"]
+                if r["recovery_seconds_after_brownout"] is not None:
+                    recoveries.append(r["recovery_seconds_after_brownout"])
+            matrix[name] = {
+                "seeds": len(seeds),
+                "pass_all": all(passes),
+                "p99_ttb_s": {
+                    "min": round(min(p99s), 4),
+                    "median": round(stats.median(p99s), 4),
+                    "max": round(max(p99s), 4),
+                },
+                "max_backlog_worst": max(backlogs),
+                "breaker_opened_total": opened,
+                "binds_while_open_total": while_open,
+            }
+            if recoveries:
+                matrix[name]["recovery_s_worst"] = round(max(recoveries), 4)
+            log(
+                f"sim sweep {name}: pass_all={matrix[name]['pass_all']} "
+                f"p99 ttb worst {matrix[name]['p99_ttb_s']['max']}s, backlog worst {max(backlogs)}"
+            )
+        wall = time.perf_counter() - t0
+        log(f"sim sweep ({len(scenarios)} scenarios x {len(seeds)} seeds): {wall:.1f}s wall")
+        return {"sim_sweep": matrix, "sim_sweep_wall_seconds": round(wall, 2)}
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"sim sweep skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
 def previous_round_value(repo_dir: str, metric: str, platform: str) -> tuple[float, str] | None:
     """(value, source-file) of the newest BENCH_r*.json carrying the same
     metric on the SAME platform — the cross-round regression baseline
@@ -680,6 +735,14 @@ def main() -> int:
     ap.add_argument("--no-constrained-row", action="store_true")
     ap.add_argument("--no-e2e-row", action="store_true")
     ap.add_argument("--no-sim-row", action="store_true")
+    ap.add_argument("--no-sim-sweep", action="store_true")
+    ap.add_argument(
+        "--sim-sweep-seeds",
+        type=int,
+        default=3,
+        metavar="N",
+        help="sim sweep: seeds 0..N-1 per scenario (the scenario x seed robustness matrix)",
+    )
     ap.add_argument("--force-cpu", action="store_true", help="testing: skip the TPU entirely")
     ap.add_argument(
         "--fail-regression-threshold",
@@ -780,6 +843,10 @@ def main() -> int:
     # time — cheap (seconds of wall), deterministic in the seed.
     if not args.no_sim_row and _remaining() > 120:
         out.update(sim_row(args.seed))
+    # Scenario x seed robustness matrix (ROADMAP "scenario sweeps"): the
+    # worst-case SLO aggregates a robustness regression shows up in.
+    if not args.no_sim_sweep and _remaining() > 300:
+        out.update(sim_sweep_row(seeds=tuple(range(args.sim_sweep_seeds))))
     if not args.no_sharded_row and _remaining() > 120:
         row = sharded_scaling_row(8192, 512, args.seed)
         if row:

@@ -9,6 +9,9 @@ SIMC  — every registered scenario name, chaos knob, and scorecard field in
 ANLZ  — every rule code this analysis suite registers must appear in the
         README "Static analysis" catalogue (the gate gating its own docs —
         same pattern as METR/SIMC).
+RESC  — every backoff failure class, circuit-breaker state, and breaker
+        config knob in ``runtime/resilience.py`` must appear in the README
+        "Resilience" catalogue.
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ CODES = {
     "METR": "a scheduler_* metric used in the package but missing from the README metric catalogue",
     "SIMC": "a sim scenario/chaos knob/scorecard field missing from the README simulation catalogue",
     "ANLZ": "an analysis rule code missing from the README static-analysis catalogue",
+    "RESC": "a resilience backoff class/breaker state/config knob missing from the README Resilience catalogue",
 }
 
 _METRIC_RE = re.compile(r'"(scheduler_[a-z0-9_]+)"')
@@ -100,5 +104,43 @@ def _run_anlz(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_resc(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel != "tpu_scheduler/runtime/resilience.py":
+            continue
+        for node in f.tree.body:
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                targets: list[tuple[str, object]] = [(node.target.id, node.value)]
+            elif isinstance(node, ast.Assign):
+                targets = [(t.id, node.value) for t in node.targets if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.ClassDef) and node.name == "BreakerConfig":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                        tokens.append(("breaker knob", stmt.target.id))
+                continue
+            else:
+                continue
+            for name, value in targets:
+                if name == "DEFAULT_POLICIES" and isinstance(value, ast.Dict):
+                    for k in value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            tokens.append(("backoff class", k.value))
+                elif name == "STATES" and isinstance(value, (ast.Tuple, ast.List)):
+                    for e in value.elts:
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                            tokens.append(("breaker state", e.value))
+    return [
+        Finding(
+            "RESC",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in runtime/resilience.py but is missing from the README \"Resilience\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
-    return _run_metr(ctx) + _run_simc(ctx) + _run_anlz(ctx)
+    return _run_metr(ctx) + _run_simc(ctx) + _run_anlz(ctx) + _run_resc(ctx)

@@ -152,6 +152,19 @@ def test_simc_drift_and_guard():
     assert not rule_hits(catalogues.run(make_ctx(mod, readme="| ghost-scenario |")), "SIMC")
 
 
+def test_resc_drift_and_guard():
+    mod = (
+        "tpu_scheduler/runtime/resilience.py",
+        "DEFAULT_POLICIES = {\"ghost-class\": None}\n"
+        "STATES = (\"closed\", \"ghost-state\")\n"
+        "class BreakerConfig:\n    ghost_knob: int = 1\n",
+    )
+    hits = rule_hits(catalogues.run(make_ctx(mod, readme="closed")), "RESC")
+    assert {h.message.split("'")[1] for h in hits} == {"ghost-class", "ghost-state", "ghost_knob"}
+    ok_readme = "closed ghost-class ghost-state ghost_knob"
+    assert not rule_hits(catalogues.run(make_ctx(mod, readme=ok_readme)), "RESC")
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")

@@ -1,0 +1,428 @@
+"""Failure-class backoff queue + API circuit breaker (runtime/resilience.py).
+
+Pins the PR's resilience contracts:
+  • per-failure-class exponential backoff: fast-then-slow for server
+    trouble, long for no-feasible-node; caps, attempt counters, and
+    seeded-jitter determinism (same seed → identical requeue schedule)
+  • the requeue-ledger leak fix: entries for pods deleted while waiting
+    are pruned from the watch DELETE stream — standby cycles included
+  • breaker state transitions under ``ChaosApiServer`` timed windows:
+    closed→open on an error burst, timed half-open probing, re-open on a
+    failed probe with an escalated window, flush-on-recovery with no lost
+    or duplicate binds and ZERO binding POSTs while open
+  • checkpoint round-trip of the escalation state
+  • the /debug/resilience route and the circuit-state gauge
+  • chaos-trace backend parity: one recorded trace replayed against the
+    native and jax backends produces the same scorecard fingerprint
+"""
+
+import json
+import random
+
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.runtime.resilience import (
+    DEFAULT_POLICIES,
+    STATES,
+    BackoffQueue,
+    BreakerConfig,
+    CircuitBreaker,
+    open_intervals,
+)
+from tpu_scheduler.sim import ChaosApiServer, ChaosConfig, ChaosWindow, VirtualClock
+from tpu_scheduler.testing import make_node, make_pod
+
+# --- BackoffQueue ------------------------------------------------------------
+
+
+def test_backoff_first_attempt_is_exact_per_class():
+    q = BackoffQueue(base_seconds=300.0, rng=random.Random(0))
+    assert q.fail("d/no-node-pod", "no-node", now=0.0) == 300.0  # long class: full base
+    assert q.fail("d/api-pod", "api-error", now=0.0) == 300.0 / 8  # fast class
+    assert q.fail("d/net-pod", "network-error", now=0.0) == 300.0 / 8
+    assert q["d/no-node-pod"] == 300.0
+    assert set(DEFAULT_POLICIES) == {"api-error", "network-error", "binding-failed", "no-node", "gang", "other"}
+
+
+def test_backoff_escalates_with_jitter_band_and_cap():
+    q = BackoffQueue(base_seconds=8.0, rng=random.Random(1))
+    delays = [q.fail("d/p", "binding-failed", now=0.0) for _ in range(8)]
+    assert delays[0] == 1.0  # 8/8, exact on attempt 1
+    for i, d in enumerate(delays[1:], start=2):
+        raw = min(8.0 * 2.0, 1.0 * 2.0 ** (i - 1))
+        assert raw / 2 <= d <= raw  # full jitter in [d/2, d]
+    assert max(delays) <= 16.0  # 2x base cap for the fast class
+    assert q.attempts("d/p") == 8
+
+
+def test_backoff_zero_base_retries_immediately():
+    q = BackoffQueue(base_seconds=0.0, rng=random.Random(0))
+    assert q.fail("d/p", "no-node", now=5.0) == 0.0
+    assert q.eligible("d/p", 5.0)
+
+
+def test_backoff_class_change_resets_escalation():
+    q = BackoffQueue(base_seconds=10.0, rng=random.Random(0))
+    for _ in range(4):
+        q.fail("d/p", "no-node", now=0.0)
+    assert q.attempts("d/p") == 4
+    q.fail("d/p", "binding-failed", now=0.0)  # fresh evidence, fresh counter
+    assert q.attempts("d/p") == 1
+
+
+def test_backoff_pop_clears_attempt_state():
+    q = BackoffQueue(base_seconds=10.0, rng=random.Random(0))
+    q.fail("d/p", "no-node", now=0.0)
+    q.fail("d/p", "no-node", now=0.0)
+    q.pop("d/p", None)
+    assert q == {} and q.attempts("d/p") == 0
+    assert q.fail("d/p", "no-node", now=0.0) == 10.0  # starts over at attempt 1
+
+
+def test_backoff_same_seed_identical_schedule():
+    """Determinism satellite: the jitter rng is injected, so two queues fed
+    the same failure sequence from the same seed produce byte-identical
+    deadline schedules."""
+    def schedule(seed):
+        q = BackoffQueue(base_seconds=30.0, rng=random.Random(seed))
+        out = []
+        for i in range(20):
+            # Same class per pod so escalation (and its jitter) engages.
+            cls = ("no-node", "api-error", "binding-failed")[i % 5 % 3]
+            out.append(q.fail(f"d/p{i % 5}", cls, now=float(i)))
+        return out
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7) != schedule(8)
+
+
+def test_backoff_prune_deleted():
+    q = BackoffQueue(base_seconds=10.0, rng=random.Random(0))
+    q.fail("d/a", "no-node", now=0.0)
+    q.fail("d/b", "no-node", now=0.0)
+    assert q.prune_deleted(["d/a", "d/zzz"]) == 1
+    assert "d/a" not in q and "d/b" in q and q.attempts("d/a") == 0
+
+
+# --- CircuitBreaker ----------------------------------------------------------
+
+
+def _clocked_breaker(**cfg):
+    clock = VirtualClock()
+    b = CircuitBreaker(clock=clock, config=BreakerConfig(**cfg))
+    return clock, b
+
+
+def test_breaker_trips_on_error_burst_and_probes_back():
+    clock, b = _clocked_breaker(window=10, min_samples=4, failure_ratio=0.5, open_seconds=5.0, probe_successes=2)
+    assert b.mode() == "closed"
+    for _ in range(4):
+        b.record(False)
+    assert b.state == "open" and b.opened_total == 1
+    assert b.seconds_until_probe(clock.now) == 5.0
+    clock.advance(4.9)
+    assert b.mode() == "open"  # window not elapsed
+    clock.advance(0.2)
+    assert b.mode() == "half-open"
+    b.record(True)
+    assert b.state == "half-open"  # one probe success is not enough
+    b.record(True)
+    assert b.state == "closed"
+    assert [(f, t) for _, f, t in b.transitions] == [
+        ("closed", "open"), ("open", "half-open"), ("half-open", "closed")
+    ]
+
+
+def test_breaker_failed_probe_reopens_with_escalated_window():
+    clock, b = _clocked_breaker(window=10, min_samples=4, failure_ratio=0.5, open_seconds=5.0, max_open_seconds=60.0)
+    for _ in range(4):
+        b.record(False)
+    clock.advance(5.0)
+    assert b.mode() == "half-open"
+    b.record(False)  # probe fails
+    assert b.state == "open" and b.opened_total == 2
+    assert b.seconds_until_probe(clock.now) == 10.0  # 5 -> 10 escalation
+    clock.advance(10.0)
+    assert b.mode() == "half-open"
+    b.record(True)
+    b.record(True)
+    assert b.state == "closed"
+    iv = open_intervals(b.transitions, clock.now)
+    assert iv == [(0.0, 5.0), (5.0, 15.0)]
+
+
+def test_breaker_mixed_outcomes_below_ratio_stay_closed():
+    _clock, b = _clocked_breaker(window=10, min_samples=4, failure_ratio=0.5)
+    for i in range(40):
+        b.record(i % 3 == 0)  # 2/3 failures would trip; 1/3 failures must not
+        b.record(True)
+        b.record(True)
+    assert b.state == "closed" and b.opened_total == 0
+
+
+def test_breaker_disabled_ratio_never_trips():
+    _clock, b = _clocked_breaker(failure_ratio=2.0)
+    for _ in range(100):
+        b.record(False)
+    assert b.state == "closed"
+
+
+# --- controller-level degraded mode under ChaosApiServer windows -------------
+
+
+def _chaos_scheduler(n_pods=20, window=ChaosWindow(start=0.0, end=10.0, binding_error_rate=1.0), **sched_kw):
+    clock = VirtualClock()
+    inner = FakeApiServer(clock=clock)
+    inner.load(
+        nodes=[make_node(f"n{i}", cpu="64", memory="256Gi") for i in range(4)],
+        pods=[make_pod(f"p{i}", cpu="100m", memory="64Mi") for i in range(n_pods)],
+    )
+    chaos = ChaosApiServer(inner, ChaosConfig(windows=(window,)), rng=random.Random(0), clock=clock)
+    sched = Scheduler(
+        chaos, NativeBackend(), requeue_seconds=1.0, clock=clock, rng=random.Random(0), **sched_kw
+    )
+    return clock, inner, chaos, sched
+
+
+def test_breaker_opens_under_bind_500_window_and_stops_posting():
+    clock, inner, chaos, sched = _chaos_scheduler()
+    sched.run_cycle()  # every POST 500s -> breaker trips mid-cycle, rest defers
+    assert sched.breaker.state == "open"
+    assert sched.metrics.snapshot().get("scheduler_deferred_binds_total", 0) > 0
+    assert len(sched.deferred_binds) > 0
+    posts_at_open = inner.binding_count  # chaos 500s never reached the inner server
+    assert posts_at_open == 0
+    # While open, cycles compute but never POST: the inner count is frozen.
+    for _ in range(3):
+        clock.advance(1.0)
+        sched.run_cycle()
+        assert inner.binding_count == posts_at_open
+    assert all(p.spec is None or p.spec.node_name is None for p in inner.list_pods())
+
+
+def test_flush_on_recovery_binds_everything_exactly_once():
+    clock, inner, chaos, sched = _chaos_scheduler()
+    sched.run_cycle()
+    assert sched.breaker.state == "open"
+    deferred = dict(sched.deferred_binds)
+    assert deferred
+    # Past the chaos window AND the breaker's open window: probes succeed,
+    # the buffer flushes, every pod binds exactly once.
+    clock.advance(12.0)
+    for _ in range(20):
+        sched.run_cycle()
+        clock.advance(1.0)
+        if not sched.deferred_binds and all(
+            p.spec is not None and p.spec.node_name for p in inner.list_pods()
+        ):
+            break
+    assert sched.breaker.state == "closed"
+    assert sched.deferred_binds == {}
+    bound = [p for p in inner.list_pods() if p.spec is not None and p.spec.node_name]
+    assert len(bound) == 20  # nothing lost
+    names = [pf for _t, pf, _n in chaos.bind_log]
+    assert len(names) == len(set(names))  # nothing double-bound
+    counters = sched.metrics.snapshot()
+    assert counters.get("scheduler_flushed_binds_total", 0) == len(deferred)
+    # The verdict stream recorded the degraded path end to end.
+    tl = sched.recorder.timeline(sorted(deferred)[0])
+    kinds = [e["kind"] for e in tl]
+    assert "bind-deferred" in kinds and "bind-flushed" in kinds and kinds[-1] == "bound"
+
+
+def test_deferred_bind_dropped_when_pod_deleted_while_open():
+    clock, inner, chaos, sched = _chaos_scheduler(n_pods=12)
+    sched.run_cycle()
+    assert sched.breaker.state == "open"
+    victim = sorted(sched.deferred_binds)[0]
+    inner.delete_pod("default", victim.split("/", 1)[1])
+    clock.advance(1.0)
+    sched.run_cycle()  # the DELETE event prunes the deferred entry
+    assert victim not in sched.deferred_binds
+    assert sched.metrics.snapshot().get("scheduler_deferred_dropped_total", 0) >= 1
+    # Recovery must not resurrect it.
+    clock.advance(12.0)
+    for _ in range(10):
+        sched.run_cycle()
+        clock.advance(1.0)
+        if not sched.deferred_binds:
+            break
+    assert all(pf != victim for _t, pf, _n in chaos.bind_log)
+
+
+def test_watch_outcomes_feed_the_breaker():
+    clock = VirtualClock()
+    inner = FakeApiServer(clock=clock)
+    inner.load(nodes=[make_node("n1")], pods=[])
+    chaos = ChaosApiServer(
+        inner, ChaosConfig(watch_drop_rate=1.0), rng=random.Random(0), clock=clock
+    )
+    sched = Scheduler(chaos, NativeBackend(), requeue_seconds=1.0, clock=clock, rng=random.Random(0))
+    for _ in range(30):
+        sched.run_cycle()
+        clock.advance(2.0)  # past the reflector backoff so polls keep failing
+        if sched.breaker.state == "open":
+            break
+    assert sched.breaker.state == "open"  # a dead watch is brownout evidence
+
+
+# --- the requeue-ledger leak fix ---------------------------------------------
+
+
+def test_backoff_entry_pruned_when_pod_deleted_while_waiting():
+    api = FakeApiServer()
+    api.create_node(make_node("tiny", cpu="1", memory="1Gi"))
+    api.create_pod(make_pod("huge", cpu="64", memory="256Gi"))
+    sched = Scheduler(api, NativeBackend())
+    sched.run_cycle()
+    assert "default/huge" in sched.requeue_at
+    api.delete_pod("default", "huge")
+    sched.run_cycle()
+    assert "default/huge" not in sched.requeue_at
+    assert sched.requeue_at.attempts("default/huge") == 0  # escalation state gone too
+    assert sched.metrics.snapshot().get("scheduler_backoff_pruned_total", 0) == 1
+
+
+def test_backoff_entry_pruned_on_standby_cycles_too():
+    """The leak this PR closes: standby cycles skip the pending-set prune
+    (deliberately — a lease blip must not wipe live backoffs), so entries
+    for pods DELETED while standing by used to survive forever.  The watch
+    DELETE stream now prunes them on every cycle, standby included."""
+    api = FakeApiServer()
+    api.create_node(make_node("tiny", cpu="1", memory="1Gi"))
+    api.create_pod(make_pod("huge", cpu="64", memory="256Gi"))
+    sched = Scheduler(api, NativeBackend(), leader_elect=True, identity="a")
+    sched.run_cycle()  # leader; pod fails -> backoff entry
+    assert "default/huge" in sched.requeue_at
+    # Another instance takes the lease: this one stands by.
+    api.release_lease("tpu-scheduler", sched.identity)
+    assert api.acquire_lease("tpu-scheduler", "rival", 3600.0)
+    api.delete_pod("default", "huge")
+    sched.run_cycle()  # standby cycle
+    assert not sched.is_leader
+    assert "default/huge" not in sched.requeue_at  # pruned despite standby
+    sched.close()
+
+
+# --- checkpoint round-trip ---------------------------------------------------
+
+
+def test_checkpoint_roundtrips_backoff_escalation(tmp_path):
+    from tests.conftest import FakeClock
+    from tpu_scheduler.runtime.checkpoint import restore_scheduler, save_scheduler
+
+    api = FakeApiServer()
+    api.load(nodes=[make_node("n1", cpu="0", memory="0")], pods=[make_pod("stuck", cpu="1", memory="1Gi")])
+    clock = FakeClock()
+    clock.t = 100.0
+    sched = Scheduler(api, NativeBackend(), clock=clock, rng=random.Random(0))
+    sched.run_cycle()
+    clock.t += 1000.0
+    sched.run_cycle()  # second failure escalates the attempt counter
+    assert sched.requeue_at.attempts("default/stuck") == 2
+    save_scheduler(sched, str(tmp_path))
+
+    clock2 = FakeClock()
+    sched2 = Scheduler(api, NativeBackend(), clock=clock2, rng=random.Random(0))
+    restore_scheduler(sched2, str(tmp_path))
+    assert isinstance(sched2.requeue_at, BackoffQueue)  # never replaced by a plain dict
+    assert sched2.requeue_at.attempts("default/stuck") == 2  # escalation survived
+
+
+# --- metrics + debug surfaces ------------------------------------------------
+
+
+def test_circuit_state_gauge_and_backoff_histogram_exposed():
+    api = FakeApiServer()
+    api.load(nodes=[make_node("n1", cpu="1", memory="1Gi")], pods=[make_pod("huge", cpu="64", memory="256Gi")])
+    sched = Scheduler(api, NativeBackend())
+    sched.run_cycle()
+    text = sched.metrics.to_prometheus()
+    assert "# TYPE scheduler_circuit_state gauge" in text
+    assert f"scheduler_circuit_state {float(STATES.index('closed'))}" in text
+    assert "# TYPE scheduler_backoff_seconds histogram" in text
+    assert 'scheduler_backoff_seconds_bucket{reason="no-node"' in text
+
+
+def test_debug_resilience_route():
+    import urllib.request
+
+    from tpu_scheduler.runtime.http_api import HttpApiServer
+
+    api = FakeApiServer()
+    api.load(nodes=[make_node("n1", cpu="1", memory="1Gi")], pods=[make_pod("huge", cpu="64", memory="256Gi")])
+    sched = Scheduler(api, NativeBackend())
+    sched.run_cycle()
+    server = HttpApiServer(api, metrics=sched.metrics, recorder=sched.recorder,
+                           resilience=sched.resilience_snapshot).start()
+    try:
+        with urllib.request.urlopen(f"{server.base_url}/debug/resilience") as resp:
+            body = json.loads(resp.read())
+        assert body["breaker"]["state"] == "closed"
+        assert body["backoff"]["entries"] == 1
+        assert "no-node" in body["backoff"]["by_class"]
+        assert body["deferred_binds"]["count"] == 0
+        # Not attached -> 404, not a crash.
+        server2 = HttpApiServer(api, metrics=sched.metrics, recorder=sched.recorder).start()
+        try:
+            import urllib.error
+
+            try:
+                urllib.request.urlopen(f"{server2.base_url}/debug/resilience")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server2.stop()
+    finally:
+        server.stop()
+
+
+# --- the api-brownout-recovery scenario + chaos backend parity ---------------
+
+
+def test_api_brownout_recovery_scenario_slos():
+    """ISSUE acceptance: fixed seed, 0 binds while the breaker is open,
+    0 lost/duplicate pods, bounded recovery after the window closes."""
+    from tpu_scheduler.sim import run_scenario
+
+    card = run_scenario("api-brownout-recovery", seed=0)
+    assert card["pass"], json.dumps(card["invariants"], indent=2)
+    r = card["resilience"]
+    assert r["breaker_opened"] >= 1  # the blackout really tripped it
+    assert r["binds_while_open"] == 0
+    assert r["deferred_binds"] > 0 and r["flushed_binds"] == r["deferred_binds"]
+    assert r["recovery_seconds_after_brownout"] is not None
+    assert r["recovery_seconds_after_brownout"] < 30.0  # bounded recovery
+    assert card["pods"]["lost"] == 0 and card["pods"]["double_bound"] == 0
+    assert card["pods"]["pending_final"] == 0  # the backlog fully drained
+
+
+def test_chaos_trace_replays_identically_on_native_and_jax_backends(tmp_path):
+    """ROADMAP "backend parity under chaos": one recorded chaos trace
+    replayed against the native and jax (TpuBackend-on-CPU) engines must
+    produce the SAME scorecard fingerprint — the determinism cross-check
+    the static parity tests cannot express."""
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.sim import Scenario, WorkloadSpec, run_scenario
+    from tpu_scheduler.sim.scenarios import SCENARIOS
+
+    sc = Scenario(
+        name="parity-mini",
+        description="test-only",
+        duration=8.0,
+        workload=WorkloadSpec(initial_nodes=5, arrival_rate=3.0, lifetime_mean_s=6.0),
+        chaos=ChaosConfig(windows=(ChaosWindow(start=1.0, end=4.0, binding_error_rate=0.4),)),
+    )
+    path = str(tmp_path / "trace.jsonl")
+    registered = SCENARIOS.setdefault("parity-mini", sc)
+    try:
+        live = run_scenario(sc, seed=11, record=path)
+        native = run_scenario(None, replay=path)  # raises ReplayMismatchError on divergence
+        jax_card = run_scenario(None, replay=path, backend=TpuBackend(use_pallas=False))
+    finally:
+        if registered is sc:
+            del SCENARIOS["parity-mini"]
+    fps = {"live": live["fingerprint"], "native": native["fingerprint"], "jax": jax_card["fingerprint"]}
+    assert len(set(fps.values())) == 1, f"chaos-replay fingerprints diverged: {fps}"

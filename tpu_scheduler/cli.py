@@ -82,7 +82,35 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--interval", type=float, default=1.0, help="daemon mode: idle sleep between settled cycles (seconds)")
     p.add_argument("--attempts", type=int, default=ATTEMPTS, help="sample policy: candidates per pod (reference ATTEMPTS)")
-    p.add_argument("--requeue-seconds", type=float, default=REQUEUE_SECONDS, help="failed-pod requeue delay")
+    p.add_argument(
+        "--requeue-seconds",
+        type=float,
+        default=REQUEUE_SECONDS,
+        help="failed-pod backoff base: per-failure-class exponential delays scale on it (runtime/resilience.py); 0 retries immediately",
+    )
+    p.add_argument(
+        "--breaker-open-seconds",
+        type=float,
+        default=5.0,
+        help="circuit breaker: first open window after tripping (escalates x2 while probes fail, capped at 60s)",
+    )
+    p.add_argument(
+        "--breaker-window",
+        type=int,
+        default=20,
+        help="circuit breaker: rolling bind/watch outcome window the failure ratio trips on",
+    )
+    p.add_argument(
+        "--no-breaker",
+        action="store_true",
+        help="disable the API circuit breaker (every bind POSTs immediately, brownout or not)",
+    )
+    p.add_argument(
+        "--flush-capacity",
+        type=int,
+        default=4096,
+        help="degraded mode: max binding POSTs deferred while the breaker is open (overflow requeues instead)",
+    )
     p.add_argument("--no-fallback", action="store_true", help="disable tpu->native failure fallback")
     p.add_argument("--log-level", default="INFO")
     p.add_argument(
@@ -221,6 +249,15 @@ def main(argv: list[str] | None = None) -> int:
         profile = profile.with_(pool_key=args.pool_key)
     if args.preemption:
         profile = profile.with_(preemption=True)
+    from .runtime.resilience import BreakerConfig
+
+    breaker_config = BreakerConfig(
+        window=args.breaker_window,
+        open_seconds=args.breaker_open_seconds,
+        # A ratio above 1 can never be reached: --no-breaker keeps the
+        # machinery (metrics, /debug/resilience) but never trips it.
+        failure_ratio=2.0 if args.no_breaker else BreakerConfig.failure_ratio,
+    )
     sched = Scheduler(
         api,
         backend,
@@ -235,6 +272,8 @@ def main(argv: list[str] | None = None) -> int:
         lease_name=args.lease_name,
         lease_duration=args.lease_duration,
         events_buffer=args.events_buffer,
+        breaker_config=breaker_config,
+        flush_capacity=args.flush_capacity,
     )
     if args.profile_dir:
         # Link the device trace from /debug/trace's Chrome-trace JSON so the
@@ -257,7 +296,11 @@ def main(argv: list[str] | None = None) -> int:
         # API server owns the cluster state.
         local_api = None if (args.api_server or args.kubeconfig is not None) else api
         http_server = HttpApiServer(
-            local_api, metrics=sched.metrics, recorder=sched.recorder, port=args.http_port
+            local_api,
+            metrics=sched.metrics,
+            recorder=sched.recorder,
+            resilience=sched.resilience_snapshot,
+            port=args.http_port,
         ).start()
         print(json.dumps({"http": True, "url": http_server.base_url}), file=sys.stderr)
 

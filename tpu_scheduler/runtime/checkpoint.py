@@ -47,6 +47,12 @@ def save_scheduler(scheduler, path: str) -> None:
         "counters": dict(scheduler.metrics.counters),
         # monotonic deadlines -> remaining seconds (clamped at 0)
         "requeue_remaining": {k: max(0.0, v - now) for k, v in scheduler.requeue_at.items()},
+        # Per-pod backoff escalation (failure class + attempt count): a
+        # restart must not reset a long no-node escalation back to the fast
+        # first-attempt delay.  Deferred binds are deliberately NOT
+        # persisted: they were never POSTed, so the pods are still Pending
+        # on the API server and a restarted scheduler simply re-places them.
+        "requeue_meta": {k: [cls, n] for k, (cls, n) in scheduler.requeue_at.meta().items()},
         # NoExecute tolerationSeconds clocks as ELAPSED time per
         # (pod, taint-key, taint-value): restarts/leader hand-offs must not
         # grant affected pods a fresh grace window (round-3 advisor) — under
@@ -126,7 +132,13 @@ def restore_scheduler(scheduler, path: str) -> bool:
     for name, value in state.get("counters", {}).items():
         scheduler.metrics.counters[name] = value
     now = scheduler.clock()
-    scheduler.requeue_at = {k: now + rem for k, rem in state.get("requeue_remaining", {}).items()}
+    # Fold into the BackoffQueue IN PLACE (never replace it with a plain
+    # dict — the controller's failure-class escalation lives on it); old
+    # checkpoints without requeue_meta restore with attempts reset to 0.
+    scheduler.requeue_at.restore(
+        {k: now + rem for k, rem in state.get("requeue_remaining", {}).items()},
+        {k: (cls, int(n)) for k, (cls, n) in state.get("requeue_meta", {}).items()},
+    )
     scheduler._noexecute_seen = {
         tuple(key): now - elapsed for key, elapsed in state.get("noexecute_elapsed", [])
     }

@@ -16,8 +16,11 @@ Two scheduling policies behind one loop:
 Shared semantics with the reference:
   • watches pending pods / all nodes through reflectors (main.rs:133-144)
   • skips already-bound pods (main.rs:74-76)
-  • failed pods (no node, binding error) requeue after ``requeue_seconds``
-    (error_policy, main.rs:122-125; default 300 s)
+  • failed pods (no node, binding error) requeue with failure-class-aware
+    exponential backoff scaled on ``requeue_seconds`` (the reference's flat
+    error_policy delay, main.rs:122-125, upgraded — runtime/resilience.py;
+    default base 300 s), and an API circuit breaker defers binding POSTs
+    into a bounded flush buffer while the server browns out
   • TPU-backend failure falls back to the native backend (SURVEY.md §5
     failure handling; the --backend flag makes native the recovery path).
 """
@@ -62,6 +65,7 @@ from ..utils.metrics import CycleMetrics, MetricsRegistry
 from ..utils.tracing import Trace, current_trace, set_log_cycle, span
 from .fake_api import ApiError, FakeApiServer
 from .reflector import ClusterReflector
+from .resilience import STATES, BackoffQueue, BreakerConfig, CircuitBreaker
 
 logger = logging.getLogger("tpu_scheduler.controller")
 
@@ -171,6 +175,9 @@ class Scheduler:
         lease_duration: float = 15.0,
         constraint_budgets: dict | None = None,
         events_buffer: int = 4096,
+        breaker_config: BreakerConfig | None = None,
+        flush_capacity: int = 4096,
+        backoff_policies: dict | None = None,
     ):
         if policy not in ("batch", "sample"):
             raise ValueError(f"unknown policy {policy!r} (expected 'batch' or 'sample')")
@@ -213,7 +220,21 @@ class Scheduler:
         self._pod_by_full_cache: tuple | None = None
         self._cycle_tag = 0  # the running cycle's number, for event stamps
         self._cycle_notes: list[str] = []  # cycle-level annotations (fallbacks)
-        self.requeue_at: dict[str, float] = {}  # pod full name -> retry time
+        # Per-pod backoff queue (runtime/resilience.py): pod full name ->
+        # retry deadline, with per-failure-class exponential escalation.
+        # Jitter draws from the scheduler rng, so one seed still reproduces
+        # a whole run (the sim determinism contract).  Dict-compatible —
+        # the checkpoint and the gang deadline alignment use it as a dict.
+        self.requeue_at = BackoffQueue(base_seconds=requeue_seconds, rng=self.rng, policies=backoff_policies)
+        # API-server circuit breaker: fed by bind/watch outcomes; while open
+        # the cycle runs in DEGRADED MODE — placements are computed but the
+        # binding POSTs defer into self.deferred_binds (bounded) and flush
+        # on recovery, so a brownout costs latency, never lost pods.
+        self.breaker = CircuitBreaker(clock=clock, config=breaker_config, on_transition=self._on_breaker_transition)
+        self.metrics.set_gauge("scheduler_circuit_state", float(STATES.index(self.breaker.state)))
+        self.deferred_binds: dict[str, str] = {}  # pod full name -> node (insertion order = flush order)
+        self.flush_capacity = flush_capacity
+        self._probe_left = 0  # half-open trial binds remaining this cycle
         # Peak observed healthy per budget — the desired-replica proxy the
         # maxUnavailable deficit uses for externally degraded workloads:
         # key -> (peak, cycle the peak was last MET).  The peak holds for
@@ -307,14 +328,21 @@ class Scheduler:
 
     def _requeue(self, pod_name: str, reason: str | SchedulerError) -> None:
         """Requeue a failed pod — the reference's error_policy
-        (``main.rs:122-125``): the reconcile error (errors.py mirrors
-        ``error.rs:3-15``) becomes a delayed retry, never a crash."""
-        self.requeue_at[pod_name] = self.clock() + self.requeue_seconds
+        (``main.rs:122-125``) upgraded to failure-class-aware exponential
+        backoff (runtime/resilience.py): transient server trouble retries
+        fast-then-slow, a no-feasible-node verdict backs off long, and the
+        reconcile error (errors.py mirrors ``error.rs:3-15``) stays a
+        delayed retry, never a crash."""
         cls = self._requeue_reason_class(reason)
+        delay = self.requeue_at.fail(pod_name, cls, self.clock())
         self.metrics.inc("scheduler_requeues_total")
         self.metrics.inc("scheduler_requeues_by_reason_total", labels={"reason": cls})
+        self.metrics.observe("scheduler_backoff_seconds", delay, labels={"reason": cls})
         self.recorder.record(pod_name, "requeued", self._cycle_tag, reason=cls, detail=str(reason))
-        logger.warning("reconcile failed on pod %s: %s; requeue in %.0fs", pod_name, reason, self.requeue_seconds)
+        logger.warning(
+            "reconcile failed on pod %s: %s; requeue in %.1fs (attempt %d)",
+            pod_name, reason, delay, self.requeue_at.attempts(pod_name),
+        )
 
     def _evict_noexecute(self, snapshot: ClusterSnapshot) -> set[str]:
         """NoExecute taint lifecycle (kube's taint manager, which the
@@ -426,19 +454,71 @@ class Scheduler:
 
     # -- binding (main.rs:83-115) -----------------------------------------
 
+    def _on_breaker_transition(self, t: float, frm: str, to: str) -> None:
+        """Breaker state changes surface everywhere an operator looks:
+        labeled counter, the state gauge, the cycle notes ring, the log."""
+        self.metrics.inc("scheduler_circuit_transitions_total", labels={"to": to})
+        self.metrics.set_gauge("scheduler_circuit_state", float(STATES.index(to)))
+        self._cycle_notes.append(f"circuit-breaker: {frm} -> {to}")
+        logger.warning("API circuit breaker %s -> %s (%d deferred binds held)", frm, to, len(self.deferred_binds))
+
+    def _defer_bind(self, pod_full: str, node_name: str) -> bool:
+        """Degraded mode: the placement is decided but the POST waits out
+        the open breaker in the bounded flush buffer.  Returns True so the
+        caller commits capacity exactly as for a dispatched bind — the
+        deferred pod overlays as bound next cycle (never re-scheduled,
+        never double-bound).  A full buffer requeues instead (counted)."""
+        if len(self.deferred_binds) >= self.flush_capacity:
+            self.metrics.inc("scheduler_deferred_overflow_total")
+            self._requeue(pod_full, "api-error: circuit breaker open and flush buffer full")
+            return False
+        self.deferred_binds[pod_full] = node_name
+        self.requeue_at.pop(pod_full, None)
+        self.metrics.inc("scheduler_deferred_binds_total")
+        self.recorder.record(pod_full, "bind-deferred", self._cycle_tag, node=node_name, detail="circuit open")
+        return True
+
     def _bind(self, namespace: str, name: str, node_name: str) -> bool:
+        """Breaker-gated bind: POST when the circuit is closed (or as one of
+        the half-open cycle's trial binds); defer into the flush buffer
+        while it is open.  Zero POSTs ever happen through an open breaker —
+        the degraded-mode invariant the sim scorecard pins."""
+        mode = self.breaker.mode()
+        if mode == "open" or (mode == "half-open" and self._probe_left <= 0):
+            return self._defer_bind(f"{namespace}/{name}", node_name)
+        if mode == "half-open":
+            self._probe_left -= 1
+        return self._post_binding(namespace, name, node_name)
+
+    def _post_binding(self, namespace: str, name: str, node_name: str, flush: bool = False) -> bool:
+        """The actual binding POST + outcome taxonomy; every outcome feeds
+        the breaker.  ``flush`` marks a deferred bind being flushed: its
+        optimistic pods-bound count was taken at defer time, so a flush
+        failure corrects the series instead of re-counting."""
         pod_full = f"{namespace}/{name}"
         try:
             self.api.create_binding(namespace, name, ObjectReference(name=node_name))
+            self.breaker.record(True)
             logger.info("Binding pod %s to %s", pod_full, node_name)
             self.metrics.inc("scheduler_bindings_total")
+            if flush:
+                self.metrics.inc("scheduler_flushed_binds_total")
+                self.recorder.record(pod_full, "bind-flushed", self._cycle_tag, node=node_name)
             self.recorder.record(pod_full, "bound", self._cycle_tag, node=node_name)
             self.requeue_at.pop(pod_full, None)
             return True
         except CreateBindingFailed as e:
+            self.breaker.record(False)
+            if flush:
+                self.metrics.inc("scheduler_pods_bound_total", -1)
             self._requeue(pod_full, f"create-binding-failed: {e}")
             return False
         except ApiError as e:
+            # A 4xx is a HEALTHY server refusing this one request; only
+            # 5xx counts against the breaker's server-health window.
+            self.breaker.record(e.code < 500)
+            if flush:
+                self.metrics.inc("scheduler_pods_bound_total", -1)
             if e.code == 409:
                 # Already bound elsewhere (await_change, main.rs:74-76).
                 logger.info("pod %s already bound; skipping", pod_full)
@@ -452,8 +532,68 @@ class Scheduler:
             # not auto-retry POSTs, so the error surfaces here — requeue
             # this one pod instead of crashing the whole cycle
             # (error_policy, main.rs:122-125).
+            self.breaker.record(False)
+            if flush:
+                self.metrics.inc("scheduler_pods_bound_total", -1)
             self._requeue(pod_full, f"network-error: {type(e).__name__}: {e}")
             return False
+
+    def _flush_or_overlay_deferred(self, snapshot: ClusterSnapshot, mode: str) -> ClusterSnapshot:
+        """Reconcile the deferred-bind buffer against the cycle snapshot:
+        drop stale entries (pod deleted / bound out-of-band / target node
+        gone), flush what the breaker allows (everything when closed, the
+        probe budget when half-open), and overlay what remains as bound so
+        the cycle neither re-schedules a deferred pod nor re-uses its
+        capacity."""
+        by_full = {full_name(p): p for p in snapshot.pods}
+        node_names = {n.name for n in snapshot.nodes}
+        for pf in [pf for pf, node in self.deferred_binds.items()
+                   if (p := by_full.get(pf)) is None or is_pod_bound(p) or node not in node_names]:
+            del self.deferred_binds[pf]
+            # The defer optimistically counted the pod bound; correct it.
+            self.metrics.inc("scheduler_deferred_dropped_total")
+            self.metrics.inc("scheduler_pods_bound_total", -1)
+        if mode == "half-open":
+            batch = list(self.deferred_binds.items())[: self._probe_left]
+        elif mode == "closed":
+            batch = list(self.deferred_binds.items())
+        else:
+            batch = []
+        flushed: dict[str, str] = {}
+        for pf, node_name in batch:
+            # Re-check per POST: a probe failure mid-flush re-opens the
+            # breaker, and the rest of the batch must stay deferred (the
+            # zero-binds-while-open invariant holds even inside a flush).
+            mode = self.breaker.mode()
+            if mode == "open":
+                break
+            if mode == "half-open":
+                if self._probe_left <= 0:
+                    break
+                self._probe_left -= 1
+            del self.deferred_binds[pf]
+            namespace, _, name = pf.rpartition("/")
+            if self._post_binding(namespace or "default", name, node_name, flush=True):
+                flushed[pf] = node_name
+        if flushed:
+            logger.info("flushed %d deferred bind(s) after breaker recovery (%d still held)",
+                        len(flushed), len(self.deferred_binds))
+        # Overlay survivors AND just-flushed pods as bound clones (the
+        # assumed-cache pattern): the snapshot was taken before the flush
+        # POSTs, so without the overlay this very cycle would re-schedule a
+        # freshly flushed pod straight into a 409.
+        overlay = {**self.deferred_binds, **flushed}
+        if not overlay:
+            return snapshot
+        node_by = {n.name: n for n in snapshot.nodes}
+        pods = []
+        for p in snapshot.pods:
+            target = overlay.get(full_name(p))
+            if target is not None and not is_pod_bound(p):
+                pods.append(self._bound_clone(p, node_by[target]))
+            else:
+                pods.append(p)
+        return ClusterSnapshot.build(snapshot.nodes, pods)
 
     # -- batch policy ------------------------------------------------------
 
@@ -942,10 +1082,14 @@ class Scheduler:
                     tr.record("bind", err)  # the overlapped POST time, attributed at drain
                 continue
             if err is None:
+                self.breaker.record(True)
                 self.metrics.inc("scheduler_bindings_total")
                 self.recorder.record(pod_full, "bound", self._cycle_tag, node=self._assumed.get(pod_full))
                 self.requeue_at.pop(pod_full, None)
                 continue
+            # Server-health taxonomy mirrors _post_binding: 4xx = healthy
+            # server refusing one request; 5xx/transport = breaker evidence.
+            self.breaker.record(isinstance(err, ApiError) and err.code < 500)
             self._assumed.pop(pod_full, None)
             # The dispatching cycle optimistically counted this pod bound
             # (observe_cycle); correct the series so pods_bound_total stays
@@ -1189,9 +1333,12 @@ class Scheduler:
                 part = partition_snapshot(snapshot, self.profile.pool_key)
                 if part is not None:
                     return self._run_routed_cycle(snapshot, part, placed)
-            if self.pipeline:
+            if self.pipeline and self.breaker.mode() == "closed":
                 # PP: hand the binds to a worker thread; the next cycle's
-                # sync/pack/solve overlaps this cycle's host I/O.
+                # sync/pack/solve overlaps this cycle's host I/O.  Degraded
+                # cycles (breaker not closed) bind synchronously instead so
+                # every outcome feeds the breaker — and an open breaker
+                # defers rather than POSTs.
                 return self._schedule_batch_pipelined(snapshot)
             # Fast path — one tensor cycle over every pending pod (and the
             # incremental device-resident pack stays hot).
@@ -1650,7 +1797,38 @@ class Scheduler:
                     # the cycle proceeds on last-known reflector state.
                     self.metrics.inc("scheduler_watch_errors_total", err_delta)
                     self._watch_errors_folded = self.reflector.errors_seen
+                    # Watch failures are API-brownout evidence too (capped:
+                    # two reflectors contribute at most a couple per cycle,
+                    # and a backlog of folded errors must not flood the
+                    # breaker's rolling window in one cycle).
+                    self.breaker.record(False, n=min(int(err_delta), 4))
+                elif self.reflector.healthy:
+                    self.breaker.record(True)
                 snapshot = self.reflector.snapshot()
+            # Prune per-pod ledgers from the watch DELETE stream — runs on
+            # EVERY cycle, standby included (the standby path deliberately
+            # skips the pending-set prune below, which used to leak backoff
+            # entries for pods deleted while this instance stood by).
+            deleted = self.reflector.take_deleted_pods()
+            if deleted:
+                pruned = 0
+                for ns, name in deleted:
+                    pf = f"{ns or 'default'}/{name}"
+                    if self.requeue_at.pop(pf, None) is not None:
+                        pruned += 1
+                    self._assumed.pop(pf, None)
+                    if self.deferred_binds.pop(pf, None) is not None:
+                        self.metrics.inc("scheduler_deferred_dropped_total")
+                        self.metrics.inc("scheduler_pods_bound_total", -1)
+                if pruned:
+                    self.metrics.inc("scheduler_backoff_pruned_total", pruned)
+            # Degraded-mode bookkeeping: promote the breaker if its open
+            # window elapsed, arm this cycle's half-open probe budget, then
+            # flush recovered deferred binds / overlay the still-held ones.
+            breaker_mode = self.breaker.mode()
+            self._probe_left = self.breaker.config.probe_budget if breaker_mode == "half-open" else 0
+            if self.deferred_binds:
+                snapshot = self._flush_or_overlay_deferred(snapshot, breaker_mode)
             if self.pipeline:
                 # Fold a FINISHED bind batch (never block — blocking here
                 # would serialize the pipeline); then hide confirmed /
@@ -1870,6 +2048,22 @@ class Scheduler:
                     self._join_binds()
                     flush_tries += 1
                     continue
+                if self.deferred_binds:
+                    # Deferred binds are waiting out an open circuit
+                    # breaker: a run must not settle with decided-but-
+                    # unPOSTed placements.  Ride out the open window like
+                    # an unhealthy watch, bounded by the same settle
+                    # timeout so a permanently dead server still fails
+                    # loudly instead of parking forever.
+                    wait = min(5.0, max(0.05, self.breaker.seconds_until_probe(self.clock())))
+                    unhealthy_idle += wait
+                    if unhealthy_idle >= settle_timeout:
+                        raise RuntimeError(
+                            f"circuit breaker {self.breaker.state} with {len(self.deferred_binds)} "
+                            f"deferred binds after {settle_timeout:.0f}s of settling"
+                        )
+                    sleep(wait)
+                    continue
                 if self.reflector.healthy:
                     break
                 # Sleep out the backoff window instead of spinning no-op
@@ -1908,6 +2102,22 @@ class Scheduler:
                     self.is_leader = False
 
         threading.Thread(target=renew, daemon=True).start()
+
+    def resilience_snapshot(self) -> dict:
+        """The /debug/resilience payload: breaker state + transition tail,
+        backoff-queue stats by failure class, deferred-bind buffer fill.
+        Called from the HTTP server thread; all three structures are
+        written only by the main cycle loop, and the reads below take
+        GIL-atomic whole-dict copies (the same benign-snapshot stance as
+        the backends' _shards baseline) — no lock needed or taken."""
+        now = self.clock()
+        deferred = dict(self.deferred_binds)
+        sample = dict(list(deferred.items())[:20])
+        return {
+            "breaker": self.breaker.debug(now),
+            "backoff": self.requeue_at.debug(now),
+            "deferred_binds": {"count": len(deferred), "capacity": self.flush_capacity, "sample": sample},
+        }
 
     def close(self) -> None:
         """Release pipeline resources (drain the in-flight bind batch, stop

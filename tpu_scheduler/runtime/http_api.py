@@ -55,11 +55,20 @@ class HttpApiServer:
     503."""
 
     def __init__(
-        self, api: FakeApiServer | None, metrics=None, recorder=None, host: str = "127.0.0.1", port: int = 0
+        self,
+        api: FakeApiServer | None,
+        metrics=None,
+        recorder=None,
+        resilience=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
     ):
         self.api = api
         self.metrics = metrics
         self.recorder = recorder  # utils/events.FlightRecorder (the /debug routes)
+        # () -> dict producing the /debug/resilience payload (the
+        # controller's resilience_snapshot: breaker + backoff + deferred).
+        self.resilience = resilience
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -148,6 +157,15 @@ class HttpApiServer:
                     elif parsed.path == "/metrics":
                         text = outer.metrics.to_prometheus() if outer.metrics is not None else ""
                         self._send(200, text.encode(), "text/plain; version=0.0.4")
+                    elif parsed.path == "/debug/resilience":
+                        # Backoff queue + circuit breaker + deferred-bind
+                        # buffer — served even with the flight recorder
+                        # disabled (it is controller state, not recorder
+                        # state).
+                        if outer.resilience is None:
+                            self._send_json(404, {"message": "resilience state not attached"})
+                        else:
+                            self._send_json(200, outer.resilience())
                     elif parsed.path.startswith("/debug/") and outer.recorder is None:
                         self._send_json(404, {"message": "flight recorder not attached (events buffer disabled)"})
                     elif parsed.path == "/debug/cycles":

@@ -157,6 +157,11 @@ class ClusterReflector:
         # largest fixed cost); folding watch deltas into this index keeps
         # snapshot() at O(deltas) + one cheap copy-on-write pass.
         self._by_node: dict[str, list] = {}
+        # Pod DELETE events since the last drain — the controller prunes its
+        # per-pod ledgers (backoff queue, assumed/deferred binds) from this
+        # stream so a pod deleted mid-backoff cannot leak its entry, even
+        # across standby cycles that deliberately skip the pending-set prune.
+        self._deleted_pods: list[tuple[str | None, str]] = []
         self._dirty = True  # anything changed since the last snapshot()
         self._last_snap: ClusterSnapshot | None = None
 
@@ -165,6 +170,8 @@ class ClusterReflector:
 
     def _pod_event(self, key, prev, new) -> None:
         self._dirty = True
+        if new is None:
+            self._deleted_pods.append(key)  # (namespace, name)
         prev_node = prev.spec.node_name if prev is not None and prev.spec is not None else None
         new_node = new.spec.node_name if new is not None and new.spec is not None else None
         if prev_node is not None and (prev_node != new_node or prev is not new):
@@ -180,6 +187,12 @@ class ClusterReflector:
     def sync(self) -> tuple[int, int]:
         """Drain both watches; returns (node_events, pod_events)."""
         return len(self.nodes.sync()), len(self.pods.sync())
+
+    def take_deleted_pods(self) -> list[tuple[str | None, str]]:
+        """Drain the (namespace, name) keys of pods deleted since the last
+        call — the controller's per-pod-ledger prune feed."""
+        out, self._deleted_pods = self._deleted_pods, []
+        return out
 
     @property
     def errors_seen(self) -> int:

@@ -309,6 +309,7 @@ def run_scenario(
 
     cycles = 0
     no_progress = 0
+    max_pending = 0
     hard_cap = int(3 * sc.duration / sc.cycle_interval) + 400
     while True:
         now = clock.now
@@ -344,6 +345,7 @@ def run_scenario(
         cycles += 1
         new_binds = fold_outcomes()
         pending = len(inner.list_pods("status.phase=Pending"))
+        max_pending = max(max_pending, pending)
         if writer:
             writer.cycle(clock.now, cycles, new_binds, pending)
         no_progress = 0 if (new_binds or pending == 0) else no_progress + 1
@@ -376,6 +378,34 @@ def run_scenario(
         (p.metadata.name, p.spec.node_name) for p in api_pods.values() if p.spec is not None and p.spec.node_name
     ]
     fp = fingerprint(chaos.bind_log, placements)
+    # Resilience verdict inputs: the breaker's open spans vs the CONFIRMED
+    # bind stream (a POST inside an open span is the degraded-mode bug the
+    # scorecard's pass gate rejects), recovery time after the last chaos
+    # window, and the worst backlog the run ever held.
+    # Strictly interior, on 9-decimal-rounded bounds (bind_log timestamps
+    # are rounded the same way): virtual time is discrete, so the POST that
+    # tripped the breaker (or a success completing in the same instant)
+    # shares the open-start timestamp, and a half-open probe shares the
+    # open-end one — both happened through a not-yet/no-longer open breaker.
+    open_iv = [(round(s, 9), round(e, 9)) for s, e in sched.breaker.open_intervals(end_t)]
+    binds_while_open = sum(1 for t, _pf, _n in chaos.bind_log if any(s < t < e for s, e in open_iv))
+    last_window_end = max((w.end for w in sc.chaos.windows), default=None)
+    recovery_s = None
+    if last_window_end is not None:
+        after = [t for t, _pf, _n in chaos.bind_log if t >= last_window_end]
+        recovery_s = round(after[0] - last_window_end, 6) if after else None
+    metrics_snapshot = sched.metrics.snapshot()
+    resilience = {
+        "breaker_transitions": len(sched.breaker.transitions),
+        "breaker_opened": sched.breaker.opened_total,
+        "breaker_open_seconds": round(sum(e - s for s, e in open_iv), 6),
+        "binds_while_open": binds_while_open,
+        "recovery_seconds_after_brownout": recovery_s,
+        "max_pending_backlog": max_pending,
+        "deferred_binds": int(metrics_snapshot.get("scheduler_deferred_binds_total", 0)),
+        "flushed_binds": int(metrics_snapshot.get("scheduler_flushed_binds_total", 0)),
+        "backoff_pruned": int(metrics_snapshot.get("scheduler_backoff_pruned_total", 0)),
+    }
     card = build_scorecard(
         scenario=sc.name,
         seed=seed,
@@ -385,9 +415,10 @@ def run_scenario(
         pod_counts=pod_counts,
         ttb=st.ttb,
         backlog_pod_seconds=backlog,
-        metrics_snapshot=sched.metrics.snapshot(),
+        metrics_snapshot=metrics_snapshot,
         invariants=invariants,
         chaos_injected=chaos.injected,
+        resilience=resilience,
         recorder_stats={
             "tracked_pods": len(sched.recorder.tracked_pods()),
             "evicted_timelines": sched.recorder.evicted_timelines,

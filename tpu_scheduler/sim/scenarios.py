@@ -113,6 +113,24 @@ _register(
 
 _register(
     Scenario(
+        name="api-brownout-recovery",
+        description="A hard 20s API blackout (every binding POST 500s, watches drop): the circuit breaker must open, defer binds with ZERO POSTs while open, then probe half-open, flush the buffer, and drain the backlog after the window closes",
+        duration=90.0,
+        workload=WorkloadSpec(initial_nodes=50, arrival_rate=10.0, lifetime_mean_s=30.0),
+        chaos=ChaosConfig(
+            windows=(
+                ChaosWindow(start=20.0, end=40.0, binding_error_rate=1.0, watch_drop_rate=0.5, api_error_rate=0.3),
+            ),
+        ),
+        # The open window escalates (5 -> 10 -> 20s virtual) while probes
+        # keep failing inside the blackout; give the post-window drain
+        # enough grace to cover one full escalated re-open.
+        drain_grace_cycles=25,
+    )
+)
+
+_register(
+    Scenario(
         name="gang-heavy",
         description="40% of arrivals are 2-8 member gangs across priority tiers on an OVERSUBSCRIBED cluster with preemption on — all-or-nothing admission under real contention",
         duration=80.0,

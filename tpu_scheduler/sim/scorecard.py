@@ -40,6 +40,7 @@ SCORECARD_FIELDS = (
     "slo",
     "invariants",
     "chaos_injected",
+    "resilience",
     "flight_recorder",
     "fingerprint",
 )
@@ -161,6 +162,7 @@ def build_scorecard(
     metrics_snapshot: dict,
     invariants: dict,
     chaos_injected: dict,
+    resilience: dict,
     recorder_stats: dict,
     fp: str,
 ) -> dict:
@@ -183,8 +185,14 @@ def build_scorecard(
         "scenario": scenario,
         "seed": seed,
         "mode": mode,
+        # The degraded-mode invariant rides the verdict: a binding POST
+        # through an OPEN circuit breaker is a resilience-layer bug even
+        # when every placement invariant holds.
         "pass": bool(
-            invariants.get("ok") and pod_counts.get("lost", 1) == 0 and pod_counts.get("double_bound", 1) == 0
+            invariants.get("ok")
+            and pod_counts.get("lost", 1) == 0
+            and pod_counts.get("double_bound", 1) == 0
+            and resilience.get("binds_while_open", 0) == 0
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -192,6 +200,7 @@ def build_scorecard(
         "slo": slo,
         "invariants": invariants,
         "chaos_injected": dict(sorted(chaos_injected.items())),
+        "resilience": resilience,
         "flight_recorder": recorder_stats,
         "fingerprint": fp,
     }

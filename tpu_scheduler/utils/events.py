@@ -41,6 +41,10 @@ EVENT_KINDS = (
     "unschedulable",
     "preempted",
     "evicted",
+    # degraded-mode verdicts (runtime/resilience.py): the bind was computed
+    # but POSTing waited out an open circuit breaker / flushed on recovery.
+    "bind-deferred",
+    "bind-flushed",
 )
 
 

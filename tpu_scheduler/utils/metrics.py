@@ -31,6 +31,9 @@ __all__ = ["CycleMetrics", "MetricsRegistry", "format_labels", "escape_label_val
 LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 # Auction rounds per cycle: the round-5 work holds the flagship at 2.
 ROUNDS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+# Requeue backoff delays (seconds): sub-second fast-class retries through
+# the reference's 5-minute flat delay and the long no-node escalation cap.
+BACKOFF_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 150.0, 300.0, 600.0, 1200.0)
 
 # Histogram name -> bucket bounds; the one registration point the README
 # drift gate (scripts/lint.py) and to_prometheus share.
@@ -39,6 +42,7 @@ HISTOGRAM_BUCKETS = {
     "scheduler_phase_seconds": LATENCY_BUCKETS,
     "scheduler_binding_seconds": LATENCY_BUCKETS,
     "scheduler_cycle_rounds": ROUNDS_BUCKETS,
+    "scheduler_backoff_seconds": BACKOFF_BUCKETS,
 }
 
 
@@ -113,6 +117,7 @@ class MetricsRegistry:
     cycles: list[CycleMetrics] = field(default_factory=list)  # guarded-by: _lock
     started_at: float = field(default_factory=time.time)
     _histograms: dict[str, dict[str, _Histogram]] = field(default_factory=dict, repr=False)  # guarded-by: _lock
+    _gauges: dict[str, float] = field(default_factory=dict, repr=False)  # guarded-by: _lock
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     # -- writes (all under _lock) -----------------------------------------
@@ -138,6 +143,12 @@ class MetricsRegistry:
         HISTOGRAM_BUCKETS, defaulting to the latency bounds)."""
         with self._lock:
             self._observe(name, value, labels)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Set an explicit gauge (e.g. ``scheduler_circuit_state``) —
+        last-write-wins, exported beside the derived last-cycle gauges."""
+        with self._lock:
+            self._gauges[name] = float(value)
 
     def observe_cycle(self, m: CycleMetrics) -> None:
         with self._lock:
@@ -173,7 +184,7 @@ class MetricsRegistry:
                 for name, per in self._histograms.items()
             }
             last = self.cycles[-1] if self.cycles else None
-        gauges: dict[str, float] = {}
+            gauges: dict[str, float] = dict(self._gauges)
         if last is not None:
             gauges["scheduler_last_cycle_seconds"] = last.wall_seconds
             gauges["scheduler_last_pods_per_second"] = last.pods_per_second
