@@ -12,10 +12,13 @@ lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
 
 # The whole static-analysis policy (scripts/analyze/): ported hygiene rules
-# plus THRD lock discipline, JAXP jit purity, DTRM sim determinism, and the
-# baseline gate (fails on new findings and on stale baseline entries).
+# plus THRD lock discipline, JAXP jit purity, DTRM sim determinism, SHPE
+# shape contracts, EXCP failure-class closure, and the baseline gate (fails
+# on new findings and on stale baseline entries).  The report artifact is
+# consumed by bench.py provenance; --budget asserts the suite stays the
+# fast part of this gate (pre-commit uses the --changed-only fast path).
 analyze:
-	$(PY) -m scripts.analyze
+	$(PY) -m scripts.analyze --json-out .analyze_report.json --budget 5
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -40,6 +40,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import pathlib
 import statistics
 import sys
 import time
@@ -568,6 +569,21 @@ def provenance(platform: str) -> dict:
         out["git_sha"] = sha or None
     except Exception:  # noqa: BLE001
         out["git_sha"] = None
+    # Static-analysis verdict from the last `make analyze` run (its
+    # --json-out artifact): a benchmark row from a tree carrying unpinned
+    # analysis findings is apples-to-oranges against a clean one, so the
+    # gate's verdict rides in the provenance rather than being re-derived
+    # here (re-running the suite would bill ~2 s to every bench row).
+    try:
+        rep = json.loads(
+            (pathlib.Path(__file__).resolve().parent / ".analyze_report.json").read_text()
+        )
+        out["analyze_findings"] = len(rep.get("findings", []))
+        out["analyze_new"] = len(rep.get("new", []))
+        out["analyze_stale"] = len(rep.get("stale", []))
+        out["analyze_elapsed_s"] = rep.get("elapsed_s")
+    except Exception:  # noqa: BLE001 — no artifact: provenance records that
+        out["analyze_findings"] = None
     return out
 
 

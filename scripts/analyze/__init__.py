@@ -8,13 +8,21 @@ lint.py`` survives as a thin shim so existing invocations keep working.
 Passes (each a module in this package; the rule catalogue is drift-gated
 into README.md by the ANLZ pass):
 
-  hygiene      — E999 W291 W191 E711 E712 B006 F841 F401 F822
+  hygiene      — E999 W291 W191 E711 E712 E722 E741 B006 F841 F401 F822
   exports      — DEAD (exported-but-referenced-nowhere symbols)
-  catalogues   — METR SIMC ANLZ (README drift gates)
+  catalogues   — METR SIMC ANLZ RESC (README drift gates)
+  excp         — EXCP (the requeue failure-class taxonomy stays closed:
+                 classifier ↔ backoff policies ↔ metric row ↔ README table)
   locks        — THRD (lock discipline: ``# guarded-by:`` attributes,
                  ``# holds-lock:`` contracts, lock-order cycle detection)
   jitpure      — JAXP (no host syncs / tracer branches inside jit)
   determinism  — DTRM (sim/ may only consume the clock and seeded rng)
+  shapes       — SHPE (``# shape:`` contracts abstract-interpreted over the
+                 tensor pipeline: dims, broadcasts, axes, dtype promotion)
+
+Each pass declares ``FILE_SCOPED``: whether it is sound on a partial file
+set (the driver's ``--changed-only`` pre-commit fast path runs only those;
+cross-file rules like DEAD/EXCP need the full context).
 
 Findings are compared against ``baseline.json`` (pinned pre-existing
 findings, each with a reason); the driver fails on any NEW finding and on

@@ -28,6 +28,10 @@ CODES = {
     "RESC": "a resilience backoff class/breaker state/config knob missing from the README Resilience catalogue",
 }
 
+# Code→README direction only: a partial (--changed-only) context can merely
+# under-report (names from a subset of files), never false-positive.
+FILE_SCOPED = True
+
 _METRIC_RE = re.compile(r'"(scheduler_[a-z0-9_]+)"')
 
 

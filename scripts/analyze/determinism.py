@@ -28,6 +28,9 @@ CODES = {
     "DTRM": "wall clock, global rng, entropy, or set-order iteration in sim/ — breaks record/replay byte-identity",
 }
 
+# Strictly per-file — safe under the driver's --changed-only fast path.
+FILE_SCOPED = True
+
 _TIME_ATTRS = ("time", "monotonic", "sleep", "perf_counter", "time_ns", "monotonic_ns", "perf_counter_ns")
 _DATETIME_ATTRS = ("now", "utcnow", "today")
 

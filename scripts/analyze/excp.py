@@ -1,0 +1,155 @@
+"""EXCP — the failure-class taxonomy must stay CLOSED.
+
+``Scheduler._requeue_reason_class`` (runtime/controller.py) is the single
+source of requeue failure classes; every class it can produce must have a
+``BackoffQueue`` policy (``DEFAULT_POLICIES`` in runtime/resilience.py), a
+row in the README Resilience failure-class table, and an entry on the
+``scheduler_requeues_by_reason_total{reason=...}`` metric catalogue row —
+and every policy must be REACHABLE (a key the controller can never produce
+is dead config that hides a renamed class).  PR 4 wired the taxonomy
+through three layers by hand; this rule fails the build on any gap in
+either direction, so adding (or renaming) a failure class without teaching
+the backoff queue and the docs is impossible.
+
+Label extraction is AST-based, not regex: constants returned by the
+classifier, plus the membership tuples guarding ``return <var>`` (the
+``if head in ("api-error", "network-error"): return head`` form).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Context, Finding
+
+CODES = {
+    "EXCP": "a requeue failure class without a backoff policy / metric row / README row (or a policy no class produces) — the taxonomy must stay closed",
+}
+
+# Needs controller.py AND resilience.py AND the README together — a partial
+# (--changed-only) context would flag one side as missing when it is merely
+# unloaded, so the driver only runs this pass on full-context runs.
+FILE_SCOPED = False
+
+_CONTROLLER = "tpu_scheduler/runtime/controller.py"
+_RESILIENCE = "tpu_scheduler/runtime/resilience.py"
+_METRIC = "scheduler_requeues_by_reason_total"
+
+
+def _classifier_labels(tree: ast.Module) -> tuple[set[str], int] | None:
+    """Labels ``_requeue_reason_class`` can produce, + its line (None when
+    the classifier is absent from the file)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_requeue_reason_class":
+            labels: set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return):
+                    if isinstance(sub.value, ast.Constant) and isinstance(sub.value.value, str):
+                        labels.add(sub.value.value)
+                elif isinstance(sub, ast.If):
+                    # `if <var> in ("a", "b"): return <var>` — the tuple IS
+                    # the label set for that branch.
+                    t = sub.test
+                    returns_var = any(
+                        isinstance(s, ast.Return) and isinstance(s.value, ast.Name) for s in sub.body
+                    )
+                    if (
+                        returns_var
+                        and isinstance(t, ast.Compare)
+                        and len(t.ops) == 1
+                        and isinstance(t.ops[0], ast.In)
+                        and isinstance(t.comparators[0], (ast.Tuple, ast.List))
+                    ):
+                        ret_names = {
+                            s.value.id
+                            for s in sub.body
+                            if isinstance(s, ast.Return) and isinstance(s.value, ast.Name)
+                        }
+                        if isinstance(t.left, ast.Name) and t.left.id in ret_names:
+                            labels.update(
+                                e.value
+                                for e in t.comparators[0].elts
+                                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                            )
+            return labels, node.lineno
+    return None
+
+
+def _policy_classes(tree: ast.Module) -> tuple[set[str], int] | None:
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "DEFAULT_POLICIES" and isinstance(node.value, ast.Dict):
+                    keys = {
+                        k.value for k in node.value.keys if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    }
+                    return keys, node.lineno
+    return None
+
+
+def run(ctx: Context) -> list[Finding]:
+    controller = resilience = None
+    for f in ctx.parsed():
+        if f.rel == _CONTROLLER:
+            controller = f
+        elif f.rel == _RESILIENCE:
+            resilience = f
+    if controller is None or resilience is None:
+        return []  # partial context: closure is unjudgeable, stay silent
+    produced = _classifier_labels(controller.tree)
+    policies = _policy_classes(resilience.tree)
+    if produced is None or policies is None:
+        out = []
+        if produced is None:
+            out.append(Finding("EXCP", _CONTROLLER, 1, "Scheduler._requeue_reason_class not found — the EXCP taxonomy anchor moved"))
+        if policies is None:
+            out.append(Finding("EXCP", _RESILIENCE, 1, "DEFAULT_POLICIES not found — the EXCP backoff-policy anchor moved"))
+        return out
+    labels, cls_line = produced
+    keys, pol_line = policies
+
+    findings: list[Finding] = []
+    for label in sorted(labels - keys):
+        findings.append(
+            Finding(
+                "EXCP",
+                _RESILIENCE,
+                pol_line,
+                f"requeue class '{label}' is produced by Scheduler._requeue_reason_class but has no BackoffQueue policy in DEFAULT_POLICIES",
+            )
+        )
+    for key in sorted(keys - labels):
+        findings.append(
+            Finding(
+                "EXCP",
+                _CONTROLLER,
+                cls_line,
+                f"backoff policy class '{key}' is never produced by Scheduler._requeue_reason_class — dead policy or renamed class",
+            )
+        )
+
+    # README: the metric catalogue row must enumerate every class, and the
+    # Resilience failure-class table must carry a `| \`class\` |` row.
+    metric_rows = " ".join(line for line in ctx.readme.splitlines() if _METRIC in line)
+    for label in sorted(labels | keys):
+        if f"`{label}`" not in metric_rows and label not in metric_rows:
+            findings.append(
+                Finding(
+                    "EXCP",
+                    "README.md",
+                    1,
+                    f"requeue class '{label}' is missing from the README {_METRIC} metric catalogue row",
+                )
+            )
+        if not re.search(rf"^\|\s*`?{re.escape(label)}`?\s*\|", ctx.readme, re.MULTILINE):
+            findings.append(
+                Finding(
+                    "EXCP",
+                    "README.md",
+                    1,
+                    f"requeue class '{label}' has no row in the README Resilience failure-class table",
+                )
+            )
+    return findings

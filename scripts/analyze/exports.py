@@ -6,6 +6,7 @@ regression class).  Cross-file by construction: runs over the whole
 from __future__ import annotations
 
 import re
+from collections import Counter
 
 from .core import Context, Finding, module_all
 
@@ -13,17 +14,27 @@ CODES = {
     "DEAD": "an __all__ export referenced nowhere else in the repo — API rot the round-2 regression shipped",
 }
 
+# Cross-file by construction: a partial (--changed-only) context would call
+# every export of a changed module dead just because its callers were not
+# loaded — this pass only runs on full-context runs.
+FILE_SCOPED = False
+
+_WORD_RE = re.compile(r"\w+")
+
 
 def run(ctx: Context) -> list[Finding]:
     findings: list[Finding] = []
-    all_text = {f.rel: f.text for f in ctx.files}
+    # One word-frequency index per file instead of one regex scan per
+    # (export, file) pair — the O(exports × files) rescans used to dominate
+    # the whole suite's wall clock (the --budget gate's worst offender).
+    counts = {f.rel: Counter(_WORD_RE.findall(f.text)) for f in ctx.files}
     for f in ctx.parsed():
         if "tpu_scheduler" not in f.rel or f.path.name == "__init__.py":
             continue
         for name in module_all(f.tree):
             refs = 0
-            for rel, text in all_text.items():
-                hits = len(re.findall(rf"\b{re.escape(name)}\b", text))
+            for rel, words in counts.items():
+                hits = words[name]
                 if rel == f.rel:
                     # definition + __all__ entry account for 2 mentions
                     refs += max(0, hits - 2)

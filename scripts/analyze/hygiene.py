@@ -1,7 +1,12 @@
 """Hygiene passes ported from the monolithic ``scripts/lint.py``: the error
 classes a round-2 regression shipped with (stale imports, phantom exports)
 plus basic mechanical hygiene, on the stdlib so the gate runs in the build
-image (which carries no installable linter)."""
+image (which carries no installable linter).
+
+Scope: the WHOLE analyzed tree — ``tpu_scheduler/``, ``tests/``,
+``scripts/``, ``bench.py``, ``__graft_entry__.py`` (every file the driver
+loads; there is no package filter here, and tests/test_analyze.py pins that
+a violation seeded under tests/ or scripts/ is flagged)."""
 
 from __future__ import annotations
 
@@ -16,11 +21,18 @@ CODES = {
     "W191": "tabs in indentation — one indentation currency repo-wide",
     "E711": "comparison to None with ==/!= — use is / is not",
     "E712": "comparison to True/False with ==/!= — use the value or is",
+    "E722": "bare except: — swallows KeyboardInterrupt/SystemExit and hides real faults; name the exception",
+    "E741": "ambiguous single-char binding (l/O/I) — unreadable in most fonts, a classic transcription bug",
     "B006": "mutable default argument — shared across calls, a classic aliasing bug",
     "F841": "local assigned once and never read — dead stores hide logic errors",
     "F401": "imported name never used in the module — stale-import rot",
     "F822": "__all__ names a symbol the module does not define — phantom export",
 }
+
+# Per-file rules only — safe under the driver's --changed-only fast path.
+FILE_SCOPED = True
+
+_AMBIGUOUS = ("l", "O", "I")
 
 
 class _ImportUsage(ast.NodeVisitor):
@@ -154,9 +166,21 @@ def _comparison_checks(tree: ast.Module, relpath: str, findings: list[Finding]) 
                     )
 
 
+def _ast_checks(tree: ast.Module, relpath: str, findings: list[Finding]) -> None:
+    """E722 bare except + E741 ambiguous single-char bindings."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding("E722", relpath, node.lineno, "bare 'except:' — name the exception"))
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store) and node.id in _AMBIGUOUS:
+            findings.append(Finding("E741", relpath, node.lineno, f"ambiguous variable name '{node.id}'"))
+        elif isinstance(node, ast.arg) and node.arg in _AMBIGUOUS:
+            findings.append(Finding("E741", relpath, node.lineno, f"ambiguous argument name '{node.arg}'"))
+
+
 def _check_module(f: SourceFile, findings: list[Finding]) -> None:
     tree = f.tree
     assert tree is not None
+    _ast_checks(tree, f.rel, findings)
     exported = set(module_all(tree))
     usage = _ImportUsage()
     usage.visit(tree)

@@ -37,6 +37,11 @@ CODES = {
     "JAXP": "host sync or Python branch on a tracer inside jit-reached code — crashes or hides a device round-trip",
 }
 
+# Reachability roots resolve within the loaded context; an unloaded caller
+# just means an unreached (unchecked) function — fewer findings under a
+# partial (--changed-only) context, never false ones.
+FILE_SCOPED = True
+
 _STATIC_ATTRS = ("shape", "dtype", "ndim", "aval", "size")
 
 

@@ -47,6 +47,11 @@ CODES = {
     "THRD": "a guarded-by attribute touched outside its lock, a plain-Lock re-entry, or a lock-order cycle",
 }
 
+# Lexical guarded-by/holds-lock checks are per-file; the cross-module
+# lock-ORDER graph can only lose edges under a partial (--changed-only)
+# context — fewer findings, never false ones — so the fast path may run it.
+FILE_SCOPED = True
+
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
 _HOLDS_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
 

@@ -1,0 +1,1292 @@
+"""SHPE — annotation-driven shape/dtype abstract interpretation over the
+pods×nodes tensor pipeline.
+
+The whole hot path (ops/masks.py, ops/score.py, ops/assign.py, both
+backends, parallel/sharded.py) lives by implicit ``[P, N]`` shape and dtype
+conventions that nothing checked statically: a transposed mask or a silent
+bool→float promotion only surfaced as a wrong placement or an XLA error
+deep inside a jit trace.  This pass makes the conventions machine-checked.
+
+A function declares its tensor contract in a ``# shape:`` comment directly
+above its ``def`` (decorators may sit between; long contracts continue onto
+following comment lines until the parentheses balance)::
+
+    # shape: (pods_mask: [P, N] bool, scores: [P, N] f32) -> [P] i32
+    def pick(pods_mask, scores):
+        ...
+
+Grammar (the authoring guide lives in the README "Shape contracts"
+section)::
+
+    contract := '(' arg ':' spec (',' arg ':' spec)* ')' '->' ret
+    spec     := '[' dim (',' dim)* ']' dtype     a tensor
+              | 'scalar' dtype | int|float|bool  a rank-0 value
+              | obj | any | dict | fn | str      opaque (unchecked)
+    dim      := symbol (P, N, B, R, ...) | integer | '?'
+    dtype    := bool | i8..i64 | u8..u64 | f16|bf16|f32|f64 | num | any
+    ret      := spec | '(' spec (',' spec)* ')'  tuple returns
+
+Parameters omitted from the contract are unchecked.  Symbols are scoped to
+one contract; a scalar parameter's *name* used in an ``xp.zeros((p_pad,
+t_pad))`` shape tuple becomes that symbolic dim, so allocation shapes check
+against the declared return.
+
+The interpreter propagates symbolic dims and dtypes through elementwise
+arithmetic (with full NumPy broadcasting), comparisons, matmuls (inner-dim
+check), ``where``/``select``, reductions (``axis=`` validated against the
+symbolic rank), ``reshape``/``transpose``/``concatenate``/``stack``,
+indexing (including ``None`` newaxis, ``...``, literal bounds checks), and
+``.astype``.  Calls to other annotated functions — resolved same-module
+first, then through from-imports across every analyzed module, the JAXP
+name-resolution pattern — unify the callee's symbols against the caller's
+dims and flow the declared return back, so a transposed ``[N, P]`` argument
+is caught at the call site.  Anything unknown stays unknown and never
+flags: the pass is deliberately conservative, findings mean a *declared*
+contract is contradicted.
+
+Findings:
+  • broadcast conflict      — ``[P, N]`` combined with ``[N, P]``
+  • matmul inner mismatch   — ``[P, L] @ [N, L]`` (forgot the ``.T``)
+  • reduction axis          — ``axis=`` outside the symbolic rank
+  • index out of bounds     — literal index past a literal dim
+  • dtype promotion         — bool masks leaking into arithmetic, int/float
+                              array mixes without an explicit ``.astype``
+  • return drift            — computed shape/dtype contradicts ``-> ...``
+  • contract rot            — malformed spec, or a parameter the function
+                              no longer has
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from .core import Context, Finding, SourceFile
+
+CODES = {
+    "SHPE": "a tensor op contradicts a declared # shape: contract — transposed dims, bad broadcast/axis, or dtype promotion",
+}
+
+# Per-file contracts + same-file/from-import resolution: a partial
+# (--changed-only) run checks what it loads and never false-positives.
+FILE_SCOPED = True
+
+_DTYPE_TOKENS = {
+    "bool": "bool",
+    "i8": "i8", "i16": "i16", "i32": "i32", "i64": "i64",
+    "u8": "u8", "u16": "u16", "u32": "u32", "u64": "u64",
+    "f16": "f16", "bf16": "bf16", "f32": "f32", "f64": "f64",
+    "num": None, "any": None,
+}
+
+# numpy/jnp attribute name -> canonical dtype token
+_NP_DTYPES = {
+    "bool_": "bool", "bool": "bool",
+    "int8": "i8", "int16": "i16", "int32": "i32", "int64": "i64", "intp": "i64",
+    "uint8": "u8", "uint16": "u16", "uint32": "u32", "uint64": "u64",
+    "float16": "f16", "bfloat16": "bf16", "float32": "f32", "float64": "f64",
+}
+
+
+def _family(dtype: str | None) -> str | None:
+    if dtype is None:
+        return None
+    if dtype == "bool":
+        return "bool"
+    return "float" if dtype.startswith(("f", "bf")) else "int"
+
+
+@dataclass(frozen=True)
+class AV:
+    """Abstract value: symbolic dims (str symbol | int | None-unknown per
+    axis; the whole tuple None when the shape is unknown) + dtype token."""
+
+    dims: tuple | None
+    dtype: str | None
+
+    @property
+    def known_shape(self) -> bool:
+        return self.dims is not None
+
+    def render(self) -> str:
+        if self.dims is None:
+            shape = "[?]"
+        elif self.dims == ():
+            shape = "scalar"
+        else:
+            shape = "[" + ", ".join("?" if d is None else str(d) for d in self.dims) + "]"
+        return f"{shape} {self.dtype or 'any'}"
+
+
+UNKNOWN = AV(None, None)
+
+
+class _DtypeCtor:
+    """``xp.float32`` / ``f32 = xp.float32`` — calling it makes a scalar."""
+
+    def __init__(self, dtype: str):
+        self.dtype = dtype
+
+
+class _Tup:
+    def __init__(self, items: list):
+        self.items = items
+
+
+# -- contract parsing --------------------------------------------------------
+
+_CONTRACT_RE = re.compile(r"#\s*shape:\s*(.*)$")
+
+
+@dataclass
+class Contract:
+    params: list  # [(name, AV | None-opaque)]
+    ret: object  # AV | _Tup | None-opaque
+    line: int
+
+
+def _parse_spec(text: str):
+    """One spec -> AV, or None for opaque.  Raises ValueError on nonsense."""
+    t = text.strip()
+    if not t:
+        raise ValueError("empty spec")
+    if t.startswith("["):
+        end = t.index("]")
+        dims_txt, dtype_txt = t[1:end], t[end + 1 :].strip()
+        dims = []
+        for d in dims_txt.split(","):
+            d = d.strip()
+            if not d:
+                raise ValueError(f"empty dim in {text!r}")
+            if d == "?":
+                dims.append(None)
+            elif re.fullmatch(r"-?\d+", d):
+                dims.append(int(d))
+            elif re.fullmatch(r"\w+", d):
+                dims.append(d)
+            else:
+                raise ValueError(f"bad dim {d!r}")
+        if dtype_txt not in _DTYPE_TOKENS:
+            raise ValueError(f"unknown dtype {dtype_txt!r}")
+        return AV(tuple(dims), _DTYPE_TOKENS[dtype_txt])
+    if t.startswith("scalar"):
+        dtype_txt = t[len("scalar") :].strip() or "any"
+        if dtype_txt not in _DTYPE_TOKENS:
+            raise ValueError(f"unknown dtype {dtype_txt!r}")
+        return AV((), _DTYPE_TOKENS[dtype_txt])
+    if t in ("int",):
+        return AV((), "i64")
+    if t in ("float",):
+        return AV((), "f64")
+    if t in ("bool",):
+        return AV((), "bool")
+    if t in ("obj", "any", "dict", "fn", "str", "bytes", "none"):
+        return None
+    raise ValueError(f"unknown spec {t!r}")
+
+
+def _split_top(text: str, sep: str = ",") -> list[str]:
+    out, depth, cur = [], 0, []
+    for ch in text:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == sep and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _parse_contract(text: str, line: int) -> Contract:
+    """``(a: SPEC, b: SPEC) -> SPEC`` (or ``-> (SPEC, SPEC)``)."""
+    m = re.match(r"\s*\((.*)\)\s*->\s*(.*)$", text.strip(), re.DOTALL)
+    if not m:
+        raise ValueError("expected '(args) -> ret'")
+    args_txt, ret_txt = m.group(1), m.group(2).strip()
+    params = []
+    if args_txt.strip():
+        for part in _split_top(args_txt):
+            if ":" not in part:
+                raise ValueError(f"arg {part.strip()!r} missing ': spec'")
+            name, spec = part.split(":", 1)
+            params.append((name.strip(), _parse_spec(spec)))
+    if ret_txt.startswith("(") and ret_txt.endswith(")"):
+        ret = _Tup([_parse_spec(p) for p in _split_top(ret_txt[1:-1])])
+    else:
+        ret = _parse_spec(ret_txt)
+    return Contract(params=params, ret=ret, line=line)
+
+
+def _collect_contracts(f: SourceFile) -> dict[ast.FunctionDef, tuple[Contract | None, str | None]]:
+    """fn-def -> (contract, parse-error).  The contract is the ``# shape:``
+    comment block directly above the def/decorators (continuation comment
+    lines are joined while parens stay unbalanced)."""
+    out: dict[ast.FunctionDef, tuple[Contract | None, str | None]] = {}
+    lines = f.lines
+    for node in ast.walk(f.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        start = min([node.lineno] + [d.lineno for d in node.decorator_list])
+        i = start - 2  # 0-indexed line above the def/decorator block
+        block: list[tuple[int, str]] = []
+        while i >= 0 and lines[i].strip().startswith("#"):
+            block.append((i + 1, lines[i].strip()))
+            i -= 1
+        # block is bottom-up; find the # shape: opener closest to the def.
+        for j, (lineno, text) in enumerate(block):
+            m = _CONTRACT_RE.match(text)
+            if not m:
+                continue
+            spec = m.group(1)
+            # Continuations run DOWN the file from the opener: earlier
+            # entries of the bottom-up block.  Keep joining until the
+            # brackets balance AND the '->' arrow has appeared (the return
+            # spec may start a fresh line after the args close).
+            for k in range(j - 1, -1, -1):
+                balanced = spec.count("(") + spec.count("[") <= spec.count(")") + spec.count("]")
+                if balanced and "->" in spec:
+                    break
+                spec += " " + block[k][1].lstrip("#").strip()
+            try:
+                out[node] = (_parse_contract(spec, lineno), None)
+            except ValueError as e:
+                out[node] = (None, f"malformed shape contract for '{node.name}': {e}")
+            break
+    return out
+
+
+# -- module index (imports, cross-module resolution) -------------------------
+
+
+class _ModIndex:
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.ns_bases: set[str] = {"xp"}  # array namespaces (np/jnp/lax/xp)
+        self.from_imports: set[str] = set()
+        tree = sf.tree
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    if a.name in ("numpy", "jax.numpy"):
+                        self.ns_bases.add(bound if a.asname else a.name.split(".")[0])
+                    if a.name == "jax.numpy" and a.asname:
+                        self.ns_bases.add(a.asname)
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    bound = a.asname or a.name
+                    self.from_imports.add(bound)
+                    if node.module == "jax" and a.name in ("numpy", "lax"):
+                        self.ns_bases.add(bound)
+        self.ns_bases.update({"np", "jnp", "lax"} & self._bound_names(tree))
+
+    @staticmethod
+    def _bound_names(tree) -> set[str]:
+        names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    if a.name != "*":
+                        names.add(a.asname or a.name.split(".")[0])
+        return names
+
+
+# -- shape algebra -----------------------------------------------------------
+
+
+def _dim_eq(a, b) -> bool:
+    return a is not None and b is not None and a == b
+
+
+def _dim_conflict(a, b) -> bool:
+    """True when two dims provably differ (and neither broadcasts)."""
+    if a is None or b is None or a == 1 or b == 1:
+        return False
+    if isinstance(a, int) and isinstance(b, int):
+        return a != b
+    if isinstance(a, str) and isinstance(b, str):
+        return a != b
+    return False  # symbol vs literal: could coincide
+
+
+def _broadcast(d1: tuple | None, d2: tuple | None) -> tuple[tuple | None, bool]:
+    """NumPy broadcast of two dim tuples -> (result dims, conflict?)."""
+    if d1 is None or d2 is None:
+        return None, False
+    r = max(len(d1), len(d2))
+    a = (1,) * (r - len(d1)) + d1
+    b = (1,) * (r - len(d2)) + d2
+    out, conflict = [], False
+    for x, y in zip(a, b):
+        if _dim_conflict(x, y):
+            conflict = True
+            out.append(None)
+        elif x == 1:
+            out.append(y)
+        elif y == 1:
+            out.append(x)
+        elif _dim_eq(x, y):
+            out.append(x)
+        else:
+            out.append(None)
+    return tuple(out), conflict
+
+
+def _merge_dtype(a: str | None, b: str | None) -> str | None:
+    if a == b:
+        return a
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return None
+
+
+_ARITH = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow)
+_BITWISE = (ast.BitAnd, ast.BitOr, ast.BitXor)
+_SHIFT = (ast.LShift, ast.RShift)
+
+_REDUCTIONS = {
+    "sum", "prod", "mean", "max", "min", "amax", "amin", "nanmax", "nanmin",
+    "any", "all", "argmax", "argmin", "count_nonzero", "std", "var",
+}
+_SCAN_REDUCTIONS = {"cumsum", "cumprod"}  # keep rank, axis still validated
+_ELEMENTWISE1 = {
+    "abs", "absolute", "floor", "ceil", "exp", "log", "log2", "sqrt", "negative",
+    "sign", "square", "tanh", "sin", "cos", "round", "rint", "clip", "nan_to_num",
+    "stop_gradient", "copy",
+}
+_BOOL_OUT1 = {"isfinite", "isnan", "isinf", "logical_not", "signbit"}
+_BINOP_FNS = {
+    "minimum", "maximum", "add", "subtract", "multiply", "divide", "true_divide",
+    "floor_divide", "power", "mod", "fmod", "hypot",
+}
+_LOGICAL2 = {"logical_and", "logical_or", "logical_xor"}
+
+
+class _FnChecker:
+    """Abstract-interprets one annotated function body against its contract."""
+
+    def __init__(self, pass_ctx: "_PassCtx", idx: _ModIndex, fn: ast.FunctionDef, contract: Contract):
+        self.p = pass_ctx
+        self.idx = idx
+        self.fn = fn
+        self.contract = contract
+        self.env: dict[str, object] = {}
+        self.dtype_ctors: dict[str, str] = {}
+        self.nested = {
+            n for n in ast.walk(fn) if n is not fn and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.findings: list[Finding] = []
+        # Symbols the contract itself declares: on return checks, a computed
+        # dim carrying some OTHER name (a derived scalar like ``p_out``) is
+        # an opaque identity, not a conflict with the declared symbol.
+        self.contract_syms: set[str] = set()
+        for _, spec in contract.params:
+            if spec is not None and spec.dims:
+                self.contract_syms.update(d for d in spec.dims if isinstance(d, str))
+        rets = contract.ret.items if isinstance(contract.ret, _Tup) else [contract.ret]
+        for r in rets:
+            if isinstance(r, AV) and r.dims:
+                self.contract_syms.update(d for d in r.dims if isinstance(d, str))
+
+    # -- entry ---------------------------------------------------------------
+
+    def check(self) -> list[Finding]:
+        arg_names = [
+            a.arg
+            for a in (
+                self.fn.args.posonlyargs + self.fn.args.args + self.fn.args.kwonlyargs
+            )
+        ]
+        if self.fn.args.vararg:
+            arg_names.append(self.fn.args.vararg.arg)
+        if self.fn.args.kwarg:
+            arg_names.append(self.fn.args.kwarg.arg)
+        for name, spec in self.contract.params:
+            if name not in arg_names:
+                self.emit(
+                    self.contract.line,
+                    f"shape contract for '{self.fn.name}' names unknown parameter '{name}'",
+                )
+            elif spec is not None:
+                self.env[name] = spec
+        self.visit_block(self.fn.body)
+        return self.findings
+
+    def emit(self, lineno: int, message: str) -> None:
+        self.findings.append(Finding("SHPE", self.idx.sf.rel, lineno, message))
+
+    # -- statements ----------------------------------------------------------
+
+    def visit_block(self, stmts) -> None:
+        for s in stmts:
+            self.visit_stmt(s)
+
+    def visit_stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, ast.Assign):
+            v = self.eval(s.value)
+            for t in s.targets:
+                self.bind(t, v)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.bind(s.target, self.eval(s.value))
+        elif isinstance(s, ast.AugAssign):
+            if isinstance(s.target, ast.Name):
+                cur = self.env.get(s.target.id, UNKNOWN)
+                self.env[s.target.id] = self.binop(cur, s.op, self.eval(s.value), s.lineno)
+            else:
+                self.eval(s.value)
+        elif isinstance(s, ast.Return):
+            v = self.eval(s.value) if s.value is not None else None
+            self.check_return(v, s.lineno)
+        elif isinstance(s, ast.If):
+            self.eval(s.test)
+            self.visit_block(s.body)
+            self.visit_block(s.orelse)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            self.eval(s.iter)
+            # loop targets are data-dependent — unknown
+            self.bind(s.target, UNKNOWN)
+            self.visit_block(s.body)
+            self.visit_block(s.orelse)
+        elif isinstance(s, ast.While):
+            self.eval(s.test)
+            self.visit_block(s.body)
+            self.visit_block(s.orelse)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars, UNKNOWN)
+            self.visit_block(s.body)
+        elif isinstance(s, ast.Try):
+            self.visit_block(s.body)
+            for h in s.handlers:
+                self.visit_block(h.body)
+            self.visit_block(s.orelse)
+            self.visit_block(s.finalbody)
+        elif isinstance(s, ast.Expr):
+            self.eval(s.value)
+        elif isinstance(s, (ast.Raise, ast.Assert)):
+            for sub in ast.iter_child_nodes(s):
+                if isinstance(sub, ast.expr):
+                    self.eval(sub)
+        # nested defs, imports, pass, etc.: no propagation
+
+    def bind(self, target, value) -> None:
+        if isinstance(target, ast.Name):
+            if isinstance(value, _DtypeCtor):
+                self.dtype_ctors[target.id] = value.dtype
+                self.env.pop(target.id, None)
+            else:
+                self.env[target.id] = value if isinstance(value, (AV, _Tup)) else UNKNOWN
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            items = value.items if isinstance(value, _Tup) else None
+            for i, t in enumerate(target.elts):
+                if isinstance(t, ast.Starred):
+                    self.bind(t.value, UNKNOWN)
+                    items = None  # positions after a star are unknowable
+                    continue
+                v = items[i] if items is not None and i < len(items) else UNKNOWN
+                self.bind(t, v if v is not None else UNKNOWN)
+        # attribute/subscript stores: no tracking
+
+    def check_return(self, value, lineno: int) -> None:
+        ret = self.contract.ret
+        if ret is None:
+            return
+        if isinstance(ret, _Tup):
+            if isinstance(value, _Tup):
+                if len(value.items) != len(ret.items):
+                    self.emit(
+                        lineno,
+                        f"'{self.fn.name}' returns {len(value.items)} values where the contract declares {len(ret.items)}",
+                    )
+                    return
+                for got, want in zip(value.items, ret.items):
+                    self.check_one_return(got, want, lineno)
+            return
+        self.check_one_return(value, ret, lineno)
+
+    def check_one_return(self, got, want, lineno: int) -> None:
+        if want is None or not isinstance(got, AV):
+            return
+        if want.dims is not None and got.dims is not None:
+            if len(got.dims) != len(want.dims):
+                self.emit(
+                    lineno,
+                    f"'{self.fn.name}' returns rank-{len(got.dims)} {got.render()} where the contract declares {want.render()}",
+                )
+                return
+            for g, w in zip(got.dims, want.dims):
+                if isinstance(g, str) and g not in self.contract_syms:
+                    continue  # derived scalar name — opaque, not a conflict
+                if _dim_conflict(g, w) and 1 not in (g, w):
+                    self.emit(
+                        lineno,
+                        f"'{self.fn.name}' returns {got.render()} where the contract declares {want.render()}",
+                    )
+                    return
+        gf, wf = _family(got.dtype), _family(want.dtype)
+        if gf is not None and wf is not None and gf != wf:
+            self.emit(
+                lineno,
+                f"'{self.fn.name}' returns dtype {got.dtype} where the contract declares {want.dtype}",
+            )
+
+    # -- expressions ---------------------------------------------------------
+
+    def eval(self, e: ast.expr):
+        if e is None:
+            return UNKNOWN
+        if isinstance(e, ast.Constant):
+            if e.value is None:
+                return None
+            if isinstance(e.value, bool):
+                return AV((), "bool")
+            if isinstance(e.value, (int, float)):
+                return AV((), None)  # weak scalar: adopts the array's dtype
+            return UNKNOWN
+        if isinstance(e, ast.Name):
+            if e.id in self.dtype_ctors:
+                return _DtypeCtor(self.dtype_ctors[e.id])
+            v = self.env.get(e.id, UNKNOWN)
+            return v
+        if isinstance(e, ast.Attribute):
+            return self.eval_attribute(e)
+        if isinstance(e, ast.Subscript):
+            return self.eval_subscript(e)
+        if isinstance(e, ast.Call):
+            return self.eval_call(e)
+        if isinstance(e, ast.BinOp):
+            return self.binop(self.eval(e.left), e.op, self.eval(e.right), e.lineno)
+        if isinstance(e, ast.UnaryOp):
+            v = self.eval(e.operand)
+            if isinstance(e.op, ast.Not):
+                return AV((), "bool")
+            return v if isinstance(v, AV) else UNKNOWN
+        if isinstance(e, ast.Compare):
+            operands = [self.eval(e.left)] + [self.eval(c) for c in e.comparators]
+            dims = None
+            ok = True
+            for op, (a, b) in zip(e.ops, zip(operands, operands[1:])):
+                if isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)):
+                    ok = False
+                    continue
+                if isinstance(a, AV) and isinstance(b, AV):
+                    d, conflict = _broadcast(a.dims, b.dims)
+                    if conflict:
+                        self.emit(
+                            e.lineno,
+                            f"comparison in '{self.fn.name}' cannot broadcast {a.render()} with {b.render()}",
+                        )
+                    dims = d
+                else:
+                    ok = False
+            if not ok:
+                return AV((), "bool") if dims is None else AV(dims, "bool")
+            return AV(dims, "bool")
+        if isinstance(e, ast.BoolOp):
+            for v in e.values:
+                self.eval(v)
+            return UNKNOWN
+        if isinstance(e, ast.IfExp):
+            self.eval(e.test)
+            a, b = self.eval(e.body), self.eval(e.orelse)
+            if isinstance(a, AV) and isinstance(b, AV):
+                if a.dims == b.dims and a.dtype == b.dtype:
+                    return a
+                dims = a.dims if a.dims == b.dims else None
+                return AV(dims, _merge_dtype(a.dtype, b.dtype) if a.dtype == b.dtype else None)
+            return UNKNOWN
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return _Tup([self.eval(x) for x in e.elts])
+        if isinstance(e, ast.Starred):
+            self.eval(e.value)
+            return UNKNOWN
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            return UNKNOWN  # own scope; targets unbound here
+        if isinstance(e, ast.Lambda):
+            return UNKNOWN
+        if isinstance(e, ast.JoinedStr):
+            return UNKNOWN
+        if isinstance(e, ast.Dict):
+            for v in e.values:
+                if v is not None:
+                    self.eval(v)
+            return UNKNOWN
+        return UNKNOWN
+
+    def is_ns(self, e: ast.expr) -> bool:
+        """Is ``e`` (the base of an attribute) an array namespace?"""
+        if isinstance(e, ast.Name):
+            return e.id in self.idx.ns_bases
+        if isinstance(e, ast.Attribute):  # jnp.linalg style
+            return self.is_ns(e.value)
+        return False
+
+    def eval_attribute(self, e: ast.Attribute):
+        if self.is_ns(e.value):
+            if e.attr in _NP_DTYPES:
+                return _DtypeCtor(_NP_DTYPES[e.attr])
+            if e.attr in ("inf", "nan", "pi", "e"):
+                return AV((), None)
+            return UNKNOWN  # namespace function referenced, not called
+        v = self.eval(e.value)
+        if isinstance(v, AV):
+            if e.attr == "T":
+                return AV(tuple(reversed(v.dims)) if v.dims is not None else None, v.dtype)
+            if e.attr in ("real", "imag"):
+                return v
+        return UNKNOWN
+
+    # -- indexing ------------------------------------------------------------
+
+    def eval_subscript(self, e: ast.Subscript):
+        recv = self.eval(e.value)
+        # x.at[idx] rides through so .set/.add give x back (handled in call)
+        if isinstance(e.value, ast.Attribute) and e.value.attr == "at":
+            return UNKNOWN
+        idx = e.slice
+        elems = list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]
+        if isinstance(recv, _Tup):
+            if len(elems) == 1 and isinstance(elems[0], ast.Constant) and isinstance(elems[0].value, int):
+                i = elems[0].value
+                if -len(recv.items) <= i < len(recv.items):
+                    v = recv.items[i]
+                    return v if isinstance(v, (AV, _Tup)) else UNKNOWN
+            return UNKNOWN
+        if not isinstance(recv, AV) or recv.dims is None:
+            for el in elems:
+                if not isinstance(el, ast.Slice):
+                    self.eval(el)
+            return UNKNOWN
+        if recv.dims == ():  # indexing a scalar: nonsense, but stay quiet
+            return UNKNOWN
+        # split around Ellipsis
+        if any(isinstance(el, ast.Constant) and el.value is Ellipsis for el in elems):
+            cut = next(i for i, el in enumerate(elems) if isinstance(el, ast.Constant) and el.value is Ellipsis)
+            head, tail = elems[:cut], elems[cut + 1 :]
+        else:
+            head, tail = elems, []
+        n_consumed = sum(1 for el in head + tail if not (isinstance(el, ast.Constant) and el.value is None))
+        if n_consumed > len(recv.dims):
+            self.emit(
+                e.lineno,
+                f"index with {n_consumed} axes into {recv.render()} in '{self.fn.name}'",
+            )
+            return UNKNOWN
+        dims = list(recv.dims)
+        out: list = []
+        unknown = False
+
+        def apply(el, dim_iter):
+            nonlocal unknown
+            if isinstance(el, ast.Constant) and el.value is None:
+                out.append(1)
+                return
+            d = next(dim_iter)
+            if isinstance(el, ast.Slice):
+                out.append(self.slice_dim(el, d))
+                return
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                if isinstance(d, int) and not (-d <= el.value < d):
+                    self.emit(
+                        e.lineno,
+                        f"index {el.value} out of bounds for dim {d} of {recv.render()} in '{self.fn.name}'",
+                    )
+                return  # scalar index drops the dim
+            v = self.eval(el)
+            if isinstance(v, AV) and v.dims is not None and len(v.dims) == 1:
+                out.append(v.dims[0])  # 1-D fancy index replaces the dim
+                return
+            if isinstance(v, AV) and v.dims == ():
+                return  # scalar variable index drops the dim
+            unknown = True
+
+        if tail:
+            # leading indices bind from the front, trailing from the back
+            front = iter(dims[: len(dims)])
+            n_tail = sum(1 for el in tail if not (isinstance(el, ast.Constant) and el.value is None))
+            mid = dims[sum(1 for el in head if not (isinstance(el, ast.Constant) and el.value is None)) : len(dims) - n_tail]
+            for el in head:
+                apply(el, front)
+            out.extend(mid)
+            back = iter(dims[len(dims) - n_tail :])
+            for el in tail:
+                apply(el, back)
+        else:
+            it = iter(dims)
+            for el in head:
+                apply(el, it)
+            out.extend(it)  # untouched trailing dims
+        if unknown:
+            return AV(None, recv.dtype)
+        return AV(tuple(out), recv.dtype)
+
+    def slice_dim(self, sl: ast.Slice, d):
+        lo, hi, step = sl.lower, sl.upper, sl.step
+        if lo is None and hi is None and step is None:
+            return d  # full slice keeps the dim
+        if lo is not None:
+            self.eval(lo)
+        if hi is not None:
+            self.eval(hi)
+        if (
+            (lo is None or (isinstance(lo, ast.Constant) and lo.value == 0))
+            and step is None
+            and isinstance(hi, ast.Constant)
+            and isinstance(hi.value, int)
+            and hi.value >= 0
+        ):
+            if isinstance(d, int) and hi.value > d:
+                return d
+            return hi.value  # x[:k] — dim becomes k (assuming the dim covers it)
+        return None
+
+    # -- operators -----------------------------------------------------------
+
+    def binop(self, a, op, b, lineno: int):
+        if isinstance(op, ast.MatMult):
+            return self.matmul(a, b, lineno)
+        if not isinstance(a, AV) or not isinstance(b, AV):
+            return UNKNOWN
+        dims, conflict = _broadcast(a.dims, b.dims)
+        if conflict:
+            self.emit(
+                lineno,
+                f"cannot broadcast {a.render()} with {b.render()} in '{self.fn.name}'",
+            )
+        fa, fb = _family(a.dtype), _family(b.dtype)
+        a_arr = a.dims is None or a.dims != ()
+        b_arr = b.dims is None or b.dims != ()
+        dtype: str | None
+        if isinstance(op, _ARITH):
+            if fa == "bool" and fb in ("int", "float") and a.known_shape and a.dims != ():
+                self.emit(lineno, f"bool mask {a.render()} promoted into {b.dtype} arithmetic in '{self.fn.name}' — cast explicitly or use &/|")
+            elif fb == "bool" and fa in ("int", "float") and b.known_shape and b.dims != ():
+                self.emit(lineno, f"bool mask {b.render()} promoted into {a.dtype} arithmetic in '{self.fn.name}' — cast explicitly or use &/|")
+            elif fa == "bool" and fb == "bool" and (a.dims != () or b.dims != ()):
+                self.emit(lineno, f"arithmetic on bool masks in '{self.fn.name}' — use logical ops or cast explicitly")
+            elif fa is not None and fb is not None and fa != fb and a_arr and b_arr and a.known_shape and b.known_shape:
+                self.emit(lineno, f"implicit {a.dtype}/{b.dtype} promotion mixing int and float arrays in '{self.fn.name}' — cast explicitly")
+            dtype = _merge_dtype(a.dtype, b.dtype) if fa == fb or fa is None or fb is None else None
+            if isinstance(op, ast.Div) and fa == "int" and fb == "int":
+                dtype = None  # true division promotes to float; width unknown
+        elif isinstance(op, _BITWISE):
+            if (fa == "bool") != (fb == "bool") and fa is not None and fb is not None:
+                self.emit(lineno, f"bitwise op mixes {a.dtype} and {b.dtype} in '{self.fn.name}'")
+                dtype = None
+            else:
+                dtype = _merge_dtype(a.dtype, b.dtype)
+        elif isinstance(op, _SHIFT):
+            dtype = a.dtype
+        else:
+            dtype = _merge_dtype(a.dtype, b.dtype)
+        return AV(dims, dtype)
+
+    def matmul(self, a, b, lineno: int):
+        if not isinstance(a, AV) or not isinstance(b, AV) or a.dims is None or b.dims is None:
+            return UNKNOWN
+        da, db = a.dims, b.dims
+        dtype = _merge_dtype(a.dtype, b.dtype)
+        if len(da) == 2 and len(db) == 2:
+            if _dim_conflict(da[1], db[0]):
+                self.emit(
+                    lineno,
+                    f"matmul inner dims differ: {a.render()} @ {b.render()} in '{self.fn.name}' — transposed operand?",
+                )
+                return AV(None, dtype)  # suppress cascading findings
+            return AV((da[0], db[1]), dtype)
+        if len(da) == 1 and len(db) == 2:
+            if _dim_conflict(da[0], db[0]):
+                self.emit(lineno, f"matmul inner dims differ: {a.render()} @ {b.render()} in '{self.fn.name}'")
+            return AV((db[1],), dtype)
+        if len(da) == 2 and len(db) == 1:
+            if _dim_conflict(da[1], db[0]):
+                self.emit(lineno, f"matmul inner dims differ: {a.render()} @ {b.render()} in '{self.fn.name}'")
+            return AV((da[0],), dtype)
+        if len(da) == 1 and len(db) == 1:
+            if _dim_conflict(da[0], db[0]):
+                self.emit(lineno, f"matmul inner dims differ: {a.render()} @ {b.render()} in '{self.fn.name}'")
+            return AV((), dtype)
+        return UNKNOWN
+
+    # -- calls ---------------------------------------------------------------
+
+    def eval_call(self, e: ast.Call):
+        f = e.func
+        args = [self.eval(a) for a in e.args if not isinstance(a, ast.Starred)]
+        if any(isinstance(a, ast.Starred) for a in e.args):
+            for a in e.args:
+                if isinstance(a, ast.Starred):
+                    self.eval(a.value)
+            args = None  # positional mapping unknowable
+        kwargs = {}
+        for kw in e.keywords:
+            v = self.eval(kw.value)
+            if kw.arg is not None:
+                kwargs[kw.arg] = v
+
+        if isinstance(f, ast.Attribute):
+            # x.at[idx].set(v) and friends give x back
+            if (
+                f.attr in ("set", "add", "multiply", "divide", "min", "max", "get", "apply")
+                and isinstance(f.value, ast.Subscript)
+                and isinstance(f.value.value, ast.Attribute)
+                and f.value.value.attr == "at"
+            ):
+                base = self.eval(f.value.value.value)
+                idx = f.value.slice
+                for el in list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]:
+                    if not isinstance(el, ast.Slice) and not (
+                        isinstance(el, ast.Constant) and el.value in (None, Ellipsis)
+                    ):
+                        self.eval(el)
+                return base if isinstance(base, AV) else UNKNOWN
+            if self.is_ns(f.value):
+                return self.ns_call(f.attr, e, args, kwargs)
+            recv = self.eval(f.value)
+            if isinstance(recv, AV):
+                return self.method_call(recv, f.attr, e, args, kwargs)
+            return UNKNOWN
+        if isinstance(f, ast.Name):
+            if f.id in self.dtype_ctors:
+                return AV((), self.dtype_ctors[f.id])
+            target = self.p.resolve(self.idx, f.id)
+            if target is not None:
+                return self.call_annotated(target, e, args, kwargs)
+            if f.id in ("float", "int", "bool", "len", "abs", "round"):
+                return AV((), {"float": "f64", "int": "i64", "bool": "bool"}.get(f.id))
+            return UNKNOWN
+        self.eval(f)
+        return UNKNOWN
+
+    def method_call(self, recv: AV, attr: str, e: ast.Call, args, kwargs):
+        if attr == "astype":
+            d = self.dtype_of_arg(e.args[0]) if e.args else None
+            return AV(recv.dims, d)
+        if attr in _REDUCTIONS or attr in _SCAN_REDUCTIONS:
+            return self.reduction(attr, recv, e, axis_args=e.args, kwargs_nodes=e.keywords)
+        if attr in ("copy", "block_until_ready", "conj"):
+            return recv
+        if attr == "item":
+            return AV((), recv.dtype)
+        if attr == "reshape":
+            return self.reshape_result(recv, e.args)
+        if attr == "transpose":
+            if not e.args:
+                return AV(tuple(reversed(recv.dims)) if recv.dims is not None else None, recv.dtype)
+            return AV(None, recv.dtype)
+        if attr in ("ravel", "flatten"):
+            return AV((None,), recv.dtype)
+        if attr == "tolist":
+            return UNKNOWN
+        return UNKNOWN
+
+    def ns_call(self, name: str, e: ast.Call, args, kwargs):
+        if name in _NP_DTYPES:
+            return AV((), _NP_DTYPES[name])
+        if args is None:
+            return UNKNOWN
+        a0 = args[0] if args else UNKNOWN
+
+        if name in ("where", "select"):
+            if len(args) == 3 and all(isinstance(a, AV) for a in args):
+                c, x, y = args
+                d1, conflict1 = _broadcast(c.dims, x.dims)
+                d2, conflict2 = _broadcast(d1, y.dims)
+                if conflict1 or conflict2:
+                    self.emit(
+                        e.lineno,
+                        f"where() operands do not broadcast: {c.render()}, {x.render()}, {y.render()} in '{self.fn.name}'",
+                    )
+                return AV(d2, _merge_dtype(x.dtype, y.dtype))
+            return UNKNOWN
+        if name in _REDUCTIONS or name in _SCAN_REDUCTIONS:
+            if isinstance(a0, AV):
+                return self.reduction(name, a0, e, axis_args=e.args[1:], kwargs_nodes=e.keywords)
+            return UNKNOWN
+        if name in _ELEMENTWISE1:
+            return a0 if isinstance(a0, AV) else UNKNOWN
+        if name in _BOOL_OUT1:
+            return AV(a0.dims, "bool") if isinstance(a0, AV) else UNKNOWN
+        if name in _BINOP_FNS:
+            if len(args) >= 2:
+                return self.binop(args[0], ast.Add(), args[1], e.lineno)
+            return UNKNOWN
+        if name in _LOGICAL2:
+            if len(args) >= 2 and isinstance(args[0], AV) and isinstance(args[1], AV):
+                dims, conflict = _broadcast(args[0].dims, args[1].dims)
+                if conflict:
+                    self.emit(
+                        e.lineno,
+                        f"cannot broadcast {args[0].render()} with {args[1].render()} in '{self.fn.name}'",
+                    )
+                return AV(dims, "bool")
+            return UNKNOWN
+        if name in ("matmul", "dot"):
+            if len(args) >= 2:
+                return self.matmul(args[0], args[1], e.lineno)
+            return UNKNOWN
+        if name in ("zeros", "ones", "empty", "full"):
+            dims = self.shape_of_arg(e.args[0]) if e.args else None
+            dt_node = kwargs_node(e, "dtype") or (e.args[2] if name == "full" and len(e.args) > 2 else None)
+            if dt_node is None and name != "full" and len(e.args) > 1:
+                dt_node = e.args[1]
+            return AV(dims, self.dtype_of_arg(dt_node) if dt_node is not None else None)
+        if name in ("zeros_like", "ones_like", "empty_like", "full_like"):
+            return a0 if isinstance(a0, AV) else UNKNOWN
+        if name == "arange":
+            d = None
+            if len(e.args) == 1:
+                d = self.dim_of_node(e.args[0])
+            dt = kwargs_node(e, "dtype")
+            return AV((d,), self.dtype_of_arg(dt) if dt is not None else None)
+        if name in ("asarray", "array"):
+            dt = kwargs_node(e, "dtype")
+            dtype = self.dtype_of_arg(dt) if dt is not None else (a0.dtype if isinstance(a0, AV) else None)
+            return AV(a0.dims, dtype) if isinstance(a0, AV) else AV(None, dtype)
+        if name == "concatenate":
+            return self.concat_result(e, args)
+        if name == "stack":
+            if e.args and isinstance(e.args[0], (ast.List, ast.Tuple)):
+                parts = [self.eval(x) for x in e.args[0].elts]
+                if parts and all(isinstance(p, AV) and p.dims is not None for p in parts):
+                    base = parts[0].dims
+                    return AV((len(parts),) + base, _merge_dtype_many([p.dtype for p in parts]))
+            return UNKNOWN
+        if name == "transpose":
+            if isinstance(a0, AV) and a0.dims is not None and len(e.args) == 1:
+                return AV(tuple(reversed(a0.dims)), a0.dtype)
+            return UNKNOWN
+        if name == "reshape":
+            if isinstance(a0, AV):
+                return self.reshape_result(a0, e.args[1:])
+            return UNKNOWN
+        if name == "broadcast_to":
+            dims = self.shape_of_arg(e.args[1]) if len(e.args) > 1 else None
+            return AV(dims, a0.dtype if isinstance(a0, AV) else None)
+        if name == "expand_dims":
+            return AV(None, a0.dtype) if isinstance(a0, AV) else UNKNOWN
+        if name == "pad":
+            if isinstance(a0, AV) and a0.dims is not None:
+                return AV((None,) * len(a0.dims), a0.dtype)
+            return UNKNOWN
+        if name == "argsort":
+            return AV(a0.dims, "i64") if isinstance(a0, AV) else UNKNOWN
+        if name == "sort":
+            return a0 if isinstance(a0, AV) else UNKNOWN
+        if name == "dynamic_slice_in_dim":
+            if isinstance(a0, AV) and a0.dims is not None:
+                axis = 0
+                ax_node = kwargs_node(e, "axis") or (e.args[3] if len(e.args) > 3 else None)
+                if isinstance(ax_node, ast.Constant) and isinstance(ax_node.value, int):
+                    axis = ax_node.value
+                size = self.dim_of_node(e.args[2]) if len(e.args) > 2 else None
+                dims = list(a0.dims)
+                if -len(dims) <= axis < len(dims):
+                    dims[axis] = size
+                return AV(tuple(dims), a0.dtype)
+            return UNKNOWN
+        if name == "dynamic_update_slice_in_dim":
+            return a0 if isinstance(a0, AV) else UNKNOWN
+        if name == "axis_index":
+            return AV((), "i32")
+        if name in ("fromiter",):
+            d = self.dim_of_node(e.args[2]) if len(e.args) > 2 else None
+            dt = self.dtype_of_arg(e.args[1]) if len(e.args) > 1 else None
+            return AV((d,), dt)
+        # unmodeled namespace fn (while_loop, all_gather, associative_scan,
+        # psum, einsum, ...) — args were already evaluated for findings
+        return UNKNOWN
+
+    def reduction(self, name: str, recv: AV, e: ast.Call, axis_args, kwargs_nodes):
+        axis_node = None
+        for kw in kwargs_nodes:
+            if kw.arg == "axis":
+                axis_node = kw.value
+        if axis_node is None and axis_args:
+            axis_node = axis_args[0]
+        keepdims = any(
+            kw.arg == "keepdims" and isinstance(kw.value, ast.Constant) and kw.value.value
+            for kw in kwargs_nodes
+        )
+        rank = len(recv.dims) if recv.dims is not None else None
+        axes: list[int] | None = None
+        if axis_node is None:
+            axes = None if name in _SCAN_REDUCTIONS else "ALL"  # type: ignore[assignment]
+        elif isinstance(axis_node, ast.Constant) and isinstance(axis_node.value, int):
+            axes = [axis_node.value]
+        elif isinstance(axis_node, ast.UnaryOp) and isinstance(axis_node.op, ast.USub) and isinstance(
+            axis_node.operand, ast.Constant
+        ):
+            axes = [-axis_node.operand.value]
+        elif isinstance(axis_node, (ast.Tuple, ast.List)) and all(
+            isinstance(x, ast.Constant) and isinstance(x.value, int) for x in axis_node.elts
+        ):
+            axes = [x.value for x in axis_node.elts]
+        else:
+            self.eval(axis_node)
+            axes = None if name in _SCAN_REDUCTIONS else "SOME"  # type: ignore[assignment]
+
+        if isinstance(axes, list) and rank is not None:
+            for ax in axes:
+                if not (-rank <= ax < rank):
+                    self.emit(
+                        e.lineno,
+                        f"{name}(axis={ax}) out of range for {recv.render()} (rank {rank}) in '{self.fn.name}'",
+                    )
+                    return AV(None, recv.dtype)  # suppress cascading findings
+        if name in ("any", "all"):
+            dtype = "bool"
+        elif name in ("argmax", "argmin"):
+            dtype = "i64"
+        elif name in ("sum", "prod", "cumsum", "cumprod", "count_nonzero"):
+            dtype = "i64" if recv.dtype == "bool" else ("i64" if name == "count_nonzero" else recv.dtype)
+        elif name in ("mean", "std", "var"):
+            dtype = recv.dtype if _family(recv.dtype) == "float" else None
+        else:
+            dtype = recv.dtype
+        if name in _SCAN_REDUCTIONS:
+            return AV(recv.dims, dtype)
+        if rank is None:
+            return AV(None, dtype)
+        if axes == "ALL":
+            return AV((1,) * rank if keepdims else (), dtype)
+        if axes == "SOME" or axes is None:
+            return AV(None, dtype)
+        dims = list(recv.dims)
+        for ax in sorted({ax % rank for ax in axes if -rank <= ax < rank}, reverse=True):
+            if keepdims:
+                dims[ax] = 1
+            else:
+                del dims[ax]
+        return AV(tuple(dims), dtype)
+
+    def concat_result(self, e: ast.Call, args):
+        if not e.args or not isinstance(e.args[0], (ast.List, ast.Tuple)):
+            return UNKNOWN
+        parts = [self.eval(x) for x in e.args[0].elts]
+        if not parts or not all(isinstance(p, AV) and p.dims is not None for p in parts):
+            return UNKNOWN
+        axis = 0
+        ax_node = kwargs_node(e, "axis") or (e.args[1] if len(e.args) > 1 else None)
+        if isinstance(ax_node, ast.Constant) and isinstance(ax_node.value, int):
+            axis = ax_node.value
+        elif isinstance(ax_node, ast.UnaryOp) and isinstance(ax_node.op, ast.USub) and isinstance(
+            ax_node.operand, ast.Constant
+        ):
+            axis = -ax_node.operand.value
+        rank = len(parts[0].dims)
+        if any(len(p.dims) != rank for p in parts) or not (-rank <= axis < rank):
+            return UNKNOWN
+        axis %= rank
+        dims = list(parts[0].dims)
+        for p in parts[1:]:
+            for i in range(rank):
+                if i == axis:
+                    continue
+                if _dim_conflict(dims[i], p.dims[i]):
+                    self.emit(
+                        e.lineno,
+                        f"concatenate non-axis dims differ: {parts[0].render()} vs {p.render()} in '{self.fn.name}'",
+                    )
+                elif dims[i] == 1:
+                    dims[i] = p.dims[i]
+        if all(isinstance(p.dims[axis], int) for p in parts):
+            dims[axis] = sum(p.dims[axis] for p in parts)
+        else:
+            dims[axis] = None
+        return AV(tuple(dims), _merge_dtype_many([p.dtype for p in parts]))
+
+    def reshape_result(self, recv: AV, shape_args):
+        if len(shape_args) == 1 and isinstance(shape_args[0], (ast.Tuple, ast.List)):
+            elts = shape_args[0].elts
+        else:
+            elts = shape_args
+        dims = []
+        for el in elts:
+            d = self.dim_of_node(el)
+            if isinstance(el, ast.Constant) and el.value == -1:
+                d = None
+            dims.append(d)
+        return AV(tuple(dims) if dims else None, recv.dtype)
+
+    def shape_of_arg(self, node) -> tuple | None:
+        """A shape literal: ``(p_pad, t_pad)`` / ``(n, 2)`` / a bare int."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self.dim_of_node(el) for el in node.elts)
+        d = self.dim_of_node(node)
+        return (d,) if d is not None else None
+
+    def dim_of_node(self, node):
+        """A dim expression -> symbolic dim: literal int, or the NAME of a
+        scalar variable (scalar params become symbols, tying allocation
+        shapes to the contract)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        if isinstance(node, ast.Name):
+            v = self.env.get(node.id)
+            if isinstance(v, AV) and v.dims not in ((), None):
+                return None  # a tensor, not a scalar dim
+            return node.id
+        self.eval(node)
+        return None
+
+    def dtype_of_arg(self, node) -> str | None:
+        if node is None:
+            return None
+        v = self.eval(node)
+        if isinstance(v, _DtypeCtor):
+            return v.dtype
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return _NP_DTYPES.get(node.value) or _DTYPE_TOKENS.get(node.value)
+        return None
+
+    # -- interprocedural -----------------------------------------------------
+
+    def call_annotated(self, target, e: ast.Call, args, kwargs):
+        callee_fn, contract = target
+        if args is None:
+            return UNKNOWN
+        params = [
+            a.arg for a in (callee_fn.args.posonlyargs + callee_fn.args.args)
+        ]
+        if params and params[0] == "self":
+            params = params[1:]
+        by_name: dict[str, object] = {}
+        for name, v in zip(params, args):
+            by_name[name] = v
+        by_name.update(kwargs)
+        specs = dict(contract.params)
+        binding: dict[str, object] = {}
+        for name, got in by_name.items():
+            spec = specs.get(name)
+            if spec is None or not isinstance(got, AV):
+                continue
+            if spec.dims is None or got.dims is None:
+                continue
+            if len(spec.dims) != len(got.dims):
+                if got.dims == ():
+                    continue  # a scalar fed to a tensor slot: runtime broadcast
+                self.emit(
+                    e.lineno,
+                    f"'{callee_fn.name}' arg '{name}' declares {spec.render()} but got rank-{len(got.dims)} {got.render()}",
+                )
+                continue
+            for sd, gd in zip(spec.dims, got.dims):
+                if isinstance(sd, int):
+                    if isinstance(gd, int) and sd != gd:
+                        self.emit(
+                            e.lineno,
+                            f"'{callee_fn.name}' arg '{name}' declares {spec.render()} but got {got.render()}",
+                        )
+                        break
+                    continue
+                if sd is None:
+                    continue
+                prev = binding.get(sd, "__unset__")
+                if prev == "__unset__" or prev is None:
+                    binding[sd] = gd
+                elif gd is not None and _dim_conflict(prev, gd):
+                    self.emit(
+                        e.lineno,
+                        f"'{callee_fn.name}' arg '{name}': dim {sd} was {prev} from an earlier arg but is {gd} here — transposed operand?",
+                    )
+                    binding[sd] = None
+                    break  # one finding per mismatched argument
+            gf, sf_ = _family(got.dtype), _family(spec.dtype)
+            if gf is not None and sf_ is not None and gf != sf_:
+                self.emit(
+                    e.lineno,
+                    f"'{callee_fn.name}' arg '{name}' declares dtype {spec.dtype} but got {got.dtype}",
+                )
+
+        def subst(spec):
+            if spec is None or spec.dims is None:
+                return UNKNOWN if spec is None else AV(None, spec.dtype)
+            dims = tuple(
+                d if isinstance(d, int) else binding.get(d) if isinstance(d, str) else None
+                for d in spec.dims
+            )
+            return AV(dims, spec.dtype)
+
+        ret = contract.ret
+        if isinstance(ret, _Tup):
+            return _Tup([subst(s) for s in ret.items])
+        return subst(ret)
+
+
+def kwargs_node(e: ast.Call, name: str):
+    for kw in e.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _merge_dtype_many(dts: list) -> str | None:
+    out = dts[0] if dts else None
+    for d in dts[1:]:
+        out = _merge_dtype(out, d)
+    return out
+
+
+# -- pass driver -------------------------------------------------------------
+
+
+class _PassCtx:
+    """Cross-module resolution: annotated top-level function name ->
+    (FunctionDef, Contract), same-module first, then from-imports."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.indices: dict[str, _ModIndex] = {}
+        self.contracts: dict[str, dict[ast.FunctionDef, tuple[Contract | None, str | None]]] = {}
+        self.by_name: dict[str, tuple[ast.FunctionDef, Contract]] = {}
+        self.local: dict[str, dict[str, tuple[ast.FunctionDef, Contract]]] = {}
+        for f in files:
+            idx = _ModIndex(f)
+            self.indices[f.rel] = idx
+            cons = _collect_contracts(f)
+            self.contracts[f.rel] = cons
+            loc: dict[str, tuple[ast.FunctionDef, Contract]] = {}
+            for fn, (contract, err) in cons.items():
+                if contract is not None:
+                    loc[fn.name] = (fn, contract)
+            self.local[f.rel] = loc
+            self.by_name.update(loc)
+        self._current_rel: str | None = None
+
+    def resolve(self, idx: _ModIndex, name: str):
+        loc = self.local.get(idx.sf.rel, {})
+        if name in loc:
+            return loc[name]
+        if name in idx.from_imports and name in self.by_name:
+            return self.by_name[name]
+        return None
+
+
+def run(ctx: Context) -> list[Finding]:
+    files = [f for f in ctx.parsed() if "# shape:" in f.text]
+    if not files:
+        return []
+    p = _PassCtx(files)
+    findings: list[Finding] = []
+    for f in files:
+        idx = p.indices[f.rel]
+        for fn, (contract, err) in sorted(p.contracts[f.rel].items(), key=lambda kv: kv[0].lineno):
+            if err is not None:
+                findings.append(Finding("SHPE", f.rel, fn.lineno, err))
+                continue
+            assert contract is not None
+            findings.extend(_FnChecker(p, idx, fn, contract).check())
+    return findings

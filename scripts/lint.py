@@ -4,11 +4,13 @@ package (single-parse driver, pluggable passes, baseline gate).
 
 Every rule the monolithic lint.py enforced (F401/F822/F841/E711/E712/B006/
 DEAD/METR/SIMC/W291/W191/E999) was ported as a pass, joined by the
-repo-invariant analyzers THRD (lock discipline), JAXP (jit purity), and
-DTRM (sim determinism).  This shim execs the new driver with identical
-CLI semantics, so ``python scripts/lint.py [paths...]`` and the
-pre-commit hook keep working unchanged.  Prefer ``python -m
-scripts.analyze`` (it adds ``--rule``, ``--json``, ``--list-rules``).
+repo-invariant analyzers THRD (lock discipline), JAXP (jit purity), DTRM
+(sim determinism), SHPE (shape/dtype contracts), and EXCP (failure-class
+taxonomy closure).  This shim execs the new driver with identical CLI
+semantics, so ``python scripts/lint.py [paths...]`` and the pre-commit
+hook (which passes ``--changed-only`` for the git-scoped fast path) keep
+working unchanged.  Prefer ``python -m scripts.analyze`` (it adds
+``--rule``, ``--json``, ``--json-out``, ``--budget``, ``--list-rules``).
 """
 
 from __future__ import annotations

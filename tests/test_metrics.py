@@ -60,7 +60,7 @@ def test_label_escaping():
     r = MetricsRegistry()
     r.inc("scheduler_unschedulable_total", labels={"reason": 'say "no"\nplease\\'})
     text = r.to_prometheus()
-    line = [l for l in text.splitlines() if l.startswith("scheduler_unschedulable_total{")][0]
+    line = [ln for ln in text.splitlines() if ln.startswith("scheduler_unschedulable_total{")][0]
     # The raw newline must never reach the wire; the escapes must.
     assert "\n" not in line and '\\"no\\"' in line and "\\n" in line and "\\\\" in line
 

@@ -26,6 +26,7 @@ __all__ = ["NativeBackend"]
 class NativeBackend(SchedulingBackend):
     name = "native"
 
+    # shape: (packed: obj, profile: obj) -> ([P] i32, scalar i32, dict)
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         node_alloc, node_avail = packed.node_alloc, packed.node_avail
         node_labels, node_valid = packed.node_labels, packed.node_valid

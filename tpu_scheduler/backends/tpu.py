@@ -22,6 +22,8 @@ from .base import SchedulingBackend
 __all__ = ["TpuBackend"]
 
 
+# shape: (assigned: [P] i32, acc_round: [P] i32, rank_of: [P] i32,
+#   rounds: scalar i32) -> [4, P] i32
 def _stack_results(assigned, acc_round, rank_of, rounds):
     """[4, P] i32: rows assigned / acc_round / rank_of / broadcast rounds —
     the single-fetch result layout (see _assign_once).  Module-level jit so
@@ -167,6 +169,7 @@ class TpuBackend(SchedulingBackend):
                 self._dev_cache.pop(oldest)[2].detach()
         return buf
 
+    # shape: (packed: obj, profile: obj, use_pallas: bool) -> ([P] i32, scalar i32, dict)
     def _assign_once(self, packed: PackedCluster, profile: SchedulingProfile, use_pallas: bool):
         jax = self._jax
         a = packed.device_arrays()
@@ -214,6 +217,7 @@ class TpuBackend(SchedulingBackend):
     def _variant_enabled(self, variant: bool) -> bool:  # holds-lock: _guard_lock
         return self.use_pallas and variant not in self._disabled_variants
 
+    # shape: (packed: obj, profile: obj) -> ([P] i32, scalar i32, dict)
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         jax = self._jax
         # Constraint cycles ride the kernel too: the per-round blocked/
@@ -306,6 +310,7 @@ class TpuBackend(SchedulingBackend):
         return self._shards[dev.id]
 
 
+# shape: (name: str) -> obj
 def make_backend(name: str, **kw) -> SchedulingBackend:
     """Factory for the --backend flag."""
     from .native import NativeBackend

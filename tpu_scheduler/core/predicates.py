@@ -65,6 +65,7 @@ class InvalidNodeReason(enum.Enum):
     TOPOLOGY_SPREAD_VIOLATION = "TopologySpreadViolation"
 
 
+# shape: (pod: obj, node: obj, snapshot: obj) -> bool
 def pod_fits_resources(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> bool:
     """Resource-fit predicate — reference ``can_pod_fit``
     (``predicates.rs:20-43``).
@@ -78,6 +79,7 @@ def pod_fits_resources(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> bool:
     return req.fits_in(available)
 
 
+# shape: (pod: obj, node: obj, snapshot: obj) -> bool
 def node_selector_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
     """nodeSelector predicate — reference ``does_node_selector_match``
     (``predicates.rs:45-61``).
@@ -112,6 +114,7 @@ def _node_expression_matches(r: LabelSelectorRequirement, labels: dict[str, str]
     return _expression_matches(r, labels)
 
 
+# shape: (term: obj, labels: dict) -> bool
 def node_selector_term_matches(term, labels: dict[str, str] | None) -> bool:
     """A nodeSelectorTerm matches iff every expression holds; a term with no
     expressions matches nothing (the empty-selector deviation)."""
@@ -122,6 +125,7 @@ def node_selector_term_matches(term, labels: dict[str, str] | None) -> bool:
     return all(_node_expression_matches(r, labels) for r in exprs)
 
 
+# shape: (pod: obj, node: obj, snapshot: obj) -> bool
 def node_affinity_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
     """Required node-affinity predicate (standard kube-scheduler; absent in
     the reference).  Terms are ORed; a pod without affinity matches
@@ -133,12 +137,14 @@ def node_affinity_matches(pod: Pod, node: Node, snapshot: ClusterSnapshot | None
     return any(node_selector_term_matches(t, labels) for t in terms)
 
 
+# shape: (pod: obj, node: obj, snapshot: obj) -> bool
 def node_schedulable(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
     """False iff the node is cordoned (``spec.unschedulable`` — kubectl
     cordon).  Beyond the reference, which has no Node.spec handling."""
     return not (node.spec is not None and node.spec.unschedulable)
 
 
+# shape: (pod: obj, node: obj, snapshot: obj) -> bool
 def taints_tolerated(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = None) -> bool:
     """Taints/tolerations predicate (standard kube-scheduler; absent in the
     reference).  Every NoSchedule/NoExecute taint on the node must be
@@ -156,6 +162,7 @@ def taints_tolerated(pod: Pod, node: Node, snapshot: ClusterSnapshot | None = No
     return True
 
 
+# shape: (selector: dict, labels: dict) -> bool
 def labels_match_selector(selector: dict[str, str] | None, labels: dict[str, str] | None) -> bool:
     """True iff ``labels`` carries every pair of ``selector``.
 
@@ -198,12 +205,14 @@ def selector_matches(
     return all(_expression_matches(r, labels) for r in match_expressions or [])
 
 
+# shape: (term: obj, labels: dict) -> bool
 def term_matches(term, labels: dict[str, str] | None) -> bool:
     """Selector match of an anti-affinity term or spread constraint against
     a pod's labels (both carry ``match_labels`` + ``match_expressions``)."""
     return selector_matches(term.match_labels, getattr(term, "match_expressions", None), labels)
 
 
+# shape: (node: obj, topology_key: str) -> obj
 def node_topology_domain(node: Node, topology_key: str) -> tuple[str, str]:
     """The topology domain of a node under ``topology_key``.
 
@@ -410,6 +419,7 @@ def topology_spread_ok(
 # --- soft (scoring) terms ---------------------------------------------------
 
 
+# shape: (pod: obj, node: obj) -> float
 def preferred_affinity_score(pod: Pod, node: Node) -> float:
     """Sum of weights of the pod's matching preferredDuringScheduling node-
     affinity terms (kube NodeAffinity scoring, pre-normalization)."""
@@ -420,6 +430,7 @@ def preferred_affinity_score(pod: Pod, node: Node) -> float:
     return float(sum(t.weight for t in terms if node_selector_term_matches(t.term, labels)))
 
 
+# shape: (pod: obj, node: obj) -> int
 def soft_taint_penalty(pod: Pod, node: Node) -> int:
     """Count of the node's PreferNoSchedule taints the pod does not
     tolerate (kube TaintToleration scoring, pre-normalization)."""
@@ -532,6 +543,7 @@ PREDICATE_CHAIN: list[tuple[InvalidNodeReason, Callable[[Pod, Node, ClusterSnaps
 ]
 
 
+# shape: (pod: obj, node: obj, snapshot: obj) -> obj
 def check_node_validity(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> InvalidNodeReason | None:
     """Run the predicate chain; return the first failure reason or None if
     the node is valid — reference ``check_node_validity``
@@ -543,6 +555,7 @@ def check_node_validity(pod: Pod, node: Node, snapshot: ClusterSnapshot) -> Inva
     return None
 
 
+# shape: (pod: obj, snapshot: obj) -> (dict, int, int)
 def unschedulable_reason_counts(pod: Pod, snapshot: ClusterSnapshot) -> tuple[dict[str, int], int, int]:
     """Per-reason candidate-node rejection counts for one pod — kube's
     "0/N nodes are available: 3 Insufficient cpu, ..." breakdown: each node
@@ -562,6 +575,7 @@ def unschedulable_reason_counts(pod: Pod, snapshot: ClusterSnapshot) -> tuple[di
     return counts, feasible, len(snapshot.nodes)
 
 
+# shape: (counts: dict, feasible: int) -> str
 def dominant_reason(counts: dict[str, int], feasible: int) -> str:
     """The one typed reason a timeline entry carries: the predicate that
     rejected the most nodes — or NotEnoughResources when some node WAS

@@ -1,0 +1,13 @@
+"""Tensor ops: the pods×nodes hot path (masks → score → pack → assign →
+constraints), shared xp-generically by the NumPy and JAX backends.
+
+Every public function in this package declares a machine-checked tensor
+contract in a ``# shape:`` comment directly above its ``def`` — symbolic
+dims (``[P, N]``, ``[B, R]``, …) plus dtypes — which the ``SHPE`` pass of
+``scripts/analyze`` abstract-interprets on every ``make check``: transposed
+operands, illegal broadcasts, wrong reduction axes, and bool/int/float
+promotion drift fail the build instead of surfacing as a wrong placement
+deep inside a jit trace.  The contract grammar and authoring guide live in
+the README "Shape contracts (the SHPE annotation language)" section; run
+``python -m scripts.analyze --rule SHPE`` to check this package alone.
+"""

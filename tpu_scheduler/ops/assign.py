@@ -88,6 +88,7 @@ _CONSTRAINT_KEYS = (
 )
 
 
+# shape: (arrays: dict) -> (dict, dict)
 def split_device_arrays(arrays: dict) -> tuple[dict, dict]:
     """Split a PackedCluster.device_arrays() dict into (node_side, pod_side)."""
     nodes = {k: v for k, v in arrays.items() if k.startswith("node_")}
@@ -95,12 +96,14 @@ def split_device_arrays(arrays: dict) -> tuple[dict, dict]:
     return nodes, pods
 
 
+# shape: (a: [S, C] i32, b: [S, C] i32) -> [S, C] i32
 def _sat_add(a, b):
     """Saturating int32 add for non-negative operands: min(a+b, INT32_MAX)."""
     s = a + b
     return jnp.where(s < 0, INT32_MAX, s)
 
 
+# shape: (x: any, y: any) -> any
 def _seg_scan_op(x, y):
     """Segmented saturating-sum operator for lax.associative_scan.
 
@@ -111,6 +114,8 @@ def _seg_scan_op(x, y):
     return fx | fy, jnp.where(fy, vy, _sat_add(vx, vy))
 
 
+# shape: (avail: [N, R] i32, nodes: dict, weights: [W] f32, blk: dict,
+#   pallas_pack: obj, round_masks: dict, salt: scalar any) -> ([B] i32, [B] bool)
 def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None, salt=None):
     """[B] best feasible node (+feasibility flag) for one block of pods.
 
@@ -198,6 +203,9 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
 
 
+# shape: (avail: [N, R] i32, ps: dict, n_active: scalar i32, nodes: dict,
+#   weights: [W] f32, block: int, use_pallas: bool, pallas_interpret: bool,
+#   round_masks: dict, salt: scalar any) -> ([P] i32, [P] bool)
 def _choose(
     avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas_interpret=False, round_masks=None, salt=None
 ):
@@ -264,6 +272,7 @@ def _choose(
     return choice, has
 
 
+# shape: (v: any, extra: int) -> any
 def _pad0(v, extra):
     return jnp.pad(v, ((0, extra),) + ((0, 0),) * (v.ndim - 1))
 
@@ -273,6 +282,7 @@ def _pad0(v, extra):
 _MIN_EPOCH_SIZE = 256
 
 
+# shape: (target: int, block: int) -> int
 def _chain_size(target: int, block: int) -> int:
     """Align one shrinking-chain size — THE single rule for both drivers
     (assign_cycle's static in-jit chain and assign_cycle_epochs' host-driven
@@ -283,6 +293,7 @@ def _chain_size(target: int, block: int) -> int:
     return max(_MIN_EPOCH_SIZE, target)
 
 
+# shape: (ps: dict) -> dict
 def _compact(ps):
     """Stable active-first packing — relative (priority) order preserved.
 
@@ -298,6 +309,7 @@ def _compact(ps):
     return {k: jnp.zeros_like(v).at[dest].set(v) for k, v in ps.items()}
 
 
+# shape: (pods: dict, block: int) -> ([P] i64, dict)
 def _prepare_pods(pods, block: int):
     """Shared cycle setup — permute to priority order, pad to a block
     multiple, init the auction bookkeeping, compact actives to the front.
@@ -326,6 +338,9 @@ def _prepare_pods(pods, block: int):
     return perm, _compact(ps)
 
 
+# shape: (nodes: dict, weights: [W] f32, block: int, use_pallas: bool,
+#   pallas_interpret: bool, cmeta: dict, soft_spread: bool, soft_pa: bool,
+#   hard_pa: bool) -> fn
 def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa=False, hard_pa=True):
     """One auction round as a while_loop body (shared by the monolithic
     assign_cycle and the size-shrinking epoch driver)."""
@@ -396,6 +411,10 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
     return body
 
 
+# shape: (nodes: dict, pods: dict, weights: [W] f32, max_rounds: int,
+#   block: int, use_pallas: bool, pallas_interpret: bool, cmeta: dict,
+#   cstate: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool)
+#   -> ([P] i32, scalar i32, [N, R] i32, [P] i32, [P] i32)
 @partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread", "soft_pa", "hard_pa"))
 def assign_cycle(
     nodes: dict,
@@ -526,6 +545,7 @@ def assign_cycle(
 # semantics — a retry later, never a crash or a spin).
 
 
+# shape: (nodes: dict, pods: dict, block: int) -> ([P] i64, [N, R] i32, dict, scalar i32)
 @partial(jax.jit, static_argnames=("block",))
 def _epoch_prelude(nodes, pods, block: int):
     """Jitted wrapper of the shared cycle setup, returning the state the
@@ -534,6 +554,8 @@ def _epoch_prelude(nodes, pods, block: int):
     return perm, nodes["node_avail"], ps, ps["active"].sum(dtype=jnp.int32)
 
 
+# shape: (nodes: dict, ps: dict, avail: [N, R] i32, n_active: scalar i32,
+#   rounds: scalar i32, cst: dict, weights: [W] f32, cmeta: dict) -> any
 @partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread", "soft_pa", "hard_pa", "floor"))
 def _assign_epoch(
     nodes, ps, avail, n_active, rounds, cst, weights, cmeta,
@@ -561,6 +583,10 @@ def _assign_epoch(
     return lax.while_loop(cond, body, (avail, ps, n_active, rounds, cst))
 
 
+# shape: (nodes: dict, pods: dict, weights: [W] f32, max_rounds: int,
+#   block: int, use_pallas: bool, pallas_interpret: bool, cmeta: dict,
+#   cstate: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool)
+#   -> ([P] i32, scalar i32, [N, R] i32, [P] i32, [P] i32)
 def assign_cycle_epochs(
     nodes: dict,
     pods: dict,

@@ -137,6 +137,7 @@ DENSE_CELLS = 1024
 DENSE_TENSOR_BYTES = 400 * 1024 * 1024
 
 
+# shape: (p: int, cells: int) -> bool
 def _dense_ok(p: int, cells: int) -> bool:
     return cells <= DENSE_CELLS and p * cells * 4 <= DENSE_TENSOR_BYTES
 
@@ -173,6 +174,7 @@ def _sig_independent(k) -> bool:
     return isinstance(k, tuple) and len(k) == 2 and k[0] == _MEMO_DK
 
 
+# shape: (memo: dict, live_ids: obj) -> dict
 def prune_match_memo(memo: dict, live_ids: set) -> dict:
     """Drop memo entries for dead pod objects, preserving the signature
     sentinel (see the key-space table above)."""
@@ -685,10 +687,12 @@ def pack_constraints(
 # ---------------------------------------------------------------------------
 
 
+# shape: (a: any) -> any
 def _clip01(xp, a):
     return xp.minimum(a, 1.0)
 
 
+# shape: (state: dict, meta: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool) -> dict
 def round_blocked_masks(
     xp, state: dict, meta: dict, soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True
 ) -> dict:
@@ -755,6 +759,7 @@ def round_blocked_masks(
     return masks
 
 
+# shape: (blk: dict, masks: dict) -> any
 def blocked_block(xp, blk: dict, masks: dict):
     """[B, N] constraint-blocked mask for one pod block (four matmuls)."""
     b = blk["pod_aa_carries"] @ masks["aa_m_node"]
@@ -772,6 +777,7 @@ def blocked_block(xp, blk: dict, masks: dict):
     return b > 0
 
 
+# shape: (size: int, idx: [P] i32, vals: [P] f32) -> [size] f32
 def _scatter_min(xp, size: int, idx, vals):
     if xp is np:
         out = np.full((size,), RANK_INF, dtype=np.float32)
@@ -780,6 +786,7 @@ def _scatter_min(xp, size: int, idx, vals):
     return xp.full((size,), RANK_INF, dtype=xp.float32).at[idx].min(vals)
 
 
+# shape: (n_rows: int, idx: [P] i32, vals: [P, C] f32) -> [n_rows, C] f32
 def _row_scatter_min(xp, n_rows: int, idx, vals):
     """out[r, c] = min over {p : idx[p] == r} of vals[p, c]  (RANK_INF fill).
 
@@ -792,6 +799,7 @@ def _row_scatter_min(xp, n_rows: int, idx, vals):
     return xp.full((n_rows, vals.shape[1]), RANK_INF, dtype=xp.float32).at[idx].min(vals)
 
 
+# shape: (state_tn: [T, N] f32, idx: [P] i32, vals: [P, T] f32) -> [T, N] f32
 def _row_scatter_max_t(xp, state_tn, idx, vals):
     """[T,N] state with state[c, idx[p]] = max(state, vals[p, c]) folded in —
     the row-scatter twin of the flattened t·n scalar scatter (transposed
@@ -804,6 +812,7 @@ def _row_scatter_max_t(xp, state_tn, idx, vals):
     return state_tn.T.at[idx].max(vals).T
 
 
+# shape: (state_tn: [T, N] f32, idx: [P] i32, vals: [P, T] f32) -> [T, N] f32
 def _row_scatter_add_t(xp, state_tn, idx, vals):
     """+= twin of :func:`_row_scatter_max_t` for count-valued state."""
     if xp is np:
@@ -813,6 +822,7 @@ def _row_scatter_add_t(xp, state_tn, idx, vals):
     return state_tn.T.at[idx].add(vals).T
 
 
+# shape: (p: int, cells: int) -> int
 def _cell_chunk(p: int, cells: int) -> int:
     """Pod-axis chunk length keeping one [chunk, S, D] tile inside the byte
     budget (0 = no chunking needed — the full tensor fits)."""
@@ -878,6 +888,8 @@ def _cell_rank_min_level(xp, mass, nd, uses, base):
     return _cell_rank_scan(xp, mass, nd, uses, out_fn)
 
 
+# shape: (accepted: [P] bool, choice: [P] i32, ranks: [P] u32, ps: dict,
+#   state: dict, meta: dict, hard_pa: bool) -> [P] bool
 def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: dict, hard_pa: bool = True) -> object:
     """Within-round conflict resolution — returns the surviving subset of
     ``accepted`` (see module docstring for the rank rules)."""
@@ -1016,6 +1028,8 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     return keep & ~bad_sp.any(axis=1)
 
 
+# shape: (accepted: [P] bool, choice: [P] i32, ps: dict, state: dict,
+#   meta: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool) -> dict
 def constraint_commit(
     xp,
     accepted,

@@ -20,6 +20,10 @@ from __future__ import annotations
 __all__ = ["feasibility_block", "feasibility_breakdown", "reason_rejection_counts"]
 
 
+# shape: (pod_req: [B, R] i32, pod_sel: [B, L] f32, pod_sel_count: [B] f32,
+#   node_avail: [N, R] i32, node_labels: [N, L] f32, pod_ntol: [B, T] f32,
+#   node_taints: [N, T] f32, pod_aff: [B, A] f32, pod_has_aff: [B] f32,
+#   node_aff: [N, A] f32) -> dict
 def feasibility_breakdown(
     xp,
     pod_req,
@@ -61,6 +65,10 @@ def feasibility_breakdown(
     return out
 
 
+# shape: (pod_req: [B, R] i32, pod_sel: [B, L] f32, pod_sel_count: [B] f32,
+#   pod_active: [B] bool, node_avail: [N, R] i32, node_labels: [N, L] f32,
+#   node_valid: [N] bool, pod_ntol: [B, T] f32, node_taints: [N, T] f32,
+#   pod_aff: [B, A] f32, pod_has_aff: [B] f32, node_aff: [N, A] f32) -> [B, N] bool
 def feasibility_block(
     xp,
     pod_req,
@@ -93,6 +101,7 @@ def feasibility_block(
     return mask
 
 
+# shape: (breakdown: dict, node_valid: [N] bool) -> dict
 def reason_rejection_counts(xp, breakdown, node_valid):
     """Per-pod candidate-node rejection counts from a breakdown:
     ``{reason -> [B] number of otherwise-valid nodes failing that

@@ -92,12 +92,14 @@ INT32_MIN = -(2**31)
 STALL_ROUNDS = 3
 
 
+# shape: (x: int, multiple: int) -> int
 def round_up(x: int, multiple: int) -> int:
     if multiple <= 1:
         return max(x, 1)
     return max(((x + multiple - 1) // multiple) * multiple, multiple)
 
 
+# shape: (x64: any) -> any
 def _clamp_i32(x64: np.ndarray) -> np.ndarray:
     """int64 → int32 with saturation (never silent wraparound)."""
     return np.clip(x64, INT32_MIN, INT32_MAX).astype(np.int32)
@@ -210,6 +212,7 @@ class PackedCluster:
         }
 
 
+# shape: (pods: obj) -> dict
 def build_selector_vocab(pods: list[Pod]) -> dict[tuple[str, str], int]:
     """Vocabulary of selector (key, value) pairs over the pending pods."""
     vocab: dict[tuple[str, str], int] = {}
@@ -221,6 +224,7 @@ def build_selector_vocab(pods: list[Pod]) -> dict[tuple[str, str], int]:
     return vocab
 
 
+# shape: (pods: obj) -> dict
 def build_affinity_vocab(pods: list[Pod]) -> dict[tuple, int]:
     """Vocabulary of canonical node-affinity terms over the pending pods."""
     vocab: dict[tuple, int] = {}
@@ -233,6 +237,7 @@ def build_affinity_vocab(pods: list[Pod]) -> dict[tuple, int]:
     return vocab
 
 
+# shape: (key: obj) -> obj
 def _term_from_key(key: tuple):
     from ..api.objects import LabelSelectorRequirement, NodeSelectorTerm
 
@@ -243,6 +248,7 @@ def _term_from_key(key: tuple):
     )
 
 
+# shape: (nodes: obj, aff_vocab: dict, n_pad: int, a_pad: int) -> [n_pad, a_pad] f32
 def _pack_node_affinity(nodes, aff_vocab: dict, n_pad: int, a_pad: int) -> np.ndarray:
     """[N,A] node-satisfies-term bitmap, host-evaluated with the full scalar
     operator semantics (core/predicates.node_selector_term_matches)."""
@@ -260,6 +266,7 @@ def _pack_node_affinity(nodes, aff_vocab: dict, n_pad: int, a_pad: int) -> np.nd
     return node_aff
 
 
+# shape: (pending: obj, aff_vocab: dict, p_pad: int, a_pad: int) -> ([p_pad, a_pad] f32, [p_pad] f32)
 def _pack_affinity(pending: list[Pod], aff_vocab: dict, p_pad: int, a_pad: int) -> tuple[np.ndarray, np.ndarray]:
     """Pod-side affinity bitmaps ([P,A] term membership, [P] has-affinity)."""
     pod_aff = np.zeros((p_pad, a_pad), dtype=np.float32)
@@ -277,6 +284,7 @@ def _pack_affinity(pending: list[Pod], aff_vocab: dict, p_pad: int, a_pad: int) 
     return pod_aff, pod_has
 
 
+# shape: (nodes: obj) -> dict
 def build_taint_vocab(nodes) -> dict[tuple[str, str, str], int]:
     """Vocabulary of hard (key, value, effect) taint triples over the nodes."""
     from ..core.predicates import HARD_TAINT_EFFECTS
@@ -292,6 +300,7 @@ def build_taint_vocab(nodes) -> dict[tuple[str, str, str], int]:
     return vocab
 
 
+# shape: (nodes: obj) -> dict
 def build_soft_taint_vocab(nodes) -> dict[tuple[str, str, str], int]:
     """Vocabulary of PreferNoSchedule taint triples — the soft (scoring)
     twin of :func:`build_taint_vocab`."""
@@ -306,6 +315,7 @@ def build_soft_taint_vocab(nodes) -> dict[tuple[str, str, str], int]:
     return vocab
 
 
+# shape: (pods: obj) -> dict
 def build_pref_vocab(pods: list[Pod]) -> dict[tuple, int]:
     """Vocabulary of canonical preferred-affinity terms over pending pods."""
     vocab: dict[tuple, int] = {}
@@ -318,6 +328,7 @@ def build_pref_vocab(pods: list[Pod]) -> dict[tuple, int]:
     return vocab
 
 
+# shape: (nodes: obj, pref_vocab: dict, n_pad: int, a_pad: int) -> [n_pad, a_pad] f32
 def _pack_node_pref(nodes, pref_vocab: dict, n_pad: int, a_pad: int) -> np.ndarray:
     """[N,A2] node-satisfies-preferred-term bitmap (full scalar operator
     semantics, same evaluator as the required-affinity pack)."""
@@ -335,6 +346,7 @@ def _pack_node_pref(nodes, pref_vocab: dict, n_pad: int, a_pad: int) -> np.ndarr
     return node_pref
 
 
+# shape: (pending: obj, pref_vocab: dict, p_pad: int, a_pad: int) -> [p_pad, a_pad] f32
 def _pack_pod_pref(pending: list[Pod], pref_vocab: dict, p_pad: int, a_pad: int) -> np.ndarray:
     """[P,A2] per-pod weight of each preferred term (duplicate declarations
     of the same canonical term sum their weights)."""
@@ -349,6 +361,7 @@ def _pack_pod_pref(pending: list[Pod], pref_vocab: dict, p_pad: int, a_pad: int)
     return pod_pref_w
 
 
+# shape: (pending: obj, taint_vocab: dict, p_pad: int, t_pad: int) -> [p_pad, t_pad] f32
 def _pack_ntol(pending: list[Pod], taint_vocab: dict, p_pad: int, t_pad: int) -> np.ndarray:
     """[P,T] 1.0 where the pod does NOT tolerate vocab taint t (padding
     rows/columns are 0 = vacuously tolerated)."""
@@ -384,6 +397,7 @@ def _pack_ntol(pending: list[Pod], taint_vocab: dict, p_pad: int, t_pad: int) ->
     return ntol
 
 
+# shape: (snapshot: obj, res_memo: dict) -> obj
 def resource_vocab(snapshot: ClusterSnapshot, res_memo: dict | None = None) -> tuple[str, ...]:
     """("cpu", "memory") plus every EXTENDED resource name
     (api/objects.is_extended_resource) any pod in the snapshot REQUESTS —
@@ -413,6 +427,7 @@ def resource_vocab(snapshot: ClusterSnapshot, res_memo: dict | None = None) -> t
     return ("cpu", "memory", *sorted(names))
 
 
+# shape: (snapshot: obj, n_pad: int, res_memo: dict, res_vocab: obj) -> ([n_pad, R] i64, [n_pad, R] i64, dict)
 def _alloc_and_used64(
     snapshot: ClusterSnapshot, n_pad: int, res_memo: dict | None = None, res_vocab: tuple[str, ...] = ("cpu", "memory")
 ) -> tuple[np.ndarray, np.ndarray, dict[str, int]]:
@@ -475,6 +490,7 @@ def _alloc_and_used64(
     return alloc64, used64, node_index
 
 
+# shape: (alloc64: [N, R] i64, req64: [P, R] i64) -> obj
 def _fit_scales(alloc64: np.ndarray, req64: np.ndarray) -> tuple[int, ...]:
     """Per-column divisors (see PackedCluster.res_scales): columns 0-1 are
     fixed (millis, KiB); each extended column takes the smallest
@@ -499,6 +515,7 @@ def _fit_scales(alloc64: np.ndarray, req64: np.ndarray) -> tuple[int, ...]:
     return tuple(scales)
 
 
+# shape: (req64: [P, R] i64, res_scales: obj) -> [P, R] i32
 def _req_i32(req64: np.ndarray, res_scales: tuple[int, ...]) -> np.ndarray:
     """Requests CEIL under the column divisors (conservative dual of the
     capacity floor)."""
@@ -506,6 +523,7 @@ def _req_i32(req64: np.ndarray, res_scales: tuple[int, ...]) -> np.ndarray:
     return _clamp_i32(-(np.floor_divide(-req64, sc)))
 
 
+# shape: (alloc64: [N, R] i64, used64: [N, R] i64, res_scales: obj) -> [N, R] i32
 def _avail_i32(alloc64: np.ndarray, used64: np.ndarray, res_scales: tuple[int, ...] = (1, 1024)) -> np.ndarray:
     avail64 = alloc64 - used64
     # Floor capacities under the column divisors (conservative; a clamped
@@ -513,6 +531,9 @@ def _avail_i32(alloc64: np.ndarray, used64: np.ndarray, res_scales: tuple[int, .
     return _clamp_i32(np.floor_divide(avail64, np.asarray(res_scales, dtype=np.int64)[None, :]))
 
 
+# shape: (snapshot: obj, pod_block: int, node_block: int, label_block: int,
+#   vocab: dict, taint_vocab: dict, aff_vocab: dict, soft_taint_vocab: dict,
+#   pref_vocab: dict, res_memo: dict) -> obj
 def pack_snapshot(
     snapshot: ClusterSnapshot,
     pod_block: int = 128,
@@ -622,6 +643,7 @@ def pack_snapshot(
     )
 
 
+# shape: (pending: obj, vocab: dict, p_pad: int, l_pad: int, res_vocab: obj, res_memo: dict) -> dict
 def _pack_pods(
     pending: list[Pod], vocab: dict, p_pad: int, l_pad: int,
     res_vocab: tuple[str, ...] = ("cpu", "memory"), res_memo: dict | None = None,
@@ -698,6 +720,7 @@ def _pack_pods(
     )
 
 
+# shape: (alloc64: [N, R] i64, res_scales: obj) -> none
 def _check_alloc_within_scales(alloc64: np.ndarray, res_scales: tuple[int, ...]) -> None:
     """Raise when an EXTENDED allocatable column outgrows the frozen
     per-column divisor (round-3 advisor): a full pack would re-derive the
@@ -712,6 +735,7 @@ def _check_alloc_within_scales(alloc64: np.ndarray, res_scales: tuple[int, ...])
             raise ValueError("resource scales outgrown by node allocatable; run a full pack_snapshot instead")
 
 
+# shape: (packed: obj, snapshot: obj) -> obj
 def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedCluster:
     """Cheap refresh of ``node_avail`` from a new snapshot over the *same*
     node set — the incremental-update path the reflector uses between full
@@ -728,6 +752,7 @@ def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedClus
     return replace(packed, node_avail=_avail_i32(alloc64, used64, packed.res_scales))
 
 
+# shape: (arr: [N, L] f32, total: int, label_block: int) -> [N, ?] f32
 def _grow_columns(arr: np.ndarray, total: int, label_block: int) -> np.ndarray:
     """Copy ``arr`` with its column count grown to cover ``total`` entries
     (padded to the block multiple).  Always copies — cached tensors may be
@@ -738,6 +763,7 @@ def _grow_columns(arr: np.ndarray, total: int, label_block: int) -> np.ndarray:
     return arr.copy()
 
 
+# shape: (packed: obj, snapshot: obj, label_block: int) -> obj
 def extend_node_vocabs(packed: PackedCluster, snapshot: ClusterSnapshot, label_block: int = 8) -> PackedCluster:
     """Grow the cached node-side tensors to cover vocabulary entries newly
     introduced by the pending pods — the in-place alternative to a full
@@ -844,6 +870,7 @@ def extend_node_vocabs(packed: PackedCluster, snapshot: ClusterSnapshot, label_b
     return replace(packed, **out)
 
 
+# shape: (packed: obj, snapshot: obj, pod_block: int, res_memo: dict) -> obj
 def repack_incremental(
     packed: PackedCluster, snapshot: ClusterSnapshot, pod_block: int = 128, res_memo: dict | None = None
 ) -> PackedCluster:

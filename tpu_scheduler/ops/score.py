@@ -29,6 +29,14 @@ from __future__ import annotations
 __all__ = ["score_block"]
 
 
+# shape: (pod_req: [B, R] i32, node_alloc: [N, R] i32, node_avail: [N, R] i32,
+#   weights: [W] f32, pod_idx: [B] u32, node_idx: [N] u32,
+#   pod_pref_w: [B, A2] f32, node_pref: [N, A2] f32,
+#   pod_ntol_soft: [B, Ts] f32, node_taints_soft: [N, Ts] f32,
+#   pod_sps_declares: [B, Ss] f32, sp_penalty_node: [Ss, N] f32,
+#   pod_sp_declares: [B, S] f32, sp_level_node: [S, N] f32,
+#   pod_ppa_w: [B, Tp] f32, ppa_cnt_node: [Tp, N] f32,
+#   salt: scalar any) -> [B, N] f32
 def score_block(
     xp,
     pod_req,
@@ -52,8 +60,9 @@ def score_block(
     """[B, N] combined priority score of a block of pods against all nodes.
 
     pod_req [B,2] int32; node_alloc, node_avail [N,2] int32;
-    weights [5] f32 — (least_requested_w, balanced_allocation_w, jitter,
-    preferred_affinity_w, soft_taint_w); pod_idx [B] / node_idx [N] uint32 —
+    weights [6] f32 — (least_requested_w, balanced_allocation_w, jitter,
+    preferred_affinity_w, soft_taint_w, topology_w — models/profiles.py
+    ``weights()`` order); pod_idx [B] / node_idx [N] uint32 —
     global indices for the jitter hash (optional; jitter term is skipped
     when either is None).
 

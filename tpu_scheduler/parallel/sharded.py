@@ -57,6 +57,16 @@ from .mesh import make_mesh
 __all__ = ["sharded_assign_cycle", "ShardedBackend", "IN_SPECS", "CONSTRAINT_KEYS", "constraint_operands"]
 
 
+# shape: (avail: [N, R] i32, active: [B] bool, req: [B, R] i32,
+#   sel: [B, L] f32, selc: [B] f32, ntol: [B, T] f32, aff: [B, A] f32,
+#   has_aff: [B] f32, pref_w: [B, A2] f32, ntol_soft: [B, Ts] f32,
+#   node_alloc: [N, R] i32, node_labels: [N, L] f32, node_taints: [N, T] f32,
+#   node_aff: [N, A] f32, node_valid: [N] bool, node_pref: [N, A2] f32,
+#   node_taints_soft: [N, Ts] f32, weights: [W] f32, pod_idx: [B] u32,
+#   node_idx: [N] u32, blocked: [B, N] bool, sps_declares: [B, Ss] f32,
+#   sp_penalty: [Ss, N] f32, spd_declares: [B, S] f32, sp_level: [S, N] f32,
+#   ppa_w: [B, Tp] f32, ppa_cnt: [Tp, N] f32, salt: scalar any)
+#   -> ([B] f32, [B] i32, [B] bool)
 def _local_choose(
     avail, active, req, sel, selc, ntol, aff, has_aff, pref_w, ntol_soft, node_alloc, node_labels, node_taints,
     node_aff, node_valid, node_pref, node_taints_soft, weights, pod_idx, node_idx,
@@ -367,6 +377,7 @@ IN_SPECS = (
 )
 
 
+# shape: (cons: obj, n_pad_from: int, n_pad_to: int) -> dict
 def constraint_operands(cons, n_pad_from: int, n_pad_to: int) -> dict:
     """Numpy constraint operands in CONSTRAINT_KEYS order (as a dict), with
     the node axis padded from the pack's padding to the mesh's tp multiple.
@@ -438,6 +449,9 @@ def _build_sharded_fn(
     return run
 
 
+# shape: (mesh: obj, arrays: dict, weights: [W] f32, max_rounds: int,
+#   constraints: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool,
+#   use_pallas: bool, pallas_interpret: bool) -> ([P] i32, scalar i32, [N, R] i32)
 def sharded_assign_cycle(
     mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None,
     soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True,
@@ -506,6 +520,7 @@ class ShardedBackend(SchedulingBackend):
         )
         return np.asarray(jax.device_get(assigned)), int(rounds)
 
+    # shape: (packed: obj, profile: obj) -> ([P] i32, scalar i32)
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         from ..errors import BackendUnavailable
 
