@@ -676,13 +676,121 @@ def sim_sweep_row(seeds=(0, 1, 2), scenarios=("sim-smoke", "api-brownout-recover
         return {}
 
 
-def previous_round_value(repo_dir: str, metric: str, platform: str) -> tuple[float, str] | None:
+def topology_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
+    """Topology-aware gang placement at a real shape (ROADMAP "topology- and
+    gang-aware placement"): a gang-heavy workload (~35% of pods in 4-8
+    member gangs) over a slice/rack-labeled fleet, solved with the fused
+    locality term — cycle latency (min/median of repeats) plus the QUALITY
+    verdict: worst-case admitted-gang placement distance and cross-rack
+    gang count.  Deterministic in the seed."""
+    import random
+
+    try:
+        from dataclasses import replace as _replace
+
+        from tpu_scheduler.core.snapshot import ClusterSnapshot
+        from tpu_scheduler.ops.pack import pack_snapshot
+        from tpu_scheduler.testing import make_node, make_pod
+        from tpu_scheduler.topology.locality import gang_placement_stats, pack_topology
+        from tpu_scheduler.topology.model import DEFAULT_LEVEL_KEYS, TopologyModel
+
+        rng = random.Random(seed)
+        slice_key, rack_key = DEFAULT_LEVEL_KEYS[0][1], DEFAULT_LEVEL_KEYS[1][1]
+        node_objs = [
+            make_node(
+                f"tn{i:05d}",
+                cpu="32",
+                memory="128Gi",
+                labels={slice_key: f"s{i // 4}", rack_key: f"r{i // 16}", "name": f"tn{i:05d}"},
+            )
+            for i in range(nodes)
+        ]
+        pod_objs = []
+        gangs: dict[str, list[str]] = {}
+        gi = 0
+        while len(pod_objs) < pods:
+            if rng.random() < 0.35:
+                size = rng.randrange(4, 9)
+                members = []
+                for m in range(size):
+                    name = f"g{gi}-m{m}"
+                    pod_objs.append(make_pod(name, cpu="2", memory="4Gi", gang=f"gang-{gi}"))
+                    members.append(f"default/{name}")
+                gangs[f"gang-{gi}"] = members
+                gi += 1
+            else:
+                pod_objs.append(make_pod(f"tp{len(pod_objs)}", cpu="1", memory="2Gi"))
+        snap = ClusterSnapshot.build(node_objs, pod_objs)
+        compiled = TopologyModel.detect(node_objs).compile(node_objs)
+        t0 = time.perf_counter()
+        packed = pack_snapshot(snap)
+        topo = pack_topology(
+            compiled, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes
+        )
+        packed = _replace(packed, topology=topo)
+        pack_s = time.perf_counter() - t0
+        times = []
+        result = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            result = backend.schedule(packed, profile)
+            times.append(time.perf_counter() - t0)
+        dists = compiled.level_distances()
+
+        def quality(res):
+            node_of = dict(res.bindings)
+            worst, cross, admitted = 0.0, 0, 0
+            for _g, members in sorted(gangs.items()):
+                placed = [node_of.get(m) for m in members]
+                if any(n is None for n in placed):
+                    continue  # the gang engine's business in the full controller
+                admitted += 1
+                stats = gang_placement_stats([compiled.domains_of(n) for n in placed], dists)
+                worst = max(worst, stats["max_distance"])
+                if stats["cross_edges"]:
+                    cross += 1
+            return worst, cross, admitted
+
+        worst, cross, admitted = quality(result)
+        # Topology-BLIND baseline solve (same packed tensors minus the
+        # locality term): the delta is the row's quality evidence.  Raw
+        # single-shot solves under total simultaneous contention — the
+        # controller's defer-and-retry backstop drives residual cross-rack
+        # gangs toward zero over cycles (the sim scenarios score that).
+        _worst_b, cross_blind, _adm_b = quality(backend.schedule(_replace(packed, topology=None), profile))
+        row = {
+            "topology_cycle_seconds": round(statistics.median(times), 4),
+            "topology_cycle_seconds_min": round(min(times), 4),
+            "topology_pack_seconds": round(pack_s, 4),
+            "topology_shape": f"{pods}x{nodes}",
+            "topology_gangs": len(gangs),
+            "topology_gangs_admitted": admitted,
+            "topology_worst_gang_distance": worst,
+            "topology_cross_rack_gangs": cross,
+            "topology_blind_cross_rack_gangs": cross_blind,
+        }
+        log(
+            f"topology row ({pods}x{nodes}): solve {row['topology_cycle_seconds']}s "
+            f"({admitted}/{len(gangs)} gangs whole, worst distance {worst}, "
+            f"{cross} cross-rack vs {cross_blind} blind)"
+        )
+        return row
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"topology row skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
+def previous_round_value(repo_dir: str, metric: str, platform: str, field: str | None = None) -> tuple[float, str] | None:
     """(value, source-file) of the newest BENCH_r*.json carrying the same
     metric on the SAME platform — the cross-round regression baseline
     (VERDICT r4 #7: a 10-15% regression is invisible inside ±25% tunnel
     noise without an explicit cross-round comparison).  Platform-mismatched
     records are never comparable (a CPU-degraded row vs a TPU record is
-    apples/oranges — the BENCH_r05 ambiguity), so they are skipped."""
+    apples/oranges — the BENCH_r05 ambiguity), so they are skipped.
+
+    With ``field``, look up that secondary row key (e.g. the topology row's
+    ``topology_cycle_seconds_min``) instead of the headline metric —
+    ``metric`` is then ignored, the same-platform rule still applies."""
     import glob
     import re
 
@@ -696,11 +804,16 @@ def previous_round_value(repo_dir: str, metric: str, platform: str) -> tuple[flo
                 parsed = json.load(f).get("parsed") or {}
         except (OSError, ValueError):
             continue
-        if parsed.get("metric") != metric or parsed.get("platform") != platform:
+        if parsed.get("platform") != platform:
             continue
+        if field is None:
+            if parsed.get("metric") != metric:
+                continue
+            # Prefer the min stat when the prior round recorded one.
+            val = parsed.get("value_min", parsed.get("value"))
+        else:
+            val = parsed.get(field)
         n = int(m.group(1))
-        # Prefer the min stat when the prior round recorded one.
-        val = parsed.get("value_min", parsed.get("value"))
         if val is not None and (best is None or n > best[0]):
             best = (n, float(val), os.path.basename(path))
     return (best[1], best[2]) if best else None
@@ -729,6 +842,38 @@ def apply_regression_check(out: dict, platform: str, repo_dir: str, threshold: f
     return False
 
 
+def apply_secondary_regression_checks(out: dict, platform: str, repo_dir: str, threshold: float | None) -> bool:
+    """Same-platform cross-round gates for SECONDARY row latencies (the
+    topology row), riding the same min-of-repeats + same-shape rules as the
+    headline gate: a shape change (downscaled fallback) makes rounds
+    incomparable, so the gate also requires matching ``topology_shape``."""
+    fired = False
+    for field, shape_field in (("topology_cycle_seconds_min", "topology_shape"),):
+        val = out.get(field)
+        if val is None:
+            continue
+        prev = previous_round_value(repo_dir, "", platform, field=field)
+        if prev is None:
+            continue
+        prev_val, prev_src = prev
+        # Shapes are strings (previous_round_value floats its result), so
+        # the same-shape rule reads the record file directly.
+        try:
+            with open(os.path.join(repo_dir, prev_src)) as f:
+                rec = json.load(f).get("parsed") or {}
+            if rec.get(shape_field) != out.get(shape_field):
+                continue
+        except (OSError, ValueError):
+            continue
+        ratio = val / prev_val if prev_val > 0 else 0.0
+        out[f"{field}_prev"] = prev_val
+        out[f"{field}_regression_vs_prev"] = round(ratio, 3)
+        if threshold is not None and ratio > threshold:
+            log(f"REGRESSION: {field} {val}s is {ratio:.2f}x the {prev_src} record ({prev_val}s), over the {threshold}x gate")
+            fired = True
+    return fired
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=100_000)
@@ -751,6 +896,7 @@ def main() -> int:
     ap.add_argument("--no-constrained-row", action="store_true")
     ap.add_argument("--no-e2e-row", action="store_true")
     ap.add_argument("--no-sim-row", action="store_true")
+    ap.add_argument("--no-topology-row", action="store_true")
     ap.add_argument("--no-sim-sweep", action="store_true")
     ap.add_argument(
         "--sim-sweep-seeds",
@@ -855,6 +1001,11 @@ def main() -> int:
     if not args.no_e2e_row and _remaining() > (500 if platform == "tpu" else 120):
         ep, en = (used_pods, used_nodes) if platform == "tpu" else (min(used_pods, 10_000), min(used_nodes, 1_000))
         out.update(e2e_row(backend, profile, ep, en, args.seed))
+    # Topology-aware gang placement at a real shape: cycle latency + the
+    # worst-case gang placement distance, gated cross-round below.
+    if not args.no_topology_row and _remaining() > (400 if platform == "tpu" else 90):
+        tp_p, tp_n = (100_000, 8_192) if platform == "tpu" else (8_192, 512)
+        out.update(topology_row(backend, profile, tp_p, tp_n, args.seed))
     # Simulation mode (sim-smoke scenario): chaos-resilience SLOs in virtual
     # time — cheap (seconds of wall), deterministic in the seed.
     if not args.no_sim_row and _remaining() > 120:
@@ -871,9 +1022,9 @@ def main() -> int:
             # overhead dominates at this size.
             row["sharded_row_note"] = "toy-scale CPU-mesh regression canary, not a perf claim"
         out.update(row)
-    regressed = apply_regression_check(
-        out, platform, os.path.dirname(os.path.abspath(__file__)), args.fail_regression_threshold
-    )
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    regressed = apply_regression_check(out, platform, repo_dir, args.fail_regression_threshold)
+    regressed = apply_secondary_regression_checks(out, platform, repo_dir, args.fail_regression_threshold) or regressed
     out["budget_seconds_left"] = round(_remaining(), 1)
     print(json.dumps(out))
     return 2 if regressed else 0

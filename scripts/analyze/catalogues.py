@@ -12,6 +12,12 @@ ANLZ  — every rule code this analysis suite registers must appear in the
 RESC  — every backoff failure class, circuit-breaker state, and breaker
         config knob in ``runtime/resilience.py`` must appear in the README
         "Resilience" catalogue.
+TOPO  — every interconnect distance level (name + label key,
+        ``topology/model.DEFAULT_LEVEL_KEYS``), locality scoring knob
+        (``topology/locality.SCORING_KNOBS``), and topology-exercising sim
+        scenario (a registry entry whose WorkloadSpec sets
+        slice_size/rack_size/rack_fail_times) must appear in the README
+        "Topology & gang placement" catalogue.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ CODES = {
     "SIMC": "a sim scenario/chaos knob/scorecard field missing from the README simulation catalogue",
     "ANLZ": "an analysis rule code missing from the README static-analysis catalogue",
     "RESC": "a resilience backoff class/breaker state/config knob missing from the README Resilience catalogue",
+    "TOPO": "a topology distance level/label key/scoring knob/scenario missing from the README \"Topology & gang placement\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -146,5 +153,68 @@ def _run_resc(ctx: Context) -> list[Finding]:
     ]
 
 
+def _topo_tuple_entries(value, kinds) -> list[tuple[str, str]]:
+    """String constants of a literal tuple/list, labeled positionally (flat
+    tuples label every element with kinds[0]; pair tuples label per slot)."""
+    out: list[tuple[str, str]] = []
+    if not isinstance(value, (ast.Tuple, ast.List)):
+        return out
+    for e in value.elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append((kinds[0], e.value))
+        elif isinstance(e, (ast.Tuple, ast.List)):
+            for kind, el in zip(kinds, e.elts):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.append((kind, el.value))
+    return out
+
+
+def _run_topo(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/topology/model.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "DEFAULT_LEVEL_KEYS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("distance level", "level label key")))
+        elif f.rel == "tpu_scheduler/topology/locality.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "SCORING_KNOBS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("scoring knob",)))
+        elif f.rel == "tpu_scheduler/sim/scenarios.py":
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "Scenario"):
+                    continue
+                name = None
+                topo = False
+                for kw in node.keywords:
+                    if kw.arg == "name" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                        name = kw.value.value
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "WorkloadSpec"
+                        and any(k.arg in ("slice_size", "rack_size", "rack_fail_times") for k in sub.keywords)
+                    ):
+                        topo = True
+                if name and topo:
+                    tokens.append(("topology scenario", name))
+    return [
+        Finding(
+            "TOPO",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the topology subsystem but is missing from the README "
+            f"\"Topology & gang placement\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
-    return _run_metr(ctx) + _run_simc(ctx) + _run_anlz(ctx) + _run_resc(ctx)
+    return _run_metr(ctx) + _run_simc(ctx) + _run_anlz(ctx) + _run_resc(ctx) + _run_topo(ctx)

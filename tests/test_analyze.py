@@ -193,6 +193,36 @@ def test_resc_drift_and_guard():
     assert not rule_hits(catalogues.run(make_ctx(mod, readme=ok_readme)), "RESC")
 
 
+def test_topo_drift_and_guard():
+    model_mod = (
+        "tpu_scheduler/topology/model.py",
+        'DEFAULT_LEVEL_KEYS = (("ghost-level", "topology.x/ghost-key"),)\n',
+    )
+    knob_mod = ("tpu_scheduler/topology/locality.py", 'SCORING_KNOBS = ("ghost_knob",)\n')
+    sc_mod = (
+        "tpu_scheduler/sim/scenarios.py",
+        '_register(Scenario(name="ghost-topo-scenario", workload=WorkloadSpec(rack_size=4)))\n'
+        '_register(Scenario(name="plain-scenario", workload=WorkloadSpec(arrival_rate=1.0)))\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(model_mod, knob_mod, sc_mod, readme="")), "TOPO")
+    assert {h.message.split("'")[1] for h in hits} == {
+        "ghost-level",
+        "topology.x/ghost-key",
+        "ghost_knob",
+        "ghost-topo-scenario",  # plain-scenario is SIMC's business, not TOPO's
+    }
+    ok = "ghost-level topology.x/ghost-key ghost_knob ghost-topo-scenario"
+    assert not rule_hits(catalogues.run(make_ctx(model_mod, knob_mod, sc_mod, readme=ok)), "TOPO")
+
+
+def test_topo_real_tree_is_catalogued():
+    files = load_files(["tpu_scheduler/topology", "tpu_scheduler/sim/scenarios.py"])
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "TOPO")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")
@@ -566,12 +596,27 @@ def test_shpe_real_annotated_modules_are_clean():
             "tpu_scheduler/core/predicates.py",
             "tpu_scheduler/backends",
             "tpu_scheduler/parallel/sharded.py",
+            "tpu_scheduler/topology",
         ]
     )
     ctx = Context(files=files, root=ROOT, readme="")
     assert sum("# shape:" in f.text for f in files) >= 8, "annotated modules went missing"
     hits = rule_hits(shapes.run(ctx), "SHPE")
     assert not hits, "; ".join(h.render() for h in hits)
+
+
+def test_shpe_topology_gather_contract_mutation_caught():
+    """ISSUE 6 satellite: mutation-check a topology contract — dropping the
+    per-pod gang-row gather in score_block ([G, N] broadcast straight into
+    the [B, N] score) must contradict the declared `# shape:` contract."""
+    path = ROOT / "tpu_scheduler" / "ops" / "score.py"
+    text = path.read_text()
+    ctx = make_ctx(("tpu_scheduler/ops/score.py", text))
+    assert not rule_hits(shapes.run(ctx), "SHPE")
+    mutated = text.replace("score + topo_gang_node[pod_gang_id]", "score + topo_gang_node")
+    assert mutated != text, "the topology gather went missing from score_block"
+    hits = rule_hits(shapes.run(make_ctx(("tpu_scheduler/ops/score.py", mutated))), "SHPE")
+    assert len(hits) == 1 and "[G, N]" in hits[0].message and "[B, N]" in hits[0].message
 
 
 # -- EXCP failure-class taxonomy closure ------------------------------------

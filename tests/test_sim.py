@@ -205,7 +205,16 @@ def test_sim_smoke_green_with_churn_and_brownout():
 
 
 def test_scenario_registry_complete():
-    expected = {"steady-state", "burst-storm", "node-flap", "api-brownout", "gang-heavy", "sim-smoke"}
+    expected = {
+        "steady-state",
+        "burst-storm",
+        "node-flap",
+        "api-brownout",
+        "gang-heavy",
+        "sim-smoke",
+        "slice-fragmented-cluster",
+        "rack-failure-during-gang-admission",
+    }
     assert expected <= set(SCENARIOS)
     for sc in SCENARIOS.values():
         assert sc.duration > 0 and sc.cycle_interval > 0 and sc.description
@@ -224,9 +233,24 @@ def test_cli_sim_subcommand(capsys):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("name", ["steady-state", "burst-storm", "node-flap", "api-brownout", "gang-heavy"])
+@pytest.mark.parametrize(
+    "name",
+    [
+        "steady-state",
+        "burst-storm",
+        "node-flap",
+        "api-brownout",
+        "gang-heavy",
+        "slice-fragmented-cluster",
+        "rack-failure-during-gang-admission",
+    ],
+)
 @pytest.mark.parametrize("seed", [0, 1])
-def test_all_scenarios_pass(name, seed):
-    card = run_scenario(name, seed=seed)
+def test_all_scenarios_pass(name, seed, tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    card = run_scenario(name, seed=seed, record=path)
     assert card["pass"], f"{name} seed {seed}: {json.dumps(card['invariants'])}"
     assert card["pods"]["lost"] == 0 and card["pods"]["double_bound"] == 0
+    # Every registered scenario replays bit-identically from its trace.
+    replayed = run_scenario(None, replay=path)
+    assert replayed["fingerprint"] == card["fingerprint"], f"{name} seed {seed} replay diverged"

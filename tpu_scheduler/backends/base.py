@@ -47,6 +47,13 @@ class SchedulingBackend(abc.ABC):
     # order on every process, which a thread pool cannot guarantee.
     supports_concurrent_shards: bool = True
 
+    # Whether assign() consumes PackedCluster.topology (the rank-aware gang
+    # locality term, topology/locality.py).  The controller only attaches
+    # the tensors — and only then arms the cross-rack quality backstop —
+    # for backends that say True: a topology-BLIND backend judged by the
+    # locality gate would have its gangs deferred every cycle (starvation).
+    supports_topology: bool = False
+
     def shard_for(self, index: int) -> "SchedulingBackend":
         """Backend instance for the ``index``-th parallel shard of a routed
         cycle (parallel/routing.py).  Default: this backend (serialized on

@@ -18,6 +18,7 @@ from ..models.profiles import SchedulingProfile
 from ..ops.masks import feasibility_block
 from ..ops.pack import INT32_MAX, STALL_ROUNDS, PackedCluster
 from ..ops.score import score_block
+from ..topology.locality import gang_state_update, gang_topology_term
 from .base import SchedulingBackend
 
 __all__ = ["NativeBackend"]
@@ -25,6 +26,7 @@ __all__ = ["NativeBackend"]
 
 class NativeBackend(SchedulingBackend):
     name = "native"
+    supports_topology = True
 
     # shape: (packed: obj, profile: obj) -> ([P] i32, scalar i32, dict)
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
@@ -60,6 +62,14 @@ class NativeBackend(SchedulingBackend):
             cmeta = cons.meta_arrays()
             cstate = {k: v.copy() for k, v in cons.state_arrays().items()}
             cpods = {k: v[perm] for k, v in cons.pod_arrays().items()}
+        topo = packed.topology
+        tmeta = gang_nodes = pod_gang = None
+        if topo is not None:
+            # Rank-aware gang co-placement (topology/locality.py) — the
+            # exact NumPy twin of the jnp round-body path in ops/assign.py.
+            tmeta = topo.meta_arrays()
+            gang_nodes = topo.state_arrays()["gang_nodes"].copy()
+            pod_gang = topo.pod_gang_id[perm]
 
         avail = node_avail.copy()
         assigned = np.full((p,), -1, dtype=np.int32)
@@ -75,6 +85,9 @@ class NativeBackend(SchedulingBackend):
                 if cons is not None
                 else None
             )
+            topo_t = None
+            if topo is not None:
+                topo_t = gang_topology_term(np, gang_nodes, tmeta, avail, pod_gang, req, active, weights[6])
             choice = np.zeros((p,), dtype=np.int32)
             has = np.zeros((p,), dtype=bool)
             node_idx = np.arange(n, dtype=np.uint32)
@@ -99,6 +112,8 @@ class NativeBackend(SchedulingBackend):
                     pod_ppa_w=cpods["pod_ppa_w"][lo:hi] if soft_pa else None,
                     ppa_cnt_node=round_masks["ppa_cnt_node"] if soft_pa else None,
                     salt=rounds,
+                    pod_gang_id=pod_gang[lo:hi] if topo is not None else None,
+                    topo_gang_node=topo_t,
                 )
                 sc = np.where(m, sc, -np.inf)
                 choice[lo:hi] = sc.argmax(axis=1).astype(np.int32)
@@ -130,6 +145,8 @@ class NativeBackend(SchedulingBackend):
                     np, accepted, choice, cpods, cstate, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa
                 )
 
+            if topo is not None:
+                gang_nodes = gang_state_update(np, gang_nodes, accepted, ch, pod_gang)
             assigned = np.where(accepted, choice, assigned)
             acc_round = np.where(accepted, rounds, acc_round)
             dec = np.zeros((n + 1, avail.shape[1]), dtype=np.int64)

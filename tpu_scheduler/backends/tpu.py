@@ -46,6 +46,7 @@ _STACK_FN = None
 
 class TpuBackend(SchedulingBackend):
     name = "tpu"
+    supports_topology = True
 
     def __init__(self, device=None, use_pallas: bool | None = None):
         try:
@@ -185,6 +186,16 @@ class TpuBackend(SchedulingBackend):
             # loop carry); the host arrays are per-cycle fresh — still cheap
             # (domain-granular, "a rounding error" next to the pod tensors).
             cstate = {k: jax.device_put(v, self.device) for k, v in cons.state_arrays().items()}
+        tmeta = tstate = None
+        topo = packed.topology
+        if topo is not None:
+            # Topology tensors (topology/locality.py): the gang-id column
+            # rides the pod dict (permuted/compacted/sliced with the rest);
+            # meta is node/domain-side (cacheable uploads); the gang-count
+            # STATE is loop-carried on device, per-cycle fresh like cstate.
+            pods.update({k: self._put(v) for k, v in topo.pod_arrays().items()})
+            tmeta = {k: self._put(v) for k, v in topo.meta_arrays().items()}
+            tstate = {k: jax.device_put(v, self.device) for k, v in topo.state_arrays().items()}
         # Driver choice (profiles.py `driver`): monolithic keeps the whole
         # auction in one jit program — one host sync per cycle, no jit-
         # boundary relayouts — and since the in-jit static size chain
@@ -205,6 +216,8 @@ class TpuBackend(SchedulingBackend):
             soft_spread=cons is not None and cons.n_spread_soft > 0,
             soft_pa=cons is not None and cons.n_ppa_terms > 0,
             hard_pa=cons is not None and cons.n_pa_terms > 0,
+            tmeta=tmeta,
+            tstate=tstate,
         )
         # ONE device→host fetch for the whole result.  Each fresh fetch
         # costs ~80 ms of tunnel latency regardless of size (measured on the

@@ -62,6 +62,17 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="node label partitioning the cluster into per-pool scheduling shards (expert-parallel routing; pods pinning the label route to their pool's shard)",
     )
+    p.add_argument(
+        "--topology-file",
+        default=None,
+        help="JSON interconnect-topology spec (levels + optional node->domain map, topology/model.py) for "
+        "rank-aware gang co-placement; default: auto-detect from the topology.tpu-scheduler/{slice,rack} node labels",
+    )
+    p.add_argument(
+        "--no-topology",
+        action="store_true",
+        help="disable topology-aware gang scoring even when nodes carry topology labels",
+    )
     p.add_argument("--nodes", type=int, default=100, help="synthetic cluster: node count")
     p.add_argument("--pods", type=int, default=1000, help="synthetic cluster: pending pods")
     p.add_argument("--bound-pods", type=int, default=0, help="synthetic cluster: pre-bound pods")
@@ -258,11 +269,20 @@ def main(argv: list[str] | None = None) -> int:
         # machinery (metrics, /debug/resilience) but never trips it.
         failure_ratio=2.0 if args.no_breaker else BreakerConfig.failure_ratio,
     )
+    if args.no_topology:
+        topology = None
+    elif args.topology_file:
+        from .topology.model import load_topology_file
+
+        topology = load_topology_file(args.topology_file)
+    else:
+        topology = "auto"
     sched = Scheduler(
         api,
         backend,
         profile=profile,
         policy=args.policy,
+        topology=topology,
         attempts=args.attempts,
         requeue_seconds=args.requeue_seconds,
         fallback_backend=fallback,

@@ -82,10 +82,17 @@ class ClusterSnapshot:
     # controller consults the pending list several times per cycle — at
     # flagship scale each uncached scan walks 200k+ pods.
     _pending: list | None = field(default=None, compare=False, repr=False)
+    # Compiled interconnect topology for THIS node set (topology/model
+    # .CompiledTopology — carries the node-distance tensor): attached by the
+    # controller once per cycle (attach_topology) so every consumer of the
+    # snapshot — pack, scoring, debug — reads the same resolved hierarchy.
+    topology: object | None = field(default=None, compare=False, repr=False)
 
     @staticmethod
-    def build(nodes: Iterable[Node], pods: Iterable[Pod]) -> "ClusterSnapshot":
-        snap = ClusterSnapshot(nodes=tuple(nodes), pods=tuple(pods))
+    def build(
+        nodes: Iterable[Node], pods: Iterable[Pod], topology: object | None = None
+    ) -> "ClusterSnapshot":
+        snap = ClusterSnapshot(nodes=tuple(nodes), pods=tuple(pods), topology=topology)
         by_name = {n.name: n for n in snap.nodes}
         for p in snap.pods:
             if p.spec is not None and p.spec.node_name is not None:
@@ -96,6 +103,12 @@ class ClusterSnapshot:
                     if p.spec.anti_affinity:
                         snap._placed_with_terms.append((p, node))
         return snap
+
+    def attach_topology(self, compiled) -> None:
+        """Attach a compiled topology post-build (the dataclass is frozen;
+        the field is cache-like non-compare state, same stance as the lazy
+        ``_pending`` memo)."""
+        object.__setattr__(self, "topology", compiled)
 
     def placed_pods(self) -> list:
         """All (pod, node) placements onto nodes present in the snapshot."""

@@ -38,6 +38,15 @@ class SchedulingProfile:
     preferred_affinity_weight: float = 1.0
     soft_taint_weight: float = 10.0
     topology_weight: float = 1.0
+    # Rank-aware gang co-placement (topology/locality.py): score points per
+    # interconnect-distance unit between a candidate node and the gang's
+    # already-placed members, and the scale of the whole-gang-fits domain
+    # bonus.  DELIBERATELY dominant over the ~200-point packing score at its
+    # default: for tightly-coupled TPU workloads placement locality IS
+    # communication performance, so a gang member prefers a worse-packed
+    # node in the right slice over a better-packed node a rack away.
+    # 0 disables the term (topology-blind gang scoring).
+    gang_locality_weight: float = 64.0
     # Auction driver (backends/tpu.py): "monolithic" (and "auto", the
     # default) runs the whole auction as ONE jit program containing a
     # static size chain — the round body at quartering array sizes with
@@ -74,6 +83,7 @@ class SchedulingProfile:
                 self.preferred_affinity_weight,
                 self.soft_taint_weight,
                 self.topology_weight,
+                self.gang_locality_weight,
             ],
             dtype=np.float32,
         )

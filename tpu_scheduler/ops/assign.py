@@ -55,6 +55,7 @@ from jax import lax
 from .masks import feasibility_block
 from .pack import INT32_MAX, STALL_ROUNDS
 from .score import score_block
+from ..topology.locality import gang_state_update, gang_topology_term
 
 __all__ = ["assign_cycle", "assign_cycle_epochs", "split_device_arrays", "INT32_MAX"]
 
@@ -115,8 +116,9 @@ def _seg_scan_op(x, y):
 
 
 # shape: (avail: [N, R] i32, nodes: dict, weights: [W] f32, blk: dict,
-#   pallas_pack: obj, round_masks: dict, salt: scalar any) -> ([B] i32, [B] bool)
-def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None, salt=None):
+#   pallas_pack: obj, round_masks: dict, salt: scalar any,
+#   topo_t: [G, N] f32) -> ([B] i32, [B] bool)
+def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None, salt=None, topo_t=None):
     """[B] best feasible node (+feasibility flag) for one block of pods.
 
     ``blk`` is the pod-side dict sliced to one block.  With ``pallas_pack``
@@ -198,6 +200,8 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
         pod_ppa_w=blk["pod_ppa_w"] if soft_pa else None,
         ppa_cnt_node=round_masks["ppa_cnt_node"] if soft_pa else None,
         salt=salt,
+        pod_gang_id=blk["pod_gang_id"] if topo_t is not None else None,
+        topo_gang_node=topo_t,
     )
     sc = jnp.where(m, sc, -jnp.inf)
     return jnp.argmax(sc, axis=1).astype(jnp.int32), m.any(axis=1)
@@ -205,9 +209,10 @@ def _choose_block(avail, nodes, weights, blk, pallas_pack=None, round_masks=None
 
 # shape: (avail: [N, R] i32, ps: dict, n_active: scalar i32, nodes: dict,
 #   weights: [W] f32, block: int, use_pallas: bool, pallas_interpret: bool,
-#   round_masks: dict, salt: scalar any) -> ([P] i32, [P] bool)
+#   round_masks: dict, salt: scalar any, topo_t: [G, N] f32) -> ([P] i32, [P] bool)
 def _choose(
-    avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas_interpret=False, round_masks=None, salt=None
+    avail, ps, n_active, nodes, weights, block, use_pallas=False, pallas_interpret=False, round_masks=None, salt=None,
+    topo_t=None,
 ):
     """Per-pod best feasible node vs current capacity, blockwise over pods.
 
@@ -220,6 +225,11 @@ def _choose(
     """
     p = ps["pod_req"].shape[0]
 
+    if topo_t is not None:
+        # The fused Pallas kernel has no gang-locality operand yet; topology
+        # cycles run the jnp expression tree (bit-identical to native by
+        # construction — the term is the same xp tree on both backends).
+        use_pallas = False
     if use_pallas:
         from .pallas_choose import pallas_kernel_supported
 
@@ -250,8 +260,12 @@ def _choose(
         )
 
     choose_keys = _CHOOSE_KEYS + (_CONSTRAINT_KEYS if round_masks is not None else ())
+    if topo_t is not None:
+        choose_keys = choose_keys + ("pod_gang_id",)
     if block >= p:
-        return _choose_block(avail, nodes, weights, {k: ps[k] for k in choose_keys}, pallas_pack, round_masks, salt)
+        return _choose_block(
+            avail, nodes, weights, {k: ps[k] for k in choose_keys}, pallas_pack, round_masks, salt, topo_t
+        )
 
     nb_occupied = (n_active + block - 1) // block  # traced; caller pads p % block == 0
 
@@ -263,7 +277,7 @@ def _choose(
         i, choice, has = s
         lo = i * block
         blk = {k: lax.dynamic_slice_in_dim(ps[k], lo, block) for k in choose_keys}
-        bc, bh = _choose_block(avail, nodes, weights, blk, pallas_pack, round_masks, salt)
+        bc, bh = _choose_block(avail, nodes, weights, blk, pallas_pack, round_masks, salt, topo_t)
         choice = lax.dynamic_update_slice_in_dim(choice, bc, lo, axis=0)
         has = lax.dynamic_update_slice_in_dim(has, bh, lo, axis=0)
         return i + 1, choice, has
@@ -340,22 +354,35 @@ def _prepare_pods(pods, block: int):
 
 # shape: (nodes: dict, weights: [W] f32, block: int, use_pallas: bool,
 #   pallas_interpret: bool, cmeta: dict, soft_spread: bool, soft_pa: bool,
-#   hard_pa: bool) -> fn
-def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa=False, hard_pa=True):
+#   hard_pa: bool, tmeta: dict) -> fn
+def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa=False, hard_pa=True, tmeta=None):
     """One auction round as a while_loop body (shared by the monolithic
-    assign_cycle and the size-shrinking epoch driver)."""
+    assign_cycle and the size-shrinking epoch driver).
+
+    ``tmeta`` (topology/locality.TopologySet.meta_arrays) switches on the
+    rank-aware gang co-placement term: each round derives the per-(gang,
+    node) score tensor from the loop-carried placement counts ``tst`` and
+    the live capacity, and commit folds the round's accepted gang members
+    back into those counts.  Gang-count state is [G, N] — NOT pod-indexed —
+    so the size-chain slicing never loses placed-member information."""
     n = nodes["node_avail"].shape[0]
 
     def body(state):
-        avail, ps, n_active, rounds, cst = state
+        avail, ps, n_active, rounds, cst, tst = state
         p = ps["pod_req"].shape[0]
         round_masks = None
         if cmeta is not None:
             from .constraints import constraint_commit, constraint_filter, round_blocked_masks
 
             round_masks = round_blocked_masks(jnp, cst, cmeta, soft_spread=soft_spread, soft_pa=soft_pa, hard_pa=hard_pa)
+        topo_t = None
+        if tmeta is not None:
+            topo_t = gang_topology_term(
+                jnp, tst["gang_nodes"], tmeta, avail, ps["pod_gang_id"], ps["pod_req"], ps["active"], weights[6]
+            )
         choice, has = _choose(
-            avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks, salt=rounds
+            avail, ps, n_active, nodes, weights, block, use_pallas, pallas_interpret, round_masks, salt=rounds,
+            topo_t=topo_t,
         )
         cand = ps["active"] & has
         ch = jnp.where(cand, choice, n).astype(jnp.int32)  # sentinel segment n for non-claimants
@@ -405,15 +432,21 @@ def _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta,
             new_match = (ps["pod_pa_matched"] * accepted[:, None].astype(jnp.float32)).sum(axis=0) > 0  # [Ta]
             pa_hope = (ps["pod_pa_declares"].sum(axis=1) > 0) & new_match.any()
             ps["active"] = ps["active"] | (was_active & ~has & pa_hope)
+        if tmeta is not None:
+            # Commit accepted gang members into the [G, N] placement counts
+            # (non-claimants carry the sentinel column, gangless pods row 0 —
+            # neither is ever read back).
+            tst = {"gang_nodes": gang_state_update(jnp, tst["gang_nodes"], accepted, ch, ps["pod_gang_id"])}
         ps = _compact(ps)
-        return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1, cst
+        return avail, ps, ps["active"].sum(dtype=jnp.int32), rounds + 1, cst, tst
 
     return body
 
 
 # shape: (nodes: dict, pods: dict, weights: [W] f32, max_rounds: int,
 #   block: int, use_pallas: bool, pallas_interpret: bool, cmeta: dict,
-#   cstate: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool)
+#   cstate: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool,
+#   tmeta: dict, tstate: dict)
 #   -> ([P] i32, scalar i32, [N, R] i32, [P] i32, [P] i32)
 @partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread", "soft_pa", "hard_pa"))
 def assign_cycle(
@@ -429,6 +462,8 @@ def assign_cycle(
     soft_spread: bool = False,
     soft_pa: bool = False,
     hard_pa: bool = True,
+    tmeta: dict | None = None,
+    tstate: dict | None = None,
 ):
     """Assign all pending pods to nodes in one on-device cycle.
 
@@ -463,7 +498,9 @@ def assign_cycle(
     if cmeta is not None:
         cstate = {**cstate, "stall": jnp.int32(0)}
 
-    body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa)
+    body = _make_round_body(
+        nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa, tmeta
+    )
 
     # Static size chain: p, p/4, p/16, … — ONE alignment/floor rule shared
     # with the epoch driver (_chain_size).  A stage is only appended when it
@@ -479,7 +516,7 @@ def assign_cycle(
 
     def make_cond(next_size, done):
         def cond(state):
-            _, _, n_active, rounds, cst = state
+            _, _, n_active, rounds, cst, _tst = state
             go = (rounds < max_rounds) & (n_active > 0) & ~done
             if cmeta is not None:
                 go = go & (cst["stall"] < STALL_ROUNDS)
@@ -496,6 +533,7 @@ def assign_cycle(
     n_active = ps["active"].sum(dtype=jnp.int32)
     rounds = jnp.int32(0)
     cst = cstate
+    tst = tstate
     # Terminal-exit latch: the stage-transition slice below is only safe
     # because a stage that exits via the round cap / stall / drained-pool
     # conditions (rather than the size handoff) guarantees every LATER stage
@@ -516,8 +554,8 @@ def assign_cycle(
             acc_round_rank = acc_round_rank.at[ps["ranks"]].set(ps["acc_round"])
             ps = {k: v[:size] for k, v in ps.items()}
         next_size = sizes[i + 1] if i + 1 < len(sizes) else 0
-        avail, ps, n_active, rounds, cst = lax.while_loop(
-            make_cond(next_size, done), body, (avail, ps, n_active, rounds, cst)
+        avail, ps, n_active, rounds, cst, tst = lax.while_loop(
+            make_cond(next_size, done), body, (avail, ps, n_active, rounds, cst, tst)
         )
         terminal = (rounds >= max_rounds) | (n_active <= 0)
         if cmeta is not None:
@@ -555,11 +593,13 @@ def _epoch_prelude(nodes, pods, block: int):
 
 
 # shape: (nodes: dict, ps: dict, avail: [N, R] i32, n_active: scalar i32,
-#   rounds: scalar i32, cst: dict, weights: [W] f32, cmeta: dict) -> any
+#   rounds: scalar i32, cst: dict, weights: [W] f32, cmeta: dict,
+#   tmeta: dict, tst: dict) -> any
 @partial(jax.jit, static_argnames=("max_rounds", "block", "use_pallas", "pallas_interpret", "soft_spread", "soft_pa", "hard_pa", "floor"))
 def _assign_epoch(
     nodes, ps, avail, n_active, rounds, cst, weights, cmeta,
     max_rounds: int, block: int, use_pallas: bool, pallas_interpret: bool, soft_spread: bool, soft_pa: bool, hard_pa: bool, floor: bool,
+    tmeta=None, tst=None,
 ):
     """Run auction rounds until done — or, when not at the ``floor`` size,
     until the active count falls to half the (static) pod-array size, so the
@@ -569,10 +609,12 @@ def _assign_epoch(
     of the jit cache key, which is what lets the body builder branch on it
     at trace time (same contract as assign_cycle)."""
     p = ps["pod_req"].shape[0]
-    body = _make_round_body(nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa)
+    body = _make_round_body(
+        nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa, tmeta
+    )
 
     def cond(state):
-        _, _, n_active, rounds, cst = state
+        _, _, n_active, rounds, cst, _tst = state
         go = (rounds < max_rounds) & (n_active > 0)
         if cmeta is not None:
             go = go & (cst["stall"] < STALL_ROUNDS)
@@ -580,12 +622,13 @@ def _assign_epoch(
             go = go & (2 * n_active > p)
         return go
 
-    return lax.while_loop(cond, body, (avail, ps, n_active, rounds, cst))
+    return lax.while_loop(cond, body, (avail, ps, n_active, rounds, cst, tst))
 
 
 # shape: (nodes: dict, pods: dict, weights: [W] f32, max_rounds: int,
 #   block: int, use_pallas: bool, pallas_interpret: bool, cmeta: dict,
-#   cstate: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool)
+#   cstate: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool,
+#   tmeta: dict, tstate: dict)
 #   -> ([P] i32, scalar i32, [N, R] i32, [P] i32, [P] i32)
 def assign_cycle_epochs(
     nodes: dict,
@@ -600,6 +643,8 @@ def assign_cycle_epochs(
     soft_spread: bool = False,
     soft_pa: bool = False,
     hard_pa: bool = True,
+    tmeta: dict | None = None,
+    tstate: dict | None = None,
 ):
     """assign_cycle with host-driven SIZE SHRINKING — the backend's driver.
 
@@ -622,6 +667,7 @@ def assign_cycle_epochs(
     n_active = int(n_active_dev)
     rounds = jnp.int32(0)
     cst = {**cstate, "stall": jnp.int32(0)} if cmeta is not None else cstate
+    tst = tstate
     assigned_rank = jnp.full((p_pad,), -1, jnp.int32)
     acc_round_rank = jnp.full((p_pad,), -1, jnp.int32)
 
@@ -629,9 +675,10 @@ def assign_cycle_epochs(
     rounds_i = 0
     while rounds_i < max_rounds and n_active > 0:
         floor = p_cur <= _MIN_EPOCH_SIZE
-        avail, ps, n_active_dev, rounds, cst = _assign_epoch(
+        avail, ps, n_active_dev, rounds, cst, tst = _assign_epoch(
             nodes, ps, avail, n_active_dev, rounds, cst, weights, cmeta,
             max_rounds, block, use_pallas, pallas_interpret, soft_spread, soft_pa, hard_pa, floor,
+            tmeta, tst,
         )
         # ONE host sync per epoch: n_active, rounds, and the stall counter
         # ride home in a single fetch (~80 ms tunnel latency each otherwise).

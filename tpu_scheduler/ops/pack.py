@@ -148,6 +148,13 @@ class PackedCluster:
     # unconstrained cycles.
     constraints: object | None = None
 
+    # Interconnect-topology tensors for this cycle (topology/locality
+    # .TopologySet): gang membership + per-level domain masks feeding the
+    # rank-aware co-placement score term.  Attached per-cycle by the
+    # controller (gang membership changes every cycle); None for gangless
+    # or topology-blind cycles.
+    topology: object | None = None
+
     # Resource axis names for the [·, R] request/capacity tensors: always
     # ("cpu", "memory") first — millicores and ceil/floor-KiB, the exact
     # reference semantics — then any EXTENDED resources (device plugins:

@@ -431,7 +431,12 @@ def choose_block_pallas(
         if constrained:
             cons_node = tuple(jnp.pad(v, ((0, 0), (0, n_pad - n))) for v in cons_node)
 
-    w = jnp.pad(weights.astype(jnp.float32), (0, 8 - weights.shape[0])).reshape(1, 8)
+    # The kernel consumes the first 6 profile weights only; slots 6-7 are
+    # the round salt and node offset.  weights may be longer (index 6 is
+    # gang_locality_weight — consumed upstream by topology/locality.py, and
+    # topology cycles never reach the kernel), so slice before padding.
+    w6 = weights.astype(jnp.float32)[:6]
+    w = jnp.pad(w6, (0, 8 - w6.shape[0])).reshape(1, 8)
     if salt is not None:
         w = w.at[0, 6].set(jnp.asarray(salt).astype(jnp.float32))
     if node_offset is not None:

@@ -36,7 +36,7 @@ __all__ = ["score_block"]
 #   pod_sps_declares: [B, Ss] f32, sp_penalty_node: [Ss, N] f32,
 #   pod_sp_declares: [B, S] f32, sp_level_node: [S, N] f32,
 #   pod_ppa_w: [B, Tp] f32, ppa_cnt_node: [Tp, N] f32,
-#   salt: scalar any) -> [B, N] f32
+#   salt: scalar any, pod_gang_id: [B] i32, topo_gang_node: [G, N] f32) -> [B, N] f32
 def score_block(
     xp,
     pod_req,
@@ -56,15 +56,18 @@ def score_block(
     pod_ppa_w=None,
     ppa_cnt_node=None,
     salt=None,
+    pod_gang_id=None,
+    topo_gang_node=None,
 ):
     """[B, N] combined priority score of a block of pods against all nodes.
 
     pod_req [B,2] int32; node_alloc, node_avail [N,2] int32;
-    weights [6] f32 — (least_requested_w, balanced_allocation_w, jitter,
-    preferred_affinity_w, soft_taint_w, topology_w — models/profiles.py
-    ``weights()`` order); pod_idx [B] / node_idx [N] uint32 —
-    global indices for the jitter hash (optional; jitter term is skipped
-    when either is None).
+    weights [7] f32 — (least_requested_w, balanced_allocation_w, jitter,
+    preferred_affinity_w, soft_taint_w, topology_w, gang_locality_w —
+    models/profiles.py ``weights()`` order; index 6 is consumed upstream by
+    topology/locality.gang_topology_term, not here); pod_idx [B] /
+    node_idx [N] uint32 — global indices for the jitter hash (optional;
+    jitter term is skipped when either is None).
 
     Soft terms (each optional-together, zero-width tensors are no-ops):
       • preferred node affinity: +w₃ · Σ matching-term weights
@@ -135,4 +138,14 @@ def score_block(
         score = score - (f32(2.0) * weights[2]) * (pod_sp_declares @ sp_level_node)
     if pod_ppa_w is not None and ppa_cnt_node is not None:
         score = score + pod_ppa_w @ ppa_cnt_node
+    if pod_gang_id is not None and topo_gang_node is not None:
+        # Rank-aware gang co-placement (topology/locality.py): the per-round
+        # [G+1, N] anchor/fit/herd tensor is SHARED by every member of a
+        # gang, so the whole batched all-ranks term is one row gather here.
+        # Added after the jitter quantization, like the hard-spread steering:
+        # its herd component is sized to dominate the per-pod tie-break so a
+        # gang converges on one domain instead of scattering across
+        # near-ties.  Row 0 is pinned to zero — score-neutral for gangless
+        # pods (and block padding, which lands in gang 0).
+        score = score + topo_gang_node[pod_gang_id]
     return score.astype(f32)

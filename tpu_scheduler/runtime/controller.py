@@ -178,6 +178,7 @@ class Scheduler:
         breaker_config: BreakerConfig | None = None,
         flush_capacity: int = 4096,
         backoff_policies: dict | None = None,
+        topology="auto",
     ):
         if policy not in ("batch", "sample"):
             raise ValueError(f"unknown policy {policy!r} (expected 'batch' or 'sample')")
@@ -289,6 +290,14 @@ class Scheduler:
         # This cycle's successful (or dispatched) placements — the capacity
         # the preemption pass must see on top of the pre-cycle snapshot.
         self._cycle_placed: list[tuple[Pod, Node]] = []
+        # Interconnect topology (topology/): "auto" detects the default node
+        # label keys per cycle, an explicit TopologyModel (e.g. from
+        # --topology-file) pins the hierarchy, None disables — gang scoring
+        # then stays topology-blind.  The compiled form is cached per node
+        # OBJECT set (the API layer replaces node objects on modification,
+        # so identity captures label changes too).
+        self.topology = topology
+        self._topo_cache: tuple[tuple, object] | None = None
         if pipeline and profile.pool_key:
             logger.warning(
                 "--pipeline applies to plain unconstrained cycles; routed (--pool-key) and "
@@ -640,6 +649,52 @@ class Scheduler:
         self._packed = packed
         return packed
 
+    def _compiled_topology(self, snapshot: ClusterSnapshot):
+        """The cycle's CompiledTopology (or None when disabled / the cluster
+        advertises no topology labels).  A snapshot that already carries one
+        (attach_topology — rebuilt segment snapshots inherit via the node
+        objects) wins; otherwise compile-and-cache keyed on the node object
+        identity tuple."""
+        if snapshot.topology is not None:
+            return snapshot.topology
+        if self.topology is None:
+            return None
+        key = tuple(id(n) for n in snapshot.nodes)
+        hit = self._topo_cache
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        from ..topology.model import TopologyModel
+
+        model = self.topology if isinstance(self.topology, TopologyModel) else TopologyModel.detect(snapshot.nodes)
+        compiled = model.compile(snapshot.nodes) if model is not None else None
+        self._topo_cache = (key, compiled)
+        return compiled
+
+    def _attach_topology(self, packed, batch_snapshot: ClusterSnapshot):
+        """Attach the cycle's TopologySet to a per-cycle copy of the packed
+        tensors (the constraints pattern: gang membership changes every
+        cycle, so it is never part of the incremental pack cache).  No-op —
+        zero added tensors, zero solve cost — for gangless batches or
+        topology-blind clusters."""
+        if not getattr(self.backend, "supports_topology", False):
+            # A topology-BLIND backend judged by the cross-rack quality
+            # backstop would see its gangs deferred every cycle; the whole
+            # subsystem stays off for it (backends/base.py supports_topology).
+            return packed
+        pending = batch_snapshot.pending_pods()
+        if not any(p.spec is not None and p.spec.gang for p in pending):
+            return packed
+        compiled = self._compiled_topology(batch_snapshot)
+        if compiled is None:
+            return packed
+        from ..topology.locality import pack_topology
+
+        topo = pack_topology(compiled, pending, packed.padded_pods, packed.node_names, packed.padded_nodes)
+        if topo is None:
+            return packed
+        self.metrics.inc("scheduler_topology_cycles_total")
+        return replace(packed, topology=topo)
+
     def _split_affinity_pending(self, snapshot: ClusterSnapshot, pending: list[Pod]) -> tuple[list[Pod], list[Pod]]:
         """Split pending pods into (plain, constrained) for the batch path.
 
@@ -922,6 +977,19 @@ class Scheduler:
 
         for _ in range(self.GANG_RESOLVE_BUDGET):  # each iteration rejects ≥1 gang
             incomplete = incomplete_now()
+            fragmented = self._cross_rack_rejects(packed, result, members, local_names, rejected_gangs)
+            if fragmented:
+                # Placement-QUALITY rejection (topology/): the gang bound
+                # whole but straddles the coarsest interconnect level even
+                # though one domain could fit it at cycle start — a
+                # contention race fragmented it mid-auction.  Deferring a
+                # cycle (fresh capacity view, empty anchor) beats admitting
+                # a permanently slow gang; its capacity reallocates in the
+                # re-solve like any incomplete gang's.
+                self.metrics.inc("scheduler_gang_locality_rejections_total", len(fragmented))
+                for g in sorted(fragmented):
+                    logger.info("gang %s admitted cross-rack despite a single-rack fit; deferring whole", g)
+                incomplete = incomplete | fragmented
             if not incomplete:
                 break
             for g in sorted(incomplete):
@@ -966,6 +1034,48 @@ class Scheduler:
             rounds=result.rounds,
             stats=result.stats,
         )
+
+    @staticmethod
+    def _cross_rack_rejects(packed, result, members, local_names, rejected_gangs) -> set[str]:
+        """Fully-bound gangs whose placement crosses the COARSEST topology
+        level although a single domain's cycle-start free capacity covered
+        the whole gang — the contention-race escape hatch of the fused
+        locality term (topology/locality.py): the auction cannot un-place a
+        member, so the quality verdict is enforced here, at admission.
+
+        The fit check is the same cpu/mem heuristic the fit bonus uses
+        (domain free >= gang demand on both axes) against the CYCLE-START
+        capacity: if no domain ever fit, a cross-rack admission is the best
+        available and stands."""
+        topo = packed.topology
+        if topo is None or not members:
+            return set()
+        import numpy as np
+
+        lv = topo.meta["level_dist"].shape[0]
+        dom_id = topo.meta[f"dom_id_{lv - 1}"]  # [N_pad] coarsest level
+        n_dom = int(topo.meta[f"dom_onehot_{lv - 1}"].shape[0]) - 1  # minus sentinel
+        free = np.maximum(packed.node_avail[:, :2], 0).astype(np.int64)
+        dom_free = np.zeros((n_dom + 1, 2), dtype=np.int64)
+        np.add.at(dom_free, dom_id, free)
+        row_of = {nm: i for i, nm in enumerate(packed.pod_names)}
+        node_row = {nm: i for i, nm in enumerate(packed.node_names)}
+        node_of = dict(result.bindings)
+        out: set[str] = set()
+        for g, ms in sorted(members.items()):
+            if g in rejected_gangs or not ms & local_names:
+                continue
+            rows = [row_of.get(nm) for nm in sorted(ms)]
+            placed = [node_row.get(node_of.get(nm)) for nm in sorted(ms)]
+            if any(r is None for r in rows) or any(p is None for p in placed):
+                continue  # not (fully) local/bound — the atomicity loop owns it
+            doms = {int(dom_id[p]) for p in placed}
+            if len(doms) <= 1:
+                continue  # already single-rack
+            demand = np.asarray([packed.pod_req[r, :2] for r in rows], dtype=np.int64).sum(axis=0)
+            if bool((dom_free[:n_dom] >= demand[None, :]).all(axis=1).any()):
+                out.add(g)
+        return out
 
     def _solve_with_fallback(self, packed, backend: SchedulingBackend | None = None):
         """backend.schedule with the BackendUnavailable→fallback contract."""
@@ -1013,7 +1123,7 @@ class Scheduler:
                 (full_name(p) for p in batch_snapshot.pending_pods()), self._cycle_tag, self.backend.name
             )
         with span("pack"):
-            packed = self._pack(batch_snapshot)
+            packed = self._attach_topology(self._pack(batch_snapshot), batch_snapshot)
         with span("solve"):
             result = self._solve_gang_aware(packed, batch_snapshot)
         self._dispatch_binds(result)
@@ -1211,7 +1321,7 @@ class Scheduler:
                 (full_name(p) for p in batch_snapshot.pending_pods()), self._cycle_tag, self.backend.name
             )
         with span("pack"):
-            packed = self._pack(batch_snapshot)
+            packed = self._attach_topology(self._pack(batch_snapshot), batch_snapshot)
             if with_constraints:
                 from ..ops.constraints import pack_constraints
 
@@ -1909,6 +2019,13 @@ class Scheduler:
                 for p in pending_all:
                     if p.spec is not None and p.spec.gang:
                         self._cycle_gangs.setdefault(p.spec.gang, set()).add(full_name(p))
+                # The cycle snapshot CARRIES the compiled interconnect
+                # topology (node-distance tensor + per-level membership):
+                # pack, scoring, and the admitted-gang locality metrics below
+                # all read the same resolved hierarchy.
+                compiled_topo = self._compiled_topology(cycle_snapshot)
+                if compiled_topo is not None:
+                    cycle_snapshot.attach_topology(compiled_topo)
                 self._explain_snapshot = cycle_snapshot
                 self.recorder.seen_many(eligible_names, self._cycle_tag)
                 if self.policy == "batch":
@@ -1928,12 +2045,37 @@ class Scheduler:
                     # multi-count) and not at admission (a per-member bind
                     # failure would overcount admissions).
                     placed_names = {full_name(p) for p, _ in self._cycle_placed}
+                    node_of = {full_name(p): n.name for p, n in self._cycle_placed}
                     for g, ms in sorted(self._cycle_gangs.items()):
                         if ms <= placed_names:
                             self.metrics.inc("scheduler_gangs_admitted_total")
+                            detail = g
+                            if compiled_topo is not None:
+                                # Placement-locality verdict per admitted
+                                # gang: worst pairwise interconnect distance
+                                # into the histogram ("why is this gang
+                                # slow" starts here), the full stats onto
+                                # the members' timelines.
+                                from ..topology.locality import gang_placement_stats
+
+                                doms = [
+                                    d
+                                    for d in (compiled_topo.domains_of(node_of[m]) for m in sorted(ms))
+                                    if d is not None
+                                ]
+                                if len(doms) >= 2:
+                                    stats = gang_placement_stats(doms, compiled_topo.level_distances())
+                                    self.metrics.observe(
+                                        "scheduler_gang_placement_distance", stats["max_distance"]
+                                    )
+                                    detail = (
+                                        f"{g} max_dist={stats['max_distance']}"
+                                        f" mean_dist={stats['mean_distance']}"
+                                        f" cross_edges={stats['cross_edges']}"
+                                    )
                             if self.recorder.enabled:
                                 for nm in sorted(ms):
-                                    self.recorder.record(nm, "gang-admitted", self._cycle_tag, detail=g)
+                                    self.recorder.record(nm, "gang-admitted", self._cycle_tag, detail=detail)
                         elif ms & eligible_names:
                             self.metrics.inc("scheduler_gang_rejections_total")
                             if self.recorder.enabled:

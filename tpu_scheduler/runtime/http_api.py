@@ -119,6 +119,7 @@ class HttpApiServer:
                 full = f"{ns}/{name}"
                 timeline = outer.recorder.timeline(full)
                 why = None
+                locality = None
                 if outer.api is not None:
                     from ..api.objects import full_name, is_pod_bound
                     from ..core.predicates import dominant_reason, unschedulable_reason_counts
@@ -141,10 +142,49 @@ class HttpApiServer:
                             "message": f"{feasible}/{total} nodes are available"
                             + (f": {parts}" if parts else ""),
                         }
+                    if pod is not None and pod.spec is not None and pod.spec.gang:
+                        locality = self._gang_locality(pod, pods)
                 elif not timeline:
                     self._send_json(404, {"message": f"no recorded timeline for pod {full}"})
                     return
-                self._send_json(200, {"pod": full, "timeline": timeline, "why_pending": why})
+                self._send_json(200, {"pod": full, "timeline": timeline, "why_pending": why, "locality": locality})
+                return
+
+            def _gang_locality(self, pod, pods):
+                """The "why is this gang slow" block (topology/): the gang's
+                bound members, their per-level domains, and the pairwise
+                placement-distance stats — computed live from node labels so
+                it is fresh even for gangs admitted before this server
+                started.  None-valued fields when the cluster advertises no
+                topology."""
+                from ..topology.locality import gang_placement_stats
+                from ..topology.model import TopologyModel
+
+                gang = pod.spec.gang
+                members = [q for q in pods if q.spec is not None and q.spec.gang == gang]
+                placed = [
+                    (f"{q.metadata.namespace or 'default'}/{q.metadata.name}", q.spec.node_name)
+                    for q in members
+                    if q.spec.node_name
+                ]
+                out = {
+                    "gang": gang,
+                    "members": len(members),
+                    "members_bound": len(placed),
+                    "placement": dict(sorted(placed)),
+                    "stats": None,
+                }
+                nodes = outer.api.list_nodes()
+                model = TopologyModel.detect(nodes)
+                if model is None or len(placed) < 2:
+                    return out
+                compiled = model.compile(nodes)
+                doms = [d for d in (compiled.domains_of(n) for _pf, n in placed) if d is not None]
+                if len(doms) >= 2:
+                    stats = gang_placement_stats(doms, compiled.level_distances())
+                    stats["levels"] = [lv.name for lv in compiled.model.levels]
+                    out["stats"] = stats
+                return out
 
             def do_GET(self):
                 parsed = urlparse(self.path)

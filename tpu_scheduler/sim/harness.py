@@ -32,6 +32,8 @@ from ..models.profiles import DEFAULT_PROFILE
 from ..runtime.controller import Scheduler
 from ..runtime.fake_api import FakeApiServer
 from ..testing import make_node, make_pod
+from ..topology.locality import gang_placement_stats
+from ..topology.model import DEFAULT_LEVEL_KEYS
 from .chaos import ChaosApiServer
 from .clock import VirtualClock
 from .scenarios import SCENARIOS, Scenario
@@ -65,6 +67,12 @@ class _SimState:
         self.disturbed_pods: set[str] = set()
         self.disturbed_nodes: set[str] = set()
         self.scheduled_names: set[str] = set()
+        # Topology bookkeeping: every node's domains (kept after delete —
+        # a failed rack's placements still need scoring) and each pod's
+        # FIRST bound node (bind-time locality; churn re-binds are the
+        # disturbed set's business, not a locality verdict's).
+        self.node_domains: dict[str, dict] = {}
+        self.first_bind: dict[str, str] = {}
         self.counts = {"arrived": 0, "churn_recreated": 0, "completed": 0, "evicted": 0}
         self.ttb: list[float] = []
         self.double_bound = 0
@@ -79,12 +87,22 @@ def _resolve_scenario(scenario: Scenario | str) -> Scenario:
         raise ValueError(f"unknown scenario {scenario!r} (known: {', '.join(sorted(SCENARIOS))})") from None
 
 
+_LEVEL_LABEL = dict(DEFAULT_LEVEL_KEYS)  # level name -> node label key
+
+
 def _node_obj(payload: dict, unschedulable: bool = False):
+    labels = {"zone": payload["zone"], "name": payload["name"]}
+    for level, key in DEFAULT_LEVEL_KEYS:
+        if payload.get(level):
+            # Topology-labeled fleets (WorkloadSpec slice_size/rack_size)
+            # advertise their domains the kube-native way, which
+            # topology-enables the scheduler under test (controller "auto").
+            labels[key] = payload[level]
     return make_node(
         payload["name"],
         cpu=payload["cpu"],
         memory=f"{payload['mem_gi']}Gi",
-        labels={"zone": payload["zone"], "name": payload["name"]},
+        labels=labels,
         unschedulable=unschedulable,
     )
 
@@ -101,6 +119,62 @@ def _pod_obj(payload: dict):
     )
 
 
+def _locality_block(sc: Scenario, st: "_SimState") -> dict:
+    """The scorecard ``locality`` verdict: per-gang placement-distance
+    statistics over FIRST-bind placements (bind-time locality — churn
+    re-binds belong to the disturbed set, which is skipped here exactly like
+    I2/I3 skip it: counted, never silent).  ``cross_rack_gangs`` is the
+    number the pass gate holds at zero for ``locality_required`` scenarios —
+    a locality regression fails a run the same way an SLO regression does."""
+    levels = [level for level, _k in DEFAULT_LEVEL_KEYS if any(level in d for d in st.node_domains.values())]
+    out = {
+        "enabled": bool(levels),
+        "required": bool(sc.locality_required),
+        "levels": levels,
+        "gangs_scored": 0,
+        "gangs_skipped_churned": 0,
+        "gangs_unscored": 0,
+        "max_distance": 0.0,
+        "mean_distance": 0.0,
+        "cross_rack_edges": 0,
+        "cross_rack_gangs": 0,
+        "single_domain_gangs": 0,
+    }
+    if not levels:
+        return out
+    level_dists = [1.0] * len(levels)
+    means: list[float] = []
+    for g, members in sorted(st.gangs.items()):
+        if members & st.disturbed_pods:
+            out["gangs_skipped_churned"] += 1
+            continue
+        doms = []
+        for m in sorted(members):
+            node = st.first_bind.get(m)
+            nd = st.node_domains.get(node) if node is not None else None
+            if nd is None:
+                doms = None
+                break
+            doms.append(tuple(nd.get(level, f"~{node}") for level in levels))
+        if doms is None or len(doms) < 2:
+            # Never admitted (or a 1-member tail) — nothing to score; the
+            # SLO/backlog numbers already account for unplaced demand.
+            out["gangs_unscored"] += 1
+            continue
+        stats = gang_placement_stats(doms, level_dists)
+        out["gangs_scored"] += 1
+        out["max_distance"] = max(out["max_distance"], stats["max_distance"])
+        means.append(stats["mean_distance"])
+        out["cross_rack_edges"] += stats["cross_edges"]
+        if stats["cross_edges"]:
+            out["cross_rack_gangs"] += 1
+        elif stats["max_distance"] == 0.0:
+            out["single_domain_gangs"] += 1
+    if means:
+        out["mean_distance"] = round(sum(means) / len(means), 6)
+    return out
+
+
 def run_scenario(
     scenario: Scenario | str,
     seed: int = 0,
@@ -108,12 +182,16 @@ def run_scenario(
     record: str | None = None,
     replay: str | None = None,
     events_buffer: int = 4096,
+    topology="auto",
 ) -> dict:
     """Run one scenario to its verdict; returns the scorecard dict.
 
     ``record`` persists the run as a JSONL trace; ``replay`` re-runs a trace
     (its header names the scenario) and raises ``ReplayMismatchError`` if
-    the replayed fingerprint differs from the recorded one."""
+    the replayed fingerprint differs from the recorded one.  ``topology``
+    passes through to the Scheduler: "auto" (default) detects the workload's
+    slice/rack node labels, None runs the topology-BLIND baseline the
+    locality scorecard block quantifies against."""
     replay_data = load_trace(replay) if replay else None
     if replay_data is not None:
         sc = _resolve_scenario(replay_data["header"]["scenario"])
@@ -140,6 +218,7 @@ def run_scenario(
         clock=clock,
         rng=random.Random(f"{seed}:sched"),
         events_buffer=events_buffer,
+        topology=topology,
     )
 
     writer = TraceWriter(record) if record else None
@@ -191,6 +270,9 @@ def run_scenario(
             payload = op["node"]
             inner.create_node(_node_obj(payload))
             st.nodes[payload["name"]] = payload
+            doms = {level: payload[level] for level, _k in DEFAULT_LEVEL_KEYS if payload.get(level)}
+            if doms:
+                st.node_domains[payload["name"]] = doms
         elif kind == "delete_node":
             inner.delete_node(op["name"])
             st.nodes.pop(op["name"], None)
@@ -241,6 +323,23 @@ def run_scenario(
             if ev.payload["name"] not in st.nodes:
                 apply_op({"op": "create_node", "node": dict(ev.payload)})
             return
+        if ev.kind == "rack-fail":
+            # Whole-rack outage: resolve "pick" against the sorted live rack
+            # list, then fail every node in it (each op recorded
+            # individually, so replay stays bit-identical).
+            rack_nodes: dict[str, list[str]] = {}
+            for name in sorted(st.nodes):
+                rack = st.nodes[name].get("rack")
+                if rack:
+                    rack_nodes.setdefault(rack, []).append(name)
+            racks = sorted(rack_nodes)
+            if not racks:
+                return
+            target = racks[int(ev.payload["pick"] * len(racks)) % len(racks)]
+            for name in rack_nodes[target]:
+                evict_node_pods(name, recreate=True)
+                apply_op({"op": "delete_node", "name": name})
+            return
         # Node-targeting events resolve "pick" against the sorted live fleet.
         names = sorted(st.nodes)
         if not names:
@@ -288,6 +387,7 @@ def run_scenario(
                 st.double_bound += 1
             st.bound_live.add(name)
             st.bind_epoch[name] = st.bind_epoch.get(name, 0) + 1
+            st.first_bind.setdefault(name, _node)
             if name in st.arrival_t:
                 st.ttb.append(round(t - st.arrival_t[name], 9))
             if replay_data is None and name in st.lifetime:
@@ -419,6 +519,7 @@ def run_scenario(
         invariants=invariants,
         chaos_injected=chaos.injected,
         resilience=resilience,
+        locality=_locality_block(sc, st),
         recorder_stats={
             "tracked_pods": len(sched.recorder.tracked_pods()),
             "evicted_timelines": sched.recorder.evicted_timelines,

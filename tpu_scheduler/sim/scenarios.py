@@ -32,6 +32,10 @@ class Scenario:
     watch_history: int = 1 << 18  # FakeApiServer retained watch events
     preemption: bool = False
     drain_grace_cycles: int = 12  # no-progress cycles after duration before stopping
+    # Gate the scorecard pass on ZERO cross-rack gangs (the locality block):
+    # for topology-labeled workloads where a single-rack fit always exists,
+    # any cross-rack admission is a placement-quality regression.
+    locality_required: bool = False
 
 
 SCENARIOS: dict[str, Scenario] = {}
@@ -145,6 +149,45 @@ _register(
         preemption=True,
         # Oversubscribed by design: the backlog drains only as lifetimes
         # expire, so give the post-duration drain a longer leash.
+        drain_grace_cycles=25,
+    )
+)
+
+_register(
+    Scenario(
+        name="slice-fragmented-cluster",
+        description="Topology-labeled fleet (8 racks x 2 slices) under mixed single-pod + gang load: fillers fragment free capacity while single-rack fits still exist everywhere — topology-aware scoring must admit EVERY gang with zero cross-rack edges (pass-gated), where blind scoring scatters them",
+        duration=40.0,
+        workload=WorkloadSpec(
+            initial_nodes=48,
+            slice_size=3,
+            rack_size=6,
+            arrival_rate=5.0,
+            bursts=((2.0, 40),),  # the fragmenting filler/gang wave
+            gang_fraction=0.45,
+            gang_size_max=4,
+            lifetime_mean_s=35.0,
+        ),
+        locality_required=True,
+        drain_grace_cycles=20,
+    )
+)
+
+_register(
+    Scenario(
+        name="rack-failure-during-gang-admission",
+        description="A whole rack dies mid-run while gangs are being admitted: every node in the picked rack vanishes, its pods re-arrive Pending, and admission must continue whole-gang on the surviving racks (invariants + replay bit-identity under rack-scale churn)",
+        duration=40.0,
+        workload=WorkloadSpec(
+            initial_nodes=30,
+            slice_size=0,
+            rack_size=5,
+            arrival_rate=6.0,
+            gang_fraction=0.4,
+            gang_size_max=5,
+            lifetime_mean_s=25.0,
+            rack_fail_times=(12.0,),
+        ),
         drain_grace_cycles=25,
     )
 )

@@ -41,6 +41,7 @@ SCORECARD_FIELDS = (
     "invariants",
     "chaos_injected",
     "resilience",
+    "locality",
     "flight_recorder",
     "fingerprint",
 )
@@ -163,6 +164,7 @@ def build_scorecard(
     invariants: dict,
     chaos_injected: dict,
     resilience: dict,
+    locality: dict,
     recorder_stats: dict,
     fp: str,
 ) -> dict:
@@ -187,12 +189,15 @@ def build_scorecard(
         "mode": mode,
         # The degraded-mode invariant rides the verdict: a binding POST
         # through an OPEN circuit breaker is a resilience-layer bug even
-        # when every placement invariant holds.
+        # when every placement invariant holds.  Locality-required scenarios
+        # additionally gate on ZERO cross-rack gangs — a communication-
+        # locality regression fails the run like an SLO regression does.
         "pass": bool(
             invariants.get("ok")
             and pod_counts.get("lost", 1) == 0
             and pod_counts.get("double_bound", 1) == 0
             and resilience.get("binds_while_open", 0) == 0
+            and not (locality.get("required") and locality.get("cross_rack_gangs", 0) != 0)
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -201,6 +206,7 @@ def build_scorecard(
         "invariants": invariants,
         "chaos_injected": dict(sorted(chaos_injected.items())),
         "resilience": resilience,
+        "locality": locality,
         "flight_recorder": recorder_stats,
         "fingerprint": fp,
     }

@@ -55,6 +55,17 @@ class WorkloadSpec:
     node_fail_rate: float = 0.0
     node_flap_rate: float = 0.0
     flap_down_s: float = 4.0  # how long a flapping node stays gone
+    # Interconnect topology (topology/): consecutive node indices group into
+    # slices of ``slice_size`` and racks of ``rack_size`` nodes (0 = level
+    # absent).  The harness turns these into the default topology node
+    # labels, which topology-enables the scheduler under test.
+    slice_size: int = 0
+    rack_size: int = 0
+    # Whole-rack outages: at each listed virtual time, one rack (picked by a
+    # seeded draw against the live rack list) fails outright — every node in
+    # it vanishes and its pods re-arrive Pending (the rack-power-loss /
+    # spine-failure event gangs must survive).
+    rack_fail_times: tuple[float, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -148,7 +159,7 @@ def generate_events(spec: WorkloadSpec, duration: float, rng: random.Random) -> 
             if ct >= duration:
                 break
             if kind == "node-add":
-                payload = _node_payload(node_seq, churn_rng)
+                payload = _node_payload(node_seq, churn_rng, spec)
                 node_seq += 1
             elif kind == "node-flap":
                 payload = {"pick": churn_rng.random(), "down_s": spec.flap_down_s}
@@ -157,13 +168,32 @@ def generate_events(spec: WorkloadSpec, duration: float, rng: random.Random) -> 
             streams.append((ct, stream, i, SimEvent(round(ct, 6), kind, payload)))
             i += 1
 
+    # Whole-rack outages (stream 6) — fixed times from the spec, the rack
+    # picked by a seeded draw resolved against the live rack list at apply
+    # time (same ``pick`` convention as the node-targeting events).
+    rack_rng = random.Random(rng.randrange(1 << 62))
+    for i, rt in enumerate(spec.rack_fail_times):
+        streams.append(
+            (float(rt), 6, i, SimEvent(round(float(rt), 6), "rack-fail", {"pick": rack_rng.random()}))
+        )
+
     streams.sort(key=lambda e: (e[0], e[1], e[2]))
     return [ev for _, _, _, ev in streams]
 
 
-def _node_payload(i: int, rng: random.Random) -> dict:
+def _topology_fields(i: int, spec: WorkloadSpec) -> dict:
+    """Per-node slice/rack assignment from the consecutive-index grouping."""
+    out: dict = {}
+    if spec.slice_size > 0:
+        out["slice"] = f"slice-{i // spec.slice_size}"
+    if spec.rack_size > 0:
+        out["rack"] = f"rack-{i // spec.rack_size}"
+    return out
+
+
+def _node_payload(i: int, rng: random.Random, spec: WorkloadSpec) -> dict:
     cores, gib = NODE_SHAPES[rng.randrange(len(NODE_SHAPES))]
-    return {"name": f"sim-n{i}", "cpu": cores, "mem_gi": gib, "zone": ZONES[i % len(ZONES)]}
+    return {"name": f"sim-n{i}", "cpu": cores, "mem_gi": gib, "zone": ZONES[i % len(ZONES)], **_topology_fields(i, spec)}
 
 
 def initial_nodes(spec: WorkloadSpec) -> list[dict]:
@@ -172,5 +202,7 @@ def initial_nodes(spec: WorkloadSpec) -> list[dict]:
     out = []
     for i in range(spec.initial_nodes):
         cores, gib = NODE_SHAPES[i % len(NODE_SHAPES)]
-        out.append({"name": f"sim-n{i}", "cpu": cores, "mem_gi": gib, "zone": ZONES[i % len(ZONES)]})
+        out.append(
+            {"name": f"sim-n{i}", "cpu": cores, "mem_gi": gib, "zone": ZONES[i % len(ZONES)], **_topology_fields(i, spec)}
+        )
     return out

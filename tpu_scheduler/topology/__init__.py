@@ -1,0 +1,38 @@
+"""Topology-aware gang placement — interconnect distance model + locality
+scoring (ROADMAP "Topology- and gang-aware placement").
+
+``model.py`` declares the TPU slice / rack interconnect hierarchy (from node
+labels or a ``--topology-file`` spec) and compiles it per node set;
+``locality.py`` packs the per-cycle tensors and provides the fused
+rank-aware co-placement score term both batched backends share.
+"""
+
+from .locality import (
+    SCORING_KNOBS,
+    TopologySet,
+    gang_placement_stats,
+    gang_state_update,
+    gang_topology_term,
+    pack_topology,
+)
+from .model import (
+    DEFAULT_LEVEL_KEYS,
+    CompiledTopology,
+    TopologyLevel,
+    TopologyModel,
+    load_topology_file,
+)
+
+__all__ = [
+    "CompiledTopology",
+    "DEFAULT_LEVEL_KEYS",
+    "SCORING_KNOBS",
+    "TopologyLevel",
+    "TopologyModel",
+    "TopologySet",
+    "gang_placement_stats",
+    "gang_state_update",
+    "gang_topology_term",
+    "load_topology_file",
+    "pack_topology",
+]

@@ -34,6 +34,10 @@ ROUNDS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 # Requeue backoff delays (seconds): sub-second fast-class retries through
 # the reference's 5-minute flat delay and the long no-node escalation cap.
 BACKOFF_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 150.0, 300.0, 600.0, 1200.0)
+# Worst pairwise interconnect distance of an admitted gang's placement
+# (topology/ levels differing, weighted): 0 = one slice, through a few
+# hierarchy levels — fractional bounds cover non-unit level weights.
+DISTANCE_BUCKETS = (0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 8.0)
 
 # Histogram name -> bucket bounds; the one registration point the README
 # drift gate (scripts/lint.py) and to_prometheus share.
@@ -43,6 +47,7 @@ HISTOGRAM_BUCKETS = {
     "scheduler_binding_seconds": LATENCY_BUCKETS,
     "scheduler_cycle_rounds": ROUNDS_BUCKETS,
     "scheduler_backoff_seconds": BACKOFF_BUCKETS,
+    "scheduler_gang_placement_distance": DISTANCE_BUCKETS,
 }
 
 
