@@ -24,10 +24,13 @@ test:
 	$(PY) -m pytest tests/ -x -q
 
 # The tier-1 simulation gate: one seeded scenario (~2k pods × 200 nodes,
-# node churn + an api-brownout window) must finish green on CPU — the same
-# contract tests/test_sim.py pins, runnable standalone for a quick verdict.
+# node churn + an api-brownout window) must finish green on CPU, plus the
+# multi-replica failover scenario (two sharded replicas, owner crash-killed
+# between solve and flush) — the same contracts tests/test_sim.py and
+# tests/test_multi_replica_sim.py pin, runnable standalone for a verdict.
 sim-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tpu_scheduler.cli sim --scenario sim-smoke --seed 0
+	JAX_PLATFORMS=cpu $(PY) -m tpu_scheduler.cli sim --scenario replica-kill-mid-cycle --seed 0
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

@@ -780,6 +780,96 @@ def topology_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
         return {}
 
 
+def multi_replica_row(seed: int, pods: int = 8192, nodes: int = 512) -> dict:
+    """Active-active sharded control plane at a real shape (ROADMAP "sharded
+    / multi-replica control plane"): K ∈ {1, 2, 4} controller replicas split
+    4 lease-owned shards over one FakeApiServer on a VirtualClock, settle the
+    same 8192×512 pending wave (wall seconds + pods/s — the sharding-overhead
+    story: replicas run sequentially in-process, so this measures per-replica
+    pack/solve duplication, not parallel speedup), then replica 0 is
+    crash-killed (leases never released) and the VIRTUAL takeover latency —
+    clock time until the survivors own its shards — is measured against the
+    2× lease-duration bound the sim scorecard pins.  The K=1 settle wall
+    (min of repeats) rides the same-platform cross-round regression gate."""
+    try:
+        from tpu_scheduler.backends.native import NativeBackend
+        from tpu_scheduler.runtime.controller import Scheduler
+        from tpu_scheduler.runtime.fake_api import FakeApiServer
+        from tpu_scheduler.sim.clock import VirtualClock
+        from tpu_scheduler.testing import synth_cluster
+
+        SHARDS, LEASE = 4, 5.0
+        per_k: dict[str, dict] = {}
+        k1_walls: list[float] = []
+        for k in (1, 2, 4):
+            for _rep in range(2 if k == 1 else 1):
+                clock = VirtualClock()
+                api = FakeApiServer(clock=clock)
+                snap = synth_cluster(n_nodes=nodes, n_pending=pods, seed=seed)
+                api.load(snap.nodes, snap.pods)
+                scheds = [
+                    Scheduler(
+                        api,
+                        NativeBackend(),
+                        clock=clock,
+                        shards=SHARDS if k > 1 else 1,
+                        identity=f"bench-r{i}",
+                        lease_duration=LEASE,
+                    )
+                    for i in range(k)
+                ]
+                t0 = time.perf_counter()
+                cycles = 0
+                while api.list_pods("status.phase=Pending") and cycles < 64:
+                    for s in scheds:
+                        s.run_cycle()
+                    clock.advance(1.0)
+                    cycles += 1
+                wall = time.perf_counter() - t0
+                bound = api.binding_count
+                takeover_s = None
+                if k > 1:
+                    orphans = set(scheds[0].shard_set.owned)
+                    t_kill = clock.now
+                    survivors = scheds[1:]
+                    while clock.now - t_kill <= 4 * LEASE:
+                        clock.advance(1.0)
+                        for s in survivors:
+                            s.run_cycle()
+                        owned = set()
+                        for s in survivors:
+                            owned |= set(s.shard_set.owned)
+                        if orphans <= owned:
+                            takeover_s = round(clock.now - t_kill, 3)
+                            break
+                for s in scheds:
+                    s.close()
+                if k == 1:
+                    k1_walls.append(wall)
+                per_k[str(k)] = {
+                    "replicas": k,
+                    "shards": SHARDS if k > 1 else 1,
+                    "settle_wall_seconds": round(wall, 3),
+                    "pods_per_second": round(bound / wall, 1) if wall > 0 else 0.0,
+                    "bound": bound,
+                    "cycles": cycles,
+                    "takeover_virtual_s": takeover_s,
+                    "takeover_bound_s": 2 * LEASE,
+                }
+                log(
+                    f"multi-replica K={k}: settle {wall:.2f}s ({bound} bound, {cycles} cycles)"
+                    + (f", takeover {takeover_s}s virtual" if takeover_s is not None else "")
+                )
+        return {
+            "multi_replica": per_k,
+            "multi_replica_shape": f"{pods}x{nodes}",
+            "multi_replica_wall_seconds_min": round(min(k1_walls), 3),
+        }
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"multi-replica row skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
 def previous_round_value(repo_dir: str, metric: str, platform: str, field: str | None = None) -> tuple[float, str] | None:
     """(value, source-file) of the newest BENCH_r*.json carrying the same
     metric on the SAME platform — the cross-round regression baseline
@@ -848,7 +938,10 @@ def apply_secondary_regression_checks(out: dict, platform: str, repo_dir: str, t
     headline gate: a shape change (downscaled fallback) makes rounds
     incomparable, so the gate also requires matching ``topology_shape``."""
     fired = False
-    for field, shape_field in (("topology_cycle_seconds_min", "topology_shape"),):
+    for field, shape_field in (
+        ("topology_cycle_seconds_min", "topology_shape"),
+        ("multi_replica_wall_seconds_min", "multi_replica_shape"),
+    ):
         val = out.get(field)
         if val is None:
             continue
@@ -898,6 +991,7 @@ def main() -> int:
     ap.add_argument("--no-sim-row", action="store_true")
     ap.add_argument("--no-topology-row", action="store_true")
     ap.add_argument("--no-sim-sweep", action="store_true")
+    ap.add_argument("--no-multi-replica-row", action="store_true")
     ap.add_argument(
         "--sim-sweep-seeds",
         type=int,
@@ -1014,6 +1108,10 @@ def main() -> int:
     # worst-case SLO aggregates a robustness regression shows up in.
     if not args.no_sim_sweep and _remaining() > 300:
         out.update(sim_sweep_row(seeds=tuple(range(args.sim_sweep_seeds))))
+    # Active-active sharded control plane: K-replica settle throughput +
+    # crash-kill takeover latency in virtual time, gated cross-round below.
+    if not args.no_multi_replica_row and _remaining() > 90:
+        out.update(multi_replica_row(args.seed))
     if not args.no_sharded_row and _remaining() > 120:
         row = sharded_scaling_row(8192, 512, args.seed)
         if row:

@@ -18,6 +18,11 @@ TOPO  — every interconnect distance level (name + label key,
         scenario (a registry entry whose WorkloadSpec sets
         slice_size/rack_size/rack_fail_times) must appear in the README
         "Topology & gang placement" catalogue.
+REPL  — every shard/replica lease-name prefix (``runtime/shards.py``
+        ``*_LEASE_PREFIX`` constants), availability-scorecard field
+        (``sim/multi.AVAILABILITY_FIELDS``), and multi-replica sim scenario
+        (a registry entry passing ``replicas=``) must appear in the README
+        "Multi-replica & failover" catalogue.
 """
 
 from __future__ import annotations
@@ -33,6 +38,7 @@ CODES = {
     "ANLZ": "an analysis rule code missing from the README static-analysis catalogue",
     "RESC": "a resilience backoff class/breaker state/config knob missing from the README Resilience catalogue",
     "TOPO": "a topology distance level/label key/scoring knob/scenario missing from the README \"Topology & gang placement\" catalogue",
+    "REPL": "a shard lease prefix/availability field/multi-replica scenario missing from the README \"Multi-replica & failover\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -216,5 +222,51 @@ def _run_topo(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_repl(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/runtime/shards.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Name)
+                            and t.id.endswith("_LEASE_PREFIX")
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)
+                        ):
+                            tokens.append(("lease prefix", node.value.value))
+        elif f.rel == "tpu_scheduler/sim/multi.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "AVAILABILITY_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("availability field",)))
+        elif f.rel == "tpu_scheduler/sim/scenarios.py":
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "Scenario"):
+                    continue
+                name = None
+                multi = False
+                for kw in node.keywords:
+                    if kw.arg == "name" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                        name = kw.value.value
+                    elif kw.arg == "replicas":
+                        multi = True
+                if name and multi:
+                    tokens.append(("multi-replica scenario", name))
+    return [
+        Finding(
+            "REPL",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the sharded control plane but is missing from the README "
+            f"\"Multi-replica & failover\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
-    return _run_metr(ctx) + _run_simc(ctx) + _run_anlz(ctx) + _run_resc(ctx) + _run_topo(ctx)
+    return _run_metr(ctx) + _run_simc(ctx) + _run_anlz(ctx) + _run_resc(ctx) + _run_topo(ctx) + _run_repl(ctx)

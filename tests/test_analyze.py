@@ -223,6 +223,38 @@ def test_topo_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_repl_drift_and_guard():
+    shards_mod = (
+        "tpu_scheduler/runtime/shards.py",
+        'SHARD_LEASE_PREFIX = "ghost-shard-"\nREPLICA_LEASE_PREFIX = "ghost-presence-"\nOTHER = "not-a-prefix"\n',
+    )
+    multi_mod = ("tpu_scheduler/sim/multi.py", 'AVAILABILITY_FIELDS = ("ghost_takeover_field",)\n')
+    sc_mod = (
+        "tpu_scheduler/sim/scenarios.py",
+        '_register(Scenario(name="ghost-replica-scenario", replicas=2))\n'
+        '_register(Scenario(name="plain-scenario", workload=WorkloadSpec(arrival_rate=1.0)))\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(shards_mod, multi_mod, sc_mod, readme="")), "REPL")
+    assert {h.message.split("'")[1] for h in hits} == {
+        "ghost-shard-",
+        "ghost-presence-",
+        "ghost_takeover_field",
+        "ghost-replica-scenario",  # plain-scenario is SIMC's business, not REPL's
+    }
+    ok = "ghost-shard- ghost-presence- ghost_takeover_field ghost-replica-scenario"
+    assert not rule_hits(catalogues.run(make_ctx(shards_mod, multi_mod, sc_mod, readme=ok)), "REPL")
+
+
+def test_repl_real_tree_is_catalogued():
+    files = load_files(
+        ["tpu_scheduler/runtime/shards.py", "tpu_scheduler/sim/multi.py", "tpu_scheduler/sim/scenarios.py"]
+    )
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "REPL")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")

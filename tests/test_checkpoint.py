@@ -120,6 +120,51 @@ def test_stale_checkpoint_falls_back_to_full_pack(tmp_path):
     assert sched2.metrics.counters["scheduler_full_packs_total"] == 2
 
 
+def test_v2_checkpoint_migrates_into_sharded_controller(tmp_path):
+    """v2 -> v3 migration: a flat-layout v2 checkpoint restores cleanly into
+    a SHARDED controller — attempt counters preserved, deadlines re-based on
+    the new clock, and a subsequent save writes the v3 layout with every pod
+    grouped under its stable-hash shard."""
+    import json
+    import os
+
+    from tpu_scheduler.runtime.shards import shard_for_name
+
+    v2_state = {
+        "version": 2,
+        "cycle_count": 7,
+        "counters": {"scheduler_bindings_total": 3},
+        "requeue_remaining": {"default/a": 12.0, "default/b": 0.5, "default/g1-0": 3.0},
+        "requeue_meta": {"default/a": ["no-node", 4], "default/b": ["api-error", 2], "default/g1-0": ["gang", 1]},
+        "noexecute_elapsed": [],
+        "pdb_peaks": {},
+        "pdb_disruptions": {},
+        "node_sig": None,
+    }
+    os.makedirs(str(tmp_path), exist_ok=True)
+    with open(os.path.join(str(tmp_path), "state.json"), "w") as f:
+        json.dump(v2_state, f)
+
+    api = FakeApiServer()
+    api.load(nodes=[make_node("n1", cpu="8", memory="32Gi")], pods=[])
+    clock = FakeClock(50.0)
+    sched = Scheduler(api, NativeBackend(), clock=clock, shards=4, identity="r1", lease_duration=6.0)
+    assert restore_scheduler(sched, str(tmp_path)) is True
+    # Attempt counters preserved; deadlines re-based on the new clock.
+    assert sched.requeue_at.attempts("default/a") == 4
+    assert sched.requeue_at.meta()["default/b"] == ("api-error", 2)
+    assert sched.requeue_at["default/a"] == pytest.approx(62.0)
+    assert sched._cycle_count == 7
+    # The sharded controller schedules by live stable-hash assignment; a
+    # save from here writes the v3 layout with each pod in its hash shard.
+    save_scheduler(sched, str(tmp_path))
+    with open(os.path.join(str(tmp_path), "state.json")) as f:
+        v3 = json.load(f)
+    assert v3["version"] == 3 and v3["shard_count"] == 4
+    for pf in ("default/a", "default/b", "default/g1-0"):
+        assert pf in v3["shards"][str(shard_for_name(pf, 4))]["requeue"]
+
+
 def test_version_mismatch_raises(tmp_path):
     sched = build()
     save_scheduler(sched, str(tmp_path))

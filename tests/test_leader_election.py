@@ -106,6 +106,65 @@ def test_lease_failure_fails_safe():
     assert not s1.is_leader and m.bound == 0
 
 
+def test_close_joins_renewal_thread_before_release():
+    """Shutdown race regression: a background renewal already past its
+    stop-check must never re-acquire the lease AFTER close() released it —
+    the zombie holder would block every standby until the TTL lapsed.
+    close() now joins the renewal thread before releasing; the
+    FakeApiServer lease-write history proves the release is the final
+    write.  The gate below holds an in-flight renewal open across the
+    shutdown window, which the OLD close() (stop-without-join) lost to."""
+    import threading
+    import time
+
+    api = FakeApiServer()
+    _cluster(api, pods=2)
+    # Real wall clock + a short TTL so the renewal thread fires quickly.
+    sched = Scheduler(api, NativeBackend(), leader_elect=True, identity="s1", clock=time.monotonic, lease_duration=0.3)
+    sched.run_cycle()
+    assert sched.is_leader and sched._renew_thread is not None
+
+    main_thread = threading.current_thread()
+    in_renew = threading.Event()
+    release_ran = threading.Event()
+    orig_acquire = api.acquire_lease
+    orig_release = api.release_lease
+
+    def gated_acquire(name, holder, duration):
+        if threading.current_thread() is not main_thread:
+            in_renew.set()
+            # Hold the renewal mid-flight: with the old stop-without-join
+            # close(), the release overtakes this acquire and the renewal
+            # lands AFTER it (the zombie-holder bug).  With the join fix,
+            # close() waits here, the renewal completes FIRST, and the
+            # release stays the final lease write.
+            release_ran.wait(timeout=1.0)
+        return orig_acquire(name, holder, duration)
+
+    def tracked_release(name, holder):
+        release_ran.set()
+        return orig_release(name, holder)
+
+    api.acquire_lease = gated_acquire
+    api.release_lease = tracked_release
+    try:
+        assert in_renew.wait(timeout=5.0), "renewal thread never fired"
+        sched.close()
+    finally:
+        api.acquire_lease = orig_acquire
+        api.release_lease = orig_release
+    assert sched._renew_thread is None
+    history = [holder for name, holder in api.lease_history if name == sched.lease_name]
+    assert "" in history, "close() must have released the lease"
+    assert history[-1] == "", f"a renewal landed after the release: {history}"
+    # And the lease is immediately takeable — no TTL wait for a standby.
+    s2 = Scheduler(api, NativeBackend(), leader_elect=True, identity="s2", clock=time.monotonic)
+    api.create_pod(make_pod("late-1"))
+    m = s2.run_cycle()
+    assert s2.is_leader and m.bound == 1
+    s2.close()
+
+
 def test_leader_election_over_http():
     from tpu_scheduler.runtime.http_api import HttpApiServer, KubeApiClient, RemoteApiAdapter
 

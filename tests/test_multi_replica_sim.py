@@ -1,0 +1,87 @@
+"""Multi-replica chaos verification (sim/multi.py + the replica-kill
+scenarios): the scorecard availability gate — double-binds = 0,
+orphaned-pods = 0, takeover within 2 x lease_duration — across seeds, with
+record->replay bit-identity and native-vs-jax chaos-trace fingerprint
+parity (the acceptance criteria of the sharded-control-plane issue)."""
+
+import json
+
+import pytest
+
+from tpu_scheduler.sim import run_scenario
+from tpu_scheduler.sim.multi import AVAILABILITY_FIELDS
+from tpu_scheduler.sim.scenarios import SCENARIOS, Scenario
+from tpu_scheduler.sim.workload import WorkloadSpec
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_replica_kill_mid_cycle_passes_and_replays(seed, tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    card = run_scenario("replica-kill-mid-cycle", seed=seed, record=path)
+    assert card["pass"], json.dumps(card["invariants"])
+    a = card["availability"]
+    assert tuple(a) == AVAILABILITY_FIELDS  # closed schema
+    assert a["enabled"] and a["ok"]
+    assert a["double_binds"] == 0 and card["pods"]["double_bound"] == 0
+    assert a["orphaned_pods"] == 0
+    # Exactly one kill, its orphaned shards absorbed within 2x the TTL.
+    assert len(a["kills"]) == 1 and a["kills"][0]["replica"] == 0
+    assert a["kills"][0]["orphan_shards"], "the killed replica must have owned shards"
+    assert a["max_takeover_latency_s"] is not None
+    assert a["max_takeover_latency_s"] <= a["takeover_bound_s"] == 2 * a["lease_duration_s"]
+    # The whole run is bit-identical under record->replay.
+    replayed = run_scenario(None, replay=path)
+    assert replayed["fingerprint"] == card["fingerprint"]
+    assert replayed["availability"] == a
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_replica_kill_during_brownout_passes_and_replays(seed, tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    card = run_scenario("replica-kill-during-brownout", seed=seed, record=path)
+    assert card["pass"], json.dumps(card["invariants"])
+    a = card["availability"]
+    assert a["ok"] and a["double_binds"] == 0 and a["orphaned_pods"] == 0
+    assert a["max_takeover_latency_s"] is not None and a["max_takeover_latency_s"] <= a["takeover_bound_s"]
+    # The compose actually exercised the breaker: binds deferred during the
+    # blackout, ZERO POSTed through an open breaker (per-replica judged).
+    assert card["resilience"]["breaker_opened"] > 0
+    assert card["resilience"]["deferred_binds"] > 0
+    assert card["resilience"]["binds_while_open"] == 0
+    replayed = run_scenario(None, replay=path)
+    assert replayed["fingerprint"] == card["fingerprint"]
+
+
+def test_multi_replica_chaos_trace_backend_parity(tmp_path):
+    """Chaos-trace backend parity on the multi-replica scenario: one trace
+    recorded with the native engine replays on TpuBackend-on-CPU to the
+    SAME fingerprint — failover decisions are backend-invariant."""
+    from tpu_scheduler.backends.tpu import TpuBackend
+
+    path = str(tmp_path / "trace.jsonl")
+    native_card = run_scenario("replica-kill-mid-cycle", seed=0, record=path)
+    assert native_card["pass"]
+    jax_card = run_scenario(None, replay=path, backend=TpuBackend(use_pallas=False))
+    assert jax_card["fingerprint"] == native_card["fingerprint"]
+    assert jax_card["availability"]["ok"]
+
+
+def test_single_replica_scenarios_report_availability_disabled():
+    sc = Scenario(
+        name="mini-single",
+        description="availability block default on a 1-replica run",
+        duration=6.0,
+        workload=WorkloadSpec(initial_nodes=4, arrival_rate=3.0),
+    )
+    card = run_scenario(sc, seed=0)
+    a = card["availability"]
+    assert tuple(a) == AVAILABILITY_FIELDS
+    assert a["enabled"] is False and a["ok"] is True and a["kills"] == []
+    assert card["pass"]
+
+
+def test_registered_replica_scenarios_carry_multi_config():
+    for name in ("replica-kill-mid-cycle", "replica-kill-during-brownout"):
+        sc = SCENARIOS[name]
+        assert sc.replicas == 2 and sc.shards == 4
+        assert sc.replica_kills and sc.cycle_interval < sc.lease_duration

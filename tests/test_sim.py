@@ -243,6 +243,8 @@ def test_cli_sim_subcommand(capsys):
         "gang-heavy",
         "slice-fragmented-cluster",
         "rack-failure-during-gang-admission",
+        "replica-kill-mid-cycle",
+        "replica-kill-during-brownout",
     ],
 )
 @pytest.mark.parametrize("seed", [0, 1])

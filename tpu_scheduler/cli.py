@@ -50,8 +50,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-rounds", type=int, default=None, help="auction round cap override (profiles default: 32)")
     p.add_argument("--leader-elect", action="store_true", help="lease-based leader election: only the lease holder schedules; standbys keep caches warm and take over on leader loss")
     p.add_argument("--lease-name", default="tpu-scheduler", help="leader-election lease name")
-    p.add_argument("--lease-duration", type=float, default=15.0, help="leader-election lease TTL (seconds)")
+    p.add_argument("--lease-duration", type=float, default=15.0, help="lease TTL (seconds) — the leader lease, or each shard lease with --shards")
     p.add_argument("--identity", default=None, help="leader-election holder identity (default: derived from pid)")
+    p.add_argument(
+        "--shards",
+        type=int,
+        default=1,
+        help="active-active sharded control plane: partition the pending set into K stable-hash shards, each owned "
+        "via its own tpu-scheduler-shard-<i> lease — run several replicas with the same K and they split the shards; "
+        "supersedes --leader-elect (runtime/shards.py)",
+    )
+    p.add_argument(
+        "--replica-id",
+        default=None,
+        help="this replica's identity for shard-lease ownership (default: --identity, then pid-derived)",
+    )
     p.add_argument(
         "--preemption",
         action="store_true",
@@ -288,9 +301,10 @@ def main(argv: list[str] | None = None) -> int:
         fallback_backend=fallback,
         pipeline=args.pipeline,
         leader_elect=args.leader_elect,
-        identity=args.identity,
+        identity=args.replica_id or args.identity,
         lease_name=args.lease_name,
         lease_duration=args.lease_duration,
+        shards=args.shards,
         events_buffer=args.events_buffer,
         breaker_config=breaker_config,
         flush_capacity=args.flush_capacity,
@@ -320,6 +334,7 @@ def main(argv: list[str] | None = None) -> int:
             metrics=sched.metrics,
             recorder=sched.recorder,
             resilience=sched.resilience_snapshot,
+            shards=sched.shards_snapshot,
             port=args.http_port,
         ).start()
         print(json.dumps({"http": True, "url": http_server.base_url}), file=sys.stderr)

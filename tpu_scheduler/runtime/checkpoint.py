@@ -31,7 +31,16 @@ from ..ops.pack import PackedCluster
 
 __all__ = ["save_scheduler", "restore_scheduler", "CHECKPOINT_VERSION"]
 
-CHECKPOINT_VERSION = 2  # v2: soft-term (PreferNoSchedule / preferred-affinity) tensors + vocabs
+# v2: soft-term (PreferNoSchedule / preferred-affinity) tensors + vocabs
+# v3: sharded control plane (runtime/shards.py) — requeue state grouped by
+#     stable-hash shard and the deferred-flush buffer persisted (each entry
+#     tagged with its shard), so a replica restoring an orphaned shard's
+#     checkpoint rebuilds exactly the per-pod state it now owns, flushes
+#     each deferred bind at most once, and never resets a backoff
+#     escalation.  v1/v2 checkpoints still restore (flat requeue fields;
+#     deferred entries simply absent — those pods are still Pending on the
+#     API server and get re-placed).
+CHECKPOINT_VERSION = 3
 
 _STATE_FILE = "state.json"
 _TENSORS_FILE = "node_tensors.npz"
@@ -41,18 +50,39 @@ def save_scheduler(scheduler, path: str) -> None:
     """Write a checkpoint directory atomically (tmp + rename)."""
     os.makedirs(path, exist_ok=True)
     now = scheduler.clock()
+    from .shards import shard_for_name
+
+    num_shards = max(1, getattr(scheduler, "num_shards", 1))
+    meta = scheduler.requeue_at.meta()
+    # v3 layout: per-pod requeue state grouped by stable-hash shard (name
+    # hash; gang pods may SCHEDULE via their gang's shard, but the grouping
+    # here is storage layout, not eligibility — restore flattens and the
+    # controller's shard filter re-derives ownership live).  Remaining
+    # seconds ride inside each entry because the scheduler clock is
+    # monotonic, exactly as v2's flat field did.
+    shard_state: dict[str, dict] = {}
+    for k in scheduler.requeue_at:
+        s = str(shard_for_name(k, num_shards))
+        cls, n = meta.get(k, ("other", 0))
+        shard_state.setdefault(s, {"requeue": {}})["requeue"][k] = [
+            max(0.0, scheduler.requeue_at[k] - now),
+            cls,
+            int(n),
+        ]
     state = {
         "version": CHECKPOINT_VERSION,
         "cycle_count": scheduler._cycle_count,
         "counters": dict(scheduler.metrics.counters),
-        # monotonic deadlines -> remaining seconds (clamped at 0)
-        "requeue_remaining": {k: max(0.0, v - now) for k, v in scheduler.requeue_at.items()},
-        # Per-pod backoff escalation (failure class + attempt count): a
-        # restart must not reset a long no-node escalation back to the fast
-        # first-attempt delay.  Deferred binds are deliberately NOT
-        # persisted: they were never POSTed, so the pods are still Pending
-        # on the API server and a restarted scheduler simply re-places them.
-        "requeue_meta": {k: [cls, n] for k, (cls, n) in scheduler.requeue_at.meta().items()},
+        "shard_count": num_shards,
+        "shards": shard_state,
+        # The deferred-flush buffer, in flush (insertion) order, each entry
+        # tagged with its shard.  Persisting it means a restart inside a
+        # brownout keeps its decided placements and flushes each at most
+        # once on recovery — a flushed-then-crashed entry is already bound
+        # on the API server and drops as stale instead of re-POSTing.
+        "deferred_binds": [
+            [pf, node, shard_for_name(pf, num_shards)] for pf, node in scheduler.deferred_binds.items()
+        ],
         # NoExecute tolerationSeconds clocks as ELAPSED time per
         # (pod, taint-key, taint-value): restarts/leader hand-offs must not
         # grant affected pods a fresh grace window (round-3 advisor) — under
@@ -122,10 +152,12 @@ def restore_scheduler(scheduler, path: str) -> bool:
         return False
     with open(state_path) as f:
         state = json.load(f)
-    # v1 checkpoints (pre-soft-terms) restore fine: the soft vocab fields
-    # default to empty below, and the tensor-consistency gate skips the v1
-    # cache (one full repack) rather than failing the restart.
-    if state.get("version") not in (1, CHECKPOINT_VERSION):
+    # v1/v2 checkpoints (pre-soft-terms / pre-sharding) restore fine: v1's
+    # soft vocab fields default to empty below and the tensor-consistency
+    # gate skips its cache (one full repack); v2's flat requeue fields fold
+    # into the queue exactly as before — shard assignment is re-derived
+    # live by the controller's stable hash, never read from the file.
+    if state.get("version") not in (1, 2, CHECKPOINT_VERSION):
         raise ValueError(f"checkpoint version {state.get('version')} != {CHECKPOINT_VERSION}")
 
     scheduler._cycle_count = state.get("cycle_count", 0)
@@ -135,10 +167,24 @@ def restore_scheduler(scheduler, path: str) -> bool:
     # Fold into the BackoffQueue IN PLACE (never replace it with a plain
     # dict — the controller's failure-class escalation lives on it); old
     # checkpoints without requeue_meta restore with attempts reset to 0.
-    scheduler.requeue_at.restore(
-        {k: now + rem for k, rem in state.get("requeue_remaining", {}).items()},
-        {k: (cls, int(n)) for k, (cls, n) in state.get("requeue_meta", {}).items()},
-    )
+    if state.get("version", 0) >= 3:
+        deadlines: dict[str, float] = {}
+        meta: dict[str, tuple] = {}
+        for s in sorted(state.get("shards", {}), key=int):
+            for k, (rem, cls, n) in state["shards"][s].get("requeue", {}).items():
+                deadlines[k] = now + rem
+                meta[k] = (str(cls), int(n))
+        scheduler.requeue_at.restore(deadlines, meta)
+        # Deferred-flush entries re-enter the buffer in flush order; the
+        # controller's stale-drop (pod gone / already bound / node gone)
+        # guarantees at-most-once flushing across the restart.
+        for pf, node, _shard in state.get("deferred_binds", []):
+            scheduler.deferred_binds[pf] = node
+    else:
+        scheduler.requeue_at.restore(
+            {k: now + rem for k, rem in state.get("requeue_remaining", {}).items()},
+            {k: (cls, int(n)) for k, (cls, n) in state.get("requeue_meta", {}).items()},
+        )
     scheduler._noexecute_seen = {
         tuple(key): now - elapsed for key, elapsed in state.get("noexecute_elapsed", [])
     }

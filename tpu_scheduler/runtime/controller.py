@@ -173,6 +173,7 @@ class Scheduler:
         identity: str | None = None,
         lease_name: str = "tpu-scheduler",
         lease_duration: float = 15.0,
+        shards: int = 1,
         constraint_budgets: dict | None = None,
         events_buffer: int = 4096,
         breaker_config: BreakerConfig | None = None,
@@ -285,8 +286,33 @@ class Scheduler:
         self.identity = identity or f"sched-{os.getpid()}-{id(self):x}"
         self.lease_name = lease_name
         self.lease_duration = lease_duration
-        self.is_leader = not leader_elect
+        # Sharded control plane (runtime/shards.py): with shards > 1 the
+        # pending set partitions into K stable-hash shards, each owned via
+        # its own coordination Lease — any replica schedules any subset it
+        # holds.  Supersedes the single-leader election (both together would
+        # serialize the shards behind one lease again); renewal rides the
+        # cycle cadence, so cycle_interval must stay under lease_duration.
+        self.num_shards = int(shards)
+        self.sharded = self.num_shards > 1
+        if self.sharded:
+            from .shards import ShardSet
+
+            if leader_elect:
+                logger.warning("--shards supersedes --leader-elect; running sharded (per-shard leases)")
+                self.leader_elect = False
+            self.shard_set = ShardSet(api, self.num_shards, self.identity, lease_duration, clock)
+        else:
+            self.shard_set = None
+        self.is_leader = not self.leader_elect and not self.sharded
+        # Takeover hygiene: set when leadership (or a shard) was newly
+        # acquired; the next owned cycle revalidates the assumed-bind
+        # overlay against the reflector cache before it is applied.
+        self._revalidate_pending = False
+        # Test/sim hook invoked before every binding POST decision — the
+        # chaos harness's replica-kill-between-solve-and-flush lever.
+        self.pre_bind_hook = None
         self._renew_stop: threading.Event | None = None
+        self._renew_thread: threading.Thread | None = None
         # This cycle's successful (or dispatched) placements — the capacity
         # the preemption pass must see on top of the pre-cycle snapshot.
         self._cycle_placed: list[tuple[Pod, Node]] = []
@@ -492,6 +518,8 @@ class Scheduler:
         the half-open cycle's trial binds); defer into the flush buffer
         while it is open.  Zero POSTs ever happen through an open breaker —
         the degraded-mode invariant the sim scorecard pins."""
+        if self.pre_bind_hook is not None:
+            self.pre_bind_hook(namespace, name, node_name)
         mode = self.breaker.mode()
         if mode == "open" or (mode == "half-open" and self._probe_left <= 0):
             return self._defer_bind(f"{namespace}/{name}", node_name)
@@ -504,6 +532,10 @@ class Scheduler:
         the breaker.  ``flush`` marks a deferred bind being flushed: its
         optimistic pods-bound count was taken at defer time, so a flush
         failure corrects the series instead of re-counting."""
+        if flush and self.pre_bind_hook is not None:
+            # Deferred-flush POSTs reach here without passing _bind; the
+            # replica-kill hook must cover the flush window too.
+            self.pre_bind_hook(namespace, name, node_name)
         pod_full = f"{namespace}/{name}"
         try:
             self.api.create_binding(namespace, name, ObjectReference(name=node_name))
@@ -1214,6 +1246,36 @@ class Scheduler:
                 unexpected = err  # surface AFTER the whole batch is folded
         if unexpected is not None:
             raise unexpected  # programming error — surface, never absorb
+
+    def _revalidate_overlays(self, snapshot: ClusterSnapshot) -> int:
+        """Takeover hygiene (first owned cycle after gaining leadership or a
+        shard): assumed-bind overlay entries are re-validated against the
+        reflector cache.  Confirmed assumptions (pod bound to the assumed
+        node) retire silently — that is the normal prune.  STALE ones — pod
+        gone, pod bound elsewhere out-of-band, or the target node vanished
+        while we stood by — are dropped and counted in
+        ``scheduler_assumed_stale_total``: without this, a stale clone would
+        overlay as bound forever (capacity leak) or re-dispatch into a
+        double-bind race on the new owner's first cycle."""
+        if not self._assumed:
+            return 0
+        by_full = {full_name(p): p for p in snapshot.pods}
+        node_names = {n.name for n in snapshot.nodes}
+        stale = 0
+        for pf in list(self._assumed):
+            target = self._assumed[pf]
+            p = by_full.get(pf)
+            if p is not None and is_pod_bound(p) and p.spec is not None and p.spec.node_name == target:
+                del self._assumed[pf]  # confirmed, not stale
+                continue
+            if p is None or is_pod_bound(p) or target not in node_names:
+                del self._assumed[pf]
+                stale += 1
+        if stale:
+            self.metrics.inc("scheduler_assumed_stale_total", stale)
+            self._cycle_notes.append(f"takeover: dropped {stale} stale assumed bind(s)")
+            logger.info("takeover revalidation dropped %d stale assumed bind(s)", stale)
+        return stale
 
     def _prune_and_overlay_assumed(self, snapshot: ClusterSnapshot) -> ClusterSnapshot:
         """Drop assumptions the watch has confirmed (or whose pod vanished),
@@ -1932,6 +1994,31 @@ class Scheduler:
                         self.metrics.inc("scheduler_pods_bound_total", -1)
                 if pruned:
                     self.metrics.inc("scheduler_backoff_pruned_total", pruned)
+            # Control-plane ownership BEFORE any overlay is applied: a
+            # takeover (new leadership / a newly acquired shard) must get to
+            # revalidate stale assumed-bind state against the fresh
+            # reflector cache before this cycle overlays it as bound.
+            if self.sharded:
+                self._refresh_shards()
+            elif self.leader_elect:
+                was = self.is_leader
+                try:
+                    self.is_leader = self.api.acquire_lease(self.lease_name, self.identity, self.lease_duration)
+                except (ApiError, OSError, http.client.HTTPException) as e:
+                    # Can't reach the lease: fail SAFE — never schedule
+                    # without proof of leadership (a partitioned ex-leader
+                    # double-scheduling is the failure this exists to stop).
+                    logger.warning("lease acquire failed (%s); standing by", e)
+                    self.is_leader = False
+                if self.is_leader and not was:
+                    self.metrics.inc("scheduler_leadership_acquisitions_total")
+                    logger.info("acquired leadership lease %s as %s", self.lease_name, self.identity)
+                    self._revalidate_pending = True
+                if self.is_leader:
+                    self._ensure_renewal_thread()
+            if self._revalidate_pending and self.is_leader:
+                self._revalidate_overlays(snapshot)
+                self._revalidate_pending = False
             # Degraded-mode bookkeeping: promote the breaker if its open
             # window elapsed, arm this cycle's half-open probe budget, then
             # flush recovered deferred binds / overlay the still-held ones.
@@ -1946,21 +2033,6 @@ class Scheduler:
                 if self._bind_inflight is not None and self._bind_inflight[1].is_set():
                     self._join_binds()
                 snapshot = self._prune_and_overlay_assumed(snapshot)
-            if self.leader_elect:
-                was = self.is_leader
-                try:
-                    self.is_leader = self.api.acquire_lease(self.lease_name, self.identity, self.lease_duration)
-                except (ApiError, OSError, http.client.HTTPException) as e:
-                    # Can't reach the lease: fail SAFE — never schedule
-                    # without proof of leadership (a partitioned ex-leader
-                    # double-scheduling is the failure this exists to stop).
-                    logger.warning("lease acquire failed (%s); standing by", e)
-                    self.is_leader = False
-                if self.is_leader and not was:
-                    self.metrics.inc("scheduler_leadership_acquisitions_total")
-                    logger.info("acquired leadership lease %s as %s", self.lease_name, self.identity)
-                if self.is_leader:
-                    self._ensure_renewal_thread()
             if self.profile.preemption:
                 # Observe PDB peak healthy EVERY cycle — standby cycles
                 # included (a successor must not baseline a crashed workload
@@ -1968,11 +2040,12 @@ class Scheduler:
                 # nothing else consumes the proxy, and on the HTTP boundary
                 # each observation is a real list_pdbs round-trip.
                 self._update_pdb_peaks(snapshot)
-            if self.leader_elect and not self.is_leader:
-                # Standby: the reflector cache above stays warm (fast
-                # takeover); scheduling is the leader's alone.  Local state
-                # (requeue backoffs) is NOT pruned on standby cycles — a
-                # transient lease failure must not wipe the backoff ledger.
+            if (self.leader_elect or self.sharded) and not self.is_leader:
+                # Standby (no lease / zero owned shards): the reflector
+                # cache above stays warm (fast takeover); scheduling belongs
+                # to the owners.  Local state (requeue backoffs) is NOT
+                # pruned on standby cycles — a transient lease failure must
+                # not wipe the backoff ledger.
                 pending_all = []
                 pending = []
             else:
@@ -1985,20 +2058,39 @@ class Scheduler:
                         snapshot.nodes, [p for p in snapshot.pods if full_name(p) not in evicted]
                     )
                 pending_all = snapshot.pending_pods()
+                full_pending_count = len(pending_all)
+                if self.sharded:
+                    # Shard filter: this replica solves only the pods whose
+                    # stable-hash shard it owns (gang members hash by gang
+                    # name, so a gang is never split across owners).
+                    pending_all = [p for p in pending_all if self.shard_set.owns_pod(p)]
                 pending = self._eligible(pending_all)
                 # Prune requeue backoffs for pods that no longer exist / are
-                # no longer pending (deleted, or bound out-of-band).
+                # no longer pending (deleted, or bound out-of-band).  In
+                # sharded mode, only keys hashing into OWNED shards are
+                # pruned against the (owned-filtered) pending set: another
+                # replica's pods are absent here by construction, and their
+                # rebuilt-on-takeover backoff state must survive ownership
+                # moves (the watch DELETE stream above prunes globally).
                 pending_names = {full_name(p) for p in pending_all}
-                for gone in [k for k in self.requeue_at if k not in pending_names]:
+                for gone in [
+                    k
+                    for k in self.requeue_at
+                    if k not in pending_names and (not self.sharded or self.shard_set.owns_name(k))
+                ]:
                     del self.requeue_at[gone]
             if pending:
                 # Schedule only eligible pods; bound pods — including
                 # bound-but-still-Pending ones (kubelet lag) — count capacity.
                 eligible_names = {full_name(p) for p in pending}
-                if len(pending) == len(pending_all):
-                    # Every pending pod is eligible (no requeue backoffs in
-                    # force) — the filtered rebuild would reproduce the
-                    # snapshot verbatim, and at flagship scale one
+                if len(pending) == full_pending_count:
+                    # Every pending pod of the WHOLE cluster is eligible (no
+                    # requeue backoffs in force, no shard filtered anything
+                    # out — the comparison is against the pre-filter count:
+                    # a sharded replica reusing the raw snapshot would solve
+                    # other replicas' shards straight into double-binds) —
+                    # the filtered rebuild would reproduce the snapshot
+                    # verbatim, and at flagship scale one
                     # ClusterSnapshot.build over 200k+ pods costs seconds
                     # (measured: the single largest avoidable e2e cost).
                     cycle_snapshot = snapshot
@@ -2177,7 +2269,7 @@ class Scheduler:
                     else:
                         sleep(daemon_interval)
             elif until_settled and m.bound == 0:
-                if self.leader_elect and not self.is_leader:
+                if (self.leader_elect or self.sharded) and not self.is_leader:
                     # A standby is never "settled" — it is waiting for
                     # leadership; idle a renewal interval and try again.
                     sleep(min(1.0, self.lease_duration / 3.0))
@@ -2223,6 +2315,46 @@ class Scheduler:
                 flush_tries = 0
         return out
 
+    def _refresh_shards(self) -> None:
+        """One shard-ownership round (runtime/shards.py): renew held shards,
+        absorb orphans up to the proportional target, release the excess.
+        An unreachable lease endpoint fails SAFE — this cycle schedules
+        nothing (the single-leader stance), while the in-memory ownership
+        ledger is left for the next successful round to reconcile."""
+        try:
+            delta = self.shard_set.refresh()
+        except (ApiError, OSError, http.client.HTTPException) as e:
+            logger.warning("shard lease refresh failed (%s); standing by", e)
+            self.is_leader = False
+            return
+        if delta.gained:
+            self.metrics.inc("scheduler_shard_acquisitions_total", len(delta.gained))
+            # Crash-safe takeover: the orphaned shard's state rebuilds from
+            # the reflector cache — stale assumed clones must not overlay.
+            self._revalidate_pending = True
+            self._cycle_notes.append(f"shards: acquired {sorted(delta.gained)}")
+            logger.info(
+                "acquired shard lease(s) %s (own %d/%d)", sorted(delta.gained), len(delta.owned), self.num_shards
+            )
+        if delta.lost:
+            self.metrics.inc("scheduler_shard_losses_total", len(delta.lost))
+            logger.warning("lost shard lease(s) %s to another replica", sorted(delta.lost))
+        if delta.released:
+            self.metrics.inc("scheduler_shard_releases_total", len(delta.released))
+            logger.info("released shard lease(s) %s (rebalance)", sorted(delta.released))
+        self.metrics.set_gauge("scheduler_shards_owned", float(len(delta.owned)))
+        self.is_leader = bool(delta.owned)
+
+    def shards_snapshot(self) -> dict:
+        """The /debug/shards payload.  Served from the HTTP thread; all
+        reads are GIL-atomic snapshots of main-thread state (the
+        resilience_snapshot stance)."""
+        if not self.sharded:
+            return {"enabled": False, "num_shards": self.num_shards, "replica_id": self.identity}
+        out = self.shard_set.debug(self.clock())
+        out["enabled"] = True
+        return out
+
     def _ensure_renewal_thread(self) -> None:
         """Kube-style background lease renewal at TTL/3: a cycle longer than
         the lease (pack+solve on a big cluster) must not let the lease lapse
@@ -2231,11 +2363,18 @@ class Scheduler:
         cycle stands down."""
         if self._renew_stop is not None:
             return
-        self._renew_stop = threading.Event()
+        self._renew_stop = stop = threading.Event()
 
         def renew():
-            while not self._renew_stop.wait(self.lease_duration / 3.0):
-                if not self.is_leader:
+            # ``stop`` is captured locally: close() nulls the attribute, and
+            # the re-check right before the acquire shrinks the window in
+            # which a renewal could slip past a shutdown.  The window is
+            # CLOSED by close() joining this thread before it releases the
+            # lease — a renewal can finish, but never land after the
+            # release (the renew-after-release race, regression-tested via
+            # FakeApiServer.lease_history).
+            while not stop.wait(self.lease_duration / 3.0):
+                if stop.is_set() or not self.is_leader:
                     continue
                 try:
                     if not self.api.acquire_lease(self.lease_name, self.identity, self.lease_duration):
@@ -2243,7 +2382,8 @@ class Scheduler:
                 except (ApiError, OSError, http.client.HTTPException):
                     self.is_leader = False
 
-        threading.Thread(target=renew, daemon=True).start()
+        self._renew_thread = threading.Thread(target=renew, daemon=True)
+        self._renew_thread.start()
 
     def resilience_snapshot(self) -> dict:
         """The /debug/resilience payload: breaker state + transition tail,
@@ -2267,11 +2407,24 @@ class Scheduler:
         immediately instead of waiting out the lease).  Idempotent."""
         self._join_binds()
         if self._renew_stop is not None:
+            # Stop AND JOIN the renewal thread BEFORE releasing: a renew
+            # already past its stop-check would otherwise re-acquire the
+            # lease AFTER the release below, leaving a zombie holder no
+            # standby can take over from until the TTL lapses.
             self._renew_stop.set()
+            if self._renew_thread is not None:
+                self._renew_thread.join(timeout=5.0)
+                self._renew_thread = None
             self._renew_stop = None
         if self._bind_queue is not None:
             self._bind_queue.put(None)  # worker-loop shutdown sentinel
             self._bind_queue = None
+        if self.sharded and self.shard_set.owned:
+            try:
+                self.shard_set.release_all()
+            except (ApiError, OSError, http.client.HTTPException):
+                pass  # the shard leases expire on their own
+            self.is_leader = False
         if self.leader_elect and self.is_leader:
             try:
                 self.api.release_lease(self.lease_name, self.identity)

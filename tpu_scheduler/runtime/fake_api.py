@@ -127,6 +127,10 @@ class FakeApiServer:
         # spec.renewTime + leaseDurationSeconds (client-go semantics,
         # runtime/lease.py).
         self._leases: dict[tuple[str, str], dict] = {}  # guarded-by: _lock
+        # Every lease WRITE in commit order: (name, holderIdentity-after) —
+        # "" marks a release.  The renew-vs-release shutdown race regression
+        # test reads this to prove no renewal lands after the release.
+        self.lease_history: list[tuple[str, str]] = []  # guarded-by: _lock
         # Fault injection: number of upcoming binding calls to fail with 500.
         self.fail_next_bindings = 0
         self.binding_count = 0
@@ -331,6 +335,7 @@ class FakeApiServer:
             self._rv += 1
             stored = {**lease, "metadata": {**lease.get("metadata", {}), "name": name, "namespace": namespace, "resourceVersion": str(self._rv)}}
             self._leases[(namespace, name)] = stored
+            self.lease_history.append((name, (stored.get("spec") or {}).get("holderIdentity") or ""))
             return json.loads(json.dumps(stored))
 
     def update_lease_object(self, namespace: str, name: str, lease: dict) -> dict:
@@ -347,6 +352,7 @@ class FakeApiServer:
             self._rv += 1
             stored = {**lease, "metadata": {**lease["metadata"], "name": name, "namespace": namespace, "resourceVersion": str(self._rv)}}
             self._leases[(namespace, name)] = stored
+            self.lease_history.append((name, (stored.get("spec") or {}).get("holderIdentity") or ""))
             return json.loads(json.dumps(stored))
 
     def acquire_lease(self, name: str, holder: str, duration_seconds: float) -> bool:
@@ -414,6 +420,28 @@ class FakeApiServer:
             return None
         renew = lease_mod.parse_micro_time(spec.get("renewTime")) or 0.0
         return {"holder": holder, "expires": renew + float(spec.get("leaseDurationSeconds") or 0)}
+
+    def list_lease_summaries(self) -> list[dict]:
+        """{'name', 'holder', 'expires'} per Lease in the election namespace,
+        name-sorted — the sharded control plane's replica-presence scan
+        (runtime/shards.py); '' holder entries (released leases) included so
+        callers judge liveness themselves."""
+        from . import lease as lease_mod
+
+        with self._lock:
+            keys = sorted(k for k in self._leases if k[0] == lease_mod.LEASE_NAMESPACE)
+        out = []
+        for _ns, name in keys:
+            spec = (self.get_lease_object(lease_mod.LEASE_NAMESPACE, name) or {}).get("spec") or {}
+            renew = lease_mod.parse_micro_time(spec.get("renewTime")) or 0.0
+            out.append(
+                {
+                    "name": name,
+                    "holder": spec.get("holderIdentity") or "",
+                    "expires": renew + float(spec.get("leaseDurationSeconds") or 0),
+                }
+            )
+        return out
 
     # -- PodDisruptionBudgets (policy/v1 subset; consulted by preemption) --
 

@@ -60,6 +60,7 @@ class HttpApiServer:
         metrics=None,
         recorder=None,
         resilience=None,
+        shards=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -69,6 +70,9 @@ class HttpApiServer:
         # () -> dict producing the /debug/resilience payload (the
         # controller's resilience_snapshot: breaker + backoff + deferred).
         self.resilience = resilience
+        # () -> dict producing the /debug/shards payload (the controller's
+        # shards_snapshot: replica id, owned shards, per-shard lease state).
+        self.shards = shards
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -197,6 +201,14 @@ class HttpApiServer:
                     elif parsed.path == "/metrics":
                         text = outer.metrics.to_prometheus() if outer.metrics is not None else ""
                         self._send(200, text.encode(), "text/plain; version=0.0.4")
+                    elif parsed.path == "/debug/shards":
+                        # Sharded-control-plane ownership (runtime/shards.py)
+                        # — controller state, served sans flight recorder
+                        # exactly like /debug/resilience.
+                        if outer.shards is None:
+                            self._send_json(404, {"message": "shard state not attached"})
+                        else:
+                            self._send_json(200, outer.shards())
                     elif parsed.path == "/debug/resilience":
                         # Backoff queue + circuit breaker + deferred-bind
                         # buffer — served even with the flight recorder
@@ -669,6 +681,22 @@ class KubeApiClient:
                 time.time(),
             )
 
+    def get_lease(self, name: str) -> dict | None:
+        """Summary view ({'holder', 'expires'} or None) matching
+        FakeApiServer.get_lease — the sharded control plane's ownership scan
+        (runtime/shards.py) reads leases through this on the HTTP boundary."""
+        from . import lease as lease_mod
+
+        obj = self.get_lease_object(lease_mod.LEASE_NAMESPACE, name)
+        if obj is None:
+            return None
+        spec = obj.get("spec") or {}
+        holder = spec.get("holderIdentity") or ""
+        if not holder:
+            return None
+        renew = lease_mod.parse_micro_time(spec.get("renewTime")) or 0.0
+        return {"holder": holder, "expires": renew + float(spec.get("leaseDurationSeconds") or 0)}
+
     def healthz(self) -> bool:
         try:
             code, _ = self._request("GET", "/healthz")
@@ -831,3 +859,9 @@ class RemoteApiAdapter:
 
     def release_lease(self, name: str, holder: str) -> None:
         self.client.release_lease(name, holder)
+
+    def get_lease(self, name: str) -> dict | None:
+        # Shard-ownership scans (runtime/shards.py) read lease summaries;
+        # list_lease_summaries is deliberately absent here — ShardSet
+        # degrades to inferring live replicas from shard holders alone.
+        return self.client.get_lease(name)

@@ -128,6 +128,14 @@ class ChaosApiServer:
         # CONFIRMED binding — the harness's time-to-bind source and the
         # run's determinism fingerprint material.
         self.bind_log: list[tuple[float, str, str]] = []
+        # Which replica POSTed each bind_log entry (parallel list, same
+        # length): the multi-replica harness sets ``actor`` before each
+        # replica's cycle so the scorecard can judge binds-while-open
+        # against the POSTING replica's breaker, not every replica's.
+        # Deliberately OUTSIDE bind_log so single-replica fingerprints are
+        # byte-identical with pre-sharding traces.
+        self.actor = 0
+        self.bind_actors: list[int] = []
         # Scheduler-driven pod deletions that succeeded (preemption victims,
         # NoExecute evictions) — sanctioned removals, not lost pods.
         self.evict_log: list[tuple[float, str]] = []
@@ -181,6 +189,7 @@ class ChaosApiServer:
             self.injected["bind-latency"] = self.injected.get("bind-latency", 0) + 1
         self.inner.create_binding(namespace, pod_name, target)
         self.bind_log.append((round(self.clock(), 9), f"{namespace}/{pod_name}", target.name))
+        self.bind_actors.append(self.actor)
 
     def delete_pod(self, namespace: str, name: str) -> None:
         if self._decide("api_error_rate", "delete-500"):

@@ -29,13 +29,13 @@ import random
 from ..api.objects import is_pod_bound
 from ..backends.native import NativeBackend
 from ..models.profiles import DEFAULT_PROFILE
-from ..runtime.controller import Scheduler
 from ..runtime.fake_api import FakeApiServer
 from ..testing import make_node, make_pod
 from ..topology.locality import gang_placement_stats
 from ..topology.model import DEFAULT_LEVEL_KEYS
 from .chaos import ChaosApiServer
 from .clock import VirtualClock
+from .multi import MultiReplicaHarness
 from .scenarios import SCENARIOS, Scenario
 from .scorecard import build_scorecard, check_invariants, fingerprint
 from .trace import TraceWriter, load_trace
@@ -210,16 +210,10 @@ def run_scenario(
     )
     backend = backend or NativeBackend()
     profile = DEFAULT_PROFILE.with_(preemption=True) if sc.preemption else DEFAULT_PROFILE
-    sched = Scheduler(
-        chaos,
-        backend,
-        profile=profile,
-        requeue_seconds=sc.requeue_seconds,
-        clock=clock,
-        rng=random.Random(f"{seed}:sched"),
-        events_buffer=events_buffer,
-        topology=topology,
-    )
+    # One harness regardless of replica count: replicas == 1 constructs the
+    # scheduler exactly as the single-replica path always did (same rng
+    # label, no shard machinery), so pre-sharding fingerprints hold.
+    fleet = MultiReplicaHarness(sc, seed, clock, chaos, backend, profile, events_buffer, topology)
 
     writer = TraceWriter(record) if record else None
     if writer:
@@ -441,7 +435,7 @@ def run_scenario(
                 resolve_event(events[ei])
                 ei += 1
 
-        sched.run_cycle()
+        fleet.step()
         cycles += 1
         new_binds = fold_outcomes()
         pending = len(inner.list_pods("status.phase=Pending"))
@@ -478,26 +472,35 @@ def run_scenario(
         (p.metadata.name, p.spec.node_name) for p in api_pods.values() if p.spec is not None and p.spec.node_name
     ]
     fp = fingerprint(chaos.bind_log, placements)
-    # Resilience verdict inputs: the breaker's open spans vs the CONFIRMED
-    # bind stream (a POST inside an open span is the degraded-mode bug the
-    # scorecard's pass gate rejects), recovery time after the last chaos
-    # window, and the worst backlog the run ever held.
+    # Resilience verdict inputs: each replica's breaker open spans vs the
+    # binds THAT replica POSTed (chaos.bind_actors attributes every bind_log
+    # entry to its posting replica — a survivor binding while a dead
+    # replica's breaker log still reads open is healthy failover, not a
+    # degraded-mode bug), recovery time after the last chaos window, and the
+    # worst backlog the run ever held.
     # Strictly interior, on 9-decimal-rounded bounds (bind_log timestamps
     # are rounded the same way): virtual time is discrete, so the POST that
     # tripped the breaker (or a success completing in the same instant)
     # shares the open-start timestamp, and a half-open probe shares the
     # open-end one — both happened through a not-yet/no-longer open breaker.
-    open_iv = [(round(s, 9), round(e, 9)) for s, e in sched.breaker.open_intervals(end_t)]
-    binds_while_open = sum(1 for t, _pf, _n in chaos.bind_log if any(s < t < e for s, e in open_iv))
+    open_iv_by_replica = [
+        [(round(s, 9), round(e, 9)) for s, e in r.breaker.open_intervals(end_t)] for r in fleet.scheds
+    ]
+    open_iv = [span for per_replica in open_iv_by_replica for span in per_replica]
+    binds_while_open = sum(
+        1
+        for (t, _pf, _n), actor in zip(chaos.bind_log, chaos.bind_actors)
+        if any(s < t < e for s, e in open_iv_by_replica[actor])
+    )
     last_window_end = max((w.end for w in sc.chaos.windows), default=None)
     recovery_s = None
     if last_window_end is not None:
         after = [t for t, _pf, _n in chaos.bind_log if t >= last_window_end]
         recovery_s = round(after[0] - last_window_end, 6) if after else None
-    metrics_snapshot = sched.metrics.snapshot()
+    metrics_snapshot = fleet.merged_metrics()
     resilience = {
-        "breaker_transitions": len(sched.breaker.transitions),
-        "breaker_opened": sched.breaker.opened_total,
+        "breaker_transitions": sum(len(r.breaker.transitions) for r in fleet.scheds),
+        "breaker_opened": sum(r.breaker.opened_total for r in fleet.scheds),
         "breaker_open_seconds": round(sum(e - s for s, e in open_iv), 6),
         "binds_while_open": binds_while_open,
         "recovery_seconds_after_brownout": recovery_s,
@@ -519,11 +522,12 @@ def run_scenario(
         invariants=invariants,
         chaos_injected=chaos.injected,
         resilience=resilience,
+        availability=fleet.availability_block(pending_final, st.double_bound),
         locality=_locality_block(sc, st),
         recorder_stats={
-            "tracked_pods": len(sched.recorder.tracked_pods()),
-            "evicted_timelines": sched.recorder.evicted_timelines,
-            "recorded_cycles": len(sched.recorder.cycles()),
+            "tracked_pods": sum(len(r.recorder.tracked_pods()) for r in fleet.scheds),
+            "evicted_timelines": sum(r.recorder.evicted_timelines for r in fleet.scheds),
+            "recorded_cycles": sum(len(r.recorder.cycles()) for r in fleet.scheds),
         },
         fp=fp,
     )

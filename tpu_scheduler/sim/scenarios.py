@@ -36,6 +36,17 @@ class Scenario:
     # for topology-labeled workloads where a single-rack fit always exists,
     # any cross-rack admission is a placement-quality regression.
     locality_required: bool = False
+    # Multi-replica control plane (sim/multi.py): run this many controller
+    # replicas against the one chaos apiserver, the pending set partitioned
+    # into ``shards`` lease-owned shards (0 = 2 x replicas).  ``replica_kills``
+    # lists (virtual time, replica index) crash points — the replica dies
+    # between solve and flush of its next cycle (zero binds POSTed) and
+    # NEVER releases its leases; survivors must absorb its shards within
+    # 2 x lease_duration (the scorecard ``availability`` pass gate).
+    replicas: int = 1
+    shards: int = 0
+    lease_duration: float = 5.0
+    replica_kills: tuple[tuple[float, int], ...] = ()
 
 
 SCENARIOS: dict[str, Scenario] = {}
@@ -189,6 +200,47 @@ _register(
             rack_fail_times=(12.0,),
         ),
         drain_grace_cycles=25,
+    )
+)
+
+_register(
+    Scenario(
+        name="replica-kill-mid-cycle",
+        description="Active-active sharded control plane: two replicas split four lease-owned shards; the busier replica is crash-killed between solve and flush (zero binds POSTed, leases never released) — the survivor must absorb the orphaned shards within 2x lease_duration with zero double-binds and zero orphaned pods (availability pass gate)",
+        duration=60.0,
+        workload=WorkloadSpec(
+            initial_nodes=30,
+            arrival_rate=6.0,
+            lifetime_mean_s=25.0,
+            gang_fraction=0.1,
+            priority_tiers=(0, 0, 5),
+        ),
+        replicas=2,
+        shards=4,
+        lease_duration=5.0,
+        replica_kills=((15.0, 0),),
+        drain_grace_cycles=20,
+    )
+)
+
+_register(
+    Scenario(
+        name="replica-kill-during-brownout",
+        description="The replica-kill composed with the PR-4 circuit breaker: a hard binding blackout opens the owner's breaker (binds defer in memory), then the owner is crash-killed mid-brownout — its deferred buffer dies with it, the survivor re-places those pods through its OWN degraded mode, and the run must still end with zero double-binds and zero binds through an open breaker",
+        duration=80.0,
+        workload=WorkloadSpec(
+            initial_nodes=30,
+            arrival_rate=6.0,
+            lifetime_mean_s=30.0,
+        ),
+        chaos=ChaosConfig(
+            windows=(ChaosWindow(start=12.0, end=30.0, binding_error_rate=1.0, watch_drop_rate=0.3),),
+        ),
+        replicas=2,
+        shards=4,
+        lease_duration=5.0,
+        replica_kills=((18.0, 0),),
+        drain_grace_cycles=30,
     )
 )
 

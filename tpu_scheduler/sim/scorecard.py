@@ -41,6 +41,7 @@ SCORECARD_FIELDS = (
     "invariants",
     "chaos_injected",
     "resilience",
+    "availability",
     "locality",
     "flight_recorder",
     "fingerprint",
@@ -164,6 +165,7 @@ def build_scorecard(
     invariants: dict,
     chaos_injected: dict,
     resilience: dict,
+    availability: dict,
     locality: dict,
     recorder_stats: dict,
     fp: str,
@@ -192,12 +194,16 @@ def build_scorecard(
         # when every placement invariant holds.  Locality-required scenarios
         # additionally gate on ZERO cross-rack gangs — a communication-
         # locality regression fails the run like an SLO regression does.
+        # Multi-replica scenarios additionally gate on the availability
+        # block's ok: zero double-binds, zero orphaned pods, and every
+        # replica-kill's shard takeover within 2 x lease_duration.
         "pass": bool(
             invariants.get("ok")
             and pod_counts.get("lost", 1) == 0
             and pod_counts.get("double_bound", 1) == 0
             and resilience.get("binds_while_open", 0) == 0
             and not (locality.get("required") and locality.get("cross_rack_gangs", 0) != 0)
+            and not (availability.get("enabled") and not availability.get("ok"))
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -206,6 +212,7 @@ def build_scorecard(
         "invariants": invariants,
         "chaos_injected": dict(sorted(chaos_injected.items())),
         "resilience": resilience,
+        "availability": availability,
         "locality": locality,
         "flight_recorder": recorder_stats,
         "fingerprint": fp,
