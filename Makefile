@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke clean
 
-check: lint test
+check: lint test profile-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -31,6 +31,13 @@ test:
 sim-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tpu_scheduler.cli sim --scenario sim-smoke --seed 0
 	JAX_PLATFORMS=cpu $(PY) -m tpu_scheduler.cli sim --scenario replica-kill-mid-cycle --seed 0
+
+# The profiler gate: one steady-state scenario with the always-on profiler,
+# failing (exit 1) when attribution coverage drops below 0.9 or the measured
+# span+ring overhead estimate exceeds 2% of the cycle wall — the same
+# contracts tests/test_profiler.py pins, runnable standalone for a verdict.
+profile-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tpu_scheduler.cli sim --scenario steady-state --seed 0 --profile-check
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

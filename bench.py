@@ -297,10 +297,86 @@ def constrained_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
             "constrained_bound": len(r.bindings),
             "constrained_bound_min_time": round(min(times), 4),
         }
+        if _remaining() > 90:
+            row.update(constrained_attribution(profile, seed))
         row.update(constrained_residue_accounting(backend, profile, snap, r, pods))
         return row
     except Exception as e:  # noqa: BLE001 — evidence row, never the headline
         log(f"constrained row skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
+def constrained_attribution(profile, seed: int, pods: int = 640, nodes: int = 64) -> dict:
+    """PER-ROUND cost attribution of a constrained cycle (off-clock — the
+    evidence the ROADMAP's 'profile the constraint rounds' item asks for,
+    emitted per bench row so the regression gate can localize WHICH round
+    regressed, not just the cycle total).
+
+    One traced run on the NativeBackend: the bit-parity oracle
+    (tests/test_fuzz_parity.py) whose Python round loop exposes the
+    round[NN]/mask/score/choose(filter/commit) split the device loop cannot
+    (ops/assign.py runs all rounds inside one lax.while_loop).  Oracle-side
+    and DOWNSCALED (the NumPy chain needs minutes beyond ~1k pods): the
+    per-round SHAPE of the cost is the signal — relative round weights and
+    the dominant sub-phase — not the absolute seconds, and the row labels
+    both the shape and the oracle explicitly."""
+    from dataclasses import replace as dc_replace
+
+    from tpu_scheduler.backends.native import NativeBackend
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+    from tpu_scheduler.utils.profiler import build_tree
+    from tpu_scheduler.utils.tracing import Trace
+
+    try:
+        snap = synth_cluster(
+            n_nodes=nodes, n_pending=pods, n_bound=2 * nodes, seed=seed,
+            anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+            pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+        )
+        packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+        cons = pack_constraints(
+            snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+            max_aa_terms=256, max_spread=256,
+        )
+        packed = dc_replace(packed, constraints=cons)
+        tr = Trace()
+        t0 = time.perf_counter()
+        with tr:
+            NativeBackend().schedule(packed, profile)
+        wall = time.perf_counter() - t0
+        tree = build_tree(tr, wall)
+        rounds = {name: node for name, node in tree["children"].items() if name.startswith("round[")}
+        if not rounds:
+            return {}
+        top_name, top_node = max(rounds.items(), key=lambda kv: kv[1]["total_s"])
+        out = {
+            "constrained_attr_shape": f"{pods}x{nodes}-native-oracle",
+            "constrained_attr_oracle_seconds": round(wall, 4),
+            "constrained_attr_rounds": {name: round(node["total_s"], 4) for name, node in sorted(rounds.items())},
+            "constrained_attr_top_round": top_name,
+            "constrained_attr_top_round_seconds": round(top_node["total_s"], 4),
+            "constrained_attr_top_round_split": {
+                k: round(v["total_s"], 4) for k, v in sorted(top_node["children"].items())
+            },
+        }
+        choose = top_node["children"].get("choose")
+        if choose and choose["children"]:
+            # One level deeper: filter (within-round conflict filter) vs
+            # commit (domain-state commit) — the split that names the
+            # constrained path's real cost center.
+            out["constrained_attr_top_round_choose_split"] = {
+                k: round(v["total_s"], 4) for k, v in sorted(choose["children"].items())
+            }
+        log(
+            f"constrained attribution ({out['constrained_attr_shape']}, {wall:.1f}s off-clock): "
+            f"top round {top_name} = {out['constrained_attr_top_round_seconds']}s of {len(rounds)} rounds; "
+            f"split {out['constrained_attr_top_round_split']}"
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 — attribution must never sink the row
+        log(f"constrained attribution skipped: {type(e).__name__}: {str(e)[:200]}")
         return {}
 
 
@@ -447,6 +523,7 @@ def e2e_row(backend, profile, pods: int, nodes: int, seed: int, cycles: int = 5)
         med = stats.median(walls)
         drain = stats.median(drains[1:])  # first join is a no-op (cold)
         log(f"e2e steady-state: median {med:.3f}s min {min(walls):.3f}s; median bind drain {drain:.3f}s")
+        prof = sched.profile_ring.snapshot()
         out = {
             "e2e_cycle_seconds": round(med, 4),
             "e2e_cycle_seconds_min": round(min(walls), 4),
@@ -456,6 +533,14 @@ def e2e_row(backend, profile, pods: int, nodes: int, seed: int, cycles: int = 5)
             "e2e_bind_dispatch_seconds": round(stats.median(binds), 4),
             "e2e_bind_drain_seconds": round(drain, 4),
             "e2e_bound_per_cycle": bound_total // max(1, cycles),
+            # Continuous-profiler evidence: how much of the e2e wall the
+            # attribution tree explains, and the lifetime per-phase totals —
+            # a stage regression shows up HERE with a name, not just in the
+            # cycle median.
+            "e2e_attribution_coverage": round(prof["coverage"], 4),
+            "e2e_phase_totals": {
+                name: node["total_s"] for name, node in sorted(prof["tree"].items())
+            },
         }
         # REALISTIC steady state: ~10% churn per cycle (a daemon rarely sees
         # its whole cluster replaced between cycles).  Each churn cycle also

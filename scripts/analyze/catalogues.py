@@ -23,6 +23,9 @@ REPL  — every shard/replica lease-name prefix (``runtime/shards.py``
         (``sim/multi.AVAILABILITY_FIELDS``), and multi-replica sim scenario
         (a registry entry passing ``replicas=``) must appear in the README
         "Multi-replica & failover" catalogue.
+PROF  — every profiler span name (``utils/profiler.SPAN_CATALOGUE``) and
+        SLO tier (``utils/profiler.SLO_TIERS``) must appear in the README
+        "Profiling" catalogue; metric names ride the METR gate as usual.
 """
 
 from __future__ import annotations
@@ -39,6 +42,7 @@ CODES = {
     "RESC": "a resilience backoff class/breaker state/config knob missing from the README Resilience catalogue",
     "TOPO": "a topology distance level/label key/scoring knob/scenario missing from the README \"Topology & gang placement\" catalogue",
     "REPL": "a shard lease prefix/availability field/multi-replica scenario missing from the README \"Multi-replica & failover\" catalogue",
+    "PROF": "a profiler span name/SLO tier missing from the README \"Profiling\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -268,5 +272,42 @@ def _run_repl(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_prof(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel != "tpu_scheduler/utils/profiler.py":
+            continue
+        for node in f.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id == "SPAN_CATALOGUE":
+                    tokens.extend(_topo_tuple_entries(node.value, ("profiler span",)))
+                elif t.id == "SLO_TIERS":
+                    # Rows are (name, floor, target) tuples; only the NAME
+                    # slot is a catalogue token (floors/targets are numbers).
+                    tokens.extend(_topo_tuple_entries(node.value, ("SLO tier",)))
+    return [
+        Finding(
+            "PROF",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in utils/profiler.py but is missing from the README \"Profiling\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
-    return _run_metr(ctx) + _run_simc(ctx) + _run_anlz(ctx) + _run_resc(ctx) + _run_topo(ctx) + _run_repl(ctx)
+    return (
+        _run_metr(ctx)
+        + _run_simc(ctx)
+        + _run_anlz(ctx)
+        + _run_resc(ctx)
+        + _run_topo(ctx)
+        + _run_repl(ctx)
+        + _run_prof(ctx)
+    )

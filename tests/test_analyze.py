@@ -255,6 +255,27 @@ def test_repl_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_prof_drift_and_guard():
+    mod = (
+        "tpu_scheduler/utils/profiler.py",
+        'SPAN_CATALOGUE = ("ghost-span",)\n'
+        'SLO_TIERS = (("ghost-tier", 100, 60.0),)\n'
+        'OTHER = ("not-a-span",)\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(mod, readme="")), "PROF")
+    assert {h.message.split("'")[1] for h in hits} == {"ghost-span", "ghost-tier"}
+    ok = "ghost-span ghost-tier"
+    assert not rule_hits(catalogues.run(make_ctx(mod, readme=ok)), "PROF")
+
+
+def test_prof_real_tree_is_catalogued():
+    files = load_files(["tpu_scheduler/utils/profiler.py"])
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "PROF")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")

@@ -17,6 +17,7 @@ from ..errors import BackendUnavailable
 from ..models.profiles import SchedulingProfile
 from ..ops.assign import assign_cycle, assign_cycle_epochs, split_device_arrays
 from ..ops.pack import PackedCluster
+from ..utils.profiler import install_jax_profile_hooks, record_transfer
 from .base import SchedulingBackend
 
 __all__ = ["TpuBackend"]
@@ -54,6 +55,10 @@ class TpuBackend(SchedulingBackend):
         except Exception as e:  # pragma: no cover - jax is baked into the image
             raise BackendUnavailable(f"jax unavailable: {e}") from e
         self._jax = jax
+        # Compile-vs-execute attribution: XLA compiles observed via
+        # jax.monitoring land in the active cycle trace as ``compile`` spans
+        # (best-effort, idempotent, never raises — utils/profiler.py).
+        install_jax_profile_hooks()
         if device is None:
             devices = jax.devices()
             if not devices:
@@ -148,6 +153,12 @@ class TpuBackend(SchedulingBackend):
                 del self._dev_cache[key]
                 self._dev_cache[key] = ent
                 return ent[1]
+        # Cache MISSES are real host->device traffic: count the bytes so the
+        # profiler's compile/execute split (utils/profiler.py) can name
+        # transfer-bound cycles (scheduler_device_transfer_bytes_total).
+        nbytes = getattr(arr, "nbytes", None)
+        if nbytes:
+            record_transfer(int(nbytes))
         buf = self._jax.device_put(arr, self.device)
         try:
             wr = weakref.ref(arr)

@@ -329,12 +329,21 @@ def main(argv: list[str] | None = None) -> int:
         # Against a remote cluster we serve metrics/health only — the remote
         # API server owns the cluster state.
         local_api = None if (args.api_server or args.kubeconfig is not None) else api
+        # /debug/profile serves through a replica registry so multi-replica
+        # deployments can aggregate (?replica= selects); a single replica
+        # registers just itself.
+        from .utils.profiler import ReplicaProfileRegistry
+
+        profile_registry = ReplicaProfileRegistry()
+        profile_registry.register(sched.identity, sched.profile_snapshot)
         http_server = HttpApiServer(
             local_api,
             metrics=sched.metrics,
             recorder=sched.recorder,
             resilience=sched.resilience_snapshot,
             shards=sched.shards_snapshot,
+            profile=profile_registry.snapshot,
+            pending_ages=sched.pending_age_debug,
             port=args.http_port,
         ).start()
         print(json.dumps({"http": True, "url": http_server.base_url}), file=sys.stderr)

@@ -56,6 +56,7 @@ from .masks import feasibility_block
 from .pack import INT32_MAX, STALL_ROUNDS
 from .score import score_block
 from ..topology.locality import gang_state_update, gang_topology_term
+from ..utils.tracing import span
 
 __all__ = ["assign_cycle", "assign_cycle_epochs", "split_device_arrays", "INT32_MAX"]
 
@@ -673,22 +674,36 @@ def assign_cycle_epochs(
 
     p_cur = p_pad
     rounds_i = 0
+    epoch_i = 0
     while rounds_i < max_rounds and n_active > 0:
         floor = p_cur <= _MIN_EPOCH_SIZE
-        avail, ps, n_active_dev, rounds, cst, tst = _assign_epoch(
-            nodes, ps, avail, n_active_dev, rounds, cst, weights, cmeta,
-            max_rounds, block, use_pallas, pallas_interpret, soft_spread, soft_pa, hard_pa, floor,
-            tmeta, tst,
-        )
-        # ONE host sync per epoch: n_active, rounds, and the stall counter
-        # ride home in a single fetch (~80 ms tunnel latency each otherwise).
-        if cmeta is not None:
-            trio = jnp.stack([n_active_dev, rounds, cst["stall"]])
-            n_active, rounds_i, stall_i = (int(v) for v in trio)
-        else:
-            duo = jnp.stack([n_active_dev, rounds])
-            n_active, rounds_i = (int(v) for v in duo)
-            stall_i = 0
+        # Profiler attribution (utils/profiler.py): ``dispatch`` is the
+        # Python/trace cost of launching the epoch (the jit call returns
+        # before the device finishes — async dispatch), ``host-sync`` is the
+        # ONE per-epoch blocking fetch where the device execute + transfer
+        # time actually lands.  Both are host-side spans OUTSIDE the jit
+        # boundary (JAXP-clean); together with the jax.monitoring compile
+        # listener they decompose "solve" into compile / dispatch /
+        # device-execute+sync.
+        with span(f"epoch[{epoch_i}]"):
+            with span("dispatch"):
+                avail, ps, n_active_dev, rounds, cst, tst = _assign_epoch(
+                    nodes, ps, avail, n_active_dev, rounds, cst, weights, cmeta,
+                    max_rounds, block, use_pallas, pallas_interpret, soft_spread, soft_pa, hard_pa, floor,
+                    tmeta, tst,
+                )
+            # ONE host sync per epoch: n_active, rounds, and the stall
+            # counter ride home in a single fetch (~80 ms tunnel latency
+            # each otherwise).
+            with span("host-sync"):
+                if cmeta is not None:
+                    trio = jnp.stack([n_active_dev, rounds, cst["stall"]])
+                    n_active, rounds_i, stall_i = (int(v) for v in trio)
+                else:
+                    duo = jnp.stack([n_active_dev, rounds])
+                    n_active, rounds_i = (int(v) for v in duo)
+                    stall_i = 0
+        epoch_i += 1
         if stall_i >= STALL_ROUNDS:
             break
         if floor:

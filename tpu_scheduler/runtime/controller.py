@@ -61,7 +61,8 @@ from ..errors import BackendUnavailable, CreateBindingFailed, NoNodeFound, Sched
 from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
 from ..ops.pack import extend_node_vocabs, pack_snapshot, repack_incremental
 from ..utils.events import FlightRecorder
-from ..utils.metrics import CycleMetrics, MetricsRegistry
+from ..utils.metrics import CycleMetrics, MetricsRegistry, cycle_phases
+from ..utils.profiler import SLO_TIERS, ProfileRing, tier_of, tier_target, transfer_bytes_total
 from ..utils.tracing import Trace, current_trace, set_log_cycle, span
 from .fake_api import ApiError, FakeApiServer
 from .reflector import ClusterReflector
@@ -214,6 +215,22 @@ class Scheduler:
         # Flight recorder (utils/events.py): bounded per-pod decision
         # timelines + cycle ring, served by /debug; events_buffer=0 disables.
         self.recorder = FlightRecorder(max_pods=events_buffer)
+        # Continuous cost-attribution profiler (utils/profiler.py): every
+        # cycle's hierarchical span tree folds into this bounded ring —
+        # always on (the <2% overhead gate is a tier-1 test), served at
+        # /debug/profile and summarized into /debug/shards.
+        self.profile_ring = ProfileRing()
+        # Pending-age tracker (SLO burn): pod full name -> (first-seen clock,
+        # SLO tier, "gang"/"solo").  Written only by the cycle loop; the
+        # HTTP debug thread reads GIL-atomic copies (resilience_snapshot
+        # stance).  Feeds scheduler_pending_age_seconds{tier=,gang=} at
+        # exit-from-pending and the per-tier burn-rate gauges every cycle.
+        self._pending_meta: dict[str, tuple[float, str, str]] = {}
+        # Device-transfer bytes already folded into the counter (the
+        # profiler's lifetime total is process-wide; we fold per-cycle
+        # deltas so the metric is a counter, not a re-published gauge).
+        self._xfer_folded = 0
+        self._unknown_phase_warned: set[str] = set()
         # Why-pending attribution state, reset per cycle: the snapshot
         # unschedulable pods are explained against, the remaining pod×node
         # explanation budget (EXPLAIN_WORK), and a lazy full-name -> Pod map.
@@ -1150,11 +1167,11 @@ class Scheduler:
         cycle's host I/O.  ``bound`` counts DISPATCHED bindings; failures
         surface next cycle via the outcome drain (requeue) exactly as a
         synchronous bind's failures would."""
-        if self.recorder.enabled:
-            self.recorder.record_packed(
-                (full_name(p) for p in batch_snapshot.pending_pods()), self._cycle_tag, self.backend.name
-            )
         with span("pack"):
+            if self.recorder.enabled:
+                self.recorder.record_packed(
+                    (full_name(p) for p in batch_snapshot.pending_pods()), self._cycle_tag, self.backend.name
+                )
             packed = self._attach_topology(self._pack(batch_snapshot), batch_snapshot)
         with span("solve"):
             result = self._solve_gang_aware(packed, batch_snapshot)
@@ -1376,13 +1393,14 @@ class Scheduler:
         plus direction-B anti-affinity matches) — the residue subset the
         stall mop-up re-tries sequentially.
         """
-        if self.recorder.enabled:
-            # "packed" only lands on already-tracked timelines (utils/events.py)
-            # — the batch membership verdict without growing the LRU.
-            self.recorder.record_packed(
-                (full_name(p) for p in batch_snapshot.pending_pods()), self._cycle_tag, self.backend.name
-            )
         with span("pack"):
+            if self.recorder.enabled:
+                # "packed" only lands on already-tracked timelines
+                # (utils/events.py) — the batch membership verdict without
+                # growing the LRU.
+                self.recorder.record_packed(
+                    (full_name(p) for p in batch_snapshot.pending_pods()), self._cycle_tag, self.backend.name
+                )
             packed = self._attach_topology(self._pack(batch_snapshot), batch_snapshot)
             if with_constraints:
                 from ..ops.constraints import pack_constraints
@@ -1492,8 +1510,11 @@ class Scheduler:
         return replace(result, unschedulable=passthrough), bound, unsched
 
     def _run_batch_cycle(self, snapshot: ClusterSnapshot, trace: Trace) -> tuple[int, int, int]:
-        pending = snapshot.pending_pods()
-        _, constrained = self._split_affinity_pending(snapshot, pending)
+        # Plain-vs-constrained classification is per-pod probe work over the
+        # whole pending set — "queue" phase, like the eligibility filter.
+        with span("queue"):
+            pending = snapshot.pending_pods()
+            _, constrained = self._split_affinity_pending(snapshot, pending)
         placed: list[tuple[Pod, Node]] = []
         if not constrained:
             # Expert-parallel routing: pods pinned to node pools schedule as
@@ -1558,10 +1579,11 @@ class Scheduler:
                     b, u = self._run_constrained_phase(snapshot, segment, placed)
                 r = 0
             else:
-                batch_snapshot = ClusterSnapshot.build(
-                    snapshot.nodes,
-                    base_pods + [self._bound_clone(q, qn) for q, qn in placed] + segment,
-                )
+                with span("queue"):
+                    batch_snapshot = ClusterSnapshot.build(
+                        snapshot.nodes,
+                        base_pods + [self._bound_clone(q, qn) for q, qn in placed] + segment,
+                    )
                 b, u, r = self._schedule_batch(batch_snapshot, placed)
             bound += b
             unschedulable += u
@@ -1947,6 +1969,77 @@ class Scheduler:
                 self._cycle_placed.append((pod, node))
         return bound, unschedulable
 
+    def _pre_cycle_overlay(self, snapshot: ClusterSnapshot) -> ClusterSnapshot:
+        """The between-snapshot-and-decision ledger work of one cycle (the
+        ``overlay`` phase): DELETE-stream pruning, control-plane ownership
+        (shard leases / leader lease), takeover revalidation, breaker
+        bookkeeping + deferred-bind flush/overlay, pipelined-bind fold, and
+        PDB peak observation.  Returns the (possibly overlaid) snapshot."""
+        # Prune per-pod ledgers from the watch DELETE stream — runs on
+        # EVERY cycle, standby included (the standby path deliberately
+        # skips the pending-set prune below, which used to leak backoff
+        # entries for pods deleted while this instance stood by).
+        deleted = self.reflector.take_deleted_pods()
+        if deleted:
+            pruned = 0
+            for ns, name in deleted:
+                pf = f"{ns or 'default'}/{name}"
+                if self.requeue_at.pop(pf, None) is not None:
+                    pruned += 1
+                self._assumed.pop(pf, None)
+                if self.deferred_binds.pop(pf, None) is not None:
+                    self.metrics.inc("scheduler_deferred_dropped_total")
+                    self.metrics.inc("scheduler_pods_bound_total", -1)
+            if pruned:
+                self.metrics.inc("scheduler_backoff_pruned_total", pruned)
+        # Control-plane ownership BEFORE any overlay is applied: a
+        # takeover (new leadership / a newly acquired shard) must get to
+        # revalidate stale assumed-bind state against the fresh
+        # reflector cache before this cycle overlays it as bound.
+        if self.sharded:
+            self._refresh_shards()
+        elif self.leader_elect:
+            was = self.is_leader
+            try:
+                self.is_leader = self.api.acquire_lease(self.lease_name, self.identity, self.lease_duration)
+            except (ApiError, OSError, http.client.HTTPException) as e:
+                # Can't reach the lease: fail SAFE — never schedule
+                # without proof of leadership (a partitioned ex-leader
+                # double-scheduling is the failure this exists to stop).
+                logger.warning("lease acquire failed (%s); standing by", e)
+                self.is_leader = False
+            if self.is_leader and not was:
+                self.metrics.inc("scheduler_leadership_acquisitions_total")
+                logger.info("acquired leadership lease %s as %s", self.lease_name, self.identity)
+                self._revalidate_pending = True
+            if self.is_leader:
+                self._ensure_renewal_thread()
+        if self._revalidate_pending and self.is_leader:
+            self._revalidate_overlays(snapshot)
+            self._revalidate_pending = False
+        # Degraded-mode bookkeeping: promote the breaker if its open
+        # window elapsed, arm this cycle's half-open probe budget, then
+        # flush recovered deferred binds / overlay the still-held ones.
+        breaker_mode = self.breaker.mode()
+        self._probe_left = self.breaker.config.probe_budget if breaker_mode == "half-open" else 0
+        if self.deferred_binds:
+            snapshot = self._flush_or_overlay_deferred(snapshot, breaker_mode)
+        if self.pipeline:
+            # Fold a FINISHED bind batch (never block — blocking here
+            # would serialize the pipeline); then hide confirmed /
+            # overlay in-flight assumptions onto the snapshot.
+            if self._bind_inflight is not None and self._bind_inflight[1].is_set():
+                self._join_binds()
+            snapshot = self._prune_and_overlay_assumed(snapshot)
+        if self.profile.preemption:
+            # Observe PDB peak healthy EVERY cycle — standby cycles
+            # included (a successor must not baseline a crashed workload
+            # at its degraded count) — but only for preemption profiles:
+            # nothing else consumes the proxy, and on the HTTP boundary
+            # each observation is a real list_pdbs round-trip.
+            self._update_pdb_peaks(snapshot)
+        return snapshot
+
     # -- the loop ----------------------------------------------------------
 
     def run_cycle(self) -> CycleMetrics:
@@ -1977,69 +2070,12 @@ class Scheduler:
                 elif self.reflector.healthy:
                     self.breaker.record(True)
                 snapshot = self.reflector.snapshot()
-            # Prune per-pod ledgers from the watch DELETE stream — runs on
-            # EVERY cycle, standby included (the standby path deliberately
-            # skips the pending-set prune below, which used to leak backoff
-            # entries for pods deleted while this instance stood by).
-            deleted = self.reflector.take_deleted_pods()
-            if deleted:
-                pruned = 0
-                for ns, name in deleted:
-                    pf = f"{ns or 'default'}/{name}"
-                    if self.requeue_at.pop(pf, None) is not None:
-                        pruned += 1
-                    self._assumed.pop(pf, None)
-                    if self.deferred_binds.pop(pf, None) is not None:
-                        self.metrics.inc("scheduler_deferred_dropped_total")
-                        self.metrics.inc("scheduler_pods_bound_total", -1)
-                if pruned:
-                    self.metrics.inc("scheduler_backoff_pruned_total", pruned)
-            # Control-plane ownership BEFORE any overlay is applied: a
-            # takeover (new leadership / a newly acquired shard) must get to
-            # revalidate stale assumed-bind state against the fresh
-            # reflector cache before this cycle overlays it as bound.
-            if self.sharded:
-                self._refresh_shards()
-            elif self.leader_elect:
-                was = self.is_leader
-                try:
-                    self.is_leader = self.api.acquire_lease(self.lease_name, self.identity, self.lease_duration)
-                except (ApiError, OSError, http.client.HTTPException) as e:
-                    # Can't reach the lease: fail SAFE — never schedule
-                    # without proof of leadership (a partitioned ex-leader
-                    # double-scheduling is the failure this exists to stop).
-                    logger.warning("lease acquire failed (%s); standing by", e)
-                    self.is_leader = False
-                if self.is_leader and not was:
-                    self.metrics.inc("scheduler_leadership_acquisitions_total")
-                    logger.info("acquired leadership lease %s as %s", self.lease_name, self.identity)
-                    self._revalidate_pending = True
-                if self.is_leader:
-                    self._ensure_renewal_thread()
-            if self._revalidate_pending and self.is_leader:
-                self._revalidate_overlays(snapshot)
-                self._revalidate_pending = False
-            # Degraded-mode bookkeeping: promote the breaker if its open
-            # window elapsed, arm this cycle's half-open probe budget, then
-            # flush recovered deferred binds / overlay the still-held ones.
-            breaker_mode = self.breaker.mode()
-            self._probe_left = self.breaker.config.probe_budget if breaker_mode == "half-open" else 0
-            if self.deferred_binds:
-                snapshot = self._flush_or_overlay_deferred(snapshot, breaker_mode)
-            if self.pipeline:
-                # Fold a FINISHED bind batch (never block — blocking here
-                # would serialize the pipeline); then hide confirmed /
-                # overlay in-flight assumptions onto the snapshot.
-                if self._bind_inflight is not None and self._bind_inflight[1].is_set():
-                    self._join_binds()
-                snapshot = self._prune_and_overlay_assumed(snapshot)
-            if self.profile.preemption:
-                # Observe PDB peak healthy EVERY cycle — standby cycles
-                # included (a successor must not baseline a crashed workload
-                # at its degraded count) — but only for preemption profiles:
-                # nothing else consumes the proxy, and on the HTTP boundary
-                # each observation is a real list_pdbs round-trip.
-                self._update_pdb_peaks(snapshot)
+            # The "overlay" phase: every ledger/ownership/degraded-mode step
+            # between the snapshot and the scheduling decision — previously
+            # unattributed wall that landed in `other` (the coverage gate's
+            # first casualty on steady-state cycles).
+            with span("overlay"):
+                snapshot = self._pre_cycle_overlay(snapshot)
             if (self.leader_elect or self.sharded) and not self.is_leader:
                 # Standby (no lease / zero owned shards): the reflector
                 # cache above stays warm (fast takeover); scheduling belongs
@@ -2051,75 +2087,82 @@ class Scheduler:
             else:
                 with span("noexecute"):
                     evicted = self._evict_noexecute(snapshot)
-                if evicted:
-                    # Evicted pods leave the cycle immediately: their capacity
-                    # frees for this very cycle's placements.
-                    snapshot = ClusterSnapshot.build(
-                        snapshot.nodes, [p for p in snapshot.pods if full_name(p) not in evicted]
-                    )
-                pending_all = snapshot.pending_pods()
-                full_pending_count = len(pending_all)
-                if self.sharded:
-                    # Shard filter: this replica solves only the pods whose
-                    # stable-hash shard it owns (gang members hash by gang
-                    # name, so a gang is never split across owners).
-                    pending_all = [p for p in pending_all if self.shard_set.owns_pod(p)]
-                pending = self._eligible(pending_all)
-                # Prune requeue backoffs for pods that no longer exist / are
-                # no longer pending (deleted, or bound out-of-band).  In
-                # sharded mode, only keys hashing into OWNED shards are
-                # pruned against the (owned-filtered) pending set: another
-                # replica's pods are absent here by construction, and their
-                # rebuilt-on-takeover backoff state must survive ownership
-                # moves (the watch DELETE stream above prunes globally).
-                pending_names = {full_name(p) for p in pending_all}
-                for gone in [
-                    k
-                    for k in self.requeue_at
-                    if k not in pending_names and (not self.sharded or self.shard_set.owns_name(k))
-                ]:
-                    del self.requeue_at[gone]
+                    if evicted:
+                        # Evicted pods leave the cycle immediately: their
+                        # capacity frees for this very cycle's placements.
+                        snapshot = ClusterSnapshot.build(
+                            snapshot.nodes, [p for p in snapshot.pods if full_name(p) not in evicted]
+                        )
+                with span("queue"):
+                    pending_all = snapshot.pending_pods()
+                    full_pending_count = len(pending_all)
+                    if self.sharded:
+                        # Shard filter: this replica solves only the pods
+                        # whose stable-hash shard it owns (gang members hash
+                        # by gang name, so a gang is never split across
+                        # owners).
+                        pending_all = [p for p in pending_all if self.shard_set.owns_pod(p)]
+                    pending = self._eligible(pending_all)
+                    # Prune requeue backoffs for pods that no longer exist /
+                    # are no longer pending (deleted, or bound out-of-band).
+                    # In sharded mode, only keys hashing into OWNED shards
+                    # are pruned against the (owned-filtered) pending set:
+                    # another replica's pods are absent here by construction,
+                    # and their rebuilt-on-takeover backoff state must
+                    # survive ownership moves (the watch DELETE stream above
+                    # prunes globally).
+                    pending_names = {full_name(p) for p in pending_all}
+                    for gone in [
+                        k
+                        for k in self.requeue_at
+                        if k not in pending_names and (not self.sharded or self.shard_set.owns_name(k))
+                    ]:
+                        del self.requeue_at[gone]
             if pending:
                 # Schedule only eligible pods; bound pods — including
                 # bound-but-still-Pending ones (kubelet lag) — count capacity.
-                eligible_names = {full_name(p) for p in pending}
-                if len(pending) == full_pending_count:
-                    # Every pending pod of the WHOLE cluster is eligible (no
-                    # requeue backoffs in force, no shard filtered anything
-                    # out — the comparison is against the pre-filter count:
-                    # a sharded replica reusing the raw snapshot would solve
-                    # other replicas' shards straight into double-binds) —
-                    # the filtered rebuild would reproduce the snapshot
-                    # verbatim, and at flagship scale one
-                    # ClusterSnapshot.build over 200k+ pods costs seconds
-                    # (measured: the single largest avoidable e2e cost).
-                    cycle_snapshot = snapshot
-                else:
-                    cycle_snapshot = ClusterSnapshot.build(
-                        snapshot.nodes,
-                        [
-                            p
-                            for p in snapshot.pods
-                            if p.status.phase != "Pending" or is_pod_bound(p) or full_name(p) in eligible_names
-                        ],
-                    )
-                # Gang membership over ALL pending pods — including ones in
-                # requeue backoff (excluded from cycle_snapshot): a gang
-                # with any ineligible member must never look complete to the
-                # eligible subset.
-                self._cycle_gangs = {}
-                for p in pending_all:
-                    if p.spec is not None and p.spec.gang:
-                        self._cycle_gangs.setdefault(p.spec.gang, set()).add(full_name(p))
-                # The cycle snapshot CARRIES the compiled interconnect
-                # topology (node-distance tensor + per-level membership):
-                # pack, scoring, and the admitted-gang locality metrics below
-                # all read the same resolved hierarchy.
-                compiled_topo = self._compiled_topology(cycle_snapshot)
-                if compiled_topo is not None:
-                    cycle_snapshot.attach_topology(compiled_topo)
-                self._explain_snapshot = cycle_snapshot
-                self.recorder.seen_many(eligible_names, self._cycle_tag)
+                # (A second "queue" interval: the rebuild + gang census cost
+                # accumulates into the same phase as the eligibility filter.)
+                with span("queue"):
+                    eligible_names = {full_name(p) for p in pending}
+                    if len(pending) == full_pending_count:
+                        # Every pending pod of the WHOLE cluster is eligible
+                        # (no requeue backoffs in force, no shard filtered
+                        # anything out — the comparison is against the
+                        # pre-filter count: a sharded replica reusing the raw
+                        # snapshot would solve other replicas' shards
+                        # straight into double-binds) — the filtered rebuild
+                        # would reproduce the snapshot verbatim, and at
+                        # flagship scale one ClusterSnapshot.build over 200k+
+                        # pods costs seconds (measured: the single largest
+                        # avoidable e2e cost).
+                        cycle_snapshot = snapshot
+                    else:
+                        cycle_snapshot = ClusterSnapshot.build(
+                            snapshot.nodes,
+                            [
+                                p
+                                for p in snapshot.pods
+                                if p.status.phase != "Pending" or is_pod_bound(p) or full_name(p) in eligible_names
+                            ],
+                        )
+                    # Gang membership over ALL pending pods — including ones
+                    # in requeue backoff (excluded from cycle_snapshot): a
+                    # gang with any ineligible member must never look
+                    # complete to the eligible subset.
+                    self._cycle_gangs = {}
+                    for p in pending_all:
+                        if p.spec is not None and p.spec.gang:
+                            self._cycle_gangs.setdefault(p.spec.gang, set()).add(full_name(p))
+                    # The cycle snapshot CARRIES the compiled interconnect
+                    # topology (node-distance tensor + per-level membership):
+                    # pack, scoring, and the admitted-gang locality metrics
+                    # below all read the same resolved hierarchy.
+                    compiled_topo = self._compiled_topology(cycle_snapshot)
+                    if compiled_topo is not None:
+                        cycle_snapshot.attach_topology(compiled_topo)
+                    self._explain_snapshot = cycle_snapshot
+                    self.recorder.seen_many(eligible_names, self._cycle_tag)
                 if self.policy == "batch":
                     bound, unsched, rounds = self._run_batch_cycle(cycle_snapshot, trace)
                 else:
@@ -2131,68 +2174,36 @@ class Scheduler:
                     bound += p_bound
                     unsched -= p_bound
                 if self._cycle_gangs:
-                    # Gang metrics counted ONCE per gang per cycle, from
-                    # actual bind outcomes (dispatched, in pipeline mode) —
-                    # not per scheduling scope (a split gang would otherwise
-                    # multi-count) and not at admission (a per-member bind
-                    # failure would overcount admissions).
-                    placed_names = {full_name(p) for p, _ in self._cycle_placed}
-                    node_of = {full_name(p): n.name for p, n in self._cycle_placed}
-                    for g, ms in sorted(self._cycle_gangs.items()):
-                        if ms <= placed_names:
-                            self.metrics.inc("scheduler_gangs_admitted_total")
-                            detail = g
-                            if compiled_topo is not None:
-                                # Placement-locality verdict per admitted
-                                # gang: worst pairwise interconnect distance
-                                # into the histogram ("why is this gang
-                                # slow" starts here), the full stats onto
-                                # the members' timelines.
-                                from ..topology.locality import gang_placement_stats
-
-                                doms = [
-                                    d
-                                    for d in (compiled_topo.domains_of(node_of[m]) for m in sorted(ms))
-                                    if d is not None
-                                ]
-                                if len(doms) >= 2:
-                                    stats = gang_placement_stats(doms, compiled_topo.level_distances())
-                                    self.metrics.observe(
-                                        "scheduler_gang_placement_distance", stats["max_distance"]
-                                    )
-                                    detail = (
-                                        f"{g} max_dist={stats['max_distance']}"
-                                        f" mean_dist={stats['mean_distance']}"
-                                        f" cross_edges={stats['cross_edges']}"
-                                    )
-                            if self.recorder.enabled:
-                                for nm in sorted(ms):
-                                    self.recorder.record(nm, "gang-admitted", self._cycle_tag, detail=detail)
-                        elif ms & eligible_names:
-                            self.metrics.inc("scheduler_gang_rejections_total")
-                            if self.recorder.enabled:
-                                for nm in sorted(ms & eligible_names):
-                                    self.recorder.record(nm, "gang-refused", self._cycle_tag, detail=g)
-                            # Align the gang's retry deadlines.  Per-member
-                            # backoff resets desynchronize the gang: each
-                            # cycle the eligible subset is rejected (gang
-                            # incomplete) and re-deadlined while the rest
-                            # still wait, so eligibility ping-pongs between
-                            # subsets forever and the gang never binds even
-                            # when capacity exists.  One shared deadline
-                            # (the max — every member's backoff is
-                            # respected) makes the gang eligible as a unit.
-                            deadlines = [self.requeue_at[m] for m in ms if m in self.requeue_at]
-                            if deadlines:
-                                align = max(deadlines)
-                                for m in ms & self.requeue_at.keys():
-                                    self.requeue_at[m] = align
+                    with span("gang"):
+                        self._account_gangs(eligible_names, compiled_topo)
             else:
                 bound, unsched, rounds = 0, 0, 0
+            if not ((self.leader_elect or self.sharded) and not self.is_leader):
+                # SLO burn bookkeeping (utils/profiler.SLO_TIERS): pods
+                # leaving the pending set observe their final time-in-queue;
+                # survivors drive the per-tier oldest-age/burn-rate gauges.
+                # Standby cycles skip it — an empty owned set is not a
+                # drained queue.
+                with span("slo"):
+                    self._update_pending_ages(pending_all)
 
         self._cycle_count += 1
         wall = time.perf_counter() - t0
-        durations = trace.summary()
+        top = trace.top_level()
+        # The breakdown fields are DERIVED from the same phase set the
+        # {phase=} metric series uses (metrics.cycle_phases): a depth-0 span
+        # outside that set is counted + warned, never silently `other`-ed.
+        phase_set = cycle_phases()
+        unknown = sorted(k for k in top if k not in phase_set)
+        if unknown:
+            self.metrics.inc("scheduler_unattributed_spans_total", len(unknown))
+            for k in unknown:
+                if k not in self._unknown_phase_warned:
+                    self._unknown_phase_warned.add(k)
+                    logger.warning(
+                        "span %r is not a CycleMetrics phase field; its time stays in other_seconds "
+                        "(add a %s_seconds field to CycleMetrics)", k, k,
+                    )
         m = CycleMetrics(
             cycle=self._cycle_count,
             backend=self.backend.name if self.policy == "batch" else f"sample×{self.attempts}",
@@ -2201,22 +2212,82 @@ class Scheduler:
             unschedulable=unsched,
             rounds=rounds,
             wall_seconds=wall,
-            pack_seconds=durations.get("pack", 0.0),
-            solve_seconds=durations.get("solve", 0.0),
-            bind_seconds=durations.get("bind", 0.0),
-            sync_seconds=durations.get("sync", 0.0),
-            mopup_seconds=durations.get("mopup", 0.0),
-            # Everything not in the five named phases (gang bookkeeping,
-            # eviction scans, the host constrained segments, …).  Spans can
-            # nest, so this subtracts only the disjoint top-level five.
+            # Everything without a phase field of its own (unknown depth-0
+            # spans + loop glue).  Spans nest, so this subtracts only the
+            # disjoint depth-0 phase totals.
             other_seconds=round(
-                max(0.0, wall - sum(durations.get(k, 0.0) for k in ("pack", "solve", "bind", "sync", "mopup"))), 6
+                max(0.0, wall - sum(v for k, v in top.items() if k in phase_set)), 6
             ),
+            **{f"{ph}_seconds": top.get(ph, 0.0) for ph in phase_set if ph != "other"},
         )
         self.metrics.observe_cycle(m)
         self.recorder.record_cycle(m.__dict__, trace.events, notes=self._cycle_notes)
+        # Continuous profiler: fold this cycle's attribution tree into the
+        # ring (outside the measured wall — the ring never inflates the
+        # cycle it records) and publish the device-transfer delta.
+        self.profile_ring.ingest(trace, wall)
+        xfer = transfer_bytes_total()
+        if xfer > self._xfer_folded:
+            self.metrics.inc("scheduler_device_transfer_bytes_total", xfer - self._xfer_folded)
+            self._xfer_folded = xfer
         set_log_cycle(None)
         return m
+
+    def _account_gangs(self, eligible_names: set[str], compiled_topo) -> None:
+        """Per-gang admission accounting (the ``gang`` phase).  Metrics
+        counted ONCE per gang per cycle, from actual bind outcomes
+        (dispatched, in pipeline mode) — not per scheduling scope (a split
+        gang would otherwise multi-count) and not at admission (a per-member
+        bind failure would overcount admissions)."""
+        placed_names = {full_name(p) for p, _ in self._cycle_placed}
+        node_of = {full_name(p): n.name for p, n in self._cycle_placed}
+        for g, ms in sorted(self._cycle_gangs.items()):
+            if ms <= placed_names:
+                self.metrics.inc("scheduler_gangs_admitted_total")
+                detail = g
+                if compiled_topo is not None:
+                    # Placement-locality verdict per admitted gang: worst
+                    # pairwise interconnect distance into the histogram
+                    # ("why is this gang slow" starts here), the full stats
+                    # onto the members' timelines.
+                    from ..topology.locality import gang_placement_stats
+
+                    doms = [
+                        d
+                        for d in (compiled_topo.domains_of(node_of[m]) for m in sorted(ms))
+                        if d is not None
+                    ]
+                    if len(doms) >= 2:
+                        stats = gang_placement_stats(doms, compiled_topo.level_distances())
+                        self.metrics.observe(
+                            "scheduler_gang_placement_distance", stats["max_distance"]
+                        )
+                        detail = (
+                            f"{g} max_dist={stats['max_distance']}"
+                            f" mean_dist={stats['mean_distance']}"
+                            f" cross_edges={stats['cross_edges']}"
+                        )
+                if self.recorder.enabled:
+                    for nm in sorted(ms):
+                        self.recorder.record(nm, "gang-admitted", self._cycle_tag, detail=detail)
+            elif ms & eligible_names:
+                self.metrics.inc("scheduler_gang_rejections_total")
+                if self.recorder.enabled:
+                    for nm in sorted(ms & eligible_names):
+                        self.recorder.record(nm, "gang-refused", self._cycle_tag, detail=g)
+                # Align the gang's retry deadlines.  Per-member backoff
+                # resets desynchronize the gang: each cycle the eligible
+                # subset is rejected (gang incomplete) and re-deadlined
+                # while the rest still wait, so eligibility ping-pongs
+                # between subsets forever and the gang never binds even
+                # when capacity exists.  One shared deadline (the max —
+                # every member's backoff is respected) makes the gang
+                # eligible as a unit.
+                deadlines = [self.requeue_at[m] for m in ms if m in self.requeue_at]
+                if deadlines:
+                    align = max(deadlines)
+                    for m in ms & self.requeue_at.keys():
+                        self.requeue_at[m] = align
 
     def run(
         self,
@@ -2350,9 +2421,18 @@ class Scheduler:
         reads are GIL-atomic snapshots of main-thread state (the
         resilience_snapshot stance)."""
         if not self.sharded:
-            return {"enabled": False, "num_shards": self.num_shards, "replica_id": self.identity}
+            return {
+                "enabled": False,
+                "num_shards": self.num_shards,
+                "replica_id": self.identity,
+                "perf": self.profile_ring.brief(),
+            }
         out = self.shard_set.debug(self.clock())
         out["enabled"] = True
+        # The perf block: this replica's cycle quantiles, attribution
+        # coverage, and costliest phases (utils/profiler.ProfileRing) — so
+        # shard-ownership pages answer "is this owner slow" in place.
+        out["perf"] = self.profile_ring.brief()
         return out
 
     def _ensure_renewal_thread(self) -> None:
@@ -2384,6 +2464,98 @@ class Scheduler:
 
         self._renew_thread = threading.Thread(target=renew, daemon=True)
         self._renew_thread.start()
+
+    def _update_pending_ages(self, pending_all: list[Pod]) -> None:
+        """SLO pending-age bookkeeping for one cycle (the ``slo`` phase).
+
+        A pod entering the pending set is stamped with first-seen clock, its
+        priority tier (utils/profiler.tier_of) and gang-ness; a pod LEAVING
+        it (bound, deleted, or shard moved away) observes its final
+        time-in-queue into ``scheduler_pending_age_seconds{tier=,gang=}``.
+        Survivors drive ``scheduler_pending_oldest_age_seconds{tier=}`` and
+        ``scheduler_slo_burn_rate{tier=}`` (oldest age over the tier's
+        time-to-bind target; >1 = the tier's SLO is burning).  In sharded
+        mode ages are per-owner: a rebalance restarts the clock on the new
+        owner — conservative (under-reports pain), documented in README."""
+        now = self.clock()
+        live: set[str] = set()
+        for p in pending_all:
+            pf = full_name(p)
+            live.add(pf)
+            if pf not in self._pending_meta:
+                gangness = "gang" if (p.spec is not None and p.spec.gang) else "solo"
+                self._pending_meta[pf] = (now, tier_of(_pod_priority(p)), gangness)
+        oldest: dict[str, float] = {}
+        for pf, (since, tier, gangness) in list(self._pending_meta.items()):
+            if pf not in live:
+                self.metrics.observe(
+                    "scheduler_pending_age_seconds", max(0.0, now - since), labels={"tier": tier, "gang": gangness}
+                )
+                del self._pending_meta[pf]
+                continue
+            age = now - since
+            if age > oldest.get(tier, 0.0):
+                oldest[tier] = age
+        for tier, _floor, target in SLO_TIERS:
+            age = oldest.get(tier, 0.0)
+            self.metrics.set_gauge("scheduler_pending_oldest_age_seconds", round(age, 6), labels={"tier": tier})
+            self.metrics.set_gauge(
+                "scheduler_slo_burn_rate", round(age / target, 6) if target > 0 else 0.0, labels={"tier": tier}
+            )
+
+    def pending_age_debug(self, pod_full: str) -> dict | None:
+        """The /debug/pods why-pending ``age`` block: how long this pod has
+        been in the queue and which SLO tier it burns against.  Called from
+        the HTTP thread; one GIL-atomic dict get (resilience_snapshot
+        stance)."""
+        meta = self._pending_meta.get(pod_full)
+        if meta is None:
+            return None
+        since, tier, gangness = meta
+        age = max(0.0, self.clock() - since)
+        target = tier_target(tier)
+        return {
+            "age_seconds": round(age, 6),
+            "tier": tier,
+            "gang": gangness == "gang",
+            "target_seconds": target,
+            "burn_rate": round(age / target, 6) if target > 0 else None,
+        }
+
+    def slo_snapshot(self) -> dict:
+        """Current per-tier pending-age summary (oldest/count), derived from
+        one GIL-atomic copy of the tracker — the /debug/profile slo block."""
+        now = self.clock()
+        meta = dict(self._pending_meta)
+        tiers: dict[str, dict] = {
+            tier: {"pending": 0, "oldest_age_s": 0.0, "target_s": target, "burn_rate": 0.0}
+            for tier, _floor, target in SLO_TIERS
+        }
+        for _pf, (since, tier, _gangness) in meta.items():
+            t = tiers[tier]
+            t["pending"] += 1
+            t["oldest_age_s"] = max(t["oldest_age_s"], round(max(0.0, now - since), 6))
+        for t in tiers.values():
+            if t["target_s"] > 0:
+                t["burn_rate"] = round(t["oldest_age_s"] / t["target_s"], 6)
+        return tiers
+
+    def profile_snapshot(self) -> dict:
+        """The /debug/profile payload for THIS replica: the continuous
+        ring's aggregated attribution tree, the compile/transfer split, and
+        the SLO burn summary.  Multi-replica deployments register this
+        callable in a ReplicaProfileRegistry (utils/profiler.py) so
+        /debug/profile?replica= can select and the default view can merge."""
+        from ..utils.profiler import compile_stats
+
+        return {
+            "replica": self.identity,
+            "shards_owned": sorted(self.shard_set.owned) if self.shard_set is not None else None,
+            "profile": self.profile_ring.snapshot(),
+            "compile": compile_stats(),
+            "device_transfer_bytes": transfer_bytes_total(),
+            "slo": self.slo_snapshot(),
+        }
 
     def resilience_snapshot(self) -> dict:
         """The /debug/resilience payload: breaker state + transition tail,

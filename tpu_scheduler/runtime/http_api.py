@@ -61,6 +61,8 @@ class HttpApiServer:
         recorder=None,
         resilience=None,
         shards=None,
+        profile=None,
+        pending_ages=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -73,6 +75,15 @@ class HttpApiServer:
         # () -> dict producing the /debug/shards payload (the controller's
         # shards_snapshot: replica id, owned shards, per-shard lease state).
         self.shards = shards
+        # (replica: str | None) -> dict producing the /debug/profile payload
+        # — a ReplicaProfileRegistry.snapshot (utils/profiler.py) in
+        # multi-replica mode, or the one scheduler's profile_snapshot
+        # wrapped; ``?replica=`` passes through as the argument.
+        self.profile = profile
+        # (pod_full: str) -> dict | None — the controller's
+        # pending_age_debug: current age-in-queue + SLO tier for the
+        # /debug/pods why-pending block.
+        self.pending_ages = pending_ages
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -124,6 +135,10 @@ class HttpApiServer:
                 timeline = outer.recorder.timeline(full)
                 why = None
                 locality = None
+                # Current age-in-queue + the SLO tier the wait burns against
+                # (utils/profiler.SLO_TIERS) — the timeline shows events,
+                # this shows elapsed pain.
+                age = outer.pending_ages(full) if outer.pending_ages is not None else None
                 if outer.api is not None:
                     from ..api.objects import full_name, is_pod_bound
                     from ..core.predicates import dominant_reason, unschedulable_reason_counts
@@ -151,7 +166,10 @@ class HttpApiServer:
                 elif not timeline:
                     self._send_json(404, {"message": f"no recorded timeline for pod {full}"})
                     return
-                self._send_json(200, {"pod": full, "timeline": timeline, "why_pending": why, "locality": locality})
+                self._send_json(
+                    200,
+                    {"pod": full, "timeline": timeline, "why_pending": why, "age": age, "locality": locality},
+                )
                 return
 
             def _gang_locality(self, pod, pods):
@@ -209,6 +227,16 @@ class HttpApiServer:
                             self._send_json(404, {"message": "shard state not attached"})
                         else:
                             self._send_json(200, outer.shards())
+                    elif parsed.path == "/debug/profile":
+                        # Continuous cost-attribution profile
+                        # (utils/profiler.py): the aggregated span tree with
+                        # p50/p99 per node, compile/transfer split, SLO burn.
+                        # ?replica= selects one replica in multi-replica
+                        # deployments (ReplicaProfileRegistry).
+                        if outer.profile is None:
+                            self._send_json(404, {"message": "profiler not attached"})
+                        else:
+                            self._send_json(200, outer.profile(q.get("replica", [None])[0]))
                     elif parsed.path == "/debug/resilience":
                         # Backoff queue + circuit breaker + deferred-bind
                         # buffer — served even with the flight recorder

@@ -27,6 +27,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--replay", default=None, metavar="PATH", help="re-run a recorded trace and verify bit-identity")
     p.add_argument("--backend", choices=["native", "tpu"], default="native", help="scheduling backend under test")
     p.add_argument("--events-buffer", type=int, default=4096, help="flight recorder capacity during the run")
+    p.add_argument(
+        "--profile-check",
+        action="store_true",
+        help="after the run, enforce the profiler gates: attribution coverage >= 0.9 and "
+        "estimated span+ring overhead < 2%% of the cycle wall (exit 1 on breach) — the "
+        "`make profile-smoke` engine",
+    )
     p.add_argument("--log-level", default="WARNING")
     p.add_argument("--list", action="store_true", help="list scenarios and exit")
     return p
@@ -51,6 +58,7 @@ def main(argv: list[str] | None = None) -> int:
         from ..backends.native import NativeBackend
 
         backend = NativeBackend()
+    gates: dict | None = {} if args.profile_check else None
     try:
         card = run_scenario(
             args.scenario,
@@ -59,11 +67,26 @@ def main(argv: list[str] | None = None) -> int:
             record=args.record,
             replay=args.replay,
             events_buffer=args.events_buffer,
+            profile_gates=gates,
         )
     except ReplayMismatchError as e:
         print(json.dumps({"replay_mismatch": True, "expected": e.expected, "got": e.got}))
         return 3
     print(json.dumps(card, sort_keys=True))
+    if gates is not None:
+        # Wall-derived gate inputs stay OFF the (byte-identical) scorecard;
+        # this line is diagnostics, the exit code is the verdict.
+        verdict = {
+            "profile_check": True,
+            "coverage": round(gates["coverage"], 4),
+            "overhead_frac": round(gates["overhead_frac"], 5),
+            "spans_per_cycle": round(gates["spans_per_cycle"], 1),
+            "coverage_ok": gates["coverage"] >= 0.9,
+            "overhead_ok": gates["overhead_frac"] < 0.02,
+        }
+        print(json.dumps(verdict), file=sys.stderr)
+        if not (verdict["coverage_ok"] and verdict["overhead_ok"]):
+            return 1
     return 0 if card["pass"] else 1
 
 

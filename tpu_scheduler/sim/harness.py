@@ -33,6 +33,7 @@ from ..runtime.fake_api import FakeApiServer
 from ..testing import make_node, make_pod
 from ..topology.locality import gang_placement_stats
 from ..topology.model import DEFAULT_LEVEL_KEYS
+from ..utils.tracing import base_name
 from .chaos import ChaosApiServer
 from .clock import VirtualClock
 from .multi import MultiReplicaHarness
@@ -119,6 +120,40 @@ def _pod_obj(payload: dict):
     )
 
 
+def _profile_block(sc: Scenario, fleet: MultiReplicaHarness) -> dict:
+    """The scorecard ``profile`` verdict: attribution coverage across the
+    fleet's continuous profile rings (utils/profiler.py) plus the span
+    CENSUS — per-path span counts with indexed segments collapsed to their
+    base (``solve/round[03]`` → ``solve/round``).
+
+    Deterministic by construction: span presence/counts are pure control
+    flow (bit-identical under record/replay) and ``coverage_ok`` is a
+    wide-margin boolean; raw durations never enter the scorecard (the
+    byte-identity contract).  ``compile`` spans are excluded — XLA
+    compile-cache state is environment, not scheduling."""
+    census: dict[str, int] = {}
+    cycles = 0
+    wall = 0.0
+    other = 0.0
+    for r in fleet.scheds:
+        snap = r.profile_ring.snapshot()
+        cycles += snap["cycles"]
+        wall += snap["wall_total_s"]
+        other += snap["other_total_s"]
+        for path, count in r.profile_ring.span_census().items():
+            if "compile" in path:
+                continue
+            base = "/".join(base_name(seg) for seg in path.split("/"))
+            census[base] = census.get(base, 0) + count
+    return {
+        "enabled": True,
+        "required": bool(sc.profile_required),
+        "coverage_ok": bool(wall <= 0 or (1.0 - other / wall) >= 0.9),
+        "cycles": cycles,
+        "span_census": dict(sorted(census.items())),
+    }
+
+
 def _locality_block(sc: Scenario, st: "_SimState") -> dict:
     """The scorecard ``locality`` verdict: per-gang placement-distance
     statistics over FIRST-bind placements (bind-time locality — churn
@@ -183,6 +218,7 @@ def run_scenario(
     replay: str | None = None,
     events_buffer: int = 4096,
     topology="auto",
+    profile_gates: dict | None = None,
 ) -> dict:
     """Run one scenario to its verdict; returns the scorecard dict.
 
@@ -191,7 +227,11 @@ def run_scenario(
     the replayed fingerprint differs from the recorded one.  ``topology``
     passes through to the Scheduler: "auto" (default) detects the workload's
     slice/rack node labels, None runs the topology-BLIND baseline the
-    locality scorecard block quantifies against."""
+    locality scorecard block quantifies against.  ``profile_gates`` (a dict,
+    filled in place) receives the WALL-derived profiler gate inputs —
+    aggregate attribution coverage and the measured overhead estimate —
+    which are deliberately kept OFF the scorecard (it must stay
+    byte-identical across runs); `sim --profile-check` consumes them."""
     replay_data = load_trace(replay) if replay else None
     if replay_data is not None:
         sc = _resolve_scenario(replay_data["header"]["scenario"])
@@ -524,6 +564,7 @@ def run_scenario(
         resilience=resilience,
         availability=fleet.availability_block(pending_final, st.double_bound),
         locality=_locality_block(sc, st),
+        profile=_profile_block(sc, fleet),
         recorder_stats={
             "tracked_pods": sum(len(r.recorder.tracked_pods()) for r in fleet.scheds),
             "evicted_timelines": sum(r.recorder.evicted_timelines for r in fleet.scheds),
@@ -540,4 +581,13 @@ def run_scenario(
         expected = replay_data["footer"]["fingerprint"]
         if expected != fp:
             raise ReplayMismatchError(expected, fp)
+    if profile_gates is not None:
+        walls = [r.profile_ring.snapshot() for r in fleet.scheds]
+        wall_total = sum(s["wall_total_s"] for s in walls)
+        other_total = sum(s["other_total_s"] for s in walls)
+        ests = [r.profile_ring.overhead_estimate() for r in fleet.scheds]
+        profile_gates["coverage"] = (1.0 - other_total / wall_total) if wall_total > 0 else 1.0
+        profile_gates["overhead_frac"] = max((e["overhead_frac"] for e in ests), default=0.0)
+        profile_gates["per_span_s"] = max((e["per_span_s"] for e in ests), default=0.0)
+        profile_gates["spans_per_cycle"] = max((e["spans_per_cycle"] for e in ests), default=0.0)
     return card

@@ -47,6 +47,12 @@ class Scenario:
     shards: int = 0
     lease_duration: float = 5.0
     replica_kills: tuple[tuple[float, int], ...] = ()
+    # Gate the scorecard pass on attribution coverage (the ``profile``
+    # block, utils/profiler.py): steady-state-family scenarios must explain
+    # ≥ 90% of their cycle wall through the span tree — an instrumentation
+    # regression (a new unattributed cycle region) fails the run like an
+    # SLO regression does.
+    profile_required: bool = False
 
 
 SCENARIOS: dict[str, Scenario] = {}
@@ -70,6 +76,7 @@ _register(
             selector_fraction=0.2,
             priority_tiers=(0, 0, 0, 5, 50),
         ),
+        profile_required=True,
     )
 )
 

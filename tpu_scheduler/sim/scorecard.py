@@ -43,6 +43,7 @@ SCORECARD_FIELDS = (
     "resilience",
     "availability",
     "locality",
+    "profile",
     "flight_recorder",
     "fingerprint",
 )
@@ -167,6 +168,7 @@ def build_scorecard(
     resilience: dict,
     availability: dict,
     locality: dict,
+    profile: dict,
     recorder_stats: dict,
     fp: str,
 ) -> dict:
@@ -197,6 +199,9 @@ def build_scorecard(
         # Multi-replica scenarios additionally gate on the availability
         # block's ok: zero double-binds, zero orphaned pods, and every
         # replica-kill's shard takeover within 2 x lease_duration.
+        # Profile-required scenarios additionally gate on attribution
+        # coverage ≥ 0.9 (the profile block): an unattributed cycle region
+        # is an observability regression and fails the run.
         "pass": bool(
             invariants.get("ok")
             and pod_counts.get("lost", 1) == 0
@@ -204,6 +209,7 @@ def build_scorecard(
             and resilience.get("binds_while_open", 0) == 0
             and not (locality.get("required") and locality.get("cross_rack_gangs", 0) != 0)
             and not (availability.get("enabled") and not availability.get("ok"))
+            and not (profile.get("required") and not profile.get("coverage_ok"))
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -214,6 +220,7 @@ def build_scorecard(
         "resilience": resilience,
         "availability": availability,
         "locality": locality,
+        "profile": profile,
         "flight_recorder": recorder_stats,
         "fingerprint": fp,
     }

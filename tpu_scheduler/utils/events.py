@@ -216,18 +216,24 @@ class FlightRecorder:
         for rec in recs:
             cycle = rec["metrics"].get("cycle")
             for name, t0, t1 in rec["spans"]:
-                events.append(
-                    {
-                        "name": name,
-                        "cat": "scheduler",
-                        "ph": "X",
-                        "ts": round(t0 * 1e6, 3),
-                        "dur": round(max(0.0, t1 - t0) * 1e6, 3),
-                        "pid": 1,
-                        "tid": 1,
-                        "args": {"cycle": cycle},
-                    }
-                )
+                # Span names are hierarchical PATHS (utils/tracing.py:
+                # ``solve/round[03]/score``); Perfetto nests ``X`` slices on
+                # one tid by time containment, so the slice carries the leaf
+                # name and the full path rides in args.  Endpoint rounding
+                # is monotone, so child slices never overhang their parent.
+                ev = {
+                    "name": name.rsplit("/", 1)[-1],
+                    "cat": "scheduler",
+                    "ph": "X",
+                    "ts": round(t0 * 1e6, 3),
+                    "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+                    "pid": 1,
+                    "tid": 1,
+                    "args": {"cycle": cycle},
+                }
+                if "/" in name:
+                    ev["args"]["path"] = name
+                events.append(ev)
             # One instant event marking the cycle boundary keeps cycles
             # countable even when a cycle recorded no spans (idle standby).
             events.append(

@@ -22,9 +22,9 @@ import bisect
 import json
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 
-__all__ = ["CycleMetrics", "MetricsRegistry", "format_labels", "escape_label_value"]
+__all__ = ["CycleMetrics", "MetricsRegistry", "cycle_phases", "format_labels", "escape_label_value"]
 
 # Latency buckets (seconds): sub-ms host phases through multi-second
 # constrained cycles at flagship shapes.
@@ -38,6 +38,10 @@ BACKOFF_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 150.0, 300.0, 600.0, 12
 # (topology/ levels differing, weighted): 0 = one slice, through a few
 # hierarchy levels — fractional bounds cover non-unit level weights.
 DISTANCE_BUCKETS = (0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 8.0)
+# Final pending age (first-seen to bind/exit) per SLO tier: sub-second
+# same-cycle binds through multi-minute backlog pain past every tier target
+# (utils/profiler.SLO_TIERS tops out at 1200 s).
+PENDING_AGE_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1200.0, 3600.0)
 
 # Histogram name -> bucket bounds; the one registration point the README
 # drift gate (scripts/lint.py) and to_prometheus share.
@@ -48,6 +52,7 @@ HISTOGRAM_BUCKETS = {
     "scheduler_cycle_rounds": ROUNDS_BUCKETS,
     "scheduler_backoff_seconds": BACKOFF_BUCKETS,
     "scheduler_gang_placement_distance": DISTANCE_BUCKETS,
+    "scheduler_pending_age_seconds": PENDING_AGE_BUCKETS,
 }
 
 
@@ -99,6 +104,24 @@ class CycleMetrics:
     # surfaced so a slow cycle is attributable from the JSON line alone.
     sync_seconds: float = 0.0
     mopup_seconds: float = 0.0
+    # The remaining cycle regions, each its own phase so the attribution
+    # coverage gate (1 − other/wall ≥ 0.9, utils/profiler.py) holds on
+    # steady-state cycles where loop glue rivals the solve: overlay (ledger
+    # prune + shard refresh + deferred flush), noexecute (taint eviction
+    # scan), queue (eligibility + snapshot rebuild + gang census),
+    # constrained (host sequential fallback), preempt, gang (admission
+    # accounting), slo (pending-age tracker).  Every field here except
+    # wall/other MUST correspond to a depth-0 span name — cycle_phases()
+    # derives the set, observe_cycle and the controller's breakdown both
+    # consume it, and tests/test_profiler.py pins the exact match so a new
+    # phase cannot silently land in `other`.
+    overlay_seconds: float = 0.0
+    noexecute_seconds: float = 0.0
+    queue_seconds: float = 0.0
+    constrained_seconds: float = 0.0
+    preempt_seconds: float = 0.0
+    gang_seconds: float = 0.0
+    slo_seconds: float = 0.0
     other_seconds: float = 0.0  # wall minus every attributed phase
 
     @property
@@ -109,6 +132,19 @@ class CycleMetrics:
         d = self.__dict__.copy()
         d["pods_per_second"] = round(self.pods_per_second, 2)
         return json.dumps(d)
+
+
+def cycle_phases() -> tuple[str, ...]:
+    """The closed cycle-phase set, DERIVED from the CycleMetrics fields
+    (every ``*_seconds`` field except ``wall``): the one source the
+    ``scheduler_phase_seconds{phase=}`` series, the controller's breakdown
+    construction, and the drift test all share — adding a phase field wires
+    the metric and the other-subtraction automatically."""
+    return tuple(
+        f.name[: -len("_seconds")]
+        for f in fields(CycleMetrics)
+        if f.name.endswith("_seconds") and f.name != "wall_seconds"
+    )
 
 
 @dataclass
@@ -149,11 +185,12 @@ class MetricsRegistry:
         with self._lock:
             self._observe(name, value, labels)
 
-    def set_gauge(self, name: str, value: float) -> None:
-        """Set an explicit gauge (e.g. ``scheduler_circuit_state``) —
+    def set_gauge(self, name: str, value: float, labels: dict[str, str] | None = None) -> None:
+        """Set an explicit gauge (e.g. ``scheduler_circuit_state``, or the
+        per-tier ``scheduler_slo_burn_rate{tier=}`` series) —
         last-write-wins, exported beside the derived last-cycle gauges."""
         with self._lock:
-            self._gauges[name] = float(value)
+            self._gauges[name + format_labels(labels)] = float(value)
 
     def observe_cycle(self, m: CycleMetrics) -> None:
         with self._lock:
@@ -165,14 +202,11 @@ class MetricsRegistry:
             self._inc("scheduler_pods_unschedulable_total", m.unschedulable, None)
             self._observe("scheduler_cycle_seconds", m.wall_seconds, None)
             self._observe("scheduler_cycle_rounds", float(m.rounds), None)
-            for phase, seconds in (
-                ("sync", m.sync_seconds),
-                ("pack", m.pack_seconds),
-                ("solve", m.solve_seconds),
-                ("bind", m.bind_seconds),
-                ("mopup", m.mopup_seconds),
-                ("other", m.other_seconds),
-            ):
+            # The phase list is DERIVED from the CycleMetrics fields
+            # (cycle_phases): a new breakdown field is a new {phase=} series
+            # by construction, never a silent addition to `other`.
+            for phase in cycle_phases():
+                seconds = getattr(m, f"{phase}_seconds")
                 if seconds > 0:
                     self._observe("scheduler_phase_seconds", seconds, {"phase": phase})
             if m.bind_seconds > 0:
@@ -245,7 +279,13 @@ class MetricsRegistry:
                 lines.append(f"{name}_bucket{{{merged}}} {cum}")
                 lines.append(f"{name}_sum{ls} {total}")
                 lines.append(f"{name}_count{ls} {cum}")
-        for name in sorted(gauges):
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {gauges[name]}")
+        # Gauges group into families exactly like counters — set_gauge keys
+        # labeled series as pre-formatted 'name{label="value"}' strings.
+        gauge_families: dict[str, list[tuple[str, float]]] = {}
+        for key in sorted(gauges):
+            gauge_families.setdefault(key.split("{", 1)[0], []).append((key, gauges[key]))
+        for fam in sorted(gauge_families):
+            lines.append(f"# TYPE {fam} gauge")
+            for key, value in gauge_families[fam]:
+                lines.append(f"{key} {value}")
         return "\n".join(lines) + "\n"

@@ -2,9 +2,20 @@
 ``tracing_subscriber::fmt()`` INFO logging (``src/main.rs:129``; SURVEY.md §5
 calls for per-cycle spans + optional device profiler traces).
 
-``span("name")`` times a block, logs it, and records the duration AND the
-wall-clock interval into the active ``Trace`` (if any) — the intervals feed
-the flight recorder's Chrome trace export (utils/events.py).
+``span("name")`` times a block and records it into the active ``Trace`` (if
+any) as a node of a HIERARCHICAL attribution tree: spans nest, and every
+recorded duration is keyed by its full ``parent/child`` path (e.g.
+``solve/round[03]/score``), so a cycle decomposes into a tree whose leaves
+are the real cost centers (utils/profiler.py aggregates the trees; the
+flight recorder's Chrome trace renders them as nested slices).  Depth-0
+paths are the cycle PHASES the ``CycleMetrics`` breakdown is built from —
+anything the phases don't cover is exactly ``other_seconds``.
+
+The active-trace stack is THREAD-LOCAL: a worker thread (routed per-pool
+solves, the pipelined bind worker) sees no active trace and its spans
+degrade to two clock reads — never a concurrent mutation of the main
+thread's tree (the THRD stance: no shared mutable state, no lock needed).
+
 ``device_profile(dir)`` wraps ``jax.profiler`` for TPU traces of the scoring
 step; it is a no-op if profiling can't start.  ``configure_logging`` grows a
 ``--log-format json`` path: one JSON object per line (ts, level, logger,
@@ -17,8 +28,8 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import threading
 import time
-from collections import defaultdict
 
 logger = logging.getLogger("tpu_scheduler")
 
@@ -26,13 +37,32 @@ __all__ = [
     "span",
     "Trace",
     "current_trace",
+    "base_name",
     "device_profile",
     "configure_logging",
     "JsonLogFormatter",
     "set_log_cycle",
 ]
 
-_active: list["Trace"] = []
+# Per-THREAD active-trace stack.  Only the thread that entered a Trace sees
+# it; spans on other threads no-op (two perf_counter reads) instead of racing
+# the owner's tree.
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def base_name(segment: str) -> str:
+    """A path segment's catalogue name: indexed spans (``round[03]``,
+    ``epoch[1]``) aggregate under their base (``round``, ``epoch``)."""
+    i = segment.find("[")
+    return segment if i < 0 else segment[:i]
+
 
 # The cycle number logs emitted "now" belong to — set by the controller at
 # the top of each cycle so the JSON formatter can stamp every line without
@@ -92,55 +122,94 @@ class Trace:
     scheduling cycle), plus the span INTERVALS in wall-clock time — the
     flight recorder's Chrome-trace source.  Intervals are derived from
     perf_counter deltas re-anchored to wall time at construction, so they
-    are monotonic within the trace and meaningful across cycles."""
+    are monotonic within the trace and meaningful across cycles.
+
+    Spans NEST: while a span is open, spans (and ``record`` calls) inside it
+    key under ``parent/child`` paths.  ``durations``/``counts``/``events``
+    are therefore PATH-keyed; depth-0 paths (no ``/``) are the cycle phases.
+    Single-threaded by design — only the entering thread's spans land here
+    (see the module docstring)."""
+
+    __slots__ = ("durations", "counts", "events", "_wall0", "_perf0", "_path")
 
     def __init__(self):
-        self.durations: dict[str, float] = defaultdict(float)
-        self.counts: dict[str, int] = defaultdict(int)
-        self.events: list[tuple[str, float, float]] = []  # (name, wall_start, wall_end)
+        self.durations: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+        self.events: list[tuple[str, float, float]] = []  # (path, wall_start, wall_end)
         self._wall0 = time.time()
         self._perf0 = time.perf_counter()
+        self._path = ""  # the currently open span path ("" = top level)
 
     def _wall(self, perf_t: float) -> float:
         return self._wall0 + (perf_t - self._perf0)
 
     def record(self, name: str, seconds: float, perf_start: float | None = None) -> None:
-        """Record a span.  ``perf_start`` (a perf_counter stamp) gives the
-        exact interval; without it the interval is synthesized as ending now
-        — the overlapped-bind drain knows only its duration, and an
-        approximate box in the trace beats an invisible one."""
-        self.durations[name] += seconds
-        self.counts[name] += 1
+        """Record a span as a child of the currently open path.  ``perf_start``
+        (a perf_counter stamp) gives the exact interval; without it the
+        interval is synthesized as ending now — the overlapped-bind drain
+        knows only its duration, and an approximate box in the trace beats
+        an invisible one."""
+        path = f"{self._path}/{name}" if self._path else name
+        self._record_path(path, seconds, perf_start)
+
+    def _record_path(self, path: str, seconds: float, perf_start: float | None) -> None:
+        self.durations[path] = self.durations.get(path, 0.0) + seconds
+        self.counts[path] = self.counts.get(path, 0) + 1
         end = time.perf_counter() if perf_start is None else perf_start + seconds
-        start = end - seconds
-        self.events.append((name, self._wall(start), self._wall(end)))
+        self.events.append((path, self._wall(end - seconds), self._wall(end)))
 
     def __enter__(self) -> "Trace":
-        _active.append(self)
+        _stack().append(self)
         return self
 
     def __exit__(self, *exc) -> None:
-        _active.remove(self)
+        s = _stack()
+        if self in s:
+            s.remove(self)
 
     def summary(self) -> dict[str, float]:
+        """Path -> accumulated seconds (depth-0 paths are plain names)."""
         return dict(self.durations)
+
+    def top_level(self) -> dict[str, float]:
+        """Depth-0 durations only — the disjoint cycle phases whose sum is
+        the attributed share of the cycle wall."""
+        return {p: s for p, s in self.durations.items() if "/" not in p}
 
 
 def current_trace() -> Trace | None:
-    return _active[-1] if _active else None
+    s = getattr(_tls, "stack", None)
+    return s[-1] if s else None
 
 
-@contextlib.contextmanager
-def span(name: str):
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        tr = current_trace()
+class span:
+    """Time a block into the active trace (hierarchically).  A plain class
+    context manager, not @contextmanager: this sits on the per-round hot
+    path and the generator protocol costs ~2 µs per use that a __slots__
+    class does not (the <2 % profiler-overhead budget is built from this)."""
+
+    __slots__ = ("name", "_t0", "_tr", "_prev")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self) -> "span":
+        s = getattr(_tls, "stack", None)
+        tr = self._tr = s[-1] if s else None
         if tr is not None:
-            tr.record(name, dt, perf_start=t0)
-        logger.debug("span %s took %.6fs", name, dt)
+            prev = tr._path
+            self._prev = prev
+            tr._path = f"{prev}/{self.name}" if prev else self.name
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        dt = time.perf_counter() - self._t0
+        tr = self._tr
+        if tr is not None:
+            path = tr._path
+            tr._path = self._prev
+            tr._record_path(path, dt, self._t0)
 
 
 @contextlib.contextmanager
