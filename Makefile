@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke clean
 
-check: lint test profile-smoke
+check: lint test profile-smoke constrained-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -38,6 +38,14 @@ sim-smoke:
 # contracts tests/test_profiler.py pins, runnable standalone for a verdict.
 profile-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tpu_scheduler.cli sim --scenario steady-state --seed 0 --profile-check
+
+# The fused-conflict-filter gate: native-vs-jit binding parity on a
+# constrained synth cluster plus a single-digit-seconds budget on the shape
+# that needed ~60 s before the round-7 active-set fusion — fails (exit 1) if
+# the filter ever re-grows a full-shape per-round sweep
+# (scripts/constrained_smoke.py).
+constrained-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.constrained_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

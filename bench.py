@@ -296,6 +296,12 @@ def constrained_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
             "constrained_rounds": r.rounds,
             "constrained_bound": len(r.bindings),
             "constrained_bound_min_time": round(min(times), 4),
+            # Stable-name twins for the cross-round regression gate
+            # (apply_secondary_regression_checks matches same-platform AND
+            # same-shape records; the dynamic key above keeps the headline
+            # readable per shape).
+            "constrained_shape": f"{pods}x{nodes}",
+            "constrained_seconds_min": round(min(times), 4),
         }
         if _remaining() > 90:
             row.update(constrained_attribution(profile, seed))
@@ -365,10 +371,20 @@ def constrained_attribution(profile, seed: int, pods: int = 640, nodes: int = 64
         if choose and choose["children"]:
             # One level deeper: filter (within-round conflict filter) vs
             # commit (domain-state commit) — the split that names the
-            # constrained path's real cost center.
+            # constrained path's real cost center.  Pre-fusion (round 6)
+            # filter was ~99% of the top round; the round-7 acceptance bar
+            # is filter below 50% of it.
             out["constrained_attr_top_round_choose_split"] = {
                 k: round(v["total_s"], 4) for k, v in sorted(choose["children"].items())
             }
+            filt = choose["children"].get("filter")
+            if filt and filt["children"]:
+                # One more level: the fused filter's per-family sub-spans
+                # (aa / pa / spread) — names WHICH constraint family
+                # dominates, not just that the filter does.
+                out["constrained_attr_top_round_filter_split"] = {
+                    k: round(v["total_s"], 4) for k, v in sorted(filt["children"].items())
+                }
         log(
             f"constrained attribution ({out['constrained_attr_shape']}, {wall:.1f}s off-clock): "
             f"top round {top_name} = {out['constrained_attr_top_round_seconds']}s of {len(rounds)} rounds; "
@@ -1026,6 +1042,7 @@ def apply_secondary_regression_checks(out: dict, platform: str, repo_dir: str, t
     for field, shape_field in (
         ("topology_cycle_seconds_min", "topology_shape"),
         ("multi_replica_wall_seconds_min", "multi_replica_shape"),
+        ("constrained_seconds_min", "constrained_shape"),
     ):
         val = out.get(field)
         if val is None:
@@ -1168,12 +1185,16 @@ def main() -> int:
     # constraint-engine rewrite (dense predecessor checks + row scatters +
     # epoch-driver auto-selection, PERF.md) the TPU row runs the FULL
     # north-star shape with the synth constraint fractions (measured 2.1 s;
-    # was 17 s at half this scale before the rewrite); quarter scale on a
-    # CPU fallback so a tunnel-down bench stays bounded.  The TPU row needs
+    # was 17 s at half this scale before the rewrite); since the round-7
+    # fused active-set conflict filter the CPU fallback runs a REAL shape
+    # too — 25000×2500, the downscaled-flagship size the headline uses —
+    # instead of the former 2500×250 toy (which needed ~60 s pre-fusion;
+    # both shapes now ride the same-platform cross-round regression gate
+    # via constrained_seconds_min/constrained_shape).  The TPU row needs
     # the same >10k-pod headroom as the scaling ladder (synth + pack + a
     # fresh constrained-shape compile).
     if not args.no_constrained_row and _remaining() > (600 if platform == "tpu" else 120):
-        cp, cn = (100_000, 10_000) if platform == "tpu" else (2_500, 250)
+        cp, cn = (100_000, 10_000) if platform == "tpu" else (25_000, 2_500)
         out.update(constrained_row(backend, profile, cp, cn, args.seed))
     # End-to-end steady-state row (VERDICT r4 #2): the real controller loop
     # at the flagship shape on chip; quarter scale on a CPU fallback.

@@ -1,0 +1,86 @@
+"""constrained-smoke — the fused conflict filter's standing gate (make check).
+
+Two contracts on a downscaled constrained cluster, runnable standalone for a
+verdict (exit 0 = green), the `make sim-smoke` pattern:
+
+  1. PARITY — the NumPy oracle and the jit engine must agree binding-for-
+     binding (and accept-round-for-accept-round) on a constrained synth
+     cluster: the active-set compaction, the fused segment scatter-min, the
+     spread-domain projection, and the round-carried conflict state are all
+     REQUIRED to be bitwise-neutral, and this is the cheap everyday check
+     that they stayed so (tests/test_fuzz_parity.py is the thorough one).
+  2. BUDGET — one warm constrained cycle at 2500×250 on the jit engine must
+     finish in single-digit seconds.  Pre-fusion this shape measured ~60 s
+     (ISSUE 9 / ROADMAP "constrained path at flagship scale"); post-fusion
+     ~0.4 s on the dev box, so the 10 s bar holds ~20× of slow-CI margin
+     while still failing hard if the filter ever re-grows a full-shape
+     per-round sweep.
+
+Off the tier-1 clock (seconds of wall); wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import replace
+
+import numpy as np
+
+BUDGET_SECONDS = 10.0
+
+
+def main() -> int:
+    from tpu_scheduler.backends.native import NativeBackend
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.models.profiles import PROFILES
+    from tpu_scheduler.ops.constraints import pack_constraints
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    profile = PROFILES["throughput"].with_(pod_block=8192, max_rounds=64)
+    kw = dict(
+        anti_affinity_fraction=0.1, spread_fraction=0.1, schedule_anyway_fraction=0.1,
+        pod_affinity_fraction=0.1, preferred_pod_affinity_fraction=0.1, extended_fraction=0.1,
+    )
+
+    def packed_at(pods: int, nodes: int, seed: int):
+        snap = synth_cluster(n_nodes=nodes, n_pending=pods, n_bound=2 * nodes, seed=seed, **kw)
+        packed = pack_snapshot(snap, pod_block=profile.pod_block, node_block=128)
+        cons = pack_constraints(
+            snap, snap.pending_pods(), packed.padded_pods, packed.node_names, packed.padded_nodes,
+            max_aa_terms=256, max_spread=256,
+        )
+        return replace(packed, constraints=cons)
+
+    # 1. parity: oracle vs jit engine, bindings + rounds + accept rounds.
+    tpu = TpuBackend()
+    packed = packed_at(640, 64, seed=0)
+    rn = NativeBackend().schedule(packed, profile)
+    rt = tpu.schedule(packed, profile)
+    ok_parity = (
+        sorted(rn.bindings) == sorted(rt.bindings)
+        and rn.rounds == rt.rounds
+        and bool(np.array_equal(rn.stats["acc_round"], rt.stats["acc_round"]))
+    )
+    print(
+        f"constrained-smoke parity 640x64: native=={len(rn.bindings)} bound/{rn.rounds} rounds, "
+        f"jit=={len(rt.bindings)}/{rt.rounds} -> {'OK' if ok_parity else 'MISMATCH'}"
+    )
+
+    # 2. budget: one warm constrained cycle at the pre-fusion pathology shape.
+    packed = packed_at(2500, 250, seed=0)
+    tpu.schedule(packed, profile)  # warm/compile
+    t0 = time.perf_counter()
+    r = tpu.schedule(packed, profile)
+    dt = time.perf_counter() - t0
+    ok_budget = dt < BUDGET_SECONDS
+    print(
+        f"constrained-smoke budget 2500x250: {dt:.2f}s ({len(r.bindings)} bound, {r.rounds} rounds) "
+        f"vs {BUDGET_SECONDS:.0f}s bar -> {'OK' if ok_budget else 'OVER BUDGET'}"
+    )
+    return 0 if (ok_parity and ok_budget) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

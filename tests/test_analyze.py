@@ -972,3 +972,22 @@ def test_tpu_backend_reads_variant_flags_under_guard_lock():
         b._guard_lock.release()
         t.join(10)
     assert results == ["ok"]
+
+
+def test_shpe_fused_filter_transposed_operand_caught():
+    """ISSUE 9 satellite: mutation-check a fused-filter contract —
+    transposing the spread-domain selection operand in
+    _project_spread_domains ([D, C] fed as [C, D]) must contradict the
+    declared `# shape:` contract via the matmul inner-dim check."""
+    path = ROOT / "tpu_scheduler" / "ops" / "constraints.py"
+    text = path.read_text()
+    ctx = make_ctx(("tpu_scheduler/ops/constraints.py", text))
+    assert not rule_hits(shapes.run(ctx), "SHPE")
+    mutated = text.replace(
+        "return nd @ sel, uses_sp @ sel, sp0 @ sel",
+        "return nd @ sel, uses_sp @ sel.T, sp0 @ sel",
+    )
+    assert mutated != text, "the spread-domain projection went missing from constraints.py"
+    hits = rule_hits(shapes.run(make_ctx(("tpu_scheduler/ops/constraints.py", mutated))), "SHPE")
+    assert len(hits) == 1, "; ".join(h.render() for h in hits)
+    assert "matmul inner dims differ" in hits[0].message and "[C, D]" in hits[0].message

@@ -512,3 +512,72 @@ def test_cell_rank_scan_chunked_equals_oneshot(monkeypatch):
     jp = np.asarray(C._cell_rank_prefix(jnp, jnp.asarray(mass), jnp.asarray(nd), jnp.asarray(uses)))
     jl = np.asarray(C._cell_rank_min_level(jnp, jnp.asarray(mass), jnp.asarray(nd), jnp.asarray(uses), jnp.asarray(base)))
     assert (jp == ref_pre).all() and (jl == ref_lvl).all()
+
+
+def test_dense_boundary_parity(monkeypatch):
+    """ISSUE 9 satellite: pin dense-vs-fused-segment outcome parity EXACTLY
+    at the DENSE_CELLS threshold shape.  The fused segment scatter-min is
+    the default AA formulation on the active-set workspace; this proves the
+    fork is perf-only right at the boundary (t*d == DENSE_CELLS runs dense,
+    one below runs the segment path) for both backends, at the unit level —
+    no synth cluster between the inputs and the filter."""
+    import numpy as np
+
+    import tpu_scheduler.ops.constraints as C
+
+    t, d, n, p = 16, C.DENSE_CELLS // 16, 96, 512
+    assert t * d == C.DENSE_CELLS
+    rng = np.random.default_rng(7)
+    ndc = np.zeros((n, d), np.float32)
+    keyed = rng.random(n) < 0.7  # some nodes lack the coarse key -> fine cells
+    ndc[np.flatnonzero(keyed), rng.integers(0, d, int(keyed.sum()))] = 1.0
+    meta = {
+        "node_dom_c": ndc,
+        "term_uses_dom": (rng.random((t, d)) < 0.4).astype(np.float32),
+        "sp_uses_dom": np.zeros((8, d), np.float32),
+        "sp_skew": np.zeros((8,), np.float32),
+    }
+    state = {"sp_counts": np.zeros((8, d), np.float32)}
+    args = []
+    for seed in range(3):
+        r = np.random.default_rng(seed)
+        accepted = r.random(p) < 0.4
+        choice = r.integers(0, n, p).astype(np.int32)
+        ranks = np.arange(p, dtype=np.uint32)
+        ps = {
+            "pod_aa_carries": (r.random((p, t)) < 0.15).astype(np.float32),
+            "pod_aa_matched": (r.random((p, t)) < 0.15).astype(np.float32),
+            "pod_sp_declares": np.zeros((p, 8), np.float32),
+            "pod_sp_matched": np.zeros((p, 8), np.float32),
+        }
+        args.append((accepted, choice, ranks, ps))
+
+    def run_all():
+        import jax.numpy as jnp
+
+        outs = []
+        for accepted, choice, ranks, ps in args:
+            o_np = C.constraint_filter(np, accepted, choice, ranks, ps, state, meta, hard_pa=False)
+            o_j = C.constraint_filter(
+                jnp,
+                jnp.asarray(accepted),
+                jnp.asarray(choice),
+                jnp.asarray(ranks),
+                {k: jnp.asarray(v) for k, v in ps.items()},
+                {k: jnp.asarray(v) for k, v in state.items()},
+                {k: jnp.asarray(v) for k, v in meta.items()},
+                hard_pa=False,
+            )
+            assert (np.asarray(o_j) == o_np).all()  # cross-backend, same branch
+            outs.append(o_np)
+        return outs
+
+    assert C._dense_ok(p, t * d)  # exactly AT the threshold: dense path
+    dense = run_all()
+    monkeypatch.setattr(C, "DENSE_CELLS", t * d - 1)
+    assert not C._dense_ok(p, t * d)  # one below: fused segment path
+    seg = run_all()
+    for a, b in zip(dense, seg):
+        assert (a == b).all()
+    assert any(a.any() for a in dense)  # non-vacuous: some pods survive
+    assert any((acc != a).any() for (acc, _c, _r, _ps), a in zip(args, dense))  # ...and some are filtered

@@ -65,10 +65,19 @@ class NativeBackend(SchedulingBackend):
         soft_pa = cons is not None and cons.n_ppa_terms > 0
         hard_pa = cons is not None and cons.n_pa_terms > 0
         if cons is not None:
-            from ..ops.constraints import blocked_block, constraint_commit, constraint_filter, round_blocked_masks
+            from ..ops.constraints import (
+                augment_round_state,
+                blocked_block,
+                constraint_commit,
+                constraint_filter,
+                round_blocked_masks,
+            )
 
             cmeta = cons.meta_arrays()
-            cstate = {k: v.copy() for k, v in cons.state_arrays().items()}
+            # Round-carried conflict state (spread water line, per-cell
+            # counts, PA bootstrap flags) — derived once, then updated
+            # incrementally by constraint_commit (ops/assign.py twin).
+            cstate = augment_round_state(np, {k: v.copy() for k, v in cons.state_arrays().items()}, cmeta)
             cpods = {k: v[perm] for k, v in cons.pod_arrays().items()}
         topo = packed.topology
         tmeta = gang_nodes = pod_gang = None
@@ -159,9 +168,15 @@ class NativeBackend(SchedulingBackend):
                     if cons is not None:
                         # Named separately under choose: measured (PERF.md
                         # "Reading an attribution profile") the within-round
-                        # conflict filter dominates constrained rounds.
+                        # conflict filter dominated constrained rounds at
+                        # ~99% of round wall before the round-7 active-set
+                        # fusion; ``spans=span`` opens the filter/aa|pa|
+                        # spread sub-spans so the attribution names WHICH
+                        # constraint family dominates, not just the filter.
                         with span("filter"):
-                            accepted = constraint_filter(np, accepted, choice, ranks, cpods, cstate, cmeta, hard_pa=hard_pa)
+                            accepted = constraint_filter(
+                                np, accepted, choice, ranks, cpods, cstate, cmeta, hard_pa=hard_pa, spans=span
+                            )
                         stall = 0 if accepted.any() else stall + 1
                         with span("commit"):
                             cstate = constraint_commit(

@@ -497,7 +497,12 @@ def assign_cycle(
     perm, ps = _prepare_pods(pods, block)
     p = ps["pod_req"].shape[0]
     if cmeta is not None:
-        cstate = {**cstate, "stall": jnp.int32(0)}
+        from .constraints import augment_round_state
+
+        # Round-carried conflict state (spread water line, per-cell counts,
+        # PA bootstrap flags) derived once at cycle start and updated
+        # incrementally by constraint_commit inside the round body.
+        cstate = {**augment_round_state(jnp, cstate, cmeta, hard_pa=hard_pa), "stall": jnp.int32(0)}
 
     body = _make_round_body(
         nodes, weights, block, use_pallas, pallas_interpret, cmeta, soft_spread, soft_pa, hard_pa, tmeta
@@ -667,7 +672,14 @@ def assign_cycle_epochs(
     p_pad = ps["pod_req"].shape[0]
     n_active = int(n_active_dev)
     rounds = jnp.int32(0)
-    cst = {**cstate, "stall": jnp.int32(0)} if cmeta is not None else cstate
+    if cmeta is not None:
+        from .constraints import augment_round_state
+
+        # Same round-carried conflict state as assign_cycle, derived once
+        # (eagerly — the carry structure must be stable across epochs).
+        cst = {**augment_round_state(jnp, cstate, cmeta, hard_pa=hard_pa), "stall": jnp.int32(0)}
+    else:
+        cst = cstate
     tst = tstate
     assigned_rank = jnp.full((p_pad,), -1, jnp.int32)
     acc_round_rank = jnp.full((p_pad,), -1, jnp.int32)

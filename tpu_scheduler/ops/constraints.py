@@ -52,6 +52,21 @@ Deferred pods stay active and retry next round against the committed state;
 the round-start choose mask blocks domains beyond the cascade's reach, so
 claimants target cells the filter can actually admit.
 
+Filter cost model (round 7): only ACCEPTED claimants can conflict, so
+``constraint_filter`` gathers them into a compact [A] workspace before any
+cell machinery runs (exact rows in NumPy; a stable accepted-first partition
+whose scans stop after ``ceil(A / ACTIVE_CHUNK)`` tiles under jit) and
+scatters survivors back — per-round filter cost tracks the accepted count,
+not the padded pod axis.  Per-pod cell lookups ride one banded gather
+matmul, the AA carrier/matched predecessor checks one fused segment
+scatter-min over a unified (term, coarse-domain ∪ node) cell space, and the
+spread water line / PA bootstrap flags are ROUND-CARRIED state
+(``augment_round_state``) updated incrementally by ``constraint_commit``
+instead of re-derived from the domain history every round.  All of it is
+bitwise-neutral: masses are exact small-integer f32, so dropping zero rows,
+banding independent matmul columns, and re-chunking prefix sums cannot
+change a single admission.
+
 Validity is *order-witnessed*: each round's kept set admits a sequential
 order in which every placement passes the scalar chain — ASCENDING RANK for
 both predicates: no conflicting AA pair survives at all, and a kept spread
@@ -75,6 +90,7 @@ is an accelerator, never a semantics change.
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
 
 import numpy as np
@@ -88,6 +104,7 @@ __all__ = [
     "UntensorizableConstraints",
     "pack_constraints",
     "prune_match_memo",
+    "augment_round_state",
     "round_blocked_masks",
     "blocked_block",
     "constraint_filter",
@@ -113,16 +130,20 @@ MAX_COARSE_DOMAINS = 256
 
 # Fast-path budget for the ANTI-AFFINITY within-round filter: below this
 # terms×D product, "who came earlier into my cell" is computed DENSELY — a
-# [P,T,D] exclusive cumsum along the (rank-ordered) pod axis — instead of
-# the scatter-min formulation.  On TPU through the tunnel the difference is
-# stark (measured at 53k pods: scalar scatter_min ~43 ms per round vs ~2-3
-# ms for the cumsum 3-tensor), because XLA lowers arbitrary-index scalar
-# scatters near-serially while cumsums ride the parallel prefix path.
-# Bit-identical results either way — counts are small exact f32 integers
-# and array order IS rank order.  (The SPREAD filter has no such split any
-# more: its rank-prefix admission always uses the cell formulation, chunked
-# along the pod axis when the byte budget below demands — see
-# _cell_rank_prefix.)
+# [A,T,D] exclusive cumsum along the (rank-ordered) pod axis of the ACTIVE
+# workspace (see constraint_filter) — instead of the fused scatter-min
+# formulation.  On TPU through the tunnel the difference is stark (measured
+# at 53k pods: scalar scatter_min ~43 ms per round vs ~2-3 ms for the cumsum
+# 3-tensor), because XLA lowers arbitrary-index scalar scatters near-serially
+# while cumsums ride the parallel prefix path; since the round-7 active-set
+# compaction the scatter index count tracks the accepted workspace, so the
+# fused segment path is the default at every real vocabulary and the dense
+# path survives for sub-budget term structures.  Bit-identical results
+# either way — counts are small exact f32 integers and array order IS rank
+# order (tests/test_constraints_tensor.py pins parity exactly at this
+# threshold).  (The SPREAD filter has no such split: its rank-prefix
+# admission always uses the cell formulation, chunked along the pod axis —
+# see _cell_rank_prefix.)
 DENSE_CELLS = 1024
 # The cells product alone does not bound the 3-tensor: its bytes scale with
 # the POD axis too (round-4 advisor finding — at 128k padded pods a
@@ -266,6 +287,14 @@ class ConstraintSet:
     sp_uses_dom: np.ndarray  # [S, D] float32
     sp_skew: np.ndarray  # [S] float32
     sps_uses_dom: np.ndarray  # [Ss, D] float32 — soft-spread constraint keys
+    # Spread-domain selection [D, Ds] one-hot: the Ds ≤ D coarse domains any
+    # HARD spread constraint references.  The filter's [·,S,D] cell passes
+    # project through it so their domain axis carries only spread-relevant
+    # columns (a zone-keyed cluster runs them at Ds=8 instead of the full
+    # padded vocabulary) — dropped columns have sp_uses_dom ≡ 0, so every
+    # product/min they fed was identically zero/INF and admissions are
+    # bitwise unchanged.
+    sp_dom_sel: np.ndarray
     # Initial state (from placed pods)
     aa_dom_m: np.ndarray  # [T, D] 0/1 — domain holds a pod matched by term
     aa_dom_c: np.ndarray  # [T, D] 0/1 — domain holds a carrier of term
@@ -307,6 +336,7 @@ class ConstraintSet:
             "sp_uses_dom": self.sp_uses_dom,
             "sp_skew": self.sp_skew,
             "sps_uses_dom": self.sps_uses_dom,
+            "sp_dom_sel": self.sp_dom_sel,
         }
 
     def state_arrays(self) -> dict:
@@ -506,6 +536,13 @@ def pack_constraints(
     for si, (key, (_ns, c)) in enumerate(sps_terms):
         for v in key_values.get(c.topology_key, ()):
             sps_uses_dom[si, dom_vocab[(c.topology_key, v)]] = 1.0
+    # Spread-domain selection (see the ConstraintSet field comment): one-hot
+    # columns for the domains any hard spread constraint references, padded
+    # to the label block so the filter's cell passes stay tile-aligned.
+    sp_cols = np.flatnonzero((sp_uses_dom > 0).any(axis=0))
+    ds_pad = round_up(max(len(sp_cols), 1), label_block)
+    sp_dom_sel = np.zeros((d_pad, ds_pad), dtype=np.float32)
+    sp_dom_sel[sp_cols, np.arange(len(sp_cols))] = 1.0
 
     # --- pod-side bitmaps -------------------------------------------------
     pod_aa_carries = np.zeros((padded_pods, t_pad), dtype=np.float32)
@@ -664,6 +701,7 @@ def pack_constraints(
         sp_uses_dom=sp_uses_dom,
         sp_skew=sp_skew,
         sps_uses_dom=sps_uses_dom,
+        sp_dom_sel=sp_dom_sel,
         aa_dom_m=aa_dom_m,
         aa_dom_c=aa_dom_c,
         aa_node_m=aa_node_m,
@@ -721,11 +759,19 @@ def round_blocked_masks(
     if hard_pa:
         pa_m_node = _clip01(xp, state["pa_dom_m"] @ ndc_t + state["pa_node_m"])
         pa_unmatched_node = 1.0 - pa_m_node
-        pa_inactive = (state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0  # [Ta]
+        # Round-carried bootstrap flags when the auction threads them
+        # (augment_round_state / constraint_commit); recompute otherwise.
+        pa_inactive = state.get("pa_inactive")
+        if pa_inactive is None:
+            pa_inactive = ((state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0).astype(xp.float32)
     uses = meta["sp_uses_dom"]
     counts = state["sp_counts"]
-    lo = xp.min(xp.where(uses > 0, counts, RANK_INF), axis=1)
-    lo = xp.where(lo >= RANK_INF, 0.0, lo)
+    # Round-carried water line when present — bitwise what this recompute
+    # yields (counts are exact integers), just not re-reduced every round.
+    lo = state.get("sp_lo")
+    if lo is None:
+        lo = xp.min(xp.where(uses > 0, counts, RANK_INF), axis=1)
+        lo = xp.where(lo >= RANK_INF, 0.0, lo)
     # Choose-time slack of CASCADE levels: the within-round admission filter
     # (constraint_filter) can raise the water line by up to CASCADE levels,
     # so domains within that reach are offered to declarers — otherwise the
@@ -749,7 +795,7 @@ def round_blocked_masks(
     }
     if hard_pa:
         masks["pa_unmatched_node"] = pa_unmatched_node
-        masks["pa_inactive"] = pa_inactive.astype(xp.float32)
+        masks["pa_inactive"] = pa_inactive
     if soft_spread:
         masks["sp_penalty_node"] = state["sps_counts"] @ ndc_t
     if soft_pa:
@@ -831,16 +877,37 @@ def _cell_chunk(p: int, cells: int) -> int:
     return max(256, DENSE_TENSOR_BYTES // (cells * 4))
 
 
-def _cell_rank_scan(xp, mass, nd, uses, out_fn):
+# Static pod-axis tile for the ACTIVE-SET cell passes under jit: the fused
+# filter compacts the round's accepted claimants into a workspace prefix and
+# the jnp cell scans run ``ceil(A / ACTIVE_CHUNK)`` tiles under a
+# dynamic-bound while_loop, so per-round filter cost tracks the accepted
+# count the way the size chain tracks actives (the NumPy oracle gathers the
+# exact [A] rows instead and needs no tiling).  Chunked and one-shot results
+# are bitwise equal (exact small-integer sums — pinned by
+# test_cell_rank_scan_chunked_equals_oneshot), so the constant is perf-only:
+# any value yields identical placements.
+ACTIVE_CHUNK = 256
+
+
+# shape: (mass: [P, S] f32, nd: [P, D] f32, uses: [S, D] f32, out_fn: fn,
+#   n_live: any) -> [P, S] f32
+def _cell_rank_scan(xp, mass, nd, uses, out_fn, n_live=None):
     """Shared chunked driver for the spread filter's exclusive-by-rank cell
     passes: feeds ``out_fn(ec3, m3)`` — ``ec3`` the [·,S,D] exclusive
     cumulative cell mass including all lower-rank pods, ``m3`` the same
     rows' own-cell one-hots — per pod-axis chunk and concatenates the [·,S]
-    outputs.  One-shot when [P,S,D] fits the byte budget; otherwise chunks
-    with an [S,D] carry (``lax.scan`` under jit, a plain loop in numpy —
-    the budget applies to BOTH backends, round-5 review finding).  Exact
-    small-integer sums, so chunked and one-shot results are bitwise equal —
-    cross-backend/stage parity depends on that."""
+    outputs.  Exact small-integer sums, so chunked and one-shot results are
+    bitwise equal — cross-backend/stage parity depends on that.
+
+    Without ``n_live``: one-shot when [P,S,D] fits the byte budget, else
+    chunks with an [S,D] carry (``lax.scan`` under jit, a plain loop in
+    numpy — the budget applies to BOTH backends, round-5 review finding).
+
+    With ``n_live`` (jit active-set path — rows beyond it must carry zero
+    mass): a while_loop over ``ceil(n_live / ACTIVE_CHUNK)`` tiles, leaving
+    later tiles' outputs at zero — their rows are exactly the non-accepted
+    workspace tail the filter masks out anyway, so cost tracks the live
+    count without a shape-dependent semantic."""
     p, s = mass.shape
     d = nd.shape[1]
 
@@ -850,32 +917,59 @@ def _cell_rank_scan(xp, mass, nd, uses, out_fn):
         ec3 = carry[None, :, :] + xp.cumsum(c3, axis=0) - c3
         return carry + c3.sum(axis=0), out_fn(ec3, m3)
 
-    chunk = _cell_chunk(p, s * d)
-    if chunk == 0:
+    if xp is np or n_live is None:
+        chunk = _cell_chunk(p, s * d)
+        if chunk == 0:
+            return step(xp.zeros((s, d), xp.float32), mass, nd)[1]
+        pad = (-p) % chunk
+        mass_c = xp.pad(mass, ((0, pad), (0, 0))).reshape(-1, chunk, s)
+        nd_c = xp.pad(nd, ((0, pad), (0, 0))).reshape(-1, chunk, d)
+        if xp is np:
+            carry = np.zeros((s, d), np.float32)
+            outs = []
+            for k in range(mass_c.shape[0]):
+                carry, out = step(carry, mass_c[k], nd_c[k])
+                outs.append(out)
+            return np.concatenate(outs, axis=0)[:p]
+        from jax import lax
+
+        _, outs = lax.scan(lambda c, inp: step(c, *inp), xp.zeros((s, d), xp.float32), (mass_c, nd_c))
+        return outs.reshape(-1, s)[:p]
+
+    chunk = min(p, ACTIVE_CHUNK)
+    if chunk >= p:
         return step(xp.zeros((s, d), xp.float32), mass, nd)[1]
+    from jax import lax
+
     pad = (-p) % chunk
     mass_c = xp.pad(mass, ((0, pad), (0, 0))).reshape(-1, chunk, s)
     nd_c = xp.pad(nd, ((0, pad), (0, 0))).reshape(-1, chunk, d)
-    if xp is np:
-        carry = np.zeros((s, d), np.float32)
-        outs = []
-        for k in range(mass_c.shape[0]):
-            carry, out = step(carry, mass_c[k], nd_c[k])
-            outs.append(out)
-        return np.concatenate(outs, axis=0)[:p]
-    from jax import lax
+    k_live = (n_live.astype(xp.int32) + chunk - 1) // chunk
 
-    _, outs = lax.scan(lambda c, inp: step(c, *inp), xp.zeros((s, d), xp.float32), (mass_c, nd_c))
+    def cond(st):
+        return st[0] < k_live
+
+    def body(st):
+        k, carry, outs = st
+        carry, out = step(carry, mass_c[k], nd_c[k])
+        return k + 1, carry, outs.at[k].set(out)
+
+    _, _, outs = lax.while_loop(
+        cond, body, (xp.int32(0), xp.zeros((s, d), xp.float32), xp.zeros(mass_c.shape, xp.float32))
+    )
     return outs.reshape(-1, s)[:p]
 
 
-def _cell_rank_prefix(xp, mass, nd, uses):
+# shape: (mass: [P, S] f32, nd: [P, D] f32, uses: [S, D] f32, n_live: any) -> [P, S] f32
+def _cell_rank_prefix(xp, mass, nd, uses, n_live=None):
     """[P,S] exclusive-by-rank (array order) mass before each pod in its own
     (s, domain) cell — the quota prefix."""
-    return _cell_rank_scan(xp, mass, nd, uses, lambda ec3, m3: (ec3 * m3).sum(axis=2))
+    return _cell_rank_scan(xp, mass, nd, uses, lambda ec3, m3: (ec3 * m3).sum(axis=2), n_live=n_live)
 
 
-def _cell_rank_min_level(xp, mass, nd, uses, base):
+# shape: (mass: [P, S] f32, nd: [P, D] f32, uses: [S, D] f32, base: [S, D] f32,
+#   n_live: any) -> [P, S] f32
+def _cell_rank_min_level(xp, mass, nd, uses, base, n_live=None):
     """[P,S] per-pod water line: min over the constraint's used domains of
     ``base`` plus the exclusive-by-rank fill of ``mass`` — the cascade's
     lower bound on the minimum count at each pod's witness-order turn."""
@@ -885,63 +979,181 @@ def _cell_rank_min_level(xp, mass, nd, uses, base):
         lo = xp.min(lvl, axis=2)
         return xp.where(lo >= RANK_INF, 0.0, lo)
 
-    return _cell_rank_scan(xp, mass, nd, uses, out_fn)
+    return _cell_rank_scan(xp, mass, nd, uses, out_fn, n_live=n_live)
+
+
+# shape: (nd: [A, D] f32, uses_sp: [S, D] f32, sp0: [S, D] f32, sel: [D, C] f32)
+#   -> ([A, C] f32, [S, C] f32, [S, C] f32)
+def _project_spread_domains(xp, nd, uses_sp, sp0, sel):
+    """Project the spread cell operands onto the pack-time spread-domain
+    selection (``ConstraintSet.sp_dom_sel``): the [·,S,D] cell passes then
+    carry only the C ≤ D domains a hard spread constraint references.
+    One-hot selection of exact small-integer columns — bitwise-neutral."""
+    return nd @ sel, uses_sp @ sel, sp0 @ sel
+
+
+# Stateless reusable no-op span context for the fused filter's family
+# sub-phases: the jit path (and any caller without a tracer) pays nothing,
+# while backends/native.py passes utils.tracing.span so the NumPy oracle's
+# attribution profile splits ``choose/filter`` into filter/aa|pa|spread.
+_NULL_SPAN_CTX = contextlib.nullcontext()
+
+
+# shape: (name: str) -> obj
+def _null_span(name):
+    return _NULL_SPAN_CTX
+
+
+# shape: (state: dict, meta: dict, hard_pa: bool) -> dict
+def augment_round_state(xp, state: dict, meta: dict, hard_pa: bool = True) -> dict:
+    """Derive the ROUND-CARRIED conflict-state entries from a cycle-start
+    constraint state: ``sp_cell`` ([S,D] per-cell counts masked to used
+    domains), ``sp_lo`` ([S] spread water line) and ``pa_inactive`` ([Ta]
+    positive-affinity bootstrap flags).  The auction threads them through
+    its while-loop carry and :func:`constraint_commit` updates them
+    INCREMENTALLY from each round's commits, so neither the choose-mask
+    build nor the conflict filter re-derives them from the accumulated
+    domain history every round.  Values are bitwise what the per-round
+    recompute produced (counts are exact small-integer f32), so carried and
+    recomputed cycles place identically — the fallback recompute survives in
+    the consumers for legacy callers handing in a bare state dict."""
+    uses = meta["sp_uses_dom"]
+    sp_cell = state["sp_counts"] * uses
+    lo = xp.min(xp.where(uses > 0, sp_cell, RANK_INF), axis=1)
+    lo = xp.where(lo >= RANK_INF, 0.0, lo)
+    out = {**state, "sp_cell": sp_cell, "sp_lo": lo}
+    out["pa_inactive"] = ((state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0).astype(xp.float32)
+    return out
 
 
 # shape: (accepted: [P] bool, choice: [P] i32, ranks: [P] u32, ps: dict,
-#   state: dict, meta: dict, hard_pa: bool) -> [P] bool
-def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: dict, hard_pa: bool = True) -> object:
+#   state: dict, meta: dict, hard_pa: bool, spans: fn) -> [P] bool
+def constraint_filter(
+    xp, accepted, choice, ranks, ps: dict, state: dict, meta: dict, hard_pa: bool = True, spans=None
+) -> object:
     """Within-round conflict resolution — returns the surviving subset of
-    ``accepted`` (see module docstring for the rank rules)."""
+    ``accepted`` (see module docstring for the rank rules).
+
+    ACTIVE-SET COMPACTION (round 7): only the round's accepted claimants can
+    conflict — every mass the filter consumes is ``accepted``-gated and a
+    non-accepted row's verdict is discarded — so the filter gathers accepted
+    rows into a compact workspace before any cell machinery runs, and
+    scatters survivors back at the end.  NumPy gathers the exact [A] rows;
+    under jit the workspace is a stable accepted-first permutation of the
+    (static-size) pod arrays whose cell scans stop after
+    ``ceil(A / ACTIVE_CHUNK)`` tiles, so both backends' per-round filter
+    cost tracks the accepted count instead of the padded pod axis.  Sums
+    and mins over the dropped all-zero rows are exact no-ops, so compacted
+    and full-width filtering are bitwise identical.
+
+    ``spans`` (optional ``name -> context-manager``, e.g.
+    utils.tracing.span) opens the ``aa`` / ``pa`` / ``spread`` sub-spans
+    around the three constraint families so an attribution profile names
+    WHICH family dominates; the default is a shared no-op context.
+    """
+    sp_span = spans if spans is not None else _null_span
+    p = accepted.shape[0]
     ndc = meta["node_dom_c"]
     d = ndc.shape[1]
     n = ndc.shape[0]
-    nd = ndc[choice]  # [P, D] one-hot domains of each pod's chosen node
-    accf = accepted.astype(xp.float32)
-    rank_f = ranks.astype(xp.float32)
+
+    # ---- active-set workspace --------------------------------------------
+    ws_keys = ["pod_aa_carries", "pod_aa_matched", "pod_sp_declares", "pod_sp_matched"]
+    if hard_pa:
+        ws_keys += ["pod_pa_declares", "pod_pa_matched"]
+    if xp is np:
+        gperm = np.flatnonzero(accepted)
+        if gperm.size == 0:
+            return accepted.copy()
+        n_live = None  # exact [A] rows — the scans need no tile bound
+        acc_ws = np.ones((gperm.size,), dtype=bool)
+    else:
+        # Stable accepted-first partition (the _compact cumsum trick): the
+        # gather permutation keeps relative order, so workspace array order
+        # is still rank order and every prefix/min below is unchanged.
+        acc_i = accepted.astype(xp.int32)
+        n_acc = acc_i.sum()
+        pos_acc = xp.cumsum(acc_i) - acc_i
+        pos_rej = xp.cumsum(1 - acc_i) - (1 - acc_i)
+        dest = xp.where(accepted, pos_acc, n_acc + pos_rej)
+        gperm = xp.zeros((p,), xp.int32).at[dest].set(xp.arange(p, dtype=xp.int32))
+        n_live = n_acc
+        acc_ws = accepted[gperm]
+    choice_ws = choice[gperm]
+    rank_f = ranks[gperm].astype(xp.float32)
+    pw = {k: ps[k][gperm] for k in ws_keys}
+    nd = ndc[choice_ws]  # [A, D] one-hot domains of each accepted pod's node
+    accf = acc_ws.astype(xp.float32)
+
+    uses = meta["term_uses_dom"]  # [T, D]
+    uses_sp = meta["sp_uses_dom"]  # [S, D]
+    t = uses.shape[0]
+    sp0 = state.get("sp_cell")
+    if sp0 is None:  # legacy caller without the round-carried state
+        sp0 = state["sp_counts"] * uses_sp
+    # ONE fused gather matmul for every per-pod cell lookup: AA coarse-key
+    # flags + coarse cell ids, spread key flags + own-cell round-start
+    # counts ride a single banded [A,D] @ [D, 2T+2S] dispatch instead of
+    # four.  Each output column is an independent exact small-integer dot,
+    # so banding is bitwise-neutral.
+    dom_ids = xp.arange(d, dtype=xp.float32)
+    band = xp.concatenate([uses, uses * dom_ids[None, :], uses_sp, sp0], axis=0)  # [2T+2S, D]
+    g_all = nd @ band.T  # [A, 2T+2S]
+    has_c = g_all[:, :t]  # [A, T] 1 if the chosen node has the term's coarse key
+    cc = g_all[:, t : 2 * t]  # [A, T] coarse cell id (sum of ≤1 one-hot)
+    s_sp = uses_sp.shape[0]
+    in_cell = g_all[:, 2 * t : 2 * t + s_sp]  # [A, S] 1 iff node carries the key
+    c_at = g_all[:, 2 * t + s_sp :]  # [A, S] own-cell round-start count
 
     # ---- anti-affinity ----------------------------------------------------
     # Rule: in each (term, cell) — cell = coarse domain when the chosen node
     # carries the term's key, else the node itself — a matched pod survives
     # only if no earlier-rank accepted carrier shares the cell, and vice
     # versa.  "Earlier rank" ≡ earlier array index (pods are compacted in
-    # priority-rank order), so existence-of-a-predecessor is an exclusive
-    # cumsum along the pod axis on the dense path, and a min-rank reduction
-    # on the fallback path — identical outcomes by construction.
-    uses = meta["term_uses_dom"]  # [T, D]
-    t = uses.shape[0]
-    has_c = nd @ uses.T  # [P, T] 1 if the chosen node has the term's coarse key
-    carr = ps["pod_aa_carries"] * accf[:, None]
-    matc = ps["pod_aa_matched"] * accf[:, None]
-    if _dense_ok(nd.shape[0], t * d):
-        m3 = nd[:, None, :] * uses[None, :, :]  # [P,T,D] one-hot coarse cell under t
+    # priority-rank order), so existence-of-a-predecessor is ONE fused
+    # min-rank segment scatter over the unified (term, cell) id space —
+    # coarse domains and fine (per-node) cells share the space, and the
+    # carrier/matched tables ride a single offset dispatch — with a dense
+    # [A,T,D] exclusive-cumsum path below the DENSE_CELLS budget; identical
+    # outcomes by construction (pinned at the threshold by
+    # test_dense_boundary_parity).
+    with sp_span("aa"):
+        carr = pw["pod_aa_carries"] * accf[:, None]
+        matc = pw["pod_aa_matched"] * accf[:, None]
+        if _dense_ok(nd.shape[0], t * d):
+            m3 = nd[:, None, :] * uses[None, :, :]  # [A,T,D] one-hot coarse cell under t
 
-        def _earlier_in_cell(v):  # [P,T] 0/1 → [P,T] "an earlier v-pod shares my coarse cell"
-            v3 = v[:, :, None] * m3
-            ec = xp.cumsum(v3, axis=0) - v3  # exclusive
-            return (ec * m3).sum(axis=2) > 0
+            def _earlier_in_cell(v):  # [A,T] 0/1 → [A,T] "an earlier v-pod shares my coarse cell"
+                v3 = v[:, :, None] * m3
+                ec = xp.cumsum(v3, axis=0) - v3  # exclusive
+                return (ec * m3).sum(axis=2) > 0
 
-        fine = has_c == 0
-        carr_c, matc_c = carr * has_c, matc * has_c
-        # Fine cells: min accepted rank per (node, term) via one row scatter.
-        min_c_fine = _row_scatter_min(xp, n, choice, xp.where((carr * fine) > 0, rank_f[:, None], RANK_INF))
-        min_m_fine = _row_scatter_min(xp, n, choice, xp.where((matc * fine) > 0, rank_f[:, None], RANK_INF))
-        earlier_c = _earlier_in_cell(carr_c) | (fine & (rank_f[:, None] > min_c_fine[choice]))
-        earlier_m = _earlier_in_cell(matc_c) | (fine & (rank_f[:, None] > min_m_fine[choice]))
-        bad_aa = ((matc > 0) & earlier_c) | ((carr > 0) & earlier_m)
-    else:
-        cells = d + n
-        dom_ids = xp.arange(d, dtype=xp.float32)
-        cc = nd @ (uses * dom_ids[None, :]).T  # [P, T] coarse cell id (sum of ≤1 one-hot)
-        cell = xp.where(has_c > 0, cc, d + choice[:, None].astype(xp.float32))
-        g = (xp.arange(t, dtype=xp.float32)[None, :] * cells + cell).astype(xp.int32)  # [P, T]
-        gf = g.reshape(-1)
-        min_carrier = _scatter_min(xp, t * cells, gf, xp.where(carr > 0, rank_f[:, None], RANK_INF).reshape(-1))
-        min_matched = _scatter_min(xp, t * cells, gf, xp.where(matc > 0, rank_f[:, None], RANK_INF).reshape(-1))
-        min_c_at = min_carrier[g]  # [P, T]
-        min_m_at = min_matched[g]
-        bad_aa = ((matc > 0) & (rank_f[:, None] > min_c_at)) | ((carr > 0) & (rank_f[:, None] > min_m_at))
-    keep = accepted & ~bad_aa.any(axis=1)
+            fine = has_c == 0
+            carr_c, matc_c = carr * has_c, matc * has_c
+            # Fine cells: min accepted rank per (node, term) via one row scatter.
+            min_c_fine = _row_scatter_min(xp, n, choice_ws, xp.where((carr * fine) > 0, rank_f[:, None], RANK_INF))
+            min_m_fine = _row_scatter_min(xp, n, choice_ws, xp.where((matc * fine) > 0, rank_f[:, None], RANK_INF))
+            earlier_c = _earlier_in_cell(carr_c) | (fine & (rank_f[:, None] > min_c_fine[choice_ws]))
+            earlier_m = _earlier_in_cell(matc_c) | (fine & (rank_f[:, None] > min_m_fine[choice_ws]))
+            bad_aa = ((matc > 0) & earlier_c) | ((carr > 0) & earlier_m)
+        else:
+            cells = d + n
+            cell = xp.where(has_c > 0, cc, d + choice_ws[:, None].astype(xp.float32))
+            g = (xp.arange(t, dtype=xp.float32)[None, :] * cells + cell).astype(xp.int32)  # [A, T]
+            # Fused dispatch: carrier mins in [0, t·cells), matched mins
+            # offset by t·cells — ONE segment scatter-min, two gathers.
+            gf2 = xp.concatenate([g.reshape(-1), (g + t * cells).reshape(-1)])
+            vals2 = xp.concatenate(
+                [
+                    xp.where(carr > 0, rank_f[:, None], RANK_INF).reshape(-1),
+                    xp.where(matc > 0, rank_f[:, None], RANK_INF).reshape(-1),
+                ]
+            )
+            mins = _scatter_min(xp, 2 * t * cells, gf2, vals2)
+            min_c_at = mins[g]  # [A, T]
+            min_m_at = mins[g + t * cells]
+            bad_aa = ((matc > 0) & (rank_f[:, None] > min_c_at)) | ((carr > 0) & (rank_f[:, None] > min_m_at))
+        keep = acc_ws & ~bad_aa.any(axis=1)
 
     # ---- positive affinity bootstrap (within-round) -----------------------
     # A term inactive at round start was waived for self-matching declarers
@@ -952,15 +1164,22 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     # it.  Keep the min-rank accepted match; defer other waived declarers
     # one round (the term is then active and the round-start mask routes
     # them to its domain).  Over-inclusive min (it counts matches a later
-    # filter may drop) only defers more — never admits a violation.
+    # filter may drop) only defers more — never admits a violation.  This
+    # family cannot ride the AA segment scatter: its min is over the
+    # POST-AA keep set, a sequential dependency.
     if hard_pa:
-        pa_inactive_f = ((state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0).astype(xp.float32)
-        keep_pa_f = keep.astype(xp.float32)
-        pa_m_acc = ps["pod_pa_matched"] * keep_pa_f[:, None]  # [P, Ta]
-        min_match_rank = xp.min(xp.where(pa_m_acc > 0, rank_f[:, None], RANK_INF), axis=0)  # [Ta]
-        waived = ps["pod_pa_declares"] * ps["pod_pa_matched"] * pa_inactive_f[None, :]  # [P, Ta]
-        bad_pa = (waived > 0) & keep[:, None] & (rank_f[:, None] > min_match_rank[None, :])
-        keep = keep & ~bad_pa.any(axis=1)
+        with sp_span("pa"):
+            pa_inactive_f = state.get("pa_inactive")
+            if pa_inactive_f is None:  # legacy caller without the carry
+                pa_inactive_f = (
+                    (state["pa_dom_m"].sum(axis=1) + state["pa_node_m"].sum(axis=1)) == 0
+                ).astype(xp.float32)
+            keep_pa_f = keep.astype(xp.float32)
+            pa_m_acc = pw["pod_pa_matched"] * keep_pa_f[:, None]  # [A, Ta]
+            min_match_rank = xp.min(xp.where(pa_m_acc > 0, rank_f[:, None], RANK_INF), axis=0)  # [Ta]
+            waived = pw["pod_pa_declares"] * pw["pod_pa_matched"] * pa_inactive_f[None, :]  # [A, Ta]
+            bad_pa = (waived > 0) & keep[:, None] & (rank_f[:, None] > min_match_rank[None, :])
+            keep = keep & ~bad_pa.any(axis=1)
 
     # ---- topology spread (rank-prefix admission + in-round cascade) -------
     # The scalar rule (core/predicates.make_spread_checker): placing a
@@ -983,49 +1202,66 @@ def constraint_filter(xp, accepted, choice, ranks, ps: dict, state: dict, meta: 
     # cells=0" for all eight fixpoint iterations).  The rank prefix breaks
     # the deadlock structurally: the lowest-rank candidate of an open cell
     # always admits.
-    uses_sp = meta["sp_uses_dom"]  # [S, D]
-    skew = meta["sp_skew"]  # [S]
-    declares, matched = ps["pod_sp_declares"], ps["pod_sp_matched"]
-    in_cell = nd @ uses_sp.T  # [P, S] 1 iff chosen node carries the key
-    keep_f = keep.astype(xp.float32)
-    # Candidate matched mass: every post-AA/PA survivor whose chosen node
-    # carries the key and whose labels match the selector — non-declarers
-    # (they commit unconditionally; nothing after this filter drops them)
-    # and declarers (they commit iff admitted below) ride ONE prefix.
-    cand_m = keep_f[:, None] * matched * in_cell  # [P, S]
-    decl_cell = keep_f[:, None] * declares * in_cell  # declarers on keyed nodes
-    sp0 = state["sp_counts"] * uses_sp  # round-start counts (padded cols zeroed)
-    c_at = nd @ sp0.T  # [P, S] own-cell round-start count
+    with sp_span("spread"):
+        skew = meta["sp_skew"]  # [S]
+        declares, matched = pw["pod_sp_declares"], pw["pod_sp_matched"]
+        keep_f = keep.astype(xp.float32)
+        # Candidate matched mass: every post-AA/PA survivor whose chosen node
+        # carries the key and whose labels match the selector — non-declarers
+        # (they commit unconditionally; nothing after this filter drops them)
+        # and declarers (they commit iff admitted below) ride ONE prefix.
+        cand_m = keep_f[:, None] * matched * in_cell  # [A, S]
+        decl_cell = keep_f[:, None] * declares * in_cell  # declarers on keyed nodes
 
-    lo0 = xp.min(xp.where(uses_sp > 0, sp0, RANK_INF), axis=1)
-    lo0 = xp.where(lo0 >= RANK_INF, 0.0, lo0)  # [S] round-start water line
+        lo0 = state.get("sp_lo")
+        if lo0 is None:  # legacy caller without the round-carried state
+            lo0 = xp.min(xp.where(uses_sp > 0, sp0, RANK_INF), axis=1)
+            lo0 = xp.where(lo0 >= RANK_INF, 0.0, lo0)  # [S] round-start water line
 
-    # ONE spread formulation for every size: the [P,S,D] cell passes run
-    # one-shot when they fit the byte budget and pod-axis CHUNKED otherwise
-    # (exact small-integer sums — bitwise identical either way).  No
-    # pod-count- or backend-dependent branch: the jit size chain runs this
-    # filter at several static pod sizes and the native backend at one, so
-    # any shape-dependent semantic would break cross-backend bit-parity.
-    pre_all = _cell_rank_prefix(xp, cand_m, nd, uses_sp)  # [P,S] mass before p in own cell
+        # Domain-axis projection: the cell passes only ever touch domains a
+        # spread constraint references, so they run on the [D, Ds] pack-time
+        # selection (sp_dom_sel) — dropped columns were identically zero in
+        # every product and RANK_INF in every min, so admissions are bitwise
+        # unchanged while a zone-keyed cluster's passes shrink ~D/Ds-fold.
+        sel = meta.get("sp_dom_sel")
+        if sel is None:  # legacy caller without the selection tensor
+            nd_sp, uses_spc, sp0c = nd, uses_sp, sp0
+        else:
+            nd_sp, uses_spc, sp0c = _project_spread_domains(xp, nd, uses_sp, sp0, sel)
+        # ONE spread formulation for every size: the [A,S,Ds] cell passes
+        # run one-shot when they fit the byte budget and pod-axis CHUNKED
+        # otherwise (exact small-integer sums — bitwise identical either
+        # way).  No pod-count- or backend-dependent SEMANTIC: the jit size
+        # chain runs this filter at several static pod sizes and the native
+        # backend at one, so admission must never depend on the stage shape.
+        pre_all = _cell_rank_prefix(xp, cand_m, nd_sp, uses_spc, n_live=n_live)  # [A,S] mass before p in own cell
 
-    bound = c_at + pre_all + 1.0  # [P, S] count-after-placement upper bound
-    lo_p = xp.zeros_like(c_at) + lo0[None, :]
-    admit = bound <= (skew[None, :] + lo_p)
-    # In-round water-line cascade.  Each sweep recomputes, per pod, the min
-    # over the constraint's domains of round-start counts plus the COMMITTED
-    # fills of lower rank — commits from the previous sweep's admissions,
-    # which only grow (admit is OR-accumulated), so every sweep is sound: a
-    # kept pod's witness-order turn really does see those lower-rank commits
-    # placed.  One sweep lifts the line one level; SPREAD_CASCADE sweeps
-    # admit a whole multi-level wave per round instead of one level per
-    # ROUND.
-    for _ in range(SPREAD_CASCADE):
-        rejected = ((decl_cell > 0) & ~admit).any(axis=1)
-        committed_pod = keep_f * (1.0 - rejected.astype(xp.float32))  # [P]
-        lo_p = _cell_rank_min_level(xp, cand_m * committed_pod[:, None], nd, uses_sp, sp0)
-        admit = admit | (bound <= (skew[None, :] + lo_p))
-    bad_sp = (decl_cell > 0) & ~admit
-    return keep & ~bad_sp.any(axis=1)
+        bound = c_at + pre_all + 1.0  # [A, S] count-after-placement upper bound
+        lo_p = xp.zeros_like(c_at) + lo0[None, :]
+        admit = bound <= (skew[None, :] + lo_p)
+        # In-round water-line cascade.  Each sweep recomputes, per pod, the
+        # min over the constraint's domains of round-start counts plus the
+        # COMMITTED fills of lower rank — commits from the previous sweep's
+        # admissions, which only grow (admit is OR-accumulated), so every
+        # sweep is sound: a kept pod's witness-order turn really does see
+        # those lower-rank commits placed.  One sweep lifts the line one
+        # level; SPREAD_CASCADE sweeps admit a whole multi-level wave per
+        # round instead of one level per ROUND.
+        for _ in range(SPREAD_CASCADE):
+            rejected = ((decl_cell > 0) & ~admit).any(axis=1)
+            committed_pod = keep_f * (1.0 - rejected.astype(xp.float32))  # [A]
+            lo_p = _cell_rank_min_level(xp, cand_m * committed_pod[:, None], nd_sp, uses_spc, sp0c, n_live=n_live)
+            admit = admit | (bound <= (skew[None, :] + lo_p))
+        bad_sp = (decl_cell > 0) & ~admit
+        keep = keep & ~bad_sp.any(axis=1)
+
+    # ---- scatter survivors back ------------------------------------------
+    if xp is np:
+        out = np.zeros_like(accepted)
+        out[gperm] = keep
+        return out
+    full = xp.zeros_like(accepted).at[gperm].set(keep)
+    return accepted & full
 
 
 # shape: (accepted: [P] bool, choice: [P] i32, ps: dict, state: dict,
@@ -1086,7 +1322,7 @@ def constraint_commit(
         sps_counts = state["sps_counts"] + (sps_m.T @ nd) * meta["sps_uses_dom"]
     else:
         sps_counts = state["sps_counts"]
-    return {
+    out = {
         "aa_dom_m": aa_dom_m,
         "aa_dom_c": aa_dom_c,
         "aa_node_m": aa_node_m,
@@ -1098,3 +1334,26 @@ def constraint_commit(
         "sp_counts": sp_counts,
         "sps_counts": sps_counts,
     }
+    # Round-carried conflict state (augment_round_state): updated HERE from
+    # the round's commits instead of re-derived from the accumulated domain
+    # history next round.  ``sp_cell``/``sp_lo`` re-reduce the just-updated
+    # [S,D] counts (domain-granular — a rounding error next to the pod
+    # tensors); ``pa_inactive`` flips per-term the moment any accepted match
+    # commits (exactly when the pa_dom_m/pa_node_m sums leave zero: a
+    # matched accepted pod lands in its domain when the node carries the
+    # key, in its node row otherwise — either way the sum grows).  Dict
+    # membership gates the update so legacy callers handing in a bare
+    # state_arrays() dict keep the old contract.
+    if "sp_cell" in state:
+        uses_sp = meta["sp_uses_dom"]
+        sp_cell = sp_counts * uses_sp
+        lo = xp.min(xp.where(uses_sp > 0, sp_cell, RANK_INF), axis=1)
+        out["sp_cell"] = sp_cell
+        out["sp_lo"] = xp.where(lo >= RANK_INF, 0.0, lo)
+    if "pa_inactive" in state:
+        if hard_pa:
+            newly_matched = (matc_pa.sum(axis=0) > 0).astype(xp.float32)  # [Ta]
+            out["pa_inactive"] = state["pa_inactive"] * (1.0 - newly_matched)
+        else:
+            out["pa_inactive"] = state["pa_inactive"]
+    return out

@@ -117,7 +117,14 @@ def build_node_info(node_avail, node_alloc, node_valid):
 def constrained_kernel_node_operands(pods: dict, masks: dict, n_nodes: int):
     """(six node-side kernel operands, pa_inactive) from one round's
     blocked/penalty masks (ops/constraints.round_blocked_masks, node axis
-    already sliced to this shard where applicable).
+    already sliced to this shard where applicable).  Since round 7 the
+    masks derive from the ROUND-CARRIED conflict state (spread water line,
+    PA bootstrap flags threaded through the auction carry and updated by
+    constraint_commit) rather than per-round re-reductions — bitwise the
+    same operand values, so the constrained kernel variant needs no new
+    refs and its parity contract is untouched; the fused active-set filter
+    itself is an ACCEPT-phase rewrite and stays outside the choose kernel
+    by design.
 
     THE one source of truth for the zero-fill convention: features absent
     from the cycle (no hard PA / soft spread / preferred terms) become

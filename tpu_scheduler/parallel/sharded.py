@@ -132,6 +132,7 @@ CONSTRAINT_KEYS = (
     "sp_uses_dom",
     "sp_skew",
     "sps_uses_dom",
+    "sp_dom_sel",
     # initial state (aa_node_* / pa_node_m are [·,N] padded to the tp multiple)
     "aa_dom_m",
     "aa_dom_c",
@@ -145,7 +146,7 @@ CONSTRAINT_KEYS = (
     "sps_counts",
 )
 _N_PODKEYS = 10
-_N_METAKEYS = 7
+_N_METAKEYS = 8
 
 
 @lru_cache(maxsize=64)
@@ -191,12 +192,21 @@ def _build_shard_map(
             pref_t, tsoft_t = node_pref.T, node_taints_soft.T
 
         if constrained:
-            from ..ops.constraints import blocked_block, constraint_commit, constraint_filter, round_blocked_masks
+            from ..ops.constraints import (
+                augment_round_state,
+                blocked_block,
+                constraint_commit,
+                constraint_filter,
+                round_blocked_masks,
+            )
 
             named = dict(zip(CONSTRAINT_KEYS, cargs))
             cpods = {k: named[k] for k in CONSTRAINT_KEYS[:_N_PODKEYS]}
             cmeta = {k: named[k] for k in CONSTRAINT_KEYS[_N_PODKEYS : _N_PODKEYS + _N_METAKEYS]}
             cst0 = {k: named[k] for k in CONSTRAINT_KEYS[_N_PODKEYS + _N_METAKEYS :]}
+            # Round-carried conflict state, replicated like the rest of the
+            # constraint carry (ops/assign.py twin).
+            cst0 = augment_round_state(jnp, cst0, cmeta, hard_pa=hard_pa)
             cst0["stall"] = jnp.int32(0)
             # This device's dp rows of the (replicated) pod bitmaps.
             blk_l = {k: lax.dynamic_slice_in_dim(v, dp_idx * p_local, p_local) for k, v in cpods.items()}
@@ -389,7 +399,7 @@ def constraint_operands(cons, n_pad_from: int, n_pad_to: int) -> dict:
     meta = cons.meta_arrays()
     state = cons.state_arrays()
     ops["node_dom_c"] = np.pad(meta["node_dom_c"], ((0, extra), (0, 0)))
-    for k in ("term_uses_dom", "pa_uses_dom", "ppa_uses_dom", "sp_uses_dom", "sp_skew", "sps_uses_dom"):
+    for k in ("term_uses_dom", "pa_uses_dom", "ppa_uses_dom", "sp_uses_dom", "sp_skew", "sps_uses_dom", "sp_dom_sel"):
         ops[k] = meta[k]
     for k in ("aa_dom_m", "aa_dom_c", "pa_dom_m", "ppa_dom_cnt", "sp_counts", "sps_counts"):
         ops[k] = state[k]

@@ -83,6 +83,9 @@ SPAN_CATALOGUE = (
     "score",       # per-round feasibility + scoring sweep
     "choose",      # per-round claim/accept/commit
     "filter",      # choose sub-span: within-round constraint conflict filter
+    "aa",          # filter sub-span: fused anti-affinity predecessor check
+    "pa",          # filter sub-span: positive-affinity bootstrap min-rank
+    "spread",      # filter sub-span: spread rank-prefix admission + cascade
     "commit",      # choose sub-span: domain-state commit of accepted claims
     "epoch",       # one epoch of the host-driven size-shrinking driver
     "dispatch",    # epoch dispatch (async jit call; Python + trace time)
