@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke clean
 
-check: lint test profile-smoke constrained-smoke
+check: lint test profile-smoke constrained-smoke delta-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -46,6 +46,13 @@ profile-smoke:
 # (scripts/constrained_smoke.py).
 constrained-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.constrained_smoke
+
+# The incremental-engine gate: the churn-steady-state scenario must pass
+# with the scorecard incremental block green (delta cycles the default,
+# zero shadow-solve parity mismatches) plus a delta-vs-full budget check on
+# a downscaled synthetic cluster (scripts/delta_smoke.py).
+delta-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.delta_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

@@ -26,6 +26,10 @@ REPL  — every shard/replica lease-name prefix (``runtime/shards.py``
 PROF  — every profiler span name (``utils/profiler.SPAN_CATALOGUE``) and
         SLO tier (``utils/profiler.SLO_TIERS``) must appear in the README
         "Profiling" catalogue; metric names ride the METR gate as usual.
+DLTA  — every full-wave escalation trigger
+        (``delta/engine.ESCALATION_REASONS``) and incremental-scorecard
+        field (``sim/scorecard.INCREMENTAL_FIELDS``) must appear in the
+        README "Incremental scheduling" catalogue.
 """
 
 from __future__ import annotations
@@ -43,6 +47,7 @@ CODES = {
     "TOPO": "a topology distance level/label key/scoring knob/scenario missing from the README \"Topology & gang placement\" catalogue",
     "REPL": "a shard lease prefix/availability field/multi-replica scenario missing from the README \"Multi-replica & failover\" catalogue",
     "PROF": "a profiler span name/SLO tier missing from the README \"Profiling\" catalogue",
+    "DLTA": "a delta-engine escalation trigger/incremental scorecard field missing from the README \"Incremental scheduling\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -301,6 +306,34 @@ def _run_prof(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_dlta(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/delta/engine.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "ESCALATION_REASONS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("escalation trigger",)))
+        elif f.rel == "tpu_scheduler/sim/scorecard.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "INCREMENTAL_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("incremental scorecard field",)))
+    return [
+        Finding(
+            "DLTA",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the incremental delta engine but is missing from the README "
+            f"\"Incremental scheduling\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
     return (
         _run_metr(ctx)
@@ -310,4 +343,5 @@ def run(ctx: Context) -> list[Finding]:
         + _run_topo(ctx)
         + _run_repl(ctx)
         + _run_prof(ctx)
+        + _run_dlta(ctx)
     )

@@ -1,0 +1,104 @@
+"""delta-smoke — the incremental engine's standing gate (make check).
+
+Two contracts, runnable standalone for a verdict (exit 0 = green), the
+`make sim-smoke` / `make constrained-smoke` pattern:
+
+  1. PARITY — the churn-steady-state scenario (seed 0) must pass its
+     scorecard with the ``incremental`` block green: full_solve_fraction
+     <= 0.10 (the delta cycle IS the default) and zero shadow-solve
+     mismatches across every sampled cycle (the full-wave solve, run
+     beside the delta path, placed exactly the same pod set each time).
+  2. BUDGET — on a downscaled synthetic cluster (2000×200, ~1% churn per
+     cycle) the warm delta cycle must run at least 3× faster than the cold
+     full-wave cycle and under an absolute 1 s bar.  The dev box measures
+     ~10 ms delta vs ~1 s cold; the relative bound keeps slow-CI margin
+     while still failing hard if the delta path ever re-grows an
+     O(all-pods) sweep.
+
+Off the tier-1 clock (seconds of wall); wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+
+BUDGET_SECONDS = 1.0
+MIN_SPEEDUP = 3.0
+
+
+def main() -> int:
+    import logging
+
+    from tpu_scheduler.backends.native import NativeBackend
+    from tpu_scheduler.runtime.controller import Scheduler
+    from tpu_scheduler.runtime.fake_api import FakeApiServer
+    from tpu_scheduler.sim.harness import run_scenario
+    from tpu_scheduler.testing import synth_cluster
+
+    logging.getLogger("tpu_scheduler").setLevel(logging.WARNING)
+
+    # 1. parity: the scenario's pass gate REQUIRES the incremental block ok.
+    card = run_scenario("churn-steady-state", seed=0)
+    inc = card["incremental"]
+    print(
+        f"churn-steady-state: pass={card['pass']} delta={inc['delta_cycles']} "
+        f"full={inc['full_solves']} fraction={inc['full_solve_fraction']} "
+        f"shadow={inc['shadow_checks']}/{inc['shadow_mismatches']} mismatches"
+    )
+    if not card["pass"] or not inc["ok"]:
+        print("FAIL: churn-steady-state scorecard (incremental block) is red", file=sys.stderr)
+        return 1
+    if inc["shadow_checks"] < 1:
+        print("FAIL: no shadow-solve parity checks ran", file=sys.stderr)
+        return 1
+
+    # 2. budget: warm delta cycles must beat the cold full wave by >= 3x.
+    from dataclasses import replace as dc_replace
+
+    base = synth_cluster(n_nodes=200, n_pending=2000, n_bound=400, seed=0)
+    api = FakeApiServer()
+    api.load(base.nodes, base.pods)
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    t0 = time.perf_counter()
+    sched.run_cycle()
+    cold = time.perf_counter() - t0
+    wave = synth_cluster(n_nodes=200, n_pending=2000, n_bound=0, seed=1).pending_pods()
+    bound_pool = [p for p in base.pods if p.spec is not None and p.spec.node_name is None]
+    churn, prev, walls = 20, [], []
+    for w in range(5):
+        for p in prev:
+            api.delete_pod(p.metadata.namespace or "default", p.metadata.name)
+        for p in bound_pool[w * churn : (w + 1) * churn]:
+            api.delete_pod(p.metadata.namespace or "default", p.metadata.name)
+        prev = [
+            dc_replace(p, metadata=dc_replace(p.metadata, name=f"s{w}-{p.metadata.name}"))
+            for p in wave[:churn]
+        ]
+        for p in prev:
+            api.create_pod(p)
+        t0 = time.perf_counter()
+        sched.run_cycle()
+        walls.append(time.perf_counter() - t0)
+    warm = statistics.median(walls)
+    stats = sched.delta.stats()
+    print(
+        f"budget: cold full wave {cold:.3f}s, warm delta median {warm:.4f}s "
+        f"(x{cold / warm:.1f}), delta cycles {stats['delta_cycles']}"
+    )
+    if stats["delta_cycles"] < 5:
+        print("FAIL: churn cycles did not ride the delta path", file=sys.stderr)
+        return 1
+    if warm > BUDGET_SECONDS:
+        print(f"FAIL: warm delta cycle {warm:.3f}s over the {BUDGET_SECONDS:.1f}s budget", file=sys.stderr)
+        return 1
+    if cold / warm < MIN_SPEEDUP:
+        print(f"FAIL: delta speedup x{cold / warm:.1f} under the x{MIN_SPEEDUP:.0f} bar", file=sys.stderr)
+        return 1
+    print("delta-smoke green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -276,6 +276,30 @@ def test_prof_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_dlta_drift_and_guard():
+    eng_mod = (
+        "tpu_scheduler/delta/engine.py",
+        'ESCALATION_REASONS = ("ghost-trigger",)\nOTHER = ("not-a-trigger",)\n',
+    )
+    sc_mod = (
+        "tpu_scheduler/sim/scorecard.py",
+        'INCREMENTAL_FIELDS = ("ghost_incremental_field",)\nSCORECARD_FIELDS = ("simc_business",)\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(eng_mod, sc_mod, readme="")), "DLTA")
+    # simc_business is SIMC's token, not DLTA's; OTHER is not a catalogue tuple.
+    assert {h.message.split("'")[1] for h in hits} == {"ghost-trigger", "ghost_incremental_field"}
+    ok = "ghost-trigger ghost_incremental_field"
+    assert not rule_hits(catalogues.run(make_ctx(eng_mod, sc_mod, readme=ok)), "DLTA")
+
+
+def test_dlta_real_tree_is_catalogued():
+    files = load_files(["tpu_scheduler/delta/engine.py", "tpu_scheduler/sim/scorecard.py"])
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "DLTA")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")
@@ -991,3 +1015,24 @@ def test_shpe_fused_filter_transposed_operand_caught():
     hits = rule_hits(shapes.run(make_ctx(("tpu_scheduler/ops/constraints.py", mutated))), "SHPE")
     assert len(hits) == 1, "; ".join(h.render() for h in hits)
     assert "matmul inner dims differ" in hits[0].message and "[C, D]" in hits[0].message
+
+
+def test_shpe_delta_candidate_mask_broadcast_caught():
+    """ISSUE 10 satellite: mutation-check a delta/ contract — dropping the
+    per-axis subscript on the min-request operand in _candidate_mask
+    (comparing the [N] node column against the whole [R] vector) must
+    contradict the declared `# shape:` contract via the broadcast check."""
+    path = ROOT / "tpu_scheduler" / "delta" / "repack.py"
+    text = path.read_text()
+    ctx = make_ctx(("tpu_scheduler/delta/repack.py", text))
+    assert not rule_hits(shapes.run(ctx), "SHPE")
+    mutated = text.replace(
+        "return valid & (avail[:, 0] >= min_req[0]) & (avail[:, 1] >= min_req[1])",
+        "return valid & (avail[:, 0] >= min_req) & (avail[:, 1] >= min_req[1])",
+    )
+    assert mutated != text, "the candidate mask went missing from delta/repack.py"
+    hits = rule_hits(shapes.run(make_ctx(("tpu_scheduler/delta/repack.py", mutated))), "SHPE")
+    assert hits, "transposed/broadcast-conflicting candidate mask not caught"
+    assert any("[N]" in h.message and "[R]" in h.message for h in hits), "; ".join(
+        h.render() for h in hits
+    )

@@ -63,7 +63,10 @@ def test_unschedulable_requeues_after_300s():
     api = FakeApiServer()
     api.create_node(make_node("tiny", cpu="1", memory="1Gi"))
     api.create_pod(make_pod("huge", cpu="64", memory="256Gi"))
-    sched = Scheduler(api, NativeBackend(), clock=clock)
+    # delta=False: this pins the BACKOFF contract (the reference's flat
+    # error_policy retry).  With the delta engine on, a futile retry is
+    # elided by the standing verdict instead — tests/test_delta.py pins that.
+    sched = Scheduler(api, NativeBackend(), clock=clock, delta=False)
     m1 = sched.run_cycle()
     assert m1.unschedulable == 1
     # Still backing off: pod is not eligible.

@@ -316,7 +316,9 @@ def test_checkpoint_roundtrips_backoff_escalation(tmp_path):
     api.load(nodes=[make_node("n1", cpu="0", memory="0")], pods=[make_pod("stuck", cpu="1", memory="1Gi")])
     clock = FakeClock()
     clock.t = 100.0
-    sched = Scheduler(api, NativeBackend(), clock=clock, rng=random.Random(0))
+    # delta=False: the second no-node failure must REACH the backoff queue
+    # (the delta engine's standing verdict would elide the futile re-solve).
+    sched = Scheduler(api, NativeBackend(), clock=clock, rng=random.Random(0), delta=False)
     sched.run_cycle()
     clock.t += 1000.0
     sched.run_cycle()  # second failure escalates the attempt counter

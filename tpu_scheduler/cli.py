@@ -136,6 +136,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="degraded mode: max binding POSTs deferred while the breaker is open (overflow requeues instead)",
     )
     p.add_argument("--no-fallback", action="store_true", help="disable tpu->native failure fallback")
+    p.add_argument(
+        "--no-delta",
+        action="store_true",
+        help="disable the incremental delta-scheduling engine: every cycle runs the classic full-wave pack+solve",
+    )
     p.add_argument("--log-level", default="INFO")
     p.add_argument(
         "--log-format",
@@ -308,6 +313,7 @@ def main(argv: list[str] | None = None) -> int:
         events_buffer=args.events_buffer,
         breaker_config=breaker_config,
         flush_capacity=args.flush_capacity,
+        delta=not args.no_delta,
     )
     if args.profile_dir:
         # Link the device trace from /debug/trace's Chrome-trace JSON so the

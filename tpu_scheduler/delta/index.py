@@ -1,0 +1,159 @@
+"""DeltaIndex — watch events → dirty pods, then the invalidation closure.
+
+The reflector already streams copy-on-write by-node indexes and DELETE keys;
+this module is the missing classification layer: each cycle's raw pod events
+fold into the SolveState's capacity tensors and produce the DIRTY set — the
+pods whose last verdict can no longer be trusted — which then CLOSES:
+
+  • **capacity closure** — a deleted/retired placement frees capacity, so
+    every skipped unschedulable verdict is retired (the freed room may fit
+    them now).  Deliberately conservative: per-(pod, node) blocking sets
+    would be a [P, N] bitmap; retiring all verdicts on any free is O(skipped)
+    and can only cause extra re-solves, never a missed placement.
+  • **constraint closure** — a deleted PENDING pod frees no capacity but may
+    have been the anti-affinity carrier (or spread-domain occupant, via the
+    ``sp_dom_sel``-projected cells) whose term blocked someone; verdicts
+    retire the same way.
+  • **gang closure** — gangs admit all-or-nothing, so a dirty member dirties
+    the whole gang's verdicts (membership from the full pending set).
+  • **pod-affinity closure** (engine commit) — fresh placements can SATISFY
+    a positive pod-affinity seeker, the one way new placements ADD
+    feasibility; verdicts flagged has_pod_affinity retire when anything
+    placed.
+
+Soundness argument (the shadow-solve parity gate holds it): with the node
+set unchanged, a skipped pod's infeasibility can only be cured by freed
+capacity, a removed constraint carrier, or a new positive-affinity match —
+each of which retires the verdict above.  Everything else (new placements,
+new pods) only ever REMOVES feasibility, which keeps an unschedulable
+verdict true.
+"""
+
+from __future__ import annotations
+
+from ..api.objects import full_name
+from .state import SolveState, req64_of
+
+__all__ = ["DeltaIndex", "FoldResult"]
+
+
+class FoldResult:
+    """One cycle's classification verdict."""
+
+    __slots__ = ("ok", "freed", "carrier_deleted", "dirty")
+
+    def __init__(self):
+        self.ok = True  # False => escalate (vocabulary drift)
+        self.freed = False  # any committed capacity was released
+        self.carrier_deleted = False  # a pending pod (potential AA/spread carrier) vanished
+        self.dirty: set[str] = set()  # pod full names whose verdict retired
+
+
+def _pod_full(key) -> str:
+    ns, name = key
+    return f"{ns or 'default'}/{name}"
+
+
+def _node_of(pod) -> str | None:
+    return pod.spec.node_name if pod is not None and pod.spec is not None else None
+
+
+class DeltaIndex:
+    """Buffers raw reflector pod events between plans and folds them into a
+    SolveState + dirty classification.  Registered as a reflector pod
+    listener once per scheduler; the buffer drains at plan time (or is
+    discarded by a full-wave rebuild, whose snapshot already reflects every
+    buffered event)."""
+
+    def __init__(self):
+        self._events: list[tuple] = []
+
+    def on_pod_event(self, key, prev, new) -> None:
+        self._events.append((key, prev, new))
+
+    def pending_events(self) -> int:
+        return len(self._events)
+
+    def take(self) -> list[tuple]:
+        out, self._events = self._events, []
+        return out
+
+    # shape: (self: obj, state: obj, events: obj) -> obj
+    def fold(self, state: SolveState, events: list[tuple]) -> FoldResult:
+        """Fold one cycle's events into ``state`` (capacity bookkeeping) and
+        classify the raw dirty set.  Exact-once accounting: confirmations of
+        our own commits are no-ops; out-of-band binds and rebinds adjust by
+        the difference; deletes free exactly what was committed."""
+        out = FoldResult()
+        for key, prev, new in events:
+            pf = _pod_full(key)
+            if new is None:  # DELETED
+                if state.release(pf):
+                    out.freed = True
+                elif _node_of(prev) is None:
+                    # A pending pod vanished: zero capacity change, but it
+                    # may have carried the term/domain cell blocking a
+                    # constrained verdict.
+                    out.carrier_deleted = True
+                state.unsched.pop(pf, None)
+                continue
+            node = _node_of(new)
+            if node is not None:  # bound (created bound, or confirmed/out-of-band)
+                req = req64_of(new, state.res_vocab)
+                if req is None:
+                    out.ok = False  # new resource column: full-pack event
+                    return out
+                ent = state.placements.get(pf)
+                if ent is None:
+                    state.commit(pf, node, req)
+                elif ent[1] != node or (ent[2] != req).any():
+                    # Re-bound elsewhere (409 winner) or request drift: move
+                    # the mass; the old node's room frees.
+                    state.release(pf)
+                    state.commit(pf, node, req)
+                    out.freed = True
+                else:
+                    state.unsched.pop(pf, None)  # confirmed; verdict moot
+                continue
+            # Pending (created or modified): its spec may have changed —
+            # any standing verdict retires and the pod re-solves.
+            out.dirty.add(pf)
+            if state.release(pf):
+                out.freed = True  # bound -> pending regression (defensive)
+            state.unsched.pop(pf, None)
+        return out
+
+    # shape: (self: obj, state: obj, fold: obj, placements_made: bool,
+    #   pending_all: obj) -> int
+    def close(self, state: SolveState, fold: FoldResult, placements_made: bool, pending_all: list) -> int:
+        """Close the dirty set over the SolveState's standing verdicts;
+        returns the number of verdicts retired.  After this, "dirty" is
+        simply "pending and without a standing verdict" — the engine picks
+        the cycle's work straight off ``state.unsched`` membership."""
+        retired = 0
+        if fold.freed or fold.carrier_deleted:
+            retired += len(state.unsched)
+            state.unsched.clear()
+        elif placements_made:
+            # New placements only ADD feasibility through positive
+            # pod-affinity — retire exactly those verdicts.
+            for pf in [pf for pf, (has_pa, _g) in state.unsched.items() if has_pa]:
+                del state.unsched[pf]
+                retired += 1
+        if not state.unsched:
+            return retired
+        # Gang closure: a dirty member (fresh pod, retired verdict) dirties
+        # the whole gang — membership over the FULL pending set, so a member
+        # in backoff still drags its gang-mates' verdicts with it when it
+        # re-dirties.
+        dirty_gangs: set[str] = set()
+        standing = state.unsched
+        for p in pending_all:
+            g = p.spec.gang if p.spec is not None else None
+            if g and full_name(p) not in standing:
+                dirty_gangs.add(g)
+        if dirty_gangs:
+            for pf in [pf for pf, (_pa, g) in standing.items() if g in dirty_gangs]:
+                del standing[pf]
+                retired += 1
+        return retired

@@ -877,9 +877,14 @@ def extend_node_vocabs(packed: PackedCluster, snapshot: ClusterSnapshot, label_b
     return replace(packed, **out)
 
 
-# shape: (packed: obj, snapshot: obj, pod_block: int, res_memo: dict) -> obj
+# shape: (packed: obj, snapshot: obj, pod_block: int, res_memo: dict,
+#   alloc_used64: obj) -> obj
 def repack_incremental(
-    packed: PackedCluster, snapshot: ClusterSnapshot, pod_block: int = 128, res_memo: dict | None = None
+    packed: PackedCluster,
+    snapshot: ClusterSnapshot,
+    pod_block: int = 128,
+    res_memo: dict | None = None,
+    alloc_used64: tuple[np.ndarray, np.ndarray] | None = None,
 ) -> PackedCluster:
     """Between-cycles repack: reuse the node-side tensors (labels, alloc,
     vocab — stable while the node set is stable) and rebuild only what a
@@ -895,17 +900,27 @@ def repack_incremental(
 
     Caller guarantees: identical node set/order (validated) and that
     ``packed.vocab`` covers every pending selector pair (KeyError otherwise).
+    ``alloc_used64`` — the delta engine's carried exact-int64 capacity pair
+    (tpu_scheduler/delta): when given, the O(bound-pods) usage sweep AND the
+    O(pods) resource-vocabulary scan are skipped; the caller asserts both
+    (the engine escalates to a full pack on any vocabulary drift).
     """
     from ..api.objects import full_name
 
     fresh_nodes = tuple(n.name for n in snapshot.nodes)
     if fresh_nodes != packed.node_names:
         raise ValueError("repack_incremental requires an identical node set/order; run a full pack_snapshot instead")
-    if resource_vocab(snapshot, res_memo) != packed.res_vocab:
-        # A new extended-resource name widens every [·,R] tensor — that is a
-        # full-pack event (the controller catches ValueError and degrades).
-        raise ValueError("resource vocabulary changed; run a full pack_snapshot instead")
-    alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_memo, packed.res_vocab)
+    if alloc_used64 is None:
+        if resource_vocab(snapshot, res_memo) != packed.res_vocab:
+            # A new extended-resource name widens every [·,R] tensor — that
+            # is a full-pack event (the controller catches ValueError and
+            # degrades).
+            raise ValueError("resource vocabulary changed; run a full pack_snapshot instead")
+        alloc64, used64, _ = _alloc_and_used64(snapshot, packed.padded_nodes, res_memo, packed.res_vocab)
+    else:
+        alloc64, used64 = alloc_used64
+        if alloc64.shape != (packed.padded_nodes, len(packed.res_vocab)) or used64.shape != alloc64.shape:
+            raise ValueError("carried capacity pair does not match the packed node axis; run a full pack_snapshot instead")
     _check_alloc_within_scales(alloc64, packed.res_scales)
     pending = snapshot.pending_pods()
     p_pad = max(packed.padded_pods, round_up(len(pending), pod_block))

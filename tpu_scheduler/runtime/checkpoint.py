@@ -40,7 +40,14 @@ __all__ = ["save_scheduler", "restore_scheduler", "CHECKPOINT_VERSION"]
 #     escalation.  v1/v2 checkpoints still restore (flat requeue fields;
 #     deferred entries simply absent — those pods are still Pending on the
 #     API server and get re-placed).
-CHECKPOINT_VERSION = 3
+# v4: incremental delta engine (tpu_scheduler/delta) — the SolveState
+#     GENERATION and escalation counters persist so the series survive
+#     restarts, but the residual tensors/ledgers themselves deliberately do
+#     NOT: restore always invalidates the engine ("restore"), forcing one
+#     full-wave solve that rebuilds them from live watch state — stale
+#     residuals are never trusted.  v1-v3 restore unchanged (no delta key;
+#     the engine just starts cold, which forces the same full wave).
+CHECKPOINT_VERSION = 4
 
 _STATE_FILE = "state.json"
 _TENSORS_FILE = "node_tensors.npz"
@@ -102,6 +109,17 @@ def save_scheduler(scheduler, path: str) -> None:
         },
         "pdb_disruptions": {k: list(v) for k, v in scheduler._pdb_disruptions.items()},
         "node_sig": [list(pair) for pair in scheduler._node_sig] if scheduler._node_sig else None,
+        # Delta-engine continuity (counters only — residuals rebuild live).
+        "delta": (
+            {
+                "generation": scheduler.delta.generation,
+                "delta_cycles": scheduler.delta.delta_cycles,
+                "skipped_total": scheduler.delta.skipped_total,
+                "full_solve_reasons": dict(scheduler.delta.full_solve_reasons),
+            }
+            if getattr(scheduler, "delta", None) is not None
+            else None
+        ),
     }
     packed = scheduler._packed
     if packed is not None:
@@ -157,10 +175,21 @@ def restore_scheduler(scheduler, path: str) -> bool:
     # gate skips its cache (one full repack); v2's flat requeue fields fold
     # into the queue exactly as before — shard assignment is re-derived
     # live by the controller's stable hash, never read from the file.
-    if state.get("version") not in (1, 2, CHECKPOINT_VERSION):
+    if state.get("version") not in (1, 2, 3, CHECKPOINT_VERSION):
         raise ValueError(f"checkpoint version {state.get('version')} != {CHECKPOINT_VERSION}")
 
     scheduler._cycle_count = state.get("cycle_count", 0)
+    if getattr(scheduler, "delta", None) is not None:
+        # The escalation/generation series survive the restart; the
+        # residual ledgers never do — force one full-wave rebuild.
+        d = state.get("delta") or {}
+        scheduler.delta.generation = int(d.get("generation", 0))
+        scheduler.delta.delta_cycles = int(d.get("delta_cycles", 0))
+        scheduler.delta.skipped_total = int(d.get("skipped_total", 0))
+        scheduler.delta.full_solve_reasons = {
+            str(k): int(v) for k, v in (d.get("full_solve_reasons") or {}).items()
+        }
+        scheduler.delta.invalidate("restore")
     for name, value in state.get("counters", {}).items():
         scheduler.metrics.counters[name] = value
     now = scheduler.clock()

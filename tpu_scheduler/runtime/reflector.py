@@ -162,14 +162,25 @@ class ClusterReflector:
         # stream so a pod deleted mid-backoff cannot leak its entry, even
         # across standby cycles that deliberately skip the pending-set prune.
         self._deleted_pods: list[tuple[str | None, str]] = []
+        # External pod-event listeners ``(key, prev, new)`` — the incremental
+        # delta engine (tpu_scheduler/delta) classifies watch deltas from
+        # this feed; every listener sees the same fold the snapshot index
+        # sees, in event order.
+        self._pod_listeners: list = []
         self._dirty = True  # anything changed since the last snapshot()
         self._last_snap: ClusterSnapshot | None = None
 
     def _node_event(self, key, prev, new) -> None:
         self._dirty = True
 
+    def add_pod_listener(self, fn) -> None:
+        """Subscribe ``fn(key, prev, new)`` to the pod event fold."""
+        self._pod_listeners.append(fn)
+
     def _pod_event(self, key, prev, new) -> None:
         self._dirty = True
+        for fn in self._pod_listeners:
+            fn(key, prev, new)
         if new is None:
             self._deleted_pods.append(key)  # (namespace, name)
         prev_node = prev.spec.node_name if prev is not None and prev.spec is not None else None

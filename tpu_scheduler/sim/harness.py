@@ -38,7 +38,7 @@ from .chaos import ChaosApiServer
 from .clock import VirtualClock
 from .multi import MultiReplicaHarness
 from .scenarios import SCENARIOS, Scenario
-from .scorecard import build_scorecard, check_invariants, fingerprint
+from .scorecard import _percentile, build_scorecard, check_invariants, fingerprint
 from .trace import TraceWriter, load_trace
 from .workload import generate_events, initial_nodes
 
@@ -152,6 +152,69 @@ def _profile_block(sc: Scenario, fleet: MultiReplicaHarness) -> dict:
         "cycles": cycles,
         "span_census": dict(sorted(census.items())),
     }
+
+
+def _incremental_block(sc: Scenario, fleet: MultiReplicaHarness) -> dict:
+    """The scorecard ``incremental`` verdict (tpu_scheduler/delta):
+    delta-vs-full cycle counts, escalation-reason tallies, dirty-set size
+    percentiles, and the shadow-solve parity record, aggregated across the
+    fleet.  Deterministic by construction — every quantity is control flow
+    (cycle counts, set sizes, parity booleans), never wall clock.
+
+    ``ok`` holds the contract the ISSUE's acceptance criterion names: zero
+    parity mismatches (with at least one check when sampling is on) and the
+    full-wave solve staying the RARE path (fraction <= 0.10)."""
+    engines = [r.delta for r in fleet.scheds if r.delta is not None]
+    out = {
+        "enabled": bool(engines),
+        "required": bool(sc.incremental_required),
+        "delta_cycles": 0,
+        "full_solves": 0,
+        "full_solve_fraction": 0.0,
+        "escalations": {},
+        "dirty_p50": 0,
+        "dirty_p95": 0,
+        "dirty_max": 0,
+        "skipped_pods": 0,
+        "standing_verdicts": 0,
+        "shadow_checks": 0,
+        "shadow_mismatches": 0,
+        "shadow_skipped": 0,
+        "shadow_parity_ok": True,
+        "ok": True,
+    }
+    if not engines:
+        out["ok"] = not sc.incremental_required
+        return out
+    sizes: list[int] = []
+    escalations: dict[str, int] = {}
+    for eng in engines:
+        s = eng.stats()
+        out["delta_cycles"] += s["delta_cycles"]
+        out["full_solves"] += s["full_solves"]
+        out["skipped_pods"] += s["skipped_total"]
+        out["standing_verdicts"] += s["standing_verdicts"]
+        out["shadow_checks"] += s["shadow_checks"]
+        out["shadow_mismatches"] += s["shadow_mismatches"]
+        out["shadow_skipped"] += s["shadow_skipped"]
+        sizes.extend(s["dirty_sizes"])
+        for reason, n in s["full_solve_reasons"].items():
+            escalations[reason] = escalations.get(reason, 0) + n
+    out["escalations"] = dict(sorted(escalations.items()))
+    total = out["delta_cycles"] + out["full_solves"]
+    if total:
+        out["full_solve_fraction"] = round(out["full_solves"] / total, 6)
+    sizes.sort()
+    out["dirty_p50"] = int(_percentile(sizes, 0.50))
+    out["dirty_p95"] = int(_percentile(sizes, 0.95))
+    out["dirty_max"] = sizes[-1] if sizes else 0
+    out["shadow_parity_ok"] = out["shadow_mismatches"] == 0
+    out["ok"] = bool(
+        out["shadow_parity_ok"]
+        and out["full_solve_fraction"] <= 0.10
+        and (out["shadow_checks"] > 0 or sc.delta_shadow_every <= 0)
+    )
+    return out
 
 
 def _locality_block(sc: Scenario, st: "_SimState") -> dict:
@@ -565,6 +628,7 @@ def run_scenario(
         availability=fleet.availability_block(pending_final, st.double_bound),
         locality=_locality_block(sc, st),
         profile=_profile_block(sc, fleet),
+        incremental=_incremental_block(sc, fleet),
         recorder_stats={
             "tracked_pods": sum(len(r.recorder.tracked_pods()) for r in fleet.scheds),
             "evicted_timelines": sum(r.recorder.evicted_timelines for r in fleet.scheds),

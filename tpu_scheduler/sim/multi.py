@@ -86,6 +86,10 @@ class MultiReplicaHarness:
                 rng=random.Random(f"{seed}:sched" if i == 0 else f"{seed}:sched{i}"),
                 events_buffer=events_buffer,
                 topology=topology,
+                # Incremental engine shadow sampling (tpu_scheduler/delta):
+                # deterministic — span presence and parity verdicts are pure
+                # control flow, so record/replay bit-identity holds.
+                delta_shadow_every=getattr(sc, "delta_shadow_every", 0),
             )
             if self.replicas > 1:
                 kwargs.update(shards=self.shards, identity=f"replica-{i}", lease_duration=sc.lease_duration)

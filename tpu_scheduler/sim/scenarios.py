@@ -53,6 +53,13 @@ class Scenario:
     # regression (a new unattributed cycle region) fails the run like an
     # SLO regression does.
     profile_required: bool = False
+    # Incremental delta engine (tpu_scheduler/delta): ``delta_shadow_every``
+    # > 0 runs the full-wave shadow solve beside every Nth delta cycle and
+    # records placed-set parity; ``incremental_required`` gates the
+    # scorecard pass on the ``incremental`` block's ok (shadow parity on
+    # every sampled cycle AND full_solve_fraction <= 0.10).
+    delta_shadow_every: int = 0
+    incremental_required: bool = False
 
 
 SCENARIOS: dict[str, Scenario] = {}
@@ -248,6 +255,24 @@ _register(
         lease_duration=5.0,
         replica_kills=((18.0, 0),),
         drain_grace_cycles=30,
+    )
+)
+
+_register(
+    Scenario(
+        name="churn-steady-state",
+        description="The incremental engine's home turf: Poisson arrivals + completions at moderate utilization with NO node churn — the delta cycle must stay the default (full_solve_fraction <= 0.10) while the sampled full-wave shadow solve proves placed-set parity on every check (pass-gated incremental block)",
+        duration=120.0,
+        workload=WorkloadSpec(
+            initial_nodes=60,
+            arrival_rate=15.0,
+            lifetime_mean_s=25.0,
+            gang_fraction=0.05,
+            selector_fraction=0.2,
+            priority_tiers=(0, 0, 0, 5, 50),
+        ),
+        delta_shadow_every=8,
+        incremental_required=True,
     )
 )
 

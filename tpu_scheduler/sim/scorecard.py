@@ -26,7 +26,7 @@ import json
 import tpu_scheduler.core.predicates as P
 from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
 
-__all__ = ["SCORECARD_FIELDS", "check_invariants", "build_scorecard", "fingerprint"]
+__all__ = ["SCORECARD_FIELDS", "INCREMENTAL_FIELDS", "check_invariants", "build_scorecard", "fingerprint"]
 
 # The closed top-level schema of a scorecard (drift-gated against README.md).
 SCORECARD_FIELDS = (
@@ -44,8 +44,33 @@ SCORECARD_FIELDS = (
     "availability",
     "locality",
     "profile",
+    "incremental",
     "flight_recorder",
     "fingerprint",
+)
+
+# The closed schema of the ``incremental`` block (drift-gated against the
+# README "Incremental scheduling" catalogue by the DLTA analyze rule).
+# Strictly virtual/control-flow quantities: cycle counts, escalation-reason
+# counts, dirty-set size percentiles, and the shadow-solve parity verdicts —
+# never wall clock, so byte-identity and record→replay hold.
+INCREMENTAL_FIELDS = (
+    "enabled",
+    "required",
+    "delta_cycles",
+    "full_solves",
+    "full_solve_fraction",
+    "escalations",
+    "dirty_p50",
+    "dirty_p95",
+    "dirty_max",
+    "skipped_pods",
+    "standing_verdicts",
+    "shadow_checks",
+    "shadow_mismatches",
+    "shadow_skipped",
+    "shadow_parity_ok",
+    "ok",
 )
 
 
@@ -169,6 +194,7 @@ def build_scorecard(
     availability: dict,
     locality: dict,
     profile: dict,
+    incremental: dict,
     recorder_stats: dict,
     fp: str,
 ) -> dict:
@@ -202,6 +228,10 @@ def build_scorecard(
         # Profile-required scenarios additionally gate on attribution
         # coverage ≥ 0.9 (the profile block): an unattributed cycle region
         # is an observability regression and fails the run.
+        # Incremental-required scenarios additionally gate on the
+        # incremental block's ok: shadow-solve parity on EVERY sampled
+        # cycle and full_solve_fraction <= 0.10 — the delta path must stay
+        # the default and provably equivalent.
         "pass": bool(
             invariants.get("ok")
             and pod_counts.get("lost", 1) == 0
@@ -210,6 +240,7 @@ def build_scorecard(
             and not (locality.get("required") and locality.get("cross_rack_gangs", 0) != 0)
             and not (availability.get("enabled") and not availability.get("ok"))
             and not (profile.get("required") and not profile.get("coverage_ok"))
+            and not (incremental.get("required") and not incremental.get("ok"))
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -221,6 +252,7 @@ def build_scorecard(
         "availability": availability,
         "locality": locality,
         "profile": profile,
+        "incremental": incremental,
         "flight_recorder": recorder_stats,
         "fingerprint": fp,
     }

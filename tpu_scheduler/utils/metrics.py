@@ -42,6 +42,9 @@ DISTANCE_BUCKETS = (0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 8.0)
 # same-cycle binds through multi-minute backlog pain past every tier target
 # (utils/profiler.SLO_TIERS tops out at 1200 s).
 PENDING_AGE_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1200.0, 3600.0)
+# Dirty-set size per delta cycle (tpu_scheduler/delta): single-pod watch
+# ripples through flagship-scale churn waves.
+DIRTY_BUCKETS = (1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0)
 
 # Histogram name -> bucket bounds; the one registration point the README
 # drift gate (scripts/lint.py) and to_prometheus share.
@@ -53,6 +56,7 @@ HISTOGRAM_BUCKETS = {
     "scheduler_backoff_seconds": BACKOFF_BUCKETS,
     "scheduler_gang_placement_distance": DISTANCE_BUCKETS,
     "scheduler_pending_age_seconds": PENDING_AGE_BUCKETS,
+    "scheduler_delta_dirty_pods": DIRTY_BUCKETS,
 }
 
 
@@ -118,6 +122,10 @@ class CycleMetrics:
     overlay_seconds: float = 0.0
     noexecute_seconds: float = 0.0
     queue_seconds: float = 0.0
+    # Incremental engine bookkeeping (tpu_scheduler/delta): watch-delta
+    # classification, invalidation closure, residual repack, commit, and the
+    # sim-only shadow parity solve.
+    delta_seconds: float = 0.0
     constrained_seconds: float = 0.0
     preempt_seconds: float = 0.0
     gang_seconds: float = 0.0

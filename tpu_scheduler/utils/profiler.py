@@ -77,7 +77,12 @@ SPAN_CATALOGUE = (
     "preempt",     # preemption pass
     "gang",        # per-gang admission accounting + locality stats
     "slo",         # pending-age tracker + burn-rate gauges
+    "delta",       # incremental engine: classification/closure/commit (tpu_scheduler/delta)
     # nested cost centers
+    "index",       # delta sub-span: watch-event fold into the SolveState
+    "close",       # delta sub-span: invalidation closure over standing verdicts
+    "repack",      # delta sub-span: carried residual-capacity materialization
+    "shadow",      # delta sub-span: sim-only full-solve parity check
     "round",       # one auction round (native backend round loop)
     "mask",        # per-round constraint/topology mask build
     "score",       # per-round feasibility + scoring sweep
