@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke clean
 
-check: lint test profile-smoke constrained-smoke delta-smoke
+check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -53,6 +53,13 @@ constrained-smoke:
 # a downscaled synthetic cluster (scripts/delta_smoke.py).
 delta-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.delta_smoke
+
+# The background-rebalancer gate: the defrag-smoke fragmentation scenario
+# must recover the scorecard rebalance block's packing-efficiency gate
+# within its migration budget (zero orphaned migrations), while the
+# rebalancer-off baseline must FAIL the same gate (scripts/defrag_smoke.py).
+defrag-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.defrag_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

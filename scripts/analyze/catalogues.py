@@ -30,6 +30,13 @@ DLTA  — every full-wave escalation trigger
         (``delta/engine.ESCALATION_REASONS``) and incremental-scorecard
         field (``sim/scorecard.INCREMENTAL_FIELDS``) must appear in the
         README "Incremental scheduling" catalogue.
+REBL  — every migration reason / skip reason / config knob of the
+        background rebalancer (``rebalance/planner.MIGRATION_REASONS``,
+        ``SKIP_REASONS``, ``RebalanceConfig`` fields), every rebalance-
+        scorecard field (``sim/scorecard.REBALANCE_FIELDS``), and every
+        rebalance-exercising sim scenario (a registry entry passing
+        ``rebalance=``) must appear in the README "Rebalancing &
+        defragmentation" catalogue.
 """
 
 from __future__ import annotations
@@ -48,6 +55,7 @@ CODES = {
     "REPL": "a shard lease prefix/availability field/multi-replica scenario missing from the README \"Multi-replica & failover\" catalogue",
     "PROF": "a profiler span name/SLO tier missing from the README \"Profiling\" catalogue",
     "DLTA": "a delta-engine escalation trigger/incremental scorecard field missing from the README \"Incremental scheduling\" catalogue",
+    "REBL": "a rebalancer migration/skip reason/config knob/scorecard field/scenario missing from the README \"Rebalancing & defragmentation\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -334,6 +342,55 @@ def _run_dlta(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_rebl(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/rebalance/planner.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if t.id == "MIGRATION_REASONS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("migration reason",)))
+                        elif t.id == "SKIP_REASONS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("skip reason",)))
+                elif isinstance(node, ast.ClassDef) and node.name == "RebalanceConfig":
+                    for stmt in node.body:
+                        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                            tokens.append(("rebalance knob", stmt.target.id))
+        elif f.rel == "tpu_scheduler/sim/scorecard.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "REBALANCE_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("rebalance scorecard field",)))
+        elif f.rel == "tpu_scheduler/sim/scenarios.py":
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "Scenario"):
+                    continue
+                name = None
+                rebalancing = False
+                for kw in node.keywords:
+                    if kw.arg == "name" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                        name = kw.value.value
+                    elif kw.arg == "rebalance":
+                        rebalancing = True
+                if name and rebalancing:
+                    tokens.append(("rebalance scenario", name))
+    return [
+        Finding(
+            "REBL",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the background rebalancer but is missing from the README "
+            f"\"Rebalancing & defragmentation\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
     return (
         _run_metr(ctx)
@@ -344,4 +401,5 @@ def run(ctx: Context) -> list[Finding]:
         + _run_repl(ctx)
         + _run_prof(ctx)
         + _run_dlta(ctx)
+        + _run_rebl(ctx)
     )

@@ -1,0 +1,66 @@
+"""defrag-smoke — the background rebalancer's standing gate (make check).
+
+Two contracts, runnable standalone for a verdict (exit 0 = green), the
+`make delta-smoke` / `make constrained-smoke` pattern:
+
+  1. RECOVERY — the ``defrag-smoke`` scenario (seed 0) must pass its
+     scorecard with the ``rebalance`` block green: final packing
+     efficiency at or above the scenario gate, migrations within the
+     budget, zero orphaned migrations, zero unbinds through an open
+     breaker.
+  2. BASELINE — the SAME scenario with the rebalancer forced OFF
+     (``run_scenario(..., rebalance=False)``) must FAIL the same
+     efficiency gate: if the baseline ever passes, the gate stopped
+     measuring defragmentation and the scenario must be re-tuned.
+
+Off the tier-1 clock (seconds of wall); wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import logging
+
+    from tpu_scheduler.sim.harness import run_scenario
+
+    logging.getLogger("tpu_scheduler").setLevel(logging.WARNING)
+
+    card = run_scenario("defrag-smoke", seed=0)
+    r = card["rebalance"]
+    print(
+        f"defrag-smoke ON: pass={card['pass']} efficiency={r['packing_efficiency']} "
+        f"(gate {r['efficiency_gate']}) occupied={r['occupied_nodes']} migrations={r['migrations']}"
+        f"/{r['migration_budget']} drained={r['nodes_drained']} orphaned={r['orphaned_migrations']}"
+    )
+    if not card["pass"] or not r["ok"]:
+        print("FAIL: defrag-smoke scorecard (rebalance block) is red", file=sys.stderr)
+        return 1
+    if r["migrations"] == 0 or r["nodes_drained"] == 0:
+        print("FAIL: the rebalancer did no work — the gate proved nothing", file=sys.stderr)
+        return 1
+
+    off = run_scenario("defrag-smoke", seed=0, rebalance=False)
+    ro = off["rebalance"]
+    print(
+        f"defrag-smoke OFF: pass={off['pass']} efficiency={ro['packing_efficiency']} "
+        f"(gate {ro['efficiency_gate']}) occupied={ro['occupied_nodes']}"
+    )
+    if off["pass"] or ro["ok"]:
+        print(
+            "FAIL: the rebalancer-off baseline passed the efficiency gate — the scenario no longer "
+            "measures defragmentation",
+            file=sys.stderr,
+        )
+        return 1
+    if ro["packing_efficiency"] >= r["packing_efficiency"]:
+        print("FAIL: rebalancing did not improve packing efficiency over the baseline", file=sys.stderr)
+        return 1
+    print("defrag-smoke green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -300,6 +300,47 @@ def test_dlta_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_rebl_drift_and_guard():
+    planner_mod = (
+        "tpu_scheduler/rebalance/planner.py",
+        'MIGRATION_REASONS = ("ghost-migration-reason",)\n'
+        'SKIP_REASONS = ("ghost-skip-reason",)\n'
+        "class RebalanceConfig:\n    ghost_knob: int = 1\n"
+        'OTHER = ("not-a-reason",)\n',
+    )
+    sc_mod = (
+        "tpu_scheduler/sim/scorecard.py",
+        'REBALANCE_FIELDS = ("ghost_rebalance_field",)\nSCORECARD_FIELDS = ("simc_business",)\n',
+    )
+    scen_mod = (
+        "tpu_scheduler/sim/scenarios.py",
+        '_register(Scenario(name="ghost-defrag-scenario", rebalance=True))\n'
+        '_register(Scenario(name="plain-scenario", workload=WorkloadSpec(arrival_rate=1.0)))\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(planner_mod, sc_mod, scen_mod, readme="")), "REBL")
+    # simc_business is SIMC's token and plain-scenario SIMC's scenario;
+    # OTHER is not a taxonomy tuple — none of them are REBL's business.
+    assert {h.message.split("'")[1] for h in hits} == {
+        "ghost-migration-reason",
+        "ghost-skip-reason",
+        "ghost_knob",
+        "ghost_rebalance_field",
+        "ghost-defrag-scenario",
+    }
+    ok = "ghost-migration-reason ghost-skip-reason ghost_knob ghost_rebalance_field ghost-defrag-scenario"
+    assert not rule_hits(catalogues.run(make_ctx(planner_mod, sc_mod, scen_mod, readme=ok)), "REBL")
+
+
+def test_rebl_real_tree_is_catalogued():
+    files = load_files(
+        ["tpu_scheduler/rebalance/planner.py", "tpu_scheduler/sim/scorecard.py", "tpu_scheduler/sim/scenarios.py"]
+    )
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "REBL")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")
@@ -1015,6 +1056,28 @@ def test_shpe_fused_filter_transposed_operand_caught():
     hits = rule_hits(shapes.run(make_ctx(("tpu_scheduler/ops/constraints.py", mutated))), "SHPE")
     assert len(hits) == 1, "; ".join(h.render() for h in hits)
     assert "matmul inner dims differ" in hits[0].message and "[C, D]" in hits[0].message
+
+
+def test_shpe_rebalance_fit_matrix_broadcast_caught():
+    """ISSUE 11 satellite: mutation-check a rebalance/ contract — dropping
+    the column-keeping subscript on the migration-diff operand in
+    _fit_matrix (comparing the [N] budget column against the [1, M] victim
+    row) must contradict the declared `# shape:` contract via the
+    broadcast check."""
+    path = ROOT / "tpu_scheduler" / "rebalance" / "solver.py"
+    text = path.read_text()
+    ctx = make_ctx(("tpu_scheduler/rebalance/solver.py", text))
+    assert not rule_hits(shapes.run(ctx), "SHPE")
+    mutated = text.replace(
+        "budget[:, 0:1] >= req_cpu[None, :]",
+        "budget[:, 0] >= req_cpu[None, :]",
+    )
+    assert mutated != text, "the fit matrix went missing from rebalance/solver.py"
+    hits = rule_hits(shapes.run(make_ctx(("tpu_scheduler/rebalance/solver.py", mutated))), "SHPE")
+    assert hits, "broadcast-conflicting fit matrix not caught"
+    assert any("[N]" in h.message and "[1, M]" in h.message for h in hits), "; ".join(
+        h.render() for h in hits
+    )
 
 
 def test_shpe_delta_candidate_mask_broadcast_caught():

@@ -72,6 +72,73 @@ def test_standing_verdict_skips_until_capacity_frees():
     _audit_capacity(sched)
 
 
+def test_unrelated_node_churn_leaves_standing_verdicts_untouched():
+    """ISSUE 11 satellite (ROADMAP): per-node blocking sets — freed
+    capacity on a node the verdict's pod could never land on (selector
+    excluded) must NOT retire the verdict, while a free on a blocking
+    node still does."""
+    api = FakeApiServer()
+    api.create_node(make_node("a1", cpu="4", memory="8Gi", labels={"zone": "zone-a"}))
+    api.create_node(make_node("b1", cpu="8", memory="16Gi", labels={"zone": "zone-b"}))
+    api.create_pod(make_pod("fill-a", cpu="3", memory="1Gi", node_selector={"zone": "zone-a"}))
+    api.create_pod(make_pod("fill-b", cpu="2", memory="1Gi", node_selector={"zone": "zone-b"}))
+    sched = _sched(api)
+    assert sched.run_cycle().bound == 2  # cold full wave
+    api.create_pod(make_pod("pinned", cpu="3", memory="1Gi", node_selector={"zone": "zone-a"}))
+    m = sched.run_cycle()
+    assert m.unschedulable == 1
+    st = sched.delta.state
+    _pa, _g, blocked, constrained = st.unsched["default/pinned"]
+    assert not constrained and blocked == frozenset({"a1"})
+    # Churn on the UNRELATED node: capacity frees on b1, but b1 is outside
+    # the blocking set — the verdict stands and the re-solve stays elided.
+    api.delete_pod("default", "fill-b")
+    m2 = sched.run_cycle()
+    assert m2.bound == 0 and m2.unschedulable == 0
+    assert sched.delta.stats()["standing_verdicts"] == 1
+    assert sched.delta.stats()["skipped_total"] >= 1
+    # A free on the BLOCKING node retires the verdict and the pod binds.
+    api.delete_pod("default", "fill-a")
+    m3 = sched.run_cycle()
+    assert m3.bound == 1
+    assert sched.delta.stats()["standing_verdicts"] == 0
+    assert sched.delta.stats()["full_solves"] == 1  # only the cold start
+    _audit_capacity(sched)
+
+
+def test_constrained_verdict_still_retires_on_any_free():
+    """The per-node narrowing must NOT apply to cross-node-entangled
+    verdicts: an anti-affinity-blocked pod retires on any freed capacity
+    (a placed-pod deletion anywhere can shift its domain state)."""
+    from tpu_scheduler.api.objects import PodAntiAffinityTerm
+
+    api = FakeApiServer()
+    api.create_node(make_node("n1", cpu="4", memory="8Gi", labels={"zone": "zone-a"}))
+    api.create_node(make_node("n2", cpu="4", memory="8Gi", labels={"zone": "zone-a"}))
+    carrier = make_pod("carrier", cpu="1", memory="1Gi", labels={"app": "x"})
+    api.create_pod(carrier)
+    sched = _sched(api)
+    assert sched.run_cycle().bound == 1
+    # A pod anti-affine to app=x over the zone key: with the carrier
+    # placed, no zone-a node is feasible.
+    api.create_pod(
+        make_pod(
+            "anti",
+            cpu="1",
+            memory="1Gi",
+            anti_affinity=[PodAntiAffinityTerm(topology_key="zone", match_labels={"app": "x"})],
+        )
+    )
+    m = sched.run_cycle()
+    assert m.unschedulable == 1
+    ent = sched.delta.state.unsched["default/anti"]
+    assert ent[3] is True  # constrained: the coarse any-free rule applies
+    api.delete_pod("default", "carrier")
+    m2 = sched.run_cycle()
+    assert m2.bound == 1
+    assert sched.delta.stats()["standing_verdicts"] == 0
+
+
 def test_modified_pod_re_dirties_its_own_verdict():
     api = FakeApiServer()
     api.create_node(make_node("n1", cpu="2", memory="4Gi"))

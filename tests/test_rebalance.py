@@ -1,0 +1,486 @@
+"""Background rebalancer (tpu_scheduler/rebalance): victim taxonomy,
+packing solve (whole-node drains, topology preference, determinism), batch
+selection + throttles, the unbind-then-cordon drain protocol end-to-end
+(convergence, pressure release, background-thread mode, /debug surface),
+the unbind CAS seam, and the pass-gated scenario family (defrag recovery
+vs the rebalancer-off baseline, chaos composition, autoscaler what-if,
+record→replay bit-identity on seeds {0, 1})."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_scheduler.api.objects import ObjectReference, PodAntiAffinityTerm
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.rebalance import (
+    MIGRATION_REASONS,
+    REBALANCE_CORDON_LABEL,
+    SKIP_REASONS,
+    RebalanceConfig,
+    Rebalancer,
+    RebalanceSnapshot,
+    packing_stats,
+    solve_packing,
+)
+from tpu_scheduler.rebalance.planner import select_batch, throttle_reason
+from tpu_scheduler.rebalance.snapshot import is_movable
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import ApiError, FakeApiServer
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.testing import make_node, make_pod
+
+from conftest import FakeClock
+
+
+def _snap(nodes, pods):
+    return ClusterSnapshot.build(nodes, pods)
+
+
+# -- victim taxonomy ----------------------------------------------------------
+
+
+def test_movable_taxonomy_pins_constrained_pods():
+    assert is_movable(make_pod("plain", node_name="n1", phase="Running"))
+    assert not is_movable(make_pod("g", node_name="n1", gang="team"))
+    assert not is_movable(make_pod("sel", node_name="n1", node_selector={"zone": "a"}))
+    assert not is_movable(
+        make_pod("aa", node_name="n1", anti_affinity=[PodAntiAffinityTerm(topology_key="zone", match_labels={"a": "b"})])
+    )
+    assert not is_movable(make_pod("ext", node_name="n1", extended={"acme.com/gpu": 1}))
+    assert not is_movable(make_pod("vetoed", node_name="n1"), victim_ok=lambda pf: False)
+
+
+def test_movable_taxonomy_respects_pdbs():
+    from tpu_scheduler.api.objects import PodDisruptionBudget, ObjectMeta
+
+    pdb = PodDisruptionBudget(metadata=ObjectMeta(name="guard"), match_labels={"app": "db"}, min_available=1)
+    protected = make_pod("db-0", node_name="n1", labels={"app": "db"})
+    free = make_pod("web-0", node_name="n1", labels={"app": "web"})
+    assert not is_movable(protected, pdbs=[pdb])
+    assert is_movable(free, pdbs=[pdb])
+
+
+# -- packing stats + solver ---------------------------------------------------
+
+
+def test_packing_stats_exact_math():
+    alloc = np.array([[8000, 100], [8000, 100], [8000, 100]], dtype=np.int64)
+    used = np.array([[4000, 10], [2000, 10], [0, 0]], dtype=np.int64)
+    s = packing_stats(alloc, used)
+    assert s["occupied_nodes"] == 2 and s["empty_nodes"] == 1
+    # Dominant axis: cpu 6000/16000 = 0.375 vs mem 20/200 = 0.1.
+    assert s["efficiency"] == 0.375 and s["stranded_frac"] == 0.625
+    empty = packing_stats(alloc, np.zeros_like(used))
+    assert empty["efficiency"] == 1.0 and empty["occupied_nodes"] == 0
+
+
+def test_solver_drains_whole_nodes_only_and_is_deterministic():
+    nodes = [make_node(f"n{i}", cpu="8", memory="32Gi") for i in range(4)]
+    pods = [
+        make_pod("a1", node_name="n0", cpu="1", memory="1Gi", phase="Running"),
+        make_pod("a2", node_name="n1", cpu="1", memory="1Gi", phase="Running"),
+        # n2 hosts a PINNED pod (gang): the node can never empty.
+        make_pod("pin", node_name="n2", cpu="1", memory="1Gi", gang="team", phase="Running"),
+        make_pod("b1", node_name="n2", cpu="1", memory="1Gi", phase="Running"),
+        make_pod("big", node_name="n3", cpu="6", memory="4Gi", phase="Running"),
+    ]
+    rs = RebalanceSnapshot.build(_snap(nodes, pods))
+    plan = solve_packing(rs)
+    # n2 is pinned: no migration may name it as a source.
+    assert all(m.src != "n2" for m in plan.migrations)
+    # Every drained node's migrations move ALL of its movable mass.
+    for src in plan.drained:
+        moved = [m for m in plan.migrations if m.src == src]
+        assert moved, src
+    assert plan.after["occupied_nodes"] < plan.before["occupied_nodes"]
+    plan2 = solve_packing(RebalanceSnapshot.build(_snap(nodes, pods)))
+    assert plan.migrations == plan2.migrations and plan.drained == plan2.drained
+
+
+def test_solver_respects_receiver_headroom_and_budget():
+    nodes = [make_node("n0", cpu="4", memory="8Gi"), make_node("n1", cpu="4", memory="8Gi")]
+    pods = [
+        make_pod("x", node_name="n0", cpu="2", memory="1Gi", phase="Running"),
+        make_pod("y", node_name="n1", cpu="3", memory="1Gi", phase="Running"),
+    ]
+    rs = RebalanceSnapshot.build(_snap(nodes, pods))
+    # headroom 0.9 -> receiver n1 budget is 3.6 - 3 = 0.6 cores: x (2) cannot move.
+    assert not solve_packing(rs, headroom=0.9).migrations
+    # Full headroom: n1 can absorb x exactly (3 + 2 > 4 still fails)...
+    assert not solve_packing(rs, headroom=1.0).migrations
+    # ...but max_migrations=0 forbids everything outright on a drainable setup.
+    pods2 = [
+        make_pod("x", node_name="n0", cpu="1", memory="1Gi", phase="Running"),
+        make_pod("y", node_name="n1", cpu="1", memory="1Gi", phase="Running"),
+    ]
+    rs2 = RebalanceSnapshot.build(_snap(nodes, pods2))
+    assert solve_packing(rs2).migrations
+    assert not solve_packing(rs2, max_migrations=0).migrations
+
+
+def test_solver_topology_prefers_emptiest_rack_and_tags_rack_defrag():
+    from tpu_scheduler.topology.model import TopologyModel
+
+    labels = lambda r: {"topology.tpu-scheduler/rack": r}  # noqa: E731
+    nodes = [
+        make_node("a0", cpu="8", memory="32Gi", labels=labels("rack-a")),
+        make_node("a1", cpu="8", memory="32Gi", labels=labels("rack-a")),
+        make_node("b0", cpu="8", memory="32Gi", labels=labels("rack-b")),
+    ]
+    pods = [
+        # rack-a: two busy nodes; rack-b: one nearly-empty node — the
+        # emptiest COARSEST domain must drain first (freeing the rack).
+        make_pod("a0-1", node_name="a0", cpu="4", memory="4Gi", phase="Running"),
+        make_pod("a1-1", node_name="a1", cpu="4", memory="4Gi", phase="Running"),
+        make_pod("b0-1", node_name="b0", cpu="1", memory="1Gi", phase="Running"),
+    ]
+    snap = _snap(nodes, pods)
+    topo = TopologyModel.detect(nodes).compile(nodes)
+    plan = solve_packing(RebalanceSnapshot.build(snap), topo=topo)
+    assert plan.migrations and plan.migrations[0].src == "b0"
+    assert plan.migrations[0].reason == "rack-defrag"  # rack-b empties whole
+    assert plan.migrations[0].reason in MIGRATION_REASONS
+
+
+# -- planner ------------------------------------------------------------------
+
+
+def test_select_batch_takes_whole_node_groups():
+    from tpu_scheduler.rebalance.solver import Migration, PackingPlan
+
+    def mig(i, src):
+        return Migration(pod_full=f"default/p{i}", src=src, dst="r", cpu=1, mem=1, reason="defrag-drain")
+
+    plan = PackingPlan(
+        migrations=(mig(0, "n0"), mig(1, "n0"), mig(2, "n0"), mig(3, "n1"), mig(4, "n1"), mig(5, "n2")),
+        drained=("n0", "n1", "n2"),
+        before={},
+        after={},
+    )
+    groups = select_batch(plan, batch=4)
+    # n0 whole (3) fits; n1 (2 more) would exceed 4 -> stops after n0.
+    assert [g[0].src for g in groups] == ["n0"]
+    # The FIRST group is taken even when it alone exceeds the batch.
+    assert [g[0].src for g in select_batch(plan, batch=2)] == ["n0"]
+    # The budget caps the total outright.
+    assert select_batch(plan, batch=8, budget_left=2) == []
+
+
+def test_throttle_reasons_precedence():
+    cfg = RebalanceConfig(burn_limit=0.5, max_pending=4, max_migrations=10)
+    assert throttle_reason("open", 0.0, 0, 0, 0, cfg) == "breaker-open"
+    assert throttle_reason("closed", 0.9, 0, 0, 0, cfg) == "slo-burn"
+    assert throttle_reason("closed", 0.0, 5, 0, 0, cfg) == "backlog"
+    assert throttle_reason("closed", 0.0, 0, 3, 0, cfg) == "inflight"
+    assert throttle_reason("closed", 0.0, 0, 0, 10, cfg) == "budget"
+    assert throttle_reason("closed", 0.0, 0, 0, 0, cfg) is None
+    for r in ("breaker-open", "slo-burn", "backlog", "inflight", "budget"):
+        assert r in SKIP_REASONS
+
+
+# -- executor unit ------------------------------------------------------------
+
+
+def _frag_api(n_nodes=6, pods_per=2):
+    api = FakeApiServer()
+    for i in range(n_nodes):
+        api.create_node(make_node(f"n{i}", cpu="8", memory="32Gi"))
+    k = 0
+    for i in range(n_nodes):
+        for _ in range(pods_per):
+            api.create_pod(make_pod(f"p{k}", node_name=f"n{i}", cpu="1", memory="1Gi", phase="Running"))
+            k += 1
+    return api
+
+
+def test_executor_unbind_failure_aborts_group_without_cordon():
+    api = _frag_api()
+    snap = _snap(api.list_nodes(), api.list_pods())
+    reb = Rebalancer(RebalanceConfig(every=1, batch=64))
+    cordoned = []
+    issued = reb.tick(
+        snap,
+        unbind=lambda pf, node: False,  # every deschedule fails
+        cordon=lambda name: cordoned.append(name) or True,
+    )
+    assert issued == 0 and cordoned == []
+    assert reb.skips.get("unbind-failed", 0) >= 1
+    assert "unbind-failed" in SKIP_REASONS
+
+
+def test_executor_victim_moved_abandons_stale_background_plan():
+    """Background mode solves against an older snapshot: if the victims
+    moved by the time the plan executes, the group is abandoned
+    (victim-moved) — the next solve sees the truth."""
+    import time as _time
+
+    api = _frag_api(n_nodes=3)
+    snap = _snap(api.list_nodes(), api.list_pods())
+    reb = Rebalancer(RebalanceConfig(every=1, batch=64, background=True))
+    calls = []
+    try:
+        # Tick 1 submits the solve request; no plan is ready yet.
+        assert reb.tick(snap, unbind=lambda pf, n: calls.append(pf) or True, cordon=lambda n: True) == 0
+        for _ in range(500):
+            with reb._bg_lock:
+                if reb._bg_plan is not None:
+                    break
+            _time.sleep(0.01)
+        # The world moves under the finished plan: one extra pod bound per
+        # node, so every planned group's victim set is stale.
+        for i in range(3):
+            api.create_pod(make_pod(f"late{i}", node_name=f"n{i}", cpu="1", memory="1Gi", phase="Running"))
+        live = _snap(api.list_nodes(), api.list_pods())
+        issued = reb.tick(live, unbind=lambda pf, n: calls.append(pf) or True, cordon=lambda n: True)
+    finally:
+        reb.close()
+    assert issued == 0 and calls == []
+    assert reb.skips.get("victim-moved", 0) >= 1
+    assert "victim-moved" in SKIP_REASONS
+
+def test_executor_reconcile_completions_and_vanished():
+    api = _frag_api(n_nodes=2, pods_per=1)
+    snap = _snap(api.list_nodes(), api.list_pods())
+    reb = Rebalancer(RebalanceConfig(every=1, batch=8))
+    unbound = []
+
+    def unbind(pf, node):
+        ns, _, name = pf.rpartition("/")
+        api.unbind_pod(ns or "default", name, expect_node=node)
+        unbound.append((pf, node))
+        return True
+
+    issued = reb.tick(snap, unbind=unbind, cordon=lambda n: True)
+    assert issued >= 1 and len(reb.inflight) == issued
+    # One pod re-binds, one vanishes: reconcile resolves both.
+    pf0, node0 = unbound[0]
+    ns, _, name0 = pf0.rpartition("/")
+    api.create_binding(ns or "default", name0, ObjectReference(name="n1" if node0 == "n0" else "n0"))
+    for pf, _n in unbound[1:]:
+        ns1, _, n1 = pf.rpartition("/")
+        api.delete_pod(ns1 or "default", n1)
+    reb.reconcile(_snap(api.list_nodes(), api.list_pods()))
+    assert reb.completed == 1
+    assert reb.vanished == len(unbound) - 1
+    assert not reb.inflight
+
+
+# -- the unbind CAS seam ------------------------------------------------------
+
+
+def test_unbind_pod_cas_and_watch_event():
+    api = FakeApiServer()
+    api.create_node(make_node("n0", cpu="8", memory="32Gi"))
+    api.create_node(make_node("n1", cpu="8", memory="32Gi"))
+    api.create_pod(make_pod("p", node_name="n0", phase="Running"))
+    w = api.watch_pods(send_initial=False)
+    with pytest.raises(ApiError) as e:
+        api.unbind_pod("default", "p", expect_node="n1")  # CAS: wrong node
+    assert e.value.code == 409
+    with pytest.raises(ApiError):
+        api.unbind_pod("default", "ghost")
+    api.unbind_pod("default", "p", expect_node="n0")
+    events = w.poll()
+    assert [ev.type for ev in events] == ["MODIFIED"]
+    assert events[0].object.spec.node_name is None
+    assert events[0].object.status.phase == "Pending"
+    with pytest.raises(ApiError) as e:
+        api.unbind_pod("default", "p")  # already pending
+    assert e.value.code == 409
+
+
+# -- controller integration ---------------------------------------------------
+
+
+def _drained_nodes(api):
+    return sorted(
+        n.name for n in api.list_nodes() if (n.metadata.labels or {}).get(REBALANCE_CORDON_LABEL)
+    )
+
+
+def test_controller_defrag_converges_and_audits_clean():
+    api = _frag_api(n_nodes=8, pods_per=2)
+    sched = Scheduler(
+        api, NativeBackend(), clock=FakeClock(), requeue_seconds=0.0,
+        rebalance=RebalanceConfig(every=2, batch=16),
+    )
+    for _ in range(24):
+        sched.run_cycle()
+    s = sched.rebalancer.stats()
+    assert s["executed"] > 0 and s["completed"] == s["executed"]
+    assert s["nodes_drained"] >= 5
+    rs = RebalanceSnapshot.build(_snap(api.list_nodes(), api.list_pods()))
+    stats = packing_stats(rs.alloc, rs.used)
+    assert stats["occupied_nodes"] <= 3
+    assert len(_drained_nodes(api)) == s["nodes_drained"]
+    # Nothing pending, nothing lost: every migration re-placed.
+    assert not [p for p in api.list_pods() if p.spec is None or not p.spec.node_name]
+    # The delta ledger survived the churn exactly (migration = watch events).
+    from tpu_scheduler.ops.pack import _alloc_and_used64
+
+    st = sched.delta.state
+    if st is not None:
+        snap = _snap(api.list_nodes(), api.list_pods())
+        alloc64, used64, _row = _alloc_and_used64(snap, st.alloc64.shape[0], None, st.res_vocab)
+        assert (st.used64 == used64).all()
+
+
+def test_pressure_release_uncordons_on_backlog():
+    api = _frag_api(n_nodes=6, pods_per=1)
+    sched = Scheduler(
+        api, NativeBackend(), clock=FakeClock(), requeue_seconds=0.0,
+        rebalance=RebalanceConfig(every=1, batch=16, max_pending=4),
+    )
+    for _ in range(12):
+        sched.run_cycle()
+    assert _drained_nodes(api), "setup: some nodes must have drained"
+    # A demand wave larger than the throttle: the next tick must UNCORDON
+    # every labeled node before standing down, and the wave then binds
+    # (10 x 3-core pods need ~5 whole nodes — impossible while drained).
+    for i in range(10):
+        api.create_pod(make_pod(f"wave{i}", cpu="3", memory="4Gi"))
+    for _ in range(4):
+        sched.run_cycle()
+    assert _drained_nodes(api) == []
+    assert sched.rebalancer.pressure_releases >= 1
+    assert sched.rebalancer.skips.get("backlog", 0) >= 1
+    for _ in range(4):
+        sched.run_cycle()
+    assert not [p for p in api.list_pods() if p.spec is None or not p.spec.node_name]
+
+
+def test_background_thread_mode_migrates():
+    api = _frag_api(n_nodes=6, pods_per=2)
+    sched = Scheduler(
+        api, NativeBackend(), requeue_seconds=0.0,
+        rebalance=RebalanceConfig(every=1, batch=16, background=True),
+    )
+    import time as _time
+
+    try:
+        for _ in range(40):
+            sched.run_cycle()
+            if sched.rebalancer.stats()["executed"]:
+                break
+            _time.sleep(0.01)  # let the worker finish a solve
+        assert sched.rebalancer.stats()["executed"] > 0
+    finally:
+        sched.close()
+    assert sched.rebalancer._bg_thread is None  # close() joined the worker
+
+
+def test_debug_rebalance_route_and_snapshot():
+    api = _frag_api(n_nodes=4, pods_per=1)
+    sched = Scheduler(
+        api, NativeBackend(), clock=FakeClock(), requeue_seconds=0.0,
+        rebalance=RebalanceConfig(every=1, batch=8),
+    )
+    for _ in range(6):
+        sched.run_cycle()
+    snap = sched.rebalance_snapshot()
+    assert snap["enabled"] and snap["solves"] >= 1
+    assert snap["config"]["every"] == 1 and "drained_nodes" in snap
+    from tpu_scheduler.runtime.http_api import HttpApiServer
+
+    srv = HttpApiServer(api, rebalance=sched.rebalance_snapshot).start()
+    try:
+        with urllib.request.urlopen(f"{srv.base_url}/debug/rebalance") as r:
+            body = json.loads(r.read())
+        assert body["enabled"] and body["solves"] == snap["solves"]
+        bare = HttpApiServer(api).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{bare.base_url}/debug/rebalance")
+            assert e.value.code == 404
+        finally:
+            bare.stop()
+    finally:
+        srv.stop()
+
+
+def test_sharded_only_shard0_owner_rebalances():
+    api = _frag_api(n_nodes=4, pods_per=1)
+    sched = Scheduler(
+        api, NativeBackend(), clock=FakeClock(), requeue_seconds=0.0, shards=2,
+        identity="r0", lease_duration=30.0,
+        rebalance=RebalanceConfig(every=1, batch=8),
+    )
+    for _ in range(6):
+        sched.run_cycle()
+    assert 0 in sched.shard_set.owned  # the only replica owns everything
+    assert sched.rebalancer.stats()["solves"] >= 1
+
+
+# -- scenario family (pass gates, baselines, chaos, replay) -------------------
+
+
+def test_defrag_smoke_scenario_recovers_gate_and_baseline_fails():
+    from tpu_scheduler.sim.harness import run_scenario
+
+    for seed in (0, 1):
+        card = run_scenario("defrag-smoke", seed=seed)
+        r = card["rebalance"]
+        assert card["pass"] and r["ok"], r
+        assert r["packing_efficiency"] >= r["efficiency_gate"]
+        assert 0 < r["migrations"] <= r["migration_budget"]
+        assert r["orphaned_migrations"] == 0 and r["unbinds_while_open"] == 0
+        assert card["pods"]["double_bound"] == 0 and card["pods"]["lost"] == 0
+    off = run_scenario("defrag-smoke", seed=0, rebalance=False)
+    assert not off["pass"] and not off["rebalance"]["ok"]
+    assert off["rebalance"]["packing_efficiency"] < off["rebalance"]["efficiency_gate"]
+    assert off["rebalance"]["migrations"] == 0
+
+
+def test_defrag_smoke_record_replay_bit_identical(tmp_path):
+    from tpu_scheduler.sim.harness import run_scenario
+
+    p = str(tmp_path / "defrag.jsonl")
+    live = run_scenario("defrag-smoke", seed=0, record=p)
+    replayed = run_scenario("defrag-smoke", seed=0, replay=p)  # raises on mismatch
+    assert replayed["fingerprint"] == live["fingerprint"]
+    assert {**replayed, "mode": "live"} == live
+
+
+def test_rebalance_under_chaos_zero_orphans_and_breaker_compose():
+    from tpu_scheduler.sim.harness import run_scenario
+
+    card = run_scenario("rebalance-under-chaos", seed=0)
+    r = card["rebalance"]
+    assert card["pass"], json.dumps(card["invariants"])[:500]
+    assert r["orphaned_migrations"] == 0 and r["unbinds_while_open"] == 0
+    assert card["pods"]["double_bound"] == 0
+    assert card["availability"]["ok"]
+    # The chaos actually composed: the breaker opened mid-defrag and the
+    # rebalancer stood down for it (and survived injected unbind 500s).
+    assert card["resilience"]["breaker_opened"] >= 1
+    assert r["skips"].get("breaker-open", 0) >= 1
+    assert r["migrations"] > 0 and r["completed"] == r["migrations"]
+
+
+def test_autoscaler_whatif_recommends_node_adds():
+    from tpu_scheduler.sim.harness import run_scenario
+
+    card = run_scenario("autoscaler-backlog-whatif", seed=0)
+    r = card["rebalance"]
+    assert card["pass"] and r["ok"]
+    w = r["whatif"]
+    assert w is not None and w["pending_pods"] > 0
+    assert w["nodes_needed"] >= 1  # the backlog needs real capacity
+    assert r["skips"].get("backlog", 0) >= 1  # the throttle stood the tier down
+    assert r["migrations"] == 0  # rebalancing never competed with the backlog
+
+
+@pytest.mark.slow
+def test_fragmentation_long_horizon_both_seeds():
+    from tpu_scheduler.sim.harness import run_scenario
+
+    for seed in (0, 1):
+        card = run_scenario("fragmentation-long-horizon", seed=seed)
+        r = card["rebalance"]
+        assert card["pass"] and r["ok"], (seed, r)
+        assert r["packing_efficiency"] >= r["efficiency_gate"]
+        assert r["migrations"] <= r["migration_budget"]
+    off = run_scenario("fragmentation-long-horizon", seed=0, rebalance=False)
+    assert not off["pass"] and not off["rebalance"]["ok"]

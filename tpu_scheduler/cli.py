@@ -141,6 +141,26 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="disable the incremental delta-scheduling engine: every cycle runs the classic full-wave pack+solve",
     )
+    p.add_argument(
+        "--rebalance",
+        action="store_true",
+        help="enable the background rebalancer (tpu_scheduler/rebalance): a cadence-gated packing solve on a "
+        "worker thread proposing bounded defragmentation migration batches (unbind -> cordon -> delta re-place)",
+    )
+    p.add_argument(
+        "--rebalance-every",
+        type=int,
+        default=8,
+        metavar="CYCLES",
+        help="rebalancer cadence: cycles between background ticks (with --rebalance)",
+    )
+    p.add_argument(
+        "--rebalance-batch",
+        type=int,
+        default=8,
+        metavar="N",
+        help="max migrations issued per rebalancer tick (whole-node drain groups; with --rebalance)",
+    )
     p.add_argument("--log-level", default="INFO")
     p.add_argument(
         "--log-format",
@@ -295,6 +315,13 @@ def main(argv: list[str] | None = None) -> int:
         topology = load_topology_file(args.topology_file)
     else:
         topology = "auto"
+    rebalance_cfg = None
+    if args.rebalance:
+        from .rebalance import RebalanceConfig
+
+        # Daemon mode runs the packing solve on a worker thread so the
+        # background tier stays off the cycle critical path.
+        rebalance_cfg = RebalanceConfig(every=args.rebalance_every, batch=args.rebalance_batch, background=True)
     sched = Scheduler(
         api,
         backend,
@@ -314,6 +341,7 @@ def main(argv: list[str] | None = None) -> int:
         breaker_config=breaker_config,
         flush_capacity=args.flush_capacity,
         delta=not args.no_delta,
+        rebalance=rebalance_cfg,
     )
     if args.profile_dir:
         # Link the device trace from /debug/trace's Chrome-trace JSON so the
@@ -350,6 +378,7 @@ def main(argv: list[str] | None = None) -> int:
             shards=sched.shards_snapshot,
             profile=profile_registry.snapshot,
             pending_ages=sched.pending_age_debug,
+            rebalance=sched.rebalance_snapshot if sched.rebalancer is not None else None,
             port=args.http_port,
         ).start()
         print(json.dumps({"http": True, "url": http_server.base_url}), file=sys.stderr)

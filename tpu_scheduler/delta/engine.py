@@ -34,7 +34,7 @@ import logging
 
 from ..api.objects import full_name
 from ..utils.tracing import span
-from .index import DeltaIndex
+from .index import DeltaIndex, blocking_nodes, verdict_constrained
 from .state import SolveState, req64_of
 
 logger = logging.getLogger("tpu_scheduler.delta")
@@ -79,6 +79,10 @@ class DeltaEngine:
     # buying anything — rebuild wholesale.
     OVERFLOW_MIN = 512
     OVERFLOW_FRAC = 0.5
+    # Per-verdict blocking-set budget (pod × node predicate probes per
+    # commit): a mass-unschedulable cycle falls back to blocked=None (the
+    # coarse any-free rule) instead of stalling the loop classifying it.
+    BLOCKING_BUDGET = 200_000
 
     def __init__(self, metrics=None, epoch_refresh: int = 64):
         self.metrics = metrics
@@ -208,12 +212,7 @@ class DeltaEngine:
             st.commit(full_name(pod), node.name, req)
         if placed:
             self._placements_since_plan = True
-        by_full = {full_name(p): p for p in pending_all} if unschedulable else {}
-        for pf in unschedulable:
-            p = by_full.get(pf)
-            if p is None or p.spec is None:
-                continue  # vanished mid-cycle; the DELETE event owns it
-            st.unsched[pf] = (bool(p.spec.pod_affinity), p.spec.gang or None)
+        self._record_verdicts(st, snapshot, unschedulable, pending_all)
         st.delta_cycles_since_full += 1
         self.delta_cycles += 1
         self.skipped_total += plan.skipped
@@ -223,6 +222,27 @@ class DeltaEngine:
             if plan.skipped:
                 self.metrics.inc("scheduler_delta_skipped_pods_total", plan.skipped)
             self.metrics.observe("scheduler_delta_dirty_pods", float(len(plan.pods)))
+
+    def _record_verdicts(self, st: SolveState, snapshot, unschedulable: list, pending_all: list) -> None:
+        """Write this cycle's unschedulable verdicts into the ledger, each
+        with its per-node blocking set (budgeted — beyond
+        ``BLOCKING_BUDGET`` pod×node probes the rest record blocked=None
+        and retire coarsely) and its constraint-entanglement flag."""
+        if not unschedulable:
+            return
+        by_full = {full_name(p): p for p in pending_all}
+        budget = self.BLOCKING_BUDGET
+        n_nodes = len(snapshot.nodes)
+        for pf in unschedulable:
+            p = by_full.get(pf)
+            if p is None or p.spec is None:
+                continue  # vanished mid-cycle; the DELETE event owns it
+            constrained = verdict_constrained(p)
+            blocked = None
+            if not constrained and n_nodes and budget >= n_nodes:
+                budget -= n_nodes
+                blocked = blocking_nodes(p, snapshot)
+            st.unsched[pf] = (bool(p.spec.pod_affinity), p.spec.gang or None, blocked, constrained)
 
     def _rebuild(self, snapshot, packed, node_sig, placed: list, unschedulable: list, pending_all: list, res_memo) -> None:
         """Reset the SolveState from a freshly solved full-wave cycle: the
@@ -264,11 +284,7 @@ class DeltaEngine:
             req = req64_of(pod, st.res_vocab, res_memo)
             if req is not None:
                 st.commit(full_name(pod), node.name, req)
-        by_full = {full_name(p): p for p in pending_all} if unschedulable else {}
-        for pf in unschedulable:
-            p = by_full.get(pf)
-            if p is not None and p.spec is not None:
-                st.unsched[pf] = (bool(p.spec.pod_affinity), p.spec.gang or None)
+        self._record_verdicts(st, snapshot, unschedulable, pending_all)
         self.state = st
         self._placements_since_plan = False
 

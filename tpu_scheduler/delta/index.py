@@ -5,11 +5,17 @@ this module is the missing classification layer: each cycle's raw pod events
 fold into the SolveState's capacity tensors and produce the DIRTY set — the
 pods whose last verdict can no longer be trusted — which then CLOSES:
 
-  • **capacity closure** — a deleted/retired placement frees capacity, so
-    every skipped unschedulable verdict is retired (the freed room may fit
-    them now).  Deliberately conservative: per-(pod, node) blocking sets
-    would be a [P, N] bitmap; retiring all verdicts on any free is O(skipped)
-    and can only cause extra re-solves, never a missed placement.
+  • **capacity closure** — a deleted/retired placement frees capacity on a
+    KNOWN node, so exactly the verdicts that node was blocking retire: a
+    plain (constraint-free) pod's infeasibility is per-node-local
+    predicates + capacity, so freed room on node X can only cure verdicts
+    whose BLOCKING SET (node-locally-feasible nodes, computed at verdict
+    time) contains X — churn on an unrelated node leaves them standing.
+    Constrained verdicts (anti-affinity / pod-affinity / spread / gang)
+    and verdicts without a blocking set (the budget ran out) keep the old
+    coarse rule — any free retires — because a placed-pod deletion
+    anywhere can shift their cross-node domain state.  Conservative
+    either way: extra re-solves possible, missed placements never.
   • **constraint closure** — a deleted PENDING pod frees no capacity but may
     have been the anti-affinity carrier (or spread-domain occupant, via the
     ``sp_dom_sel``-projected cells) whose term blocked someone; verdicts
@@ -40,13 +46,28 @@ __all__ = ["DeltaIndex", "FoldResult"]
 class FoldResult:
     """One cycle's classification verdict."""
 
-    __slots__ = ("ok", "freed", "carrier_deleted", "dirty")
+    __slots__ = ("ok", "freed_nodes", "freed_unknown", "carrier_deleted", "dirty")
 
     def __init__(self):
         self.ok = True  # False => escalate (vocabulary drift)
-        self.freed = False  # any committed capacity was released
+        self.freed_nodes: set[str] = set()  # nodes where committed capacity released
+        self.freed_unknown = False  # capacity freed on an untracked node (coarse)
         self.carrier_deleted = False  # a pending pod (potential AA/spread carrier) vanished
         self.dirty: set[str] = set()  # pod full names whose verdict retired
+
+    @property
+    def freed(self) -> bool:
+        return self.freed_unknown or bool(self.freed_nodes)
+
+    def note_release(self, node) -> None:
+        """Fold one SolveState.release result: a node name is a per-node
+        free, "" an untracked (coarse) free, None a no-op."""
+        if node is None:
+            return
+        if node:
+            self.freed_nodes.add(node)
+        else:
+            self.freed_unknown = True
 
 
 def _pod_full(key) -> str:
@@ -56,6 +77,31 @@ def _pod_full(key) -> str:
 
 def _node_of(pod) -> str | None:
     return pod.spec.node_name if pod is not None and pod.spec is not None else None
+
+
+# shape: (pod: obj, snapshot: obj) -> obj
+def blocking_nodes(pod, snapshot) -> frozenset:
+    """The pod's node-locally-feasible node names — the per-verdict
+    BLOCKING SET: selector / taint / required-node-affinity / cordon
+    exclusions are static for the SolveState's node signature (any node
+    content change escalates to a full wave), so freed capacity on a node
+    OUTSIDE this set can never cure the verdict."""
+    from ..core.predicates import NODE_LOCAL_PREDICATES
+
+    return frozenset(
+        node.name
+        for node in snapshot.nodes
+        if all(pred(pod, node, snapshot) for _r, pred in NODE_LOCAL_PREDICATES)
+    )
+
+
+# shape: (pod: obj) -> bool
+def verdict_constrained(pod) -> bool:
+    """Cross-node-entangled verdicts (anti-affinity / pod-affinity /
+    topology-spread / gang) always retire on any freed capacity — a
+    placed-pod deletion anywhere can shift their domain counts."""
+    s = pod.spec
+    return s is not None and bool(s.anti_affinity or s.pod_affinity or s.topology_spread or s.gang)
 
 
 class DeltaIndex:
@@ -88,8 +134,9 @@ class DeltaIndex:
         for key, prev, new in events:
             pf = _pod_full(key)
             if new is None:  # DELETED
-                if state.release(pf):
-                    out.freed = True
+                released = state.release(pf)
+                if released is not None:
+                    out.note_release(released)
                 elif _node_of(prev) is None:
                     # A pending pod vanished: zero capacity change, but it
                     # may have carried the term/domain cell blocking a
@@ -109,17 +156,17 @@ class DeltaIndex:
                 elif ent[1] != node or (ent[2] != req).any():
                     # Re-bound elsewhere (409 winner) or request drift: move
                     # the mass; the old node's room frees.
-                    state.release(pf)
+                    out.note_release(state.release(pf))
                     state.commit(pf, node, req)
-                    out.freed = True
                 else:
                     state.unsched.pop(pf, None)  # confirmed; verdict moot
                 continue
             # Pending (created or modified): its spec may have changed —
-            # any standing verdict retires and the pod re-solves.
+            # any standing verdict retires and the pod re-solves.  A
+            # bound -> pending transition (a rebalancer deschedule, or a
+            # defensive regression) frees its node's room.
             out.dirty.add(pf)
-            if state.release(pf):
-                out.freed = True  # bound -> pending regression (defensive)
+            out.note_release(state.release(pf))
             state.unsched.pop(pf, None)
         return out
 
@@ -131,29 +178,45 @@ class DeltaIndex:
         simply "pending and without a standing verdict" — the engine picks
         the cycle's work straight off ``state.unsched`` membership."""
         retired = 0
-        if fold.freed or fold.carrier_deleted:
-            retired += len(state.unsched)
-            state.unsched.clear()
-        elif placements_made:
+        standing = state.unsched
+        if fold.freed_unknown or fold.carrier_deleted:
+            # Coarse path: capacity freed outside the packed axis, or a
+            # potential constraint carrier vanished — retire everything.
+            retired += len(standing)
+            standing.clear()
+        elif fold.freed_nodes:
+            # Per-node capacity closure: freed room on node X retires a
+            # PLAIN verdict only when X is in its blocking set (node-
+            # locally feasible — a selector/taint-excluded node's churn
+            # cannot cure it).  Constrained verdicts and budget-elided
+            # blocking sets keep the coarse any-free rule.
+            freed = fold.freed_nodes
+            for pf in [
+                pf
+                for pf, (_pa, _g, blocked, constrained) in standing.items()
+                if constrained or blocked is None or (blocked & freed)
+            ]:
+                del standing[pf]
+                retired += 1
+        if placements_made:
             # New placements only ADD feasibility through positive
             # pod-affinity — retire exactly those verdicts.
-            for pf in [pf for pf, (has_pa, _g) in state.unsched.items() if has_pa]:
-                del state.unsched[pf]
+            for pf in [pf for pf, ent in standing.items() if ent[0]]:
+                del standing[pf]
                 retired += 1
-        if not state.unsched:
+        if not standing:
             return retired
         # Gang closure: a dirty member (fresh pod, retired verdict) dirties
         # the whole gang — membership over the FULL pending set, so a member
         # in backoff still drags its gang-mates' verdicts with it when it
         # re-dirties.
         dirty_gangs: set[str] = set()
-        standing = state.unsched
         for p in pending_all:
             g = p.spec.gang if p.spec is not None else None
             if g and full_name(p) not in standing:
                 dirty_gangs.add(g)
         if dirty_gangs:
-            for pf in [pf for pf, (_pa, g) in standing.items() if g in dirty_gangs]:
+            for pf in [pf for pf, ent in standing.items() if ent[1] in dirty_gangs]:
                 del standing[pf]
                 retired += 1
         return retired

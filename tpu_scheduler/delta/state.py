@@ -88,10 +88,17 @@ class SolveState:
     # pod full name -> (node row or -1 for untracked nodes, node name,
     # [R] i64 request) for every committed placement.
     placements: dict[str, tuple[int, str, np.ndarray]] = field(default_factory=dict)
-    # pod full name -> (has_pod_affinity, gang name or None): the
-    # skipped-verdict ledger.  Membership means "proven unschedulable and
-    # the proof still stands"; delta/index.py retires entries.
-    unsched: dict[str, tuple[bool, str | None]] = field(default_factory=dict)
+    # pod full name -> (has_pod_affinity, gang name or None, blocking node
+    # set or None, constrained): the skipped-verdict ledger.  Membership
+    # means "proven unschedulable and the proof still stands";
+    # delta/index.py retires entries.  The BLOCKING SET is the pod's
+    # node-locally-feasible node names — the only nodes where freed
+    # capacity could cure a plain pod's verdict (None = unknown, treated
+    # coarsely: any free retires).  ``constrained`` marks verdicts whose
+    # feasibility entangles cross-node state (anti-affinity / pod-affinity
+    # / spread / gang): a placed-pod deletion ANYWHERE can shift their
+    # domain counts, so they always retire on any freed capacity.
+    unsched: dict[str, tuple[bool, str | None, frozenset | None, bool]] = field(default_factory=dict)
     generation: int = 0
     delta_cycles_since_full: int = 0
 
@@ -117,15 +124,19 @@ class SolveState:
         self.unsched.pop(pod_full, None)
         return True
 
-    # shape: (self: obj, pod_full: obj) -> bool
-    def release(self, pod_full: str) -> bool:
+    # shape: (self: obj, pod_full: obj) -> obj
+    def release(self, pod_full: str):
         """Retire one placement, freeing its capacity (watch DELETE, requeue
         after a failed async bind, out-of-band rebind adjustments).  Returns
-        True when capacity was actually freed."""
+        the freed NODE NAME (the invalidation closure's per-node blocking
+        key), "" for a placement on an untracked node (freed, but outside
+        the packed axis — callers treat it coarsely), or None when there
+        was nothing to free."""
         ent = self.placements.pop(pod_full, None)
         if ent is None:
-            return False
-        r, _node, req64 = ent
+            return None
+        r, node, req64 = ent
         if r >= 0:
             self.used64[r] -= req64
-        return True
+            return node
+        return ""

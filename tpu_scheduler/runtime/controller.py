@@ -183,6 +183,7 @@ class Scheduler:
         topology="auto",
         delta: bool = True,
         delta_shadow_every: int = 0,
+        rebalance=None,
     ):
         if policy not in ("batch", "sample"):
             raise ValueError(f"unknown policy {policy!r} (expected 'batch' or 'sample')")
@@ -358,6 +359,19 @@ class Scheduler:
             self.delta.attach(self.reflector)
         else:
             self.delta = None
+        # Background rebalancer (tpu_scheduler/rebalance): the placement-
+        # quality tier — a cadence-gated packing solve over a consistent
+        # snapshot proposing bounded defragmentation migration batches,
+        # executed as breaker-gated unbind → cordon-empty → delta-engine
+        # re-place.  Batch-policy only (the victim taxonomy and the packing
+        # view are built on the batch path's ledgers); pass a
+        # RebalanceConfig (or True for defaults) to enable.
+        self.rebalancer = None
+        if rebalance is not None and rebalance is not False and policy == "batch":
+            from ..rebalance import Rebalancer, RebalanceConfig
+
+            cfg = rebalance if isinstance(rebalance, RebalanceConfig) else RebalanceConfig()
+            self.rebalancer = Rebalancer(cfg, metrics=self.metrics)
         # Sim-only shadow parity sampling: every Nth delta cycle also runs
         # the full-wave solve and asserts both placed the same pod set.
         self.delta_shadow_every = int(delta_shadow_every)
@@ -2321,6 +2335,13 @@ class Scheduler:
                 # drained queue.
                 with span("slo"):
                     self._update_pending_ages(pending_all)
+                if self.rebalancer is not None:
+                    # Background defrag tier (tpu_scheduler/rebalance):
+                    # AFTER the cycle's scheduling work — cadence-gated,
+                    # throttled by SLO burn/backlog/breaker, so the tier
+                    # never competes with the fast path for the cycle.
+                    with span("rebalance"):
+                        self._rebalance_tick(snapshot, pending_all)
 
         self._cycle_count += 1
         wall = time.perf_counter() - t0
@@ -2691,6 +2712,134 @@ class Scheduler:
                 "scheduler_slo_burn_rate", round(age / target, 6) if target > 0 else 0.0, labels={"tier": tier}
             )
 
+    # -- background rebalancer (tpu_scheduler/rebalance) -------------------
+
+    def _unbind(self, pod_full: str, node_name: str) -> bool:
+        """Breaker-gated deschedule of one migration victim: a CAS-guarded
+        ``unbind_pod`` POST (409 = the pod moved under the plan — the stale
+        plan loses, never the pod).  Every outcome feeds the breaker with
+        the usual taxonomy; the pre-bind hook covers the deschedule
+        decision point too, so a replica kill lands BEFORE the POST and a
+        crashed plan leaves every victim still bound."""
+        namespace, _, name = pod_full.rpartition("/")
+        if self.pre_bind_hook is not None:
+            self.pre_bind_hook(namespace or "default", name, node_name)
+        if self.breaker.mode() != "closed":
+            return False
+        try:
+            self.api.unbind_pod(namespace or "default", name, expect_node=node_name)
+        except ApiError as e:
+            self.breaker.record(e.code < 500)
+            logger.info("migration unbind of %s from %s failed: %s", pod_full, node_name, e)
+            return False
+        except (OSError, http.client.HTTPException) as e:
+            self.breaker.record(False)
+            logger.warning("migration unbind of %s failed: %s: %s", pod_full, type(e).__name__, e)
+            return False
+        self.breaker.record(True)
+        self.recorder.record(pod_full, "migration-unbound", self._cycle_tag, node=node_name, detail="defrag")
+        return True
+
+    def _set_rebalance_cordon(self, node: Node, drained: bool) -> bool:
+        """Cordon (label + unschedulable) or uncordon one rebalancer node
+        via the API — state lives in the cluster, so it survives a crash
+        and any successor's rebalancer recognizes it."""
+        from dataclasses import replace as dc_replace
+
+        from ..api.objects import NodeSpec
+        from ..rebalance import REBALANCE_CORDON_LABEL
+
+        labels = dict(node.metadata.labels or {})
+        if drained:
+            labels[REBALANCE_CORDON_LABEL] = "true"
+        else:
+            labels.pop(REBALANCE_CORDON_LABEL, None)
+        spec = node.spec if node.spec is not None else NodeSpec()
+        updated = dc_replace(
+            node,
+            metadata=dc_replace(node.metadata, labels=labels),
+            spec=dc_replace(spec, unschedulable=drained),
+        )
+        try:
+            self.api.update_node(updated)
+        except (ApiError, OSError, http.client.HTTPException) as e:
+            logger.warning("rebalance %scordon of %s failed: %s", "" if drained else "un", node.name, e)
+            return False
+        return True
+
+    def _rebalance_tick(self, snapshot: ClusterSnapshot, pending_all: list[Pod]) -> None:
+        """Assemble one tick's inputs and hand off to the Rebalancer.  In
+        sharded mode only the shard-0 owner rebalances (one cluster-wide
+        instance; a takeover of shard 0 IS the rebalancer failover)."""
+        if self.sharded and 0 not in self.shard_set.owned:
+            return
+        now = self.clock()
+        burn = 0.0
+        for _pf, (since, tier, _g) in self._pending_meta.items():
+            target = tier_target(tier)
+            if target > 0:
+                burn = max(burn, (now - since) / target)
+        try:
+            pdbs = list(getattr(self.api, "list_pdbs", list)())
+        except (ApiError, OSError, http.client.HTTPException):
+            pdbs = None  # the tick stands down (api-error) rather than guess
+        node_by = {n.name: n for n in snapshot.nodes}
+        # The throttle judges the RESIDUAL backlog — what this very cycle's
+        # solve left unplaced — not the pre-cycle pending list (which still
+        # counts pods the cycle just re-placed; a 1-cycle cadence would
+        # read its own migrations as demand pressure and thrash).
+        placed_names = {full_name(p) for p, _n in self._cycle_placed}
+        backlog = sum(1 for p in pending_all if full_name(p) not in placed_names)
+
+        def victim_ok(pf: str) -> bool:
+            return (
+                pf not in self.deferred_binds
+                and pf not in self._assumed
+                and (not self.sharded or self.shard_set.owns_name(pf))
+            )
+
+        self.rebalancer.tick(
+            snapshot,
+            topo=self._compiled_topology(snapshot),
+            pdbs=pdbs,
+            burn=burn,
+            backlog=backlog,
+            breaker_mode=self.breaker.mode(),
+            unbind=self._unbind,
+            cordon=lambda name: name in node_by and self._set_rebalance_cordon(node_by[name], True),
+            uncordon=lambda node: self._set_rebalance_cordon(node, False),
+            victim_ok=victim_ok,
+        )
+
+    def rebalance_snapshot(self) -> dict:
+        """The /debug/rebalance payload (GIL-atomic copies — the
+        resilience_snapshot stance), plus the live labeled-drained node
+        census so operators see the scale-down candidate set in place."""
+        if self.rebalancer is None:
+            return {"enabled": False}
+        from ..rebalance import REBALANCE_CORDON_LABEL
+
+        out = self.rebalancer.stats()
+        try:
+            drained = sorted(
+                n.name
+                for n in self.reflector.nodes.state()
+                if (n.metadata.labels or {}).get(REBALANCE_CORDON_LABEL)
+            )
+        except Exception:  # noqa: BLE001 — debug surface, never a crash
+            drained = []
+        out["drained_nodes"] = drained
+        cfg = self.rebalancer.config
+        out["config"] = {
+            "every": cfg.every,
+            "batch": cfg.batch,
+            "burn_limit": cfg.burn_limit,
+            "max_pending": cfg.max_pending,
+            "max_migrations": cfg.max_migrations,
+            "background": cfg.background,
+        }
+        return out
+
     def pending_age_debug(self, pod_full: str) -> dict | None:
         """The /debug/pods why-pending ``age`` block: how long this pod has
         been in the queue and which SLO tier it burns against.  Called from
@@ -2766,6 +2915,8 @@ class Scheduler:
         the bind worker) and hand off leadership (standbys take over
         immediately instead of waiting out the lease).  Idempotent."""
         self._join_binds()
+        if self.rebalancer is not None:
+            self.rebalancer.close()  # stop the background solve worker
         if self._renew_stop is not None:
             # Stop AND JOIN the renewal thread BEFORE releasing: a renew
             # already past its stop-check would otherwise re-acquire the

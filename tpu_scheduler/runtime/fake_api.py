@@ -316,6 +316,29 @@ class FakeApiServer:
             self._pods[(namespace, pod_name)] = bound
             self._emit("Pod", WatchEvent("MODIFIED", bound), prev=pod)
 
+    def unbind_pod(self, namespace: str, pod_name: str, expect_node: str | None = None) -> None:
+        """Deschedule: clear ``spec.nodeName`` and return the pod to
+        Pending in ONE atomic call (the rebalancer's migration seam — a
+        crash leaves the pod either bound or pending, never lost).
+
+        ``expect_node`` is a CAS guard: when given, the pod must currently
+        be bound to exactly that node or the call 409s — a stale migration
+        plan can never deschedule a pod that already moved."""
+        with self._lock:
+            pod = self._pods.get((namespace, pod_name))
+            if pod is None:
+                raise ApiError(404, f"pod {namespace}/{pod_name} not found")
+            if not is_pod_bound(pod):
+                raise ApiError(409, f"pod {namespace}/{pod_name} is not bound")
+            if expect_node is not None and pod.spec.node_name != expect_node:
+                raise ApiError(
+                    409, f"pod {namespace}/{pod_name} is bound to {pod.spec.node_name}, not {expect_node}"
+                )
+            unbound = _evolve(pod, spec=_evolve(pod.spec, node_name=None), status=_evolve(pod.status, phase="Pending"))
+            self._bump(unbound)
+            self._pods[(namespace, pod_name)] = unbound
+            self._emit("Pod", WatchEvent("MODIFIED", unbound), prev=pod)
+
     # -- leader election (coordination.k8s.io/v1 Lease objects) ------------
     #
     # Spec-shaped primitives with resourceVersion compare-and-swap — the

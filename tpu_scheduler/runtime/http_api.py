@@ -63,6 +63,7 @@ class HttpApiServer:
         shards=None,
         profile=None,
         pending_ages=None,
+        rebalance=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -84,6 +85,10 @@ class HttpApiServer:
         # pending_age_debug: current age-in-queue + SLO tier for the
         # /debug/pods why-pending block.
         self.pending_ages = pending_ages
+        # () -> dict producing the /debug/rebalance payload (the
+        # controller's rebalance_snapshot: background-tier stats, drained
+        # node census, throttle config).
+        self.rebalance = rebalance
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -237,6 +242,15 @@ class HttpApiServer:
                             self._send_json(404, {"message": "profiler not attached"})
                         else:
                             self._send_json(200, outer.profile(q.get("replica", [None])[0]))
+                    elif parsed.path == "/debug/rebalance":
+                        # Background rebalancer (tpu_scheduler/rebalance):
+                        # migration/skip counters, in-flight ledger size,
+                        # drained-node census — controller state, served
+                        # sans flight recorder like /debug/resilience.
+                        if outer.rebalance is None:
+                            self._send_json(404, {"message": "rebalancer state not attached"})
+                        else:
+                            self._send_json(200, outer.rebalance())
                     elif parsed.path == "/debug/resilience":
                         # Backoff queue + circuit breaker + deferred-bind
                         # buffer — served even with the flight recorder

@@ -139,6 +139,16 @@ class ChaosApiServer:
         # Scheduler-driven pod deletions that succeeded (preemption victims,
         # NoExecute evictions) — sanctioned removals, not lost pods.
         self.evict_log: list[tuple[float, str]] = []
+        # Rebalancer deschedules that succeeded (rebalance/executor.py):
+        # the pod returned to Pending for a delta-engine re-place.  The
+        # harness drains this to keep its bound-pod bookkeeping exact (a
+        # migrated pod's re-bind is a migration completing, never a
+        # double-bind) and the scorecard derives orphaned-migration
+        # evidence from it.  ``unbind_actors`` mirrors ``bind_actors``:
+        # which replica issued each deschedule, so unbinds-while-open is
+        # judged against the POSTING replica's breaker.
+        self.unbind_log: list[tuple[float, str]] = []
+        self.unbind_actors: list[int] = []
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
@@ -196,6 +206,13 @@ class ChaosApiServer:
             raise ApiError(500, f"chaos: injected apiserver 500 deleting {namespace}/{name}")
         self.inner.delete_pod(namespace, name)
         self.evict_log.append((round(self.clock(), 9), f"{namespace}/{name}"))
+
+    def unbind_pod(self, namespace: str, pod_name: str, expect_node: str | None = None) -> None:
+        if self._decide("api_error_rate", "unbind-500"):
+            raise ApiError(500, f"chaos: injected apiserver 500 descheduling {namespace}/{pod_name}")
+        self.inner.unbind_pod(namespace, pod_name, expect_node)
+        self.unbind_log.append((round(self.clock(), 9), f"{namespace}/{pod_name}"))
+        self.unbind_actors.append(self.actor)
 
     def list_pdbs(self) -> list:
         if self._decide("api_error_rate", "list-pdbs-500"):

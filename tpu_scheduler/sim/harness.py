@@ -74,7 +74,7 @@ class _SimState:
         # disturbed set's business, not a locality verdict's).
         self.node_domains: dict[str, dict] = {}
         self.first_bind: dict[str, str] = {}
-        self.counts = {"arrived": 0, "churn_recreated": 0, "completed": 0, "evicted": 0}
+        self.counts = {"arrived": 0, "churn_recreated": 0, "completed": 0, "evicted": 0, "migrated": 0}
         self.ttb: list[float] = []
         self.double_bound = 0
 
@@ -217,6 +217,105 @@ def _incremental_block(sc: Scenario, fleet: MultiReplicaHarness) -> dict:
     return out
 
 
+def _rebalance_block(
+    sc: Scenario,
+    fleet: MultiReplicaHarness,
+    inner,
+    chaos,
+    pending_final,
+    lost_names,
+    open_iv_by_replica,
+    enabled: bool,
+    slo_churn: int,
+) -> dict:
+    """The scorecard ``rebalance`` verdict (tpu_scheduler/rebalance).
+
+    Packing efficiency / stranded capacity are computed from the FINAL API
+    state with the same exact-integer capacity math the rebalancer itself
+    packs with — so the rebalancer-OFF baseline gets the identical verdict
+    surface and must fail the same gate.  Orphan evidence comes from the
+    chaos unbind log vs the final state: a pod ever descheduled that ends
+    the run neither bound nor legitimately gone is an orphaned migration
+    (the acceptance quantity chaos variants hold at zero), and a
+    deschedule POSTed inside its OWN replica's breaker-open interval is a
+    degraded-mode bug counted in ``unbinds_while_open``."""
+    from ..core.snapshot import ClusterSnapshot
+    from ..rebalance import REBALANCE_CORDON_LABEL, RebalanceSnapshot, packing_stats
+
+    rebs = [r.rebalancer for r in fleet.scheds if r.rebalancer is not None]
+    out = {
+        "enabled": bool(rebs),
+        "required": bool(sc.rebalance_required),
+        "solves": 0,
+        "migrations": 0,
+        "completed": 0,
+        "skips": {},
+        "nodes_drained": 0,
+        "pressure_releases": 0,
+        "unbinds_while_open": 0,
+        "orphaned_migrations": 0,
+        "packing_efficiency": 1.0,
+        "efficiency_gate": round(float(sc.rebalance_efficiency_gate), 6),
+        "stranded_frac": 0.0,
+        "occupied_nodes": 0,
+        "empty_nodes": 0,
+        "migration_budget": int(sc.rebalance_migration_budget),
+        "preemption_churn": int(slo_churn),
+        "whatif": None,
+        "ok": True,
+    }
+    skips: dict[str, int] = {}
+    for reb in rebs:
+        s = reb.stats()
+        out["solves"] += s["solves"]
+        out["migrations"] += s["executed"]
+        out["completed"] += s["completed"]
+        out["nodes_drained"] += s["nodes_drained"]
+        out["pressure_releases"] += s["pressure_releases"]
+        for k, v in s["skips"].items():
+            skips[k] = skips.get(k, 0) + v
+    out["skips"] = dict(sorted(skips.items()))
+    final = ClusterSnapshot.build(inner.list_nodes(), inner.list_pods())
+    rs = RebalanceSnapshot.build(final)
+    stats = packing_stats(rs.alloc, rs.used)
+    out["packing_efficiency"] = stats["efficiency"]
+    out["stranded_frac"] = stats["stranded_frac"]
+    out["occupied_nodes"] = stats["occupied_nodes"]
+    out["empty_nodes"] = stats["empty_nodes"]
+    unbound_names = {pf.rpartition("/")[2] for _t, pf in chaos.unbind_log}
+    pending_names = {p.metadata.name for p in pending_final}
+    out["orphaned_migrations"] = len(unbound_names & (pending_names | set(lost_names)))
+    out["unbinds_while_open"] = sum(
+        1
+        for (t, _pf), actor in zip(chaos.unbind_log, chaos.unbind_actors)
+        if any(s < t < e for s, e in open_iv_by_replica[actor])
+    )
+    if sc.rebalance_whatif:
+        from ..rebalance import autoscaler_whatif
+
+        drained_labeled = sum(
+            1 for n in final.nodes if (n.metadata.labels or {}).get(REBALANCE_CORDON_LABEL)
+        )
+        out["whatif"] = autoscaler_whatif(final, pending_final, drained_labeled=drained_labeled)
+    gate = out["efficiency_gate"]
+    budget = out["migration_budget"]
+    whatif = out["whatif"]
+    out["ok"] = bool(
+        (gate <= 0 or out["packing_efficiency"] >= gate)
+        and (budget <= 0 or out["migrations"] <= budget)
+        and out["orphaned_migrations"] == 0
+        and out["unbinds_while_open"] == 0
+        and (
+            whatif is None
+            or whatif["pending_unplaceable"] == 0
+            or whatif["nodes_needed"] >= 1
+        )
+    )
+    if not enabled and not sc.rebalance_required:
+        out["ok"] = True  # a scenario without the tier has nothing to judge
+    return out
+
+
 def _locality_block(sc: Scenario, st: "_SimState") -> dict:
     """The scorecard ``locality`` verdict: per-gang placement-distance
     statistics over FIRST-bind placements (bind-time locality — churn
@@ -282,6 +381,7 @@ def run_scenario(
     events_buffer: int = 4096,
     topology="auto",
     profile_gates: dict | None = None,
+    rebalance="auto",
 ) -> dict:
     """Run one scenario to its verdict; returns the scorecard dict.
 
@@ -294,7 +394,11 @@ def run_scenario(
     filled in place) receives the WALL-derived profiler gate inputs —
     aggregate attribution coverage and the measured overhead estimate —
     which are deliberately kept OFF the scorecard (it must stay
-    byte-identical across runs); `sim --profile-check` consumes them."""
+    byte-identical across runs); `sim --profile-check` consumes them.
+    ``rebalance`` mirrors the topology switch for the background defrag
+    tier: "auto" (default) follows the scenario's ``rebalance`` knob,
+    False forces the rebalancer-OFF baseline the fragmentation scorecard
+    block quantifies against (and must FAIL the efficiency gate)."""
     replay_data = load_trace(replay) if replay else None
     if replay_data is not None:
         sc = _resolve_scenario(replay_data["header"]["scenario"])
@@ -316,7 +420,10 @@ def run_scenario(
     # One harness regardless of replica count: replicas == 1 constructs the
     # scheduler exactly as the single-replica path always did (same rng
     # label, no shard machinery), so pre-sharding fingerprints hold.
-    fleet = MultiReplicaHarness(sc, seed, clock, chaos, backend, profile, events_buffer, topology)
+    rebalance_on = bool(getattr(sc, "rebalance", False)) and rebalance is not False
+    fleet = MultiReplicaHarness(
+        sc, seed, clock, chaos, backend, profile, events_buffer, topology, rebalance_on=rebalance_on
+    )
 
     writer = TraceWriter(record) if record else None
     if writer:
@@ -472,11 +579,14 @@ def run_scenario(
 
     bind_cursor = 0
     evict_cursor = 0
+    unbind_cursor = 0
 
     def fold_outcomes() -> int:
         """Fold chaos logs since the last cycle: time-to-bind, completion
-        scheduling, double-bind detection, sanctioned evictions."""
-        nonlocal bind_cursor, evict_cursor
+        scheduling, double-bind detection, sanctioned evictions, and
+        rebalancer deschedules (a migrated pod leaves the bound set so its
+        re-bind is a migration completing, never a double-bind)."""
+        nonlocal bind_cursor, evict_cursor, unbind_cursor
         new_binds = 0
         for t, pod_full, _node in chaos.bind_log[bind_cursor:]:
             name = pod_full.rpartition("/")[2]
@@ -500,6 +610,15 @@ def run_scenario(
                 st.disturbed_pods.add(name)
                 st.counts["evicted"] += 1
         evict_cursor = len(chaos.evict_log)
+        # Rebalancer deschedules happen AFTER the cycle's binds (the tick
+        # runs at cycle end), so draining them after the bind fold keeps
+        # intra-cycle order: unbound pods re-enter pending and their next
+        # bind re-adds them above.
+        for _t, pod_full in chaos.unbind_log[unbind_cursor:]:
+            name = pod_full.rpartition("/")[2]
+            st.bound_live.discard(name)
+            st.counts["migrated"] += 1
+        unbind_cursor = len(chaos.unbind_log)
         return new_binds
 
     # -- the discrete-event loop --------------------------------------------
@@ -629,6 +748,18 @@ def run_scenario(
         locality=_locality_block(sc, st),
         profile=_profile_block(sc, fleet),
         incremental=_incremental_block(sc, fleet),
+        rebalance=_rebalance_block(
+            sc,
+            fleet,
+            inner,
+            chaos,
+            pending_final,
+            lost,
+            open_iv_by_replica,
+            rebalance_on,
+            int(metrics_snapshot.get("scheduler_preemption_victims_total", 0))
+            + int(metrics_snapshot.get("scheduler_noexecute_evictions_total", 0)),
+        ),
         recorder_stats={
             "tracked_pods": sum(len(r.recorder.tracked_pods()) for r in fleet.scheds),
             "evicted_timelines": sum(r.recorder.evicted_timelines for r in fleet.scheds),

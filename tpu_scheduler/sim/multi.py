@@ -69,6 +69,7 @@ class MultiReplicaHarness:
         profile,
         events_buffer: int,
         topology,
+        rebalance_on: bool = False,
     ):
         self.sc = sc
         self.clock = clock
@@ -91,6 +92,21 @@ class MultiReplicaHarness:
                 # control flow, so record/replay bit-identity holds.
                 delta_shadow_every=getattr(sc, "delta_shadow_every", 0),
             )
+            if rebalance_on:
+                # Background rebalancer (tpu_scheduler/rebalance), INLINE
+                # solve mode: a worker thread would race the VirtualClock,
+                # so the sim runs the packing solve synchronously inside
+                # the cadence-gated tick — every decision is control flow
+                # and record/replay bit-identity holds.
+                from ..rebalance import RebalanceConfig
+
+                kwargs.update(
+                    rebalance=RebalanceConfig(
+                        every=int(sc.rebalance_every),
+                        batch=int(sc.rebalance_batch),
+                        max_migrations=int(sc.rebalance_migration_budget),
+                    )
+                )
             if self.replicas > 1:
                 kwargs.update(shards=self.shards, identity=f"replica-{i}", lease_duration=sc.lease_duration)
             self.scheds.append(Scheduler(chaos, backend, **kwargs))

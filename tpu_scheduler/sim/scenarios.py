@@ -60,6 +60,22 @@ class Scenario:
     # every sampled cycle AND full_solve_fraction <= 0.10).
     delta_shadow_every: int = 0
     incremental_required: bool = False
+    # Background rebalancer (tpu_scheduler/rebalance): ``rebalance`` runs
+    # the defrag tier inline on the cycle cadence (``rebalance_every``
+    # cycles between ticks, ``rebalance_batch`` migrations per tick);
+    # ``rebalance_required`` gates the scorecard pass on the ``rebalance``
+    # block's ok — final packing efficiency >= ``rebalance_efficiency_gate``
+    # (0 disables the efficiency gate), migrations within
+    # ``rebalance_migration_budget`` (0 = unbounded), and ZERO orphaned
+    # migrations.  ``rebalance_whatif`` computes the autoscaler what-if
+    # block (node-add need for the final backlog, scale-down headroom).
+    rebalance: bool = False
+    rebalance_every: int = 4
+    rebalance_batch: int = 8
+    rebalance_required: bool = False
+    rebalance_efficiency_gate: float = 0.0
+    rebalance_migration_budget: int = 0
+    rebalance_whatif: bool = False
 
 
 SCENARIOS: dict[str, Scenario] = {}
@@ -273,6 +289,110 @@ _register(
         ),
         delta_shadow_every=8,
         incremental_required=True,
+    )
+)
+
+_register(
+    Scenario(
+        name="fragmentation-long-horizon",
+        description="Long-horizon fragmentation: arrival waves place-and-spread across 24 nodes, completions thin the cluster to a sparse scatter, and the quiet tail belongs to the background rebalancer — the scorecard rebalance block must recover the packing-efficiency gate within the migration budget (pass-gated), where the rebalancer-off baseline stays fragmented and fails it",
+        duration=120.0,
+        workload=WorkloadSpec(
+            initial_nodes=24,
+            arrival_rate=0.0,
+            bursts=((1.0, 90), (8.0, 70), (16.0, 60)),
+            pod_cpu_m=(500, 1000, 2000),
+            pod_mem_mi=(512, 1024, 2048),
+            lifetime_mean_s=45.0,
+        ),
+        rebalance=True,
+        rebalance_every=4,
+        rebalance_batch=12,
+        rebalance_required=True,
+        rebalance_efficiency_gate=0.35,
+        rebalance_migration_budget=160,
+        drain_grace_cycles=20,
+    )
+)
+
+_register(
+    Scenario(
+        name="defrag-smoke",
+        description="The defrag tier-1 gate: a 12-node single-wave fragmentation run sized to finish on CPU in seconds — the rebalancer must consolidate the surviving scatter past the efficiency gate within the migration budget while the rebalancer-off baseline fails the same gate (make defrag-smoke)",
+        duration=60.0,
+        workload=WorkloadSpec(
+            initial_nodes=12,
+            arrival_rate=0.0,
+            bursts=((1.0, 90),),
+            pod_cpu_m=(500, 1000, 2000),
+            pod_mem_mi=(512, 1024, 2048),
+            lifetime_mean_s=30.0,
+        ),
+        rebalance=True,
+        rebalance_every=3,
+        rebalance_batch=12,
+        rebalance_required=True,
+        rebalance_efficiency_gate=0.35,
+        rebalance_migration_budget=120,
+        drain_grace_cycles=20,
+    )
+)
+
+_register(
+    Scenario(
+        name="rebalance-under-chaos",
+        description="Migrations composed with the chaos stack: a hard binding blackout opens the breaker mid-defrag (unbinds must defer — zero deschedules through an open breaker), then the shard-0 owner carrying the rebalancer is crash-killed — the survivor absorbs shard 0 and the background tier with it, and the run must end with zero double-binds and ZERO orphaned migrations (pass-gated rebalance + availability blocks)",
+        duration=110.0,
+        workload=WorkloadSpec(
+            initial_nodes=20,
+            arrival_rate=0.0,
+            bursts=((1.0, 70), (10.0, 50)),
+            pod_cpu_m=(500, 1000, 2000),
+            pod_mem_mi=(512, 1024, 2048),
+            lifetime_mean_s=40.0,
+        ),
+        chaos=ChaosConfig(
+            windows=(
+                # Mid-defrag blackout: every binding POST 500s AND the
+                # deschedule endpoint itself faults — the breaker must
+                # open, the rebalancer must stand down (breaker-open
+                # skips), and zero unbinds may land inside the open spans.
+                ChaosWindow(start=8.0, end=22.0, binding_error_rate=1.0, api_error_rate=0.4, watch_drop_rate=0.3),
+            ),
+        ),
+        replicas=2,
+        shards=4,
+        lease_duration=5.0,
+        replica_kills=((40.0, 0),),
+        rebalance=True,
+        rebalance_every=4,
+        rebalance_batch=10,
+        rebalance_required=True,
+        rebalance_efficiency_gate=0.0,
+        rebalance_migration_budget=200,
+        drain_grace_cycles=30,
+    )
+)
+
+_register(
+    Scenario(
+        name="autoscaler-backlog-whatif",
+        description="The autoscaler what-if the packing tier makes answerable: an 8-node cluster buried under a forever-lived burst holds a standing pending backlog — the rebalancer must stand DOWN (backlog/SLO-burn throttle, counted skips), and the scorecard rebalance block's whatif must recommend a concrete node-add count that would clear the backlog (pass-gated consistency)",
+        duration=30.0,
+        workload=WorkloadSpec(
+            initial_nodes=8,
+            arrival_rate=0.0,
+            bursts=((1.0, 140),),
+            pod_cpu_m=(1000, 2000),
+            pod_mem_mi=(1024, 2048),
+            lifetime_mean_s=0.0,
+        ),
+        rebalance=True,
+        rebalance_every=2,
+        rebalance_batch=8,
+        rebalance_required=True,
+        rebalance_whatif=True,
+        drain_grace_cycles=10,
     )
 )
 

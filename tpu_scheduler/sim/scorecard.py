@@ -26,7 +26,14 @@ import json
 import tpu_scheduler.core.predicates as P
 from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
 
-__all__ = ["SCORECARD_FIELDS", "INCREMENTAL_FIELDS", "check_invariants", "build_scorecard", "fingerprint"]
+__all__ = [
+    "SCORECARD_FIELDS",
+    "INCREMENTAL_FIELDS",
+    "REBALANCE_FIELDS",
+    "check_invariants",
+    "build_scorecard",
+    "fingerprint",
+]
 
 # The closed top-level schema of a scorecard (drift-gated against README.md).
 SCORECARD_FIELDS = (
@@ -45,6 +52,7 @@ SCORECARD_FIELDS = (
     "locality",
     "profile",
     "incremental",
+    "rebalance",
     "flight_recorder",
     "fingerprint",
 )
@@ -70,6 +78,34 @@ INCREMENTAL_FIELDS = (
     "shadow_mismatches",
     "shadow_skipped",
     "shadow_parity_ok",
+    "ok",
+)
+
+# The closed schema of the ``rebalance`` block (drift-gated against the
+# README "Rebalancing & defragmentation" catalogue by the REBL analyze
+# rule).  Strictly deterministic quantities: lifetime counts from the
+# Rebalancer ledger, exact-integer packing stats over the FINAL cluster
+# state, and the orphan evidence derived from the chaos unbind log — never
+# wall clock, so byte-identity and record→replay hold.
+REBALANCE_FIELDS = (
+    "enabled",
+    "required",
+    "solves",
+    "migrations",
+    "completed",
+    "skips",
+    "nodes_drained",
+    "pressure_releases",
+    "unbinds_while_open",
+    "orphaned_migrations",
+    "packing_efficiency",
+    "efficiency_gate",
+    "stranded_frac",
+    "occupied_nodes",
+    "empty_nodes",
+    "migration_budget",
+    "preemption_churn",
+    "whatif",
     "ok",
 )
 
@@ -195,6 +231,7 @@ def build_scorecard(
     locality: dict,
     profile: dict,
     incremental: dict,
+    rebalance: dict,
     recorder_stats: dict,
     fp: str,
 ) -> dict:
@@ -241,6 +278,13 @@ def build_scorecard(
             and not (availability.get("enabled") and not availability.get("ok"))
             and not (profile.get("required") and not profile.get("coverage_ok"))
             and not (incremental.get("required") and not incremental.get("ok"))
+            # Rebalance-required scenarios additionally gate on the
+            # rebalance block's ok: final packing efficiency past the
+            # scenario's gate within the migration budget, zero orphaned
+            # migrations, zero deschedules through an open breaker, and a
+            # consistent autoscaler what-if — a fragmentation regression
+            # fails the run like an SLO regression does.
+            and not (rebalance.get("required") and not rebalance.get("ok"))
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -253,6 +297,7 @@ def build_scorecard(
         "locality": locality,
         "profile": profile,
         "incremental": incremental,
+        "rebalance": rebalance,
         "flight_recorder": recorder_stats,
         "fingerprint": fp,
     }

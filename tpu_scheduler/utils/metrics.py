@@ -130,6 +130,10 @@ class CycleMetrics:
     preempt_seconds: float = 0.0
     gang_seconds: float = 0.0
     slo_seconds: float = 0.0
+    # Background rebalancer tick (tpu_scheduler/rebalance): reconcile,
+    # packing snapshot/solve (inline mode), batch planning, migrations —
+    # its own phase so background-tier cost can never hide in `other`.
+    rebalance_seconds: float = 0.0
     other_seconds: float = 0.0  # wall minus every attributed phase
 
     @property

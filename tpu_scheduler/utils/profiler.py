@@ -78,6 +78,7 @@ SPAN_CATALOGUE = (
     "gang",        # per-gang admission accounting + locality stats
     "slo",         # pending-age tracker + burn-rate gauges
     "delta",       # incremental engine: classification/closure/commit (tpu_scheduler/delta)
+    "rebalance",   # background defrag tier: reconcile/solve/plan/migrate (tpu_scheduler/rebalance)
     # nested cost centers
     "index",       # delta sub-span: watch-event fold into the SolveState
     "close",       # delta sub-span: invalidation closure over standing verdicts
@@ -92,6 +93,9 @@ SPAN_CATALOGUE = (
     "pa",          # filter sub-span: positive-affinity bootstrap min-rank
     "spread",      # filter sub-span: spread rank-prefix admission + cascade
     "commit",      # choose sub-span: domain-state commit of accepted claims
+    "snapshot",    # rebalance sub-span: consistent packing-view build
+    "plan",        # rebalance sub-span: bounded whole-node batch selection
+    "migrate",     # rebalance sub-span: breaker-gated unbinds + cordons
     "epoch",       # one epoch of the host-driven size-shrinking driver
     "dispatch",    # epoch dispatch (async jit call; Python + trace time)
     "host-sync",   # the one per-epoch device fetch (device execute + transfer)
