@@ -37,6 +37,11 @@ REBL  — every migration reason / skip reason / config knob of the
         rebalance-exercising sim scenario (a registry entry passing
         ``rebalance=``) must appear in the README "Rebalancing &
         defragmentation" catalogue.
+FLET  — every multi-mesh fleet keyer mode (``fleet/keyer.KEYER_MODES``),
+        gang-reservation state (``fleet/reservation.RESERVATION_STATES``),
+        and fleet lease name/prefix (``fleet/reservation.
+        GANG_RESERVATION_PREFIX``, ``fleet/resize.SHARD_MAP_LEASE``) must
+        appear in the README "Multi-mesh fleet" catalogue.
 """
 
 from __future__ import annotations
@@ -56,6 +61,7 @@ CODES = {
     "PROF": "a profiler span name/SLO tier missing from the README \"Profiling\" catalogue",
     "DLTA": "a delta-engine escalation trigger/incremental scorecard field missing from the README \"Incremental scheduling\" catalogue",
     "REBL": "a rebalancer migration/skip reason/config knob/scorecard field/scenario missing from the README \"Rebalancing & defragmentation\" catalogue",
+    "FLET": "a fleet keyer mode/reservation state/lease name missing from the README \"Multi-mesh fleet\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -391,6 +397,53 @@ def _run_rebl(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_flet(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/fleet/keyer.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "KEYER_MODES":
+                            tokens.extend(_topo_tuple_entries(node.value, ("keyer mode",)))
+        elif f.rel == "tpu_scheduler/fleet/reservation.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if t.id == "RESERVATION_STATES":
+                            tokens.extend(_topo_tuple_entries(node.value, ("reservation state",)))
+                        elif (
+                            t.id == "GANG_RESERVATION_PREFIX"
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)
+                        ):
+                            tokens.append(("fleet lease prefix", node.value.value))
+        elif f.rel == "tpu_scheduler/fleet/resize.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Name)
+                            and t.id == "SHARD_MAP_LEASE"
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)
+                        ):
+                            tokens.append(("fleet lease name", node.value.value))
+    return [
+        Finding(
+            "FLET",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the multi-mesh fleet layer but is missing from the README "
+            f"\"Multi-mesh fleet\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
     return (
         _run_metr(ctx)
@@ -402,4 +455,5 @@ def run(ctx: Context) -> list[Finding]:
         + _run_prof(ctx)
         + _run_dlta(ctx)
         + _run_rebl(ctx)
+        + _run_flet(ctx)
     )

@@ -341,6 +341,40 @@ def test_rebl_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_flet_drift_and_guard():
+    keyer_mod = (
+        "tpu_scheduler/fleet/keyer.py",
+        'KEYER_MODES = ("ghost-keyer-mode",)\nOTHER = ("not-a-mode",)\n',
+    )
+    res_mod = (
+        "tpu_scheduler/fleet/reservation.py",
+        'RESERVATION_STATES = ("ghost-reservation-state",)\n'
+        'GANG_RESERVATION_PREFIX = "ghost-gang-"\n'
+        'NOT_A_LEASE = "plain-string"\n',
+    )
+    resize_mod = ("tpu_scheduler/fleet/resize.py", 'SHARD_MAP_LEASE = "ghost-shard-map"\n')
+    hits = rule_hits(catalogues.run(make_ctx(keyer_mod, res_mod, resize_mod, readme="")), "FLET")
+    # OTHER / NOT_A_LEASE are not catalogue constants — not FLET's business.
+    assert {h.message.split("'")[1] for h in hits} == {
+        "ghost-keyer-mode",
+        "ghost-reservation-state",
+        "ghost-gang-",
+        "ghost-shard-map",
+    }
+    ok = "ghost-keyer-mode ghost-reservation-state ghost-gang- ghost-shard-map"
+    assert not rule_hits(catalogues.run(make_ctx(keyer_mod, res_mod, resize_mod, readme=ok)), "FLET")
+
+
+def test_flet_real_tree_is_catalogued():
+    files = load_files(
+        ["tpu_scheduler/fleet/keyer.py", "tpu_scheduler/fleet/reservation.py", "tpu_scheduler/fleet/resize.py"]
+    )
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "FLET")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")

@@ -160,7 +160,7 @@ def test_v2_checkpoint_migrates_into_sharded_controller(tmp_path):
     save_scheduler(sched, str(tmp_path))
     with open(os.path.join(str(tmp_path), "state.json")) as f:
         v3 = json.load(f)
-    assert v3["version"] == 4 and v3["shard_count"] == 4
+    assert v3["version"] == 5 and v3["shard_count"] == 4
     for pf in ("default/a", "default/b", "default/g1-0"):
         assert pf in v3["shards"][str(shard_for_name(pf, 4))]["requeue"]
 

@@ -372,7 +372,7 @@ def test_checkpoint_v4_roundtrip_forces_full_wave(tmp_path):
     assert sched.delta.stats()["delta_cycles"] == 1
     save_scheduler(sched, str(tmp_path))
     state = json.load(open(os.path.join(str(tmp_path), "state.json")))
-    assert state["version"] == 4
+    assert state["version"] == 5
     assert state["delta"]["delta_cycles"] == 1 and state["delta"]["full_solve_reasons"] == {"cold": 1}
 
     sched2 = _sched(api)

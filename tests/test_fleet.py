@@ -1,0 +1,486 @@
+"""Multi-mesh fleet layer (tpu_scheduler/fleet): topology-keyed shard
+assignment (DomainShardMap/ShardKeyer, hash-mode bit-parity with the flat
+crc32), two-phase cross-replica gang reservations (all-or-nothing, TTL
+reclaim, zero-orphan accounting), live shard resizing (published shard map,
+disjoint-ownership invariant across split/merge without restart), checkpoint
+v5 shard-map persistence with v4 migration, and the vectorized reflector
+event fold (bit-parity with the scalar loop + microbench)."""
+
+import json
+import time
+
+import numpy as np
+
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.delta.index import DeltaIndex
+from tpu_scheduler.delta.state import SolveState, req64_of
+from tpu_scheduler.fleet.keyer import KEYER_MODES, DomainShardMap, ShardKeyer
+from tpu_scheduler.fleet.reservation import (
+    GANG_RESERVATION_PREFIX,
+    RESERVATION_STATES,
+    GangReservationLedger,
+    count_orphaned_reservations,
+    reservation_lease_name,
+)
+from tpu_scheduler.fleet.resize import (
+    SHARD_MAP_LEASE,
+    decode_shard_map,
+    encode_shard_map,
+    publish_shard_map,
+    read_shard_map,
+)
+from tpu_scheduler.runtime.checkpoint import restore_scheduler, save_scheduler
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.runtime.shards import (
+    ShardSet,
+    shard_for_name,
+    shard_lease_name,
+    shard_of_pod,
+)
+from tpu_scheduler.testing import make_node, make_pod
+from tpu_scheduler.topology.model import TopologyModel
+
+RACK_KEY = "topology.tpu-scheduler/rack"
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _racked_nodes(n, rack_size):
+    return [
+        make_node(f"n{i:03d}", cpu="64", memory="256Gi", labels={RACK_KEY: f"rack-{i // rack_size}"})
+        for i in range(n)
+    ]
+
+
+def _topo(nodes):
+    return TopologyModel.detect(nodes).compile(nodes)
+
+
+# -- topology-keyed sharding (fleet/keyer.py) --------------------------------
+
+
+def test_domain_map_partitions_contiguous_and_balanced():
+    nodes = _racked_nodes(8, 2)  # 4 racks of 2, snapshot order n000..n007
+    dm = DomainShardMap.compile(_topo(nodes), 2)
+    assert dm.num_shards == 2
+    assert dm.domains == ("rack-0", "rack-1", "rack-2", "rack-3")
+    assert dm.domain_shard == (0, 0, 1, 1)
+    # Each shard's node columns are a contiguous snapshot-order slice.
+    assert dm.shard_nodes[0] == tuple(f"n{i:03d}" for i in range(4))
+    assert dm.shard_nodes[1] == tuple(f"n{i:03d}" for i in range(4, 8))
+    assert dm.domains_of_shard(0) == ("rack-0", "rack-1")
+    assert dm.domains_of_shard(1) == ("rack-2", "rack-3")
+    assert all(dm.node_shard[f"n{i:03d}"] == (0 if i < 4 else 1) for i in range(8))
+
+
+def test_domain_map_never_splits_a_rack_and_stays_contiguous_when_uneven():
+    # 10 nodes, rack size 3 -> racks of 3/3/3/1: boundaries land between
+    # racks, never inside one, and concatenating the slices recovers the
+    # exact snapshot order (contiguity).
+    nodes = _racked_nodes(10, 3)
+    dm = DomainShardMap.compile(_topo(nodes), 3)
+    for dom, shard in zip(dm.domains, dm.domain_shard):
+        owners = {dm.node_shard[n.metadata.name] for n in nodes if n.metadata.labels[RACK_KEY] == dom}
+        assert owners == {shard}, (dom, owners)
+    flat = tuple(name for slice_ in dm.shard_nodes for name in slice_)
+    assert flat == tuple(n.metadata.name for n in nodes)
+    assert sum(len(s) for s in dm.shard_nodes) == 10
+
+
+def test_domain_map_is_deterministic_across_compiles():
+    nodes = _racked_nodes(12, 4)
+    a = DomainShardMap.compile(_topo(nodes), 4)
+    b = DomainShardMap.compile(_topo(nodes), 4)
+    assert a == b  # every replica derives the identical map
+
+
+def test_domain_map_degenerate_inputs_return_none():
+    nodes = _racked_nodes(4, 2)
+    topo = _topo(nodes)
+    assert DomainShardMap.compile(None, 4) is None  # topology-blind cluster
+    assert DomainShardMap.compile(topo, 1) is None  # unsharded K
+    assert DomainShardMap.compile(topo, 0) is None
+    empty = TopologyModel.from_node_labels().compile([])
+    assert DomainShardMap.compile(empty, 4) is None  # no nodes
+
+
+def test_hash_mode_is_bit_identical_to_flat_crc32():
+    k = ShardKeyer(4)
+    assert k.mode == KEYER_MODES[1] == "hash"
+    for i in range(200):
+        key = f"default/p{i}"
+        assert k.shard_for_key(key) == shard_for_name(key, 4)
+    pods = [make_pod(f"p{i}") for i in range(50)]
+    pods += [make_pod(f"g{i}", gang="train-job-7") for i in range(8)]
+    pods += [make_pod("other-ns", namespace="team-a")]
+    for p in pods:
+        assert k.shard_of_pod(p) == shard_of_pod(p, 4)
+    # No node columns in hash mode: the flat hash spans no topology slice.
+    assert k.node_set([0, 1, 2, 3]) == set()
+
+
+def test_topology_keyer_gang_atomicity_and_locality():
+    nodes = _racked_nodes(16, 4)
+    dm = DomainShardMap.compile(_topo(nodes), 4)
+    k = ShardKeyer(4, dm)
+    assert k.mode == KEYER_MODES[0] == "topology"
+    # Every gang member keys by the GANG name: one owner, atomic admission.
+    members = [make_pod(f"m{i}", gang="train-7") for i in range(12)]
+    assert {k.shard_of_pod(p) for p in members} == {k.shard_for_key("train-7")}
+    solo = make_pod("solo")
+    assert k.shard_of_pod(solo) == k.shard_for_key("default/solo")
+    # Keys spread over every shard and stay in range.
+    seen = {k.shard_for_key(f"default/p{i}") for i in range(400)}
+    assert seen == set(range(4))
+    # node_set unions the slices; out-of-range shard ids are ignored.
+    assert k.node_set([0]) == set(dm.shard_nodes[0])
+    assert k.node_set([0, 3]) == set(dm.shard_nodes[0]) | set(dm.shard_nodes[3])
+    assert k.node_set([99, -1]) == set()
+
+
+def test_keyer_single_shard_degenerates_to_zero():
+    nodes = _racked_nodes(4, 2)
+    dm = DomainShardMap.compile(_topo(nodes), 2)
+    k = ShardKeyer(1, dm)
+    assert k.shard_for_key("anything") == 0
+
+
+# -- cross-replica gang reservations (fleet/reservation.py) ------------------
+
+
+def test_reserve_is_all_or_nothing_with_rollback():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    led = GangReservationLedger(api, "r1", 6.0, clock)
+    assert reservation_lease_name("g1", 2).startswith(GANG_RESERVATION_PREFIX)
+    assert led.reserve("g1", [1, 2]) is True
+    assert api.get_lease(reservation_lease_name("g1", 1))["holder"] == "r1"
+    assert led.active() == {"g1": [1, 2]}
+    assert led.active_shards() == {1, 2}
+    # Re-reserving an active gang renews, never double-counts.
+    assert led.reserve("g1", [1, 2]) is True
+    assert led.counts["reserved"] == 1
+    # One refused peer CAS aborts the whole reservation and rolls back the
+    # rows already taken.
+    api.acquire_lease(reservation_lease_name("g2", 3), "r2", 60.0)
+    assert led.reserve("g2", [1, 3]) is False
+    assert api.get_lease(reservation_lease_name("g2", 1)) is None  # rolled back
+    assert "g2" not in led.active()
+    assert led.counts["aborted"] == 1
+    # Commit releases the rows immediately (no TTL wait for the peers).
+    assert led.commit("g1") is True
+    assert api.get_lease(reservation_lease_name("g1", 1)) is None
+    assert api.get_lease(reservation_lease_name("g1", 2)) is None
+    assert led.counts["committed"] == 1
+    assert led.commit("g1") is False  # already gone
+    assert set(led.counts) == set(RESERVATION_STATES)
+
+
+def test_crashed_owner_reservations_expire_within_one_ttl():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    led1 = GangReservationLedger(api, "r1", 6.0, clock)
+    assert led1.reserve("wide", [0, 1]) is True
+    # r1 crashes (stops renewing).  Before expiry the rows are orphaned and
+    # refuse a peer's reservation.
+    clock.t += 3.0
+    assert count_orphaned_reservations(api, clock.t, {"r2"}) == 2
+    led2 = GangReservationLedger(api, "r2", 6.0, clock)
+    assert led2.reserve("wide", [0]) is False
+    # Past the TTL the rows free with no survivor action: zero orphans, the
+    # peer's reservation lands.
+    clock.t += 4.0
+    assert count_orphaned_reservations(api, clock.t, {"r2"}) == 0
+    assert led2.reserve("wide", [0, 1]) is True
+    # The crashed owner's next renew discovers the loss and reports EXPIRED.
+    assert led1.renew() == 1
+    assert led1.active() == {} and led1.counts["expired"] == 1
+    # The live holder's rows are not orphans.
+    assert count_orphaned_reservations(api, clock.t, {"r2"}) == 0
+
+
+def test_abort_and_release_all_hand_rows_back_immediately():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    led = GangReservationLedger(api, "r1", 60.0, clock)  # long TTL: only release explains freeing
+    assert led.reserve("a", [1]) and led.reserve("b", [2, 3])
+    d = led.debug()
+    assert d["active"] == {"a": [1], "b": [2, 3]}
+    assert set(d["counts"]) == set(RESERVATION_STATES)
+    assert led.abort("a") is True
+    assert api.get_lease(reservation_lease_name("a", 1)) is None
+    led.release_all()
+    assert led.active() == {}
+    assert api.get_lease(reservation_lease_name("b", 2)) is None
+    assert led.counts["aborted"] == 2  # the explicit abort + release_all's
+    assert count_orphaned_reservations(api, clock.t, set()) == 0
+
+
+def test_orphan_count_is_vacuous_without_a_lease_collection_route():
+    class NoListApi:
+        pass
+
+    assert count_orphaned_reservations(NoListApi(), 0.0, set()) == 0
+
+
+# -- live shard resizing (fleet/resize.py + ShardSet) ------------------------
+
+
+def test_shard_map_holder_string_encoding():
+    assert encode_shard_map(3, 8) == "3:8"
+    assert decode_shard_map("3:8") == (3, 8)
+    for bad in (None, "", "x", "3", "a:b", "-1:4", "2:0", "1:2:3x", 7):
+        assert decode_shard_map(bad) is None, bad
+
+
+def test_publish_is_monotonic_and_read_ignores_expiry():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    assert read_shard_map(api) is None  # never published
+    assert publish_shard_map(api, 1, 8, 2.0) is True
+    assert read_shard_map(api) == (1, 8)
+    assert api.get_lease(SHARD_MAP_LEASE)["holder"] == "1:8"
+    # A stale publisher (generation not above the published one) is refused.
+    assert publish_shard_map(api, 1, 2, 2.0) is False
+    assert publish_shard_map(api, 0, 16, 2.0) is False
+    assert publish_shard_map(api, 2, 2, 2.0) is True
+    # The map outlives its lease TTL: configuration, not liveness.
+    clock.t += 100.0
+    assert read_shard_map(api) == (2, 2)
+    assert publish_shard_map(api, 3, 16, 2.0) is True
+    assert read_shard_map(api) == (3, 16)
+
+
+def test_live_split_and_merge_keep_ownership_disjoint_without_restart():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    s1 = ShardSet(api, 4, "r1", 6.0, clock)
+    s2 = ShardSet(api, 4, "r2", 6.0, clock)
+
+    def settle(rounds=4):
+        for _ in range(rounds):
+            s1.refresh()
+            s2.refresh()
+            clock.t += 1.0
+            # The invariant under test: at no refresh round do two live
+            # replicas ever own the same shard.
+            assert not (set(s1.owned) & set(s2.owned)), (s1.owned, s2.owned)
+
+    settle()
+    assert set(s1.owned) | set(s2.owned) == {0, 1, 2, 3}
+    # Split 4 -> 8: published by the shard-0 coordinator, adopted
+    # fleet-wide on the refresh cadence — no process restarted.
+    coord, other = (s1, s2) if 0 in s1.owned else (s2, s1)
+    assert other.publish_resize(8) is False  # only the shard-0 owner coordinates
+    assert coord.publish_resize(8) is True
+    settle()
+    assert s1.num_shards == s2.num_shards == 8
+    assert s1.map_generation == s2.map_generation >= 1
+    assert set(s1.owned) | set(s2.owned) == set(range(8))
+    assert len(s1.owned) == len(s2.owned) == 4  # proportional target holds
+    # Merge 8 -> 2: leases beyond the new range release on adoption.
+    coord = s1 if 0 in s1.owned else s2
+    assert coord.publish_resize(2) is True
+    settle()
+    assert s1.num_shards == s2.num_shards == 2
+    assert set(s1.owned) | set(s2.owned) == {0, 1}
+    for s in range(2, 8):
+        assert api.get_lease(shard_lease_name(s)) is None, s
+
+
+# -- checkpoint v5 / v4 migration -------------------------------------------
+
+
+def _sched(api, clock, identity="r1", shards=4):
+    return Scheduler(api, NativeBackend(), shards=shards, identity=identity, clock=clock, lease_duration=6.0)
+
+
+def _load(api, nodes=2):
+    api.load(nodes=[make_node(f"n{i}", cpu="64", memory="256Gi") for i in range(nodes)], pods=[])
+
+
+def test_checkpoint_v5_roundtrips_adopted_shard_map(tmp_path):
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    _load(api)
+    s = _sched(api, clock)
+    s.run_cycle()
+    assert publish_shard_map(api, 1, 8, 6.0) is True
+    clock.t += 1.0
+    s.run_cycle()  # the refresh round adopts the split
+    assert s.shard_set.num_shards == 8 and s.shard_set.map_generation == 1
+    save_scheduler(s, str(tmp_path))
+    state = json.load(open(tmp_path / "state.json"))
+    assert state["version"] == 5
+    assert state["shard_map"] == {"generation": 1, "num_shards": 8, "keyer": "hash"}
+
+    # Restore into a replica constructed on the deploy-time K=4: it resumes
+    # on the adopted K=8 instead of racing the old count against peers.
+    clock2 = FakeClock(5000.0)
+    api2 = FakeApiServer(clock=clock2)
+    _load(api2)
+    s2 = _sched(api2, clock2)
+    assert restore_scheduler(s2, str(tmp_path)) is True
+    assert s2.shard_set.num_shards == 8 and s2.shard_set.map_generation == 1
+    assert s2.num_shards == 8
+    # A NEWER published map still wins on the first refresh round.
+    assert publish_shard_map(api2, 2, 2, 6.0) is True
+    s2.run_cycle()
+    assert s2.shard_set.num_shards == 2 and s2.shard_set.map_generation == 2
+
+
+def test_checkpoint_without_resize_omits_shard_map(tmp_path):
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    _load(api)
+    s = _sched(api, clock)
+    s.run_cycle()
+    save_scheduler(s, str(tmp_path))
+    state = json.load(open(tmp_path / "state.json"))
+    assert state["version"] == 5 and state["shard_map"] is None
+
+
+def test_checkpoint_v4_migrates_with_one_full_wave(tmp_path):
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    _load(api)
+    s = _sched(api, clock)
+    s.run_cycle()
+    save_scheduler(s, str(tmp_path))
+    # Rewrite as a v4 file: no shard_map key existed before v5.
+    state = json.load(open(tmp_path / "state.json"))
+    state["version"] = 4
+    state.pop("shard_map", None)
+    json.dump(state, open(tmp_path / "state.json", "w"))
+
+    clock2 = FakeClock(5000.0)
+    api2 = FakeApiServer(clock=clock2)
+    _load(api2)
+    s2 = _sched(api2, clock2)
+    assert restore_scheduler(s2, str(tmp_path)) is True
+    # No map to adopt: the replica keeps its constructed K…
+    assert s2.shard_set.num_shards == 4 and s2.shard_set.map_generation == 0
+    # …and the restore escalates exactly the documented one full wave.
+    s2.run_cycle()
+    assert s2.delta.full_solve_reasons.get("restore", 0) >= 1
+
+
+# -- vectorized reflector event fold (delta/index.py) ------------------------
+
+
+def _mk_state(n_nodes=6):
+    names = tuple(f"fn{i}" for i in range(n_nodes))
+    return SolveState(
+        node_names=names,
+        node_sig=("sig",),
+        res_vocab=("cpu", "memory"),
+        res_scales=(1, 1),
+        alloc64=np.full((n_nodes, 2), 10**12, dtype=np.int64),
+        used64=np.zeros((n_nodes, 2), dtype=np.int64),
+        row={nm: i for i, nm in enumerate(names)},
+    )
+
+
+def _seed_and_events(state, n=30):
+    """Commit placements then build one unique-key event wave mixing
+    deletes, re-pendings, rebinds, fresh binds, and a pending-carrier
+    delete — deterministic, so two states seed identically."""
+    names = state.node_names
+    for i in range(n // 2):
+        node = names[i % len(names)]
+        pod = make_pod(f"old{i}", cpu="500m", memory="1Gi", node_name=node)
+        state.commit(f"default/old{i}", node, req64_of(pod, state.res_vocab))
+    state.unsched["default/old1"] = (False, None, None, False)
+    events = []
+    for i in range(n // 2):
+        prev = make_pod(f"old{i}", node_name=names[i % len(names)])
+        if i % 3 == 0:  # watch DELETE of a committed placement
+            events.append((("default", f"old{i}"), prev, None))
+        elif i % 3 == 1:  # bound -> pending (deschedule)
+            events.append((("default", f"old{i}"), prev, make_pod(f"old{i}")))
+        else:  # out-of-band rebind to another node
+            other = names[(i + 1) % len(names)]
+            events.append((("default", f"old{i}"), prev, make_pod(f"old{i}", node_name=other)))
+    for i in range(n - n // 2):
+        node = names[(i * 3) % len(names)]
+        events.append(
+            (("default", f"new{i}"), None, make_pod(f"new{i}", cpu="250m", memory="512Mi", node_name=node))
+        )
+    # A pending pod vanishing: zero capacity change, carrier_deleted set.
+    events.append((("default", "ghost"), make_pod("ghost"), None))
+    return events
+
+
+def test_vectorized_fold_matches_scalar_bit_for_bit():
+    fast, slow = _mk_state(), _mk_state()
+    ev_fast, ev_slow = _seed_and_events(fast), _seed_and_events(slow)
+    assert len(ev_fast) >= 8 and len({k for k, _p, _n in ev_fast}) == len(ev_fast)
+    out_fast = DeltaIndex().fold(fast, ev_fast)
+    out_slow = DeltaIndex()._fold_scalar(slow, ev_slow)
+    # int64 scatter adds are exact and order-free: bit-identical tensors.
+    assert (fast.used64 == slow.used64).all()
+    assert set(fast.placements) == set(slow.placements)
+    for pf, ent in fast.placements.items():
+        other = slow.placements[pf]
+        assert ent[0] == other[0] and ent[1] == other[1] and (ent[2] == other[2]).all()
+    assert fast.unsched == slow.unsched
+    # The FoldResult verdict matches field for field.
+    assert out_fast.ok == out_slow.ok is True
+    assert out_fast.freed_nodes == out_slow.freed_nodes
+    assert out_fast.freed_unknown == out_slow.freed_unknown
+    assert out_fast.carrier_deleted == out_slow.carrier_deleted is True
+    assert out_fast.dirty == out_slow.dirty
+
+
+def test_fold_dispatch_fast_path_vs_fallbacks(monkeypatch):
+    calls = []
+    orig = DeltaIndex._fold_scalar
+    monkeypatch.setattr(
+        DeltaIndex, "_fold_scalar", lambda self, st, ev: calls.append(len(ev)) or orig(self, st, ev)
+    )
+    st = _mk_state()
+    events = _seed_and_events(st, n=20)
+    out = DeltaIndex().fold(st, events)
+    assert out.ok and not calls  # unique keys, >= 8 events: vectorized path
+    # Duplicate keys fall back to the order-dependent scalar loop.
+    st2 = _mk_state()
+    ev2 = _seed_and_events(st2, n=20)
+    DeltaIndex().fold(st2, ev2 + [ev2[0]])
+    assert calls == [len(ev2) + 1]
+    # Small waves take the scalar loop directly.
+    calls.clear()
+    st3 = _mk_state()
+    DeltaIndex().fold(st3, _seed_and_events(st3, n=4)[:3])
+    assert len(calls) == 1
+
+
+def test_vectorized_fold_microbench():
+    """The batch fold must not lose to the scalar loop on a large unique-key
+    wave (generous 1.5x margin absorbs timer noise; the dispatch test above
+    pins that the fast path actually runs)."""
+    n = 3000
+    best = {"fast": float("inf"), "slow": float("inf")}
+    for _ in range(3):
+        for label, fn in (("fast", DeltaIndex.fold), ("slow", DeltaIndex._fold_scalar)):
+            st = _mk_state(64)
+            events = [
+                (
+                    ("default", f"p{i}"),
+                    None,
+                    make_pod(f"p{i}", cpu="250m", memory="512Mi", node_name=st.node_names[i % 64]),
+                )
+                for i in range(n)
+            ]
+            idx = DeltaIndex()
+            t0 = time.perf_counter()
+            out = fn(idx, st, events)
+            best[label] = min(best[label], time.perf_counter() - t0)
+            assert out.ok and len(st.placements) == n
+    assert best["fast"] <= best["slow"] * 1.5, best

@@ -1,0 +1,87 @@
+"""Multi-mesh fleet chaos verification (the fleet scale-out scenarios):
+killing a replica must drive takeover -> mesh rebind -> one escalated full
+wave within the lease bound, cross-shard gangs must admit through two-phase
+reservations with zero orphans, and both runs must replay bit-identically
+from their recorded chaos traces."""
+
+import json
+
+import pytest
+
+from tpu_scheduler.fleet.reservation import GangReservationLedger
+from tpu_scheduler.sim import run_scenario
+from tpu_scheduler.sim.multi import AVAILABILITY_FIELDS
+from tpu_scheduler.sim.scenarios import SCENARIOS
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_mesh_rebind_on_takeover_passes_and_replays(seed, tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    card = run_scenario("mesh-rebind-on-takeover", seed=seed, record=path)
+    assert card["pass"], json.dumps(card["invariants"])
+    a = card["availability"]
+    assert tuple(a) == AVAILABILITY_FIELDS  # closed schema
+    assert a["enabled"] and a["ok"]
+    assert a["double_binds"] == 0 and a["orphaned_pods"] == 0
+    assert a["orphaned_reservations"] == 0
+    # Exactly one kill, absorbed within the 2 x lease_duration bound.
+    assert len(a["kills"]) == 1 and a["kills"][0]["replica"] == 0
+    assert a["kills"][0]["orphan_shards"], "the killed replica must have owned shards"
+    assert a["max_takeover_latency_s"] is not None
+    assert a["max_takeover_latency_s"] <= a["takeover_bound_s"] == 2 * a["lease_duration_s"]
+    # The survivor re-bound the orphaned shards onto its own device mesh:
+    # the delta engine's escalation ledger carries the mesh-rebind wave.
+    esc = card["incremental"]["escalations"]
+    assert esc.get("mesh-rebind", 0) >= 1, esc
+    assert esc.get("takeover", 0) >= 1, esc
+    # The whole run is bit-identical under record -> replay.
+    replayed = run_scenario(None, replay=path)
+    assert replayed["fingerprint"] == card["fingerprint"]
+    assert replayed["availability"] == a
+    assert replayed["incremental"]["escalations"] == esc
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_cross_shard_gang_admission_passes_and_replays(seed, tmp_path, monkeypatch):
+    # Spy on the ledger (call-through, zero behavior change) to prove the
+    # workload actually exercised two-phase reservations: the scorecard's
+    # metrics block is curated and does not surface the fleet counters.
+    calls = []
+    orig = GangReservationLedger.reserve
+    monkeypatch.setattr(
+        GangReservationLedger,
+        "reserve",
+        lambda self, gang, peers: calls.append(gang) or orig(self, gang, peers),
+    )
+    path = str(tmp_path / "trace.jsonl")
+    card = run_scenario("cross-shard-gang-admission", seed=seed, record=path)
+    assert card["pass"], json.dumps(card["invariants"])
+    a = card["availability"]
+    assert tuple(a) == AVAILABILITY_FIELDS
+    assert a["enabled"] and a["ok"]
+    assert a["kills"] == []  # chaos here is a brownout, not a crash
+    assert a["double_binds"] == 0 and a["orphaned_pods"] == 0
+    # The zero-orphans verdict: every reservation committed, aborted, or
+    # expired — none left wedging peer capacity at settle.
+    assert a["orphaned_reservations"] == 0
+    assert calls, "no cross-shard gang reservation was ever attempted"
+    # Gang pods bound atomically (the sim's standing gang invariant).
+    assert card["pods"]["double_bound"] == 0
+    n_recorded = len(calls)
+    calls.clear()
+    replayed = run_scenario(None, replay=path)
+    assert replayed["fingerprint"] == card["fingerprint"]
+    assert replayed["availability"] == a
+    # Replay drives the identical reservation sequence.
+    assert len(calls) == n_recorded
+
+
+def test_registered_fleet_scenarios_carry_multi_config():
+    sc = SCENARIOS["mesh-rebind-on-takeover"]
+    assert sc.replicas == 2 and sc.shards == 4 and sc.replica_kills
+    assert sc.cycle_interval < sc.lease_duration
+    assert sc.workload.rack_size > 0  # topology-labeled: the keyer engages
+    gc = SCENARIOS["cross-shard-gang-admission"]
+    assert gc.replicas == 4 and gc.shards == 4 and not gc.replica_kills
+    assert gc.workload.gang_fraction > 0.3 and gc.workload.gang_size_max >= 8
+    assert gc.chaos.windows and gc.cycle_interval < gc.lease_duration

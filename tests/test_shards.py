@@ -274,7 +274,7 @@ def test_checkpoint_v3_roundtrips_shard_grouped_requeue_and_deferred(tmp_path):
     state = json.load(open(tmp_path / "state.json"))
     # v4 keeps the v3 shard-grouped layout byte-compatible (the delta key
     # rides alongside; tests/test_delta.py pins the v3 -> v4 migration).
-    assert state["version"] == 4 and state["shard_count"] == 4
+    assert state["version"] == 5 and state["shard_count"] == 4
     # Requeue entries grouped under their stable-hash shard.
     for pf in ("default/a", "default/b"):
         group = state["shards"][str(shard_for_name(pf, 4))]["requeue"]

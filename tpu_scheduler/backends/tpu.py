@@ -118,6 +118,49 @@ class TpuBackend(SchedulingBackend):
         self._dev_cache: dict[int, tuple[weakref.ref, object, object]] = {}  # guarded-by: _put_lock
         self._dev_cache_cap = 512
         self._put_lock = threading.Lock()
+        # Fleet mesh-per-replica bindings (parallel/mesh.MeshBinding), keyed
+        # by shard id.  Main-thread state: bound/released from the
+        # controller's shard-refresh path only.
+        self._mesh_bindings: dict[int, object] = {}
+
+    # -- fleet mesh bindings (tpu_scheduler/fleet) --------------------------
+
+    # shape: (self: obj, shard: int, num_shards: int) -> obj
+    def bind_shard_mesh(self, shard: int, num_shards: int):
+        """Bind one owned shard to this replica's contiguous device-slice
+        mesh (parallel/mesh.mesh_binding).  Idempotent per (shard, K); a
+        resize (new K) rebuilds the binding — the old slice geometry is
+        meaningless under the new shard map."""
+        ent = self._mesh_bindings.get(int(shard))
+        if ent is not None and ent.num_shards == int(num_shards):
+            return ent
+        from ..parallel.mesh import mesh_binding
+
+        ent = mesh_binding(int(shard), int(num_shards), devices=[self.device] if self.device else None)
+        self._mesh_bindings[int(shard)] = ent
+        return ent
+
+    # shape: (self: obj, shard: int) -> bool
+    def release_shard_mesh(self, shard: int) -> bool:
+        """Forget a lost shard's binding (the new owner builds its own)."""
+        return self._mesh_bindings.pop(int(shard), None) is not None
+
+    # shape: (self: obj) -> obj
+    def mesh_bindings_info(self) -> dict:
+        """/debug/shards payload: per-shard device ids + mesh shape + the
+        node-axis partition spec the slice's tensors are laid out over
+        (parallel/mesh.node_sharding)."""
+        from ..parallel.mesh import node_sharding
+
+        return {
+            str(s): {
+                "devices": list(b.device_ids),
+                "mesh_shape": [int(b.mesh.shape["dp"]), int(b.mesh.shape["tp"])],
+                "dedicated": bool(b.dedicated),
+                "node_sharding": str(node_sharding(b)),
+            }
+            for s, b in sorted(self._mesh_bindings.items())
+        }
 
     def _drop_dev_cache(self) -> None:
         """Forget every cached upload — after a device-runtime failure the

@@ -20,6 +20,10 @@ rebuild.  Escalation happens only on the closed ``ESCALATION_REASONS``:
                     a full sweep is cheaper than incremental bookkeeping
   epoch-refresh     periodic paranoia full-wave (bounds the lifetime of any
                     undetected bookkeeping drift)
+  mesh-rebind       a fleet takeover/resize rebound absorbed shards onto
+                    this replica's device mesh — the carried residuals were
+                    laid out for the old node slice, so the widened slice
+                    re-solves from scratch (tpu_scheduler/fleet)
 
 The shadow-solve parity gate (sim): on sampled delta cycles the controller
 solves the FULL eligible set beside the delta path and the engine records
@@ -52,6 +56,7 @@ ESCALATION_REASONS = (
     "vocab-change",
     "closure-overflow",
     "epoch-refresh",
+    "mesh-rebind",
 )
 
 
@@ -106,8 +111,14 @@ class DeltaEngine:
 
     def attach(self, reflector) -> None:
         """Subscribe to the reflector's pod event stream (the watch-delta
-        feed the DeltaIndex classifies)."""
-        reflector.add_pod_listener(self.index.on_pod_event)
+        feed the DeltaIndex classifies).  Prefers the BATCH feed (one call
+        per sync with the drained event list) over per-event dispatch; the
+        per-event path survives for reflectors without the batch hook."""
+        batch = getattr(reflector, "add_pod_batch_listener", None)
+        if batch is not None:
+            batch(self.index.on_pod_events)
+        else:
+            reflector.add_pod_listener(self.index.on_pod_event)
 
     def invalidate(self, reason: str) -> None:
         """Force the next plan to escalate (takeover, restore, breaker

@@ -21,10 +21,11 @@ single-process oracle.
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["make_mesh", "mesh_shape_for", "init_distributed"]
+__all__ = ["make_mesh", "mesh_shape_for", "init_distributed", "MeshBinding", "mesh_binding", "node_sharding"]
 
 
 def init_distributed(
@@ -90,3 +91,61 @@ def make_mesh(devices=None, tp: int | None = None):
     devices = sorted(devices, key=lambda d: (d.process_index, d.id))
     dp, tp_ = mesh_shape_for(len(devices), tp)
     return Mesh(np.array(devices).reshape(dp, tp_), ("dp", "tp"))
+
+
+@dataclass(frozen=True)
+class MeshBinding:
+    """One shard bound to one replica's device mesh (the fleet layer's
+    mesh-per-replica unit): the shard id, the (dp, tp) Mesh over the
+    shard's device slice, and the device ids for the /debug/shards view."""
+
+    shard: int
+    num_shards: int
+    mesh: object
+    device_ids: tuple
+    dedicated: bool  # False = fewer devices than shards; the slice is the whole set
+
+
+# shape: (shard: int, num_shards: int, devices: obj, tp: int) -> obj
+def mesh_binding(shard: int, num_shards: int, devices=None, tp: int | None = None) -> MeshBinding:
+    """Bind one shard to its contiguous slice of the device list.
+
+    Devices order process-major (the make_mesh contract) and split into
+    ``num_shards`` contiguous chunks; shard *i* gets chunk *i*, so peer
+    shards' solves run on disjoint silicon and a takeover rebinds the
+    absorbed shard onto the survivor's own chunk.  With fewer devices than
+    shards (the CPU tests, a 1-chip dev box) every shard binds the WHOLE
+    device set — correct, just not parallel across replicas."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    n = len(devices)
+    per = n // int(num_shards)
+    if per < 1:
+        chunk = devices
+        dedicated = False
+    else:
+        lo = int(shard) * per
+        # The last shard absorbs the remainder chunk.
+        hi = n if int(shard) == int(num_shards) - 1 else lo + per
+        chunk = devices[lo:hi]
+        dedicated = True
+    return MeshBinding(
+        shard=int(shard),
+        num_shards=int(num_shards),
+        mesh=make_mesh(chunk, tp=tp if tp is not None and len(chunk) % tp == 0 else 1),
+        device_ids=tuple(d.id for d in chunk),
+        dedicated=dedicated,
+    )
+
+
+# shape: (binding: obj) -> obj
+def node_sharding(binding: MeshBinding):
+    """NamedSharding laying the NODE sub-axis of a [..., N] operand over the
+    binding's ``tp`` mesh axis (the SNIPPETS.md NamedSharding idiom) — how a
+    shard's packed node tensors land on its own device slice."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(binding.mesh, P("tp"))

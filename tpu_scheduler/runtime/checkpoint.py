@@ -47,7 +47,16 @@ __all__ = ["save_scheduler", "restore_scheduler", "CHECKPOINT_VERSION"]
 #     full-wave solve that rebuilds them from live watch state — stale
 #     residuals are never trusted.  v1-v3 restore unchanged (no delta key;
 #     the engine just starts cold, which forces the same full wave).
-CHECKPOINT_VERSION = 4
+# v5: multi-mesh fleet (tpu_scheduler/fleet) — the adopted shard-map
+#     (generation, count) persists so a restarted replica resumes the
+#     RESIZED shard count instead of its constructed ``--shards`` (and never
+#     re-adopts an older generation).  The topology keyer itself is NOT
+#     persisted: it recompiles from the live node labels on the first
+#     cycle, the same trust-nothing stance as the delta residuals.  v4
+#     restores unchanged — no shard_map key, so the replica starts on its
+#     constructed count and the existing ``invalidate("restore")`` full
+#     wave doubles as the one-wave migration.
+CHECKPOINT_VERSION = 5
 
 _STATE_FILE = "state.json"
 _TENSORS_FILE = "node_tensors.npz"
@@ -109,6 +118,17 @@ def save_scheduler(scheduler, path: str) -> None:
         },
         "pdb_disruptions": {k: list(v) for k, v in scheduler._pdb_disruptions.items()},
         "node_sig": [list(pair) for pair in scheduler._node_sig] if scheduler._node_sig else None,
+        # v5: the adopted fleet shard map (generation + count + keyer mode);
+        # None for unsharded schedulers and fleets that never resized.
+        "shard_map": (
+            {
+                "generation": scheduler.shard_set.map_generation,
+                "num_shards": scheduler.shard_set.num_shards,
+                "keyer": scheduler.shard_set.keyer.mode if scheduler.shard_set.keyer is not None else "hash",
+            }
+            if getattr(scheduler, "shard_set", None) is not None and scheduler.shard_set.map_generation > 0
+            else None
+        ),
         # Delta-engine continuity (counters only — residuals rebuild live).
         "delta": (
             {
@@ -175,10 +195,24 @@ def restore_scheduler(scheduler, path: str) -> bool:
     # gate skips its cache (one full repack); v2's flat requeue fields fold
     # into the queue exactly as before — shard assignment is re-derived
     # live by the controller's stable hash, never read from the file.
-    if state.get("version") not in (1, 2, 3, CHECKPOINT_VERSION):
+    if state.get("version") not in (1, 2, 3, 4, CHECKPOINT_VERSION):
         raise ValueError(f"checkpoint version {state.get('version')} != {CHECKPOINT_VERSION}")
 
     scheduler._cycle_count = state.get("cycle_count", 0)
+    # v5: resume the adopted shard map.  The generation guard in
+    # ShardSet._adopt_shard_map still lets a NEWER published map win on the
+    # first refresh round; restoring here only prevents the restart from
+    # racing the old count against peers that already adopted the resize.
+    sm = state.get("shard_map")
+    if sm is not None and getattr(scheduler, "shard_set", None) is not None:
+        try:
+            gen, count = int(sm.get("generation", 0)), int(sm.get("num_shards", 0))
+        except (TypeError, ValueError):
+            gen, count = 0, 0
+        if gen > scheduler.shard_set.map_generation and count >= 1:
+            scheduler.shard_set.map_generation = gen
+            scheduler.shard_set.num_shards = count
+            scheduler.num_shards = count
     if getattr(scheduler, "delta", None) is not None:
         # The escalation/generation series survive the restart; the
         # residual ledgers never do — force one full-wave rebuild.

@@ -323,6 +323,22 @@ class Scheduler:
             self.shard_set = ShardSet(api, self.num_shards, self.identity, lease_duration, clock)
         else:
             self.shard_set = None
+        # Multi-mesh fleet layer (tpu_scheduler/fleet): topology-keyed
+        # sharding, one device mesh per replica, cross-replica gang
+        # admission.  Engages only when sharded AND the cycle's compiled
+        # topology is non-degenerate; otherwise every piece below is a
+        # no-op and the flat-hash shards behave exactly as before.
+        self._fleet_keyer: tuple | None = None  # (compiled-topo, ShardKeyer) cache
+        self._mesh_shards: frozenset = frozenset()  # shards with live mesh bindings
+        self._mesh_engaged = False  # a first binding exists; later gains escalate
+        self._fleet_slice_backoff = False  # sliced cycle left unschedulables → widen once
+        self._fleet_sliced = False  # the running cycle solved a node slice
+        if self.sharded:
+            from ..fleet.reservation import GangReservationLedger
+
+            self._fleet_reservations = GangReservationLedger(api, self.identity, lease_duration, clock)
+        else:
+            self._fleet_reservations = None
         self.is_leader = not self.leader_elect and not self.sharded
         # Takeover hygiene: set when leadership (or a shard) was newly
         # acquired; the next owned cycle revalidates the assumed-bind
@@ -343,7 +359,7 @@ class Scheduler:
         # OBJECT set (the API layer replaces node objects on modification,
         # so identity captures label changes too).
         self.topology = topology
-        self._topo_cache: tuple[tuple, object] | None = None
+        self._topo_cache: dict[tuple, tuple] = {}
         # Incremental delta-scheduling engine (tpu_scheduler/delta): the
         # steady-state cycle solves only the pods invalidated by watch
         # deltas against carried residual-capacity tensors; the full-wave
@@ -764,14 +780,18 @@ class Scheduler:
         if self.topology is None:
             return None
         key = tuple(id(n) for n in snapshot.nodes)
-        hit = self._topo_cache
-        if hit is not None and hit[0] == key:
-            return hit[1]
+        hit = self._topo_cache.get(key)
+        if hit is not None:
+            return hit[0]
         from ..topology.model import TopologyModel
 
         model = self.topology if isinstance(self.topology, TopologyModel) else TopologyModel.detect(snapshot.nodes)
         compiled = model.compile(snapshot.nodes) if model is not None else None
-        self._topo_cache = (key, compiled)
+        if len(self._topo_cache) >= 4:
+            # Tiny LRU-ish cap: the fleet path legitimately compiles two
+            # views per cycle (global for keying, sliced for the solve).
+            self._topo_cache.pop(next(iter(self._topo_cache)))
+        self._topo_cache[key] = (compiled,)
         return compiled
 
     def _attach_topology(self, packed, batch_snapshot: ClusterSnapshot):
@@ -2193,12 +2213,41 @@ class Scheduler:
                 with span("queue"):
                     pending_all = snapshot.pending_pods()
                     full_pending_count = len(pending_all)
+                    solve_base = snapshot
+                    fleet_sliced = False
                     if self.sharded:
+                        # Fleet keyer sync FIRST (tpu_scheduler/fleet): the
+                        # topology-keyed pod→shard map must be installed
+                        # before the ownership filter judges anything.
+                        self._fleet_sync(snapshot)
                         # Shard filter: this replica solves only the pods
-                        # whose stable-hash shard it owns (gang members hash
-                        # by gang name, so a gang is never split across
-                        # owners).
+                        # whose shard it owns (gang members key by gang
+                        # name, so a gang is never split across owners).
                         pending_all = [p for p in pending_all if self.shard_set.owns_pod(p)]
+                        # Cross-replica gang admission: reserve peer slices
+                        # for owned gangs wider than this replica's slice,
+                        # commit reservations whose gang left pending.
+                        self._fleet_reservation_tick(snapshot, pending_all)
+                        # Node slicing: under topology keying the solve sees
+                        # only the owned (+ reserved) shards' contiguous
+                        # node columns — P/K pods against N/K nodes, the
+                        # multi-mesh scaling surface.  The sliced snapshot
+                        # is ALSO what the delta engine plans/commits
+                        # against: its packed node axis must match.
+                        allowed = self._fleet_node_filter(snapshot)
+                        if allowed is not None:
+                            fleet_sliced = True
+                            solve_base = ClusterSnapshot.build(
+                                [n for n in snapshot.nodes if n.name in allowed],
+                                [
+                                    p
+                                    for p in snapshot.pods
+                                    if p.status.phase != "Pending"
+                                    or is_pod_bound(p)
+                                    or self.shard_set.owns_pod(p)
+                                ],
+                            )
+                    self._fleet_sliced = fleet_sliced
                     pending = self._eligible(pending_all)
                     # Prune requeue backoffs for pods that no longer exist /
                     # are no longer pending (deleted, or bound out-of-band).
@@ -2224,8 +2273,12 @@ class Scheduler:
                 eligible_all = pending
                 if self.delta is not None:
                     with span("delta"):
+                        # NB: the plan/commit snapshot is solve_base — under
+                        # fleet node slicing the engine's packed node axis
+                        # is the SLICED one, and handing it the global
+                        # snapshot would bail every rebuild.
                         self._delta_plan = self.delta.plan(
-                            snapshot,
+                            solve_base,
                             pending,
                             pending_all,
                             self._packed,
@@ -2248,7 +2301,7 @@ class Scheduler:
                         # object copies, no O(all pods) rebuild (the
                         # filtered rebuild below is the full-wave path's
                         # cost, exactly what the delta cycle shrinks away).
-                        cycle_snapshot = self._reduced_view(snapshot, pending)
+                        cycle_snapshot = self._reduced_view(solve_base, pending)
                     elif len(pending) == full_pending_count:
                         # Every pending pod of the WHOLE cluster is eligible
                         # (no requeue backoffs in force, no shard filtered
@@ -2259,14 +2312,15 @@ class Scheduler:
                         # would reproduce the snapshot verbatim, and at
                         # flagship scale one ClusterSnapshot.build over 200k+
                         # pods costs seconds (measured: the single largest
-                        # avoidable e2e cost).
-                        cycle_snapshot = snapshot
+                        # avoidable e2e cost).  (Under fleet node slicing
+                        # solve_base IS the sliced rebuild — still verbatim.)
+                        cycle_snapshot = solve_base
                     else:
                         cycle_snapshot = ClusterSnapshot.build(
-                            snapshot.nodes,
+                            solve_base.nodes,
                             [
                                 p
-                                for p in snapshot.pods
+                                for p in solve_base.pods
                                 if p.status.phase != "Pending" or is_pod_bound(p) or full_name(p) in eligible_names
                             ],
                         )
@@ -2313,7 +2367,7 @@ class Scheduler:
                         with span("commit"):
                             self.delta.commit(
                                 self._delta_plan,
-                                snapshot,
+                                solve_base,
                                 self._packed,
                                 self.reflector.node_set_signature(),
                                 self._cycle_placed,
@@ -2327,7 +2381,14 @@ class Scheduler:
                             and self._cycle_tag % self.delta_shadow_every == 0
                         ):
                             with span("shadow"):
-                                self._delta_shadow_check(snapshot, eligible_all, pending_all)
+                                self._delta_shadow_check(solve_base, eligible_all, pending_all)
+                if self.sharded:
+                    # Spillover backoff: a SLICED cycle that still left pods
+                    # unschedulable widens the next cycle to the full node
+                    # set (one cycle only — the flag re-arms each cycle), so
+                    # slice-capacity pressure degrades to the pre-fleet
+                    # behavior instead of wedging pods against N/K nodes.
+                    self._fleet_slice_backoff = bool(self._fleet_sliced and self._cycle_unschedulable)
                 # SLO burn bookkeeping (utils/profiler.SLO_TIERS): pods
                 # leaving the pending set observe their final time-in-queue;
                 # survivors drive the per-tier oldest-age/burn-rate gauges.
@@ -2622,8 +2683,197 @@ class Scheduler:
             # serve stale skips if they ever move back.  (Gains already
             # invalidate via the _revalidate_pending path.)
             self.delta.invalidate("takeover")
+        if delta.resized:
+            # A published shard-map generation changed K under us: the keyer
+            # compiled for the old K is meaningless — drop it (the next
+            # cycle's fleet sync recompiles) and escalate, since every
+            # carried residual was laid out for the old partition.
+            self._fleet_keyer = None
+            self._cycle_notes.append(f"shards: adopted map generation {self.shard_set.map_generation} (K={self.num_shards} -> {self.shard_set.num_shards})")
+            self.num_shards = self.shard_set.num_shards
+            if self.delta is not None:
+                self.delta.invalidate("mesh-rebind")
+        self._sync_mesh_bindings(delta)
+        if self._fleet_reservations is not None:
+            # Reservation heartbeat rides the shard-refresh cadence; an
+            # expired row means the TTL already reclaimed it for the fleet.
+            self._fleet_reservations.renew()
         self.metrics.set_gauge("scheduler_shards_owned", float(len(delta.owned)))
         self.is_leader = bool(delta.owned)
+
+    # -- multi-mesh fleet (tpu_scheduler/fleet) ----------------------------
+
+    # shape: (self: obj, snapshot: obj) -> none
+    def _fleet_sync(self, snapshot: ClusterSnapshot) -> None:
+        """Compile (or refresh) the topology shard keyer for this cycle and
+        install it on the ShardSet BEFORE the ownership filter runs.
+
+        The keyer caches on the compiled-topology object identity (the same
+        key discipline as _compiled_topology): label churn replaces node
+        objects, which replaces the compiled topology, which recompiles the
+        domain map.  A keying change moves pods between shards mid-flight,
+        so it invalidates exactly like a takeover."""
+        compiled = self._compiled_topology(snapshot)
+        hit = self._fleet_keyer
+        if hit is None or hit[0] is not compiled:
+            from ..fleet.keyer import DomainShardMap, ShardKeyer
+
+            dm = DomainShardMap.compile(compiled, self.shard_set.num_shards)
+            keyer = ShardKeyer(self.shard_set.num_shards, dm)
+            prev = self.shard_set.keyer
+            self.shard_set.set_keyer(keyer)
+            self._fleet_keyer = (compiled, keyer)
+            if prev is not None and (prev.mode != keyer.mode or prev.domain_map != keyer.domain_map):
+                # The pod→shard map changed shape: standing ownership
+                # verdicts and assumed overlays were derived under the old
+                # keying — same hygiene as losing a shard to a takeover.
+                self._revalidate_pending = True
+                if self.delta is not None:
+                    self.delta.invalidate("takeover")
+            if keyer.mode == "topology":
+                self._cycle_notes.append(
+                    f"fleet: topology keyer over {len(dm.domains)} domains / K={keyer.num_shards}"
+                )
+        keyer = self.shard_set.keyer
+        dm = keyer.domain_map if keyer is not None else None
+        if dm is None:
+            return
+        # Domain-affinity gauge: of this replica's owned BOUND pods, the
+        # fraction sitting on a node inside their shard's topology slice
+        # (1.0 with no owned bound pods — nothing is misplaced).
+        total = aligned = 0
+        owned = self.shard_set.owned
+        for p in snapshot.pods:
+            node = p.spec.node_name if p.spec is not None else None
+            if not node:
+                continue
+            s = keyer.shard_of_pod(p)
+            if s not in owned:
+                continue
+            total += 1
+            if dm.node_shard.get(node) == s:
+                aligned += 1
+        self.metrics.set_gauge("scheduler_shard_domain_affinity", (aligned / total) if total else 1.0)
+
+    # shape: (self: obj, snapshot: obj, pending_owned: obj) -> none
+    def _fleet_reservation_tick(self, snapshot: ClusterSnapshot, pending_owned: list[Pod]) -> None:
+        """Cross-replica gang admission, the two-phase half that runs inside
+        the cycle: RESERVE peer shards for owned pending gangs wider than
+        this replica's topology slice, COMMIT (release) reservations whose
+        gang left the owned pending set — admitted, deleted, or re-keyed.
+
+        Width is judged by node count (one gang member per node is the
+        conservative packing bound this repo's gang workloads follow); a
+        reservation that still cannot admit simply expires or commits on the
+        next transition — never wedges capacity past its TTL."""
+        led = self._fleet_reservations
+        if led is None:
+            return
+        keyer = self.shard_set.keyer
+        dm = keyer.domain_map if keyer is not None else None
+        if dm is None:
+            # Hash mode spans no node columns — nothing to reserve against.
+            for gang in list(led.active()):
+                led.commit(gang)
+            return
+        gangs: dict[str, int] = {}
+        for p in pending_owned:
+            if p.spec is not None and p.spec.gang:
+                gangs[p.spec.gang] = gangs.get(p.spec.gang, 0) + 1
+        # Commit the reservations whose gang is done here (two-phase commit:
+        # the admission already happened in a previous cycle's solve).
+        for gang in list(led.active()):
+            if gang not in gangs:
+                led.commit(gang)
+        owned = self.shard_set.owned
+        own_nodes = len(keyer.node_set(owned))
+        kk = keyer.num_shards
+        for gang, size in sorted(gangs.items()):
+            if gang in led.active() or size <= own_nodes:
+                continue
+            # Walk shards outward from the gang's home shard until the
+            # cumulative slice is wide enough; peers = the span minus what
+            # this replica already owns.
+            home = keyer.shard_for_key(gang)
+            span: list[int] = []
+            width = 0
+            for i in range(kk):
+                s = (home + i) % kk
+                span.append(s)
+                width += len(dm.shard_nodes[s]) if s < len(dm.shard_nodes) else 0
+                if width >= size:
+                    break
+            peers = [s for s in span if s not in owned]
+            if not peers:
+                continue
+            if led.reserve(gang, peers):
+                self.metrics.inc("scheduler_gang_reservations_total")
+                self._cycle_notes.append(f"fleet: reserved shards {peers} for gang {gang} ({size} wide)")
+
+    # shape: (self: obj, snapshot: obj) -> obj
+    def _fleet_node_filter(self, snapshot: ClusterSnapshot):
+        """The node-name set this replica's solve should see — its owned
+        shards' topology slices plus any reserved peer slices — or None to
+        solve the full node set (hash keying, spillover backoff, or a slice
+        that already covers everything)."""
+        if not self.sharded or self._fleet_slice_backoff:
+            return None
+        keyer = self.shard_set.keyer
+        if keyer is None or keyer.domain_map is None:
+            return None
+        shards = set(self.shard_set.owned)
+        if self._fleet_reservations is not None:
+            shards |= self._fleet_reservations.active_shards()
+        allowed = keyer.node_set(shards)
+        if not allowed or len(allowed) >= len(snapshot.nodes):
+            return None
+        return allowed
+
+    # shape: (self: obj, delta: obj) -> none
+    def _sync_mesh_bindings(self, delta) -> None:
+        """Mesh-per-replica maintenance for one shard-refresh round: bind
+        gained shards onto this replica's device slice, release lost ones.
+        A gain AFTER the first binding existed is a takeover/rebalance
+        rebind — the carried residuals were laid out for the old slice, so
+        the delta engine escalates one "mesh-rebind" full wave."""
+        keyer = self.shard_set.keyer if self.shard_set is not None else None
+        if keyer is None or keyer.domain_map is None:
+            return
+        binder = getattr(self.backend, "bind_shard_mesh", None)
+        releaser = getattr(self.backend, "release_shard_mesh", None)
+        owned = frozenset(delta.owned)
+        gained = owned - self._mesh_shards
+        dropped = self._mesh_shards - owned
+        for s in sorted(dropped):
+            if releaser is not None:
+                try:
+                    releaser(s)
+                except Exception:
+                    logger.warning("mesh release failed for shard %d", s, exc_info=True)
+        for s in sorted(gained):
+            if binder is not None:
+                try:
+                    binder(s, keyer.num_shards)
+                except Exception:
+                    logger.warning("mesh bind failed for shard %d", s, exc_info=True)
+        self._mesh_shards = owned
+        if gained and self._mesh_engaged:
+            self.metrics.inc("scheduler_mesh_rebinds_total", len(gained))
+            self._cycle_notes.append(f"fleet: mesh rebind for shard(s) {sorted(gained)}")
+            if self.delta is not None:
+                self.delta.invalidate("mesh-rebind")
+        if owned:
+            self._mesh_engaged = True
+
+    # shape: (self: obj, count: int) -> bool
+    def resize_shards(self, count: int) -> bool:
+        """Publish a new shard count through the shard-map lease
+        (tpu_scheduler/fleet/resize).  Coordinator-gated: only the shard-0
+        owner may publish (the rebalancer's tie-break), every replica adopts
+        on its next refresh round without restarting."""
+        if not self.sharded:
+            return False
+        return self.shard_set.publish_resize(int(count))
 
     def shards_snapshot(self) -> dict:
         """The /debug/shards payload.  Served from the HTTP thread; all
@@ -2642,6 +2892,19 @@ class Scheduler:
         # coverage, and costliest phases (utils/profiler.ProfileRing) — so
         # shard-ownership pages answer "is this owner slow" in place.
         out["perf"] = self.profile_ring.brief()
+        # The fleet block (tpu_scheduler/fleet): keyer mode + per-shard
+        # topology domains ride shard_set.debug above; here the mesh
+        # bindings (device-level from the backend when it has them, the
+        # logical ledger otherwise) and the gang-reservation ledger.
+        info = getattr(self.backend, "mesh_bindings_info", None)
+        fleet: dict = {
+            "mesh_shards": sorted(self._mesh_shards),
+            "mesh_bindings": info() if info is not None else None,
+            "slice_backoff": self._fleet_slice_backoff,
+        }
+        if self._fleet_reservations is not None:
+            fleet["reservations"] = self._fleet_reservations.debug()
+        out["fleet"] = fleet
         return out
 
     def _ensure_renewal_thread(self) -> None:
@@ -2930,6 +3193,10 @@ class Scheduler:
         if self._bind_queue is not None:
             self._bind_queue.put(None)  # worker-loop shutdown sentinel
             self._bind_queue = None
+        if self._fleet_reservations is not None:
+            # Hand reservations back before the shard leases: a clean
+            # shutdown must never leave peers waiting out a gang TTL.
+            self._fleet_reservations.release_all()
         if self.sharded and self.shard_set.owned:
             try:
                 self.shard_set.release_all()

@@ -9,7 +9,10 @@ set into K shards so any replica can own any subset of them:
   • ``shard_for_name`` — stable hash (crc32, PYTHONHASHSEED-proof) of the pod
     full name; ``shard_of_pod`` pins every member of a gang to the GANG
     name's shard, so all-or-nothing admission survives partitioning (a gang
-    split across owners could never look complete to any one replica).
+    split across owners could never look complete to any one replica).  The
+    fleet layer (tpu_scheduler/fleet) can swap this flat hash for a
+    topology-keyed ``ShardKeyer`` via ``ShardSet.set_keyer`` — each shard's
+    node columns then form a contiguous topology slice.
   • one ``coordination.k8s.io`` Lease per shard (``tpu-scheduler-shard-<i>``),
     acquired/renewed through the SAME CAS primitives as the leader lease
     (fake_api.acquire_lease → lease.try_acquire_or_renew) — acquisition races
@@ -91,6 +94,7 @@ class ShardDelta:
     lost: frozenset = frozenset()  # held last round, not renewable now
     released: frozenset = frozenset()  # voluntarily released (rebalance)
     holders: dict = field(default_factory=dict)  # shard -> live holder identity ("" = unheld)
+    resized: bool = False  # a newer shard-map generation was adopted this round (fleet/resize.py)
 
 
 class ShardSet:
@@ -101,26 +105,42 @@ class ShardSet:
     FakeApiServer, RemoteApiAdapter, and the chaos proxy all serve.
     """
 
-    def __init__(self, api, num_shards: int, identity: str, lease_duration: float, clock):
+    def __init__(self, api, num_shards: int, identity: str, lease_duration: float, clock, keyer=None):
         self.api = api
         self.num_shards = int(num_shards)
         self.identity = identity
         self.lease_duration = float(lease_duration)
         self.clock = clock
         self.owned: frozenset = frozenset()
+        # Pluggable pod→shard assignment (fleet/keyer.ShardKeyer): topology
+        # mode keys pods to contiguous topology-domain slices; None keeps
+        # the historic flat crc32 exactly.
+        self.keyer = keyer
+        # Highest shard-map generation adopted so far (fleet/resize.py).
+        self.map_generation = 0
 
     # -- assignment ---------------------------------------------------------
 
+    def set_keyer(self, keyer) -> None:
+        """Install (or clear) the fleet ShardKeyer.  The caller owns the
+        consequences: a keying change moves pods between shards, so it must
+        revalidate its pending view exactly as a takeover does."""
+        self.keyer = keyer
+
     def shard_of(self, pod) -> int:
+        if self.keyer is not None:
+            return self.keyer.shard_of_pod(pod)
         return shard_of_pod(pod, self.num_shards)
 
     def owns_pod(self, pod) -> bool:
-        return shard_of_pod(pod, self.num_shards) in self.owned
+        return self.shard_of(pod) in self.owned
 
     def owns_name(self, pod_full: str) -> bool:
         """Ownership by pod full name only — the ledger-prune filter.  Gang
         pods may hash elsewhere via their gang name, so this is used ONLY to
         scope prunes conservatively, never for scheduling eligibility."""
+        if self.keyer is not None:
+            return self.keyer.shard_for_key(pod_full) in self.owned
         return shard_for_name(pod_full, self.num_shards) in self.owned
 
     # -- one ownership round ------------------------------------------------
@@ -159,6 +179,43 @@ class ShardSet:
                     live.add(holders[s])
         return len(live)
 
+    def _adopt_shard_map(self) -> bool:
+        """Fold a newer published shard map (fleet/resize.py) into this
+        replica's view before the ownership round: a merge releases leases
+        beyond the new range (their pods re-key into the survivors), a
+        split leaves the new orphan shards for the absorb pass.  Returns
+        True when the shard COUNT changed (the caller re-keys and rebinds)."""
+        from ..fleet.resize import read_shard_map
+
+        info = read_shard_map(self.api)
+        if info is None:
+            return False
+        gen, count = info
+        if gen <= self.map_generation:
+            return False
+        self.map_generation = gen
+        if count == self.num_shards:
+            return False
+        for s in sorted(self.owned):
+            if s >= count:
+                self.api.release_lease(shard_lease_name(s), self.identity)
+        self.owned = frozenset(s for s in self.owned if s < count)
+        self.num_shards = count
+        return True
+
+    def publish_resize(self, count: int) -> bool:
+        """Coordinator-side split/merge: publish ``generation+1:<count>``.
+        Only the shard-0 owner may call this (the rebalancer's tie-break);
+        the change lands fleet-wide on the next refresh cadence — including
+        on this replica, through the same ``_adopt_shard_map`` path."""
+        if 0 not in self.owned or int(count) < 1:
+            return False
+        from ..fleet.resize import publish_shard_map, read_shard_map
+
+        current = read_shard_map(self.api)
+        gen = max(self.map_generation, current[0] if current is not None else 0) + 1
+        return publish_shard_map(self.api, gen, int(count), self.lease_duration)
+
     def refresh(self) -> ShardDelta:
         """Renew owned shards, absorb orphans up to the proportional target,
         release the excess.  Deterministic: shards are visited in a rotated
@@ -168,6 +225,7 @@ class ShardSet:
         # Presence first: visible to every other replica's target math even
         # while we hold nothing.
         self.api.acquire_lease(REPLICA_LEASE_PREFIX + self.identity, self.identity, self.lease_duration)
+        resized = self._adopt_shard_map()
         holders = self._live_holders(now)
         n_replicas = self._live_replicas(now, holders)
         target = -(-self.num_shards // n_replicas)  # ceil
@@ -213,6 +271,7 @@ class ShardSet:
             lost=frozenset(prev - owned - released),
             released=frozenset(released),
             holders=holders,
+            resized=resized,
         )
 
     def release_all(self) -> None:
@@ -236,10 +295,21 @@ class ShardSet:
                 if info is None
                 else {"holder": info["holder"], "expires_in_s": round(float(info.get("expires", 0.0)) - now, 3)}
             )
-        return {
+        out = {
             "replica_id": self.identity,
             "num_shards": self.num_shards,
             "owned": sorted(self.owned),
             "lease_duration_seconds": self.lease_duration,
             "leases": leases,
+            "keyer": self.keyer.mode if self.keyer is not None else "hash",
+            "map_generation": self.map_generation,
         }
+        dm = getattr(self.keyer, "domain_map", None)
+        if dm is not None:
+            # Per-shard topology-domain + node-slice info (the fleet view
+            # of /debug/shards — which racks each shard's columns span).
+            out["shard_domains"] = {
+                str(s): {"domains": list(dm.domains_of_shard(s)), "nodes": len(dm.shard_nodes[s])}
+                for s in range(dm.num_shards)
+            }
+        return out

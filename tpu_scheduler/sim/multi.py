@@ -42,6 +42,7 @@ AVAILABILITY_FIELDS = (
     "max_takeover_latency_s",
     "takeover_bound_s",
     "orphaned_pods",
+    "orphaned_reservations",
     "double_binds",
     "ok",
 )
@@ -205,10 +206,24 @@ class MultiReplicaHarness:
                     out[k] = v
         return out
 
+    def _shard_of(self, pod) -> int:
+        """Keyer-consistent pod→shard: a live replica's installed ShardKeyer
+        (the fleet's topology keying) judges ownership exactly as the
+        controllers do; the flat module hash is the fallback for fleets that
+        never compiled one."""
+        for i, sched in enumerate(self.scheds):
+            if self.alive[i] and sched.shard_set is not None:
+                return sched.shard_set.shard_of(pod)
+        from ..runtime.shards import shard_of_pod
+
+        return shard_of_pod(pod, self.shards)
+
     def availability_block(self, pending_final, double_binds: int) -> dict:
         """The scorecard ``availability`` verdict.  ``ok`` requires zero
         double-binds, zero orphaned pods (a final-pending pod whose shard no
-        live replica owns has no controller responsible for it), and every
+        live replica owns has no controller responsible for it), zero
+        orphaned gang reservations (an unexpired reservation lease held by a
+        dead replica would wedge peer capacity past the settle), and every
         kill's takeover resolved within 2 × lease_duration of virtual
         time."""
         enabled = self.replicas > 1
@@ -221,15 +236,18 @@ class MultiReplicaHarness:
             "max_takeover_latency_s": None,
             "takeover_bound_s": round(2.0 * float(self.sc.lease_duration), 6) if enabled else None,
             "orphaned_pods": 0,
+            "orphaned_reservations": 0,
             "double_binds": int(double_binds),
             "ok": True,
         }
         if not enabled:
             return out
-        from ..runtime.shards import shard_of_pod
-
         owned_now = self._live_owned()
-        out["orphaned_pods"] = sum(1 for p in pending_final if shard_of_pod(p, self.shards) not in owned_now)
+        out["orphaned_pods"] = sum(1 for p in pending_final if self._shard_of(p) not in owned_now)
+        from ..fleet.reservation import count_orphaned_reservations
+
+        live = {sched.identity for i, sched in enumerate(self.scheds) if self.alive[i]}
+        out["orphaned_reservations"] = count_orphaned_reservations(self.chaos, self.clock.now, live)
         latencies = [rec["takeover_latency_s"] for rec in self.kills]
         resolved = [lat for lat in latencies if lat is not None]
         if resolved:
@@ -237,6 +255,7 @@ class MultiReplicaHarness:
         out["ok"] = bool(
             double_binds == 0
             and out["orphaned_pods"] == 0
+            and out["orphaned_reservations"] == 0
             and all(lat is not None and lat <= out["takeover_bound_s"] for lat in latencies)
         )
         return out

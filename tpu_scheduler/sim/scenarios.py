@@ -255,6 +255,52 @@ _register(
 
 _register(
     Scenario(
+        name="mesh-rebind-on-takeover",
+        description="Multi-mesh fleet failover (tpu_scheduler/fleet): a topology-labeled 4-rack fleet keys its four shards to contiguous rack slices (one device-mesh binding per owned shard); killing a replica mid-cycle forces the survivor to absorb the orphaned shards AND rebind them onto its own mesh — the delta engine must escalate exactly one mesh-rebind full wave, takeover within 2x lease_duration, zero double-binds, zero orphaned reservations",
+        duration=40.0,
+        workload=WorkloadSpec(
+            initial_nodes=32,
+            rack_size=8,
+            arrival_rate=6.0,
+            lifetime_mean_s=25.0,
+            gang_fraction=0.1,
+            priority_tiers=(0, 0, 5),
+        ),
+        replicas=2,
+        shards=4,
+        lease_duration=5.0,
+        replica_kills=((15.0, 0),),
+        drain_grace_cycles=20,
+    )
+)
+
+_register(
+    Scenario(
+        name="cross-shard-gang-admission",
+        description="Cross-replica gang admission (tpu_scheduler/fleet): four replicas each own ONE rack-keyed shard (8 nodes) while gangs of up to 12 members arrive — wider than any single slice under the one-member-per-node proxy, so the owner must two-phase RESERVE peer shards, solve the gang against the widened slice, and COMMIT the reservation on admission; a lease brownout window exercises the all-or-nothing abort path, and the run must settle with zero double-binds and zero orphaned reservations",
+        duration=40.0,
+        workload=WorkloadSpec(
+            initial_nodes=32,
+            rack_size=8,
+            arrival_rate=4.0,
+            lifetime_mean_s=30.0,
+            gang_fraction=0.35,
+            gang_size_max=12,
+            pod_cpu_m=(2000, 4000),
+            pod_mem_mi=(512, 1024),
+        ),
+        chaos=ChaosConfig(
+            windows=(ChaosWindow(start=18.0, end=24.0, api_error_rate=0.2, watch_drop_rate=0.1),),
+        ),
+        replicas=4,
+        shards=4,
+        lease_duration=5.0,
+        drain_grace_cycles=25,
+    )
+)
+
+_register(
+    Scenario(
         name="replica-kill-during-brownout",
         description="The replica-kill composed with the PR-4 circuit breaker: a hard binding blackout opens the owner's breaker (binds defer in memory), then the owner is crash-killed mid-brownout — its deferred buffer dies with it, the survivor re-places those pods through its OWN degraded mode, and the run must still end with zero double-binds and zero binds through an open breaker",
         duration=80.0,
