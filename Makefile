@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke clean
 
-check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke
+check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -60,6 +60,14 @@ delta-smoke:
 # rebalancer-off baseline must FAIL the same gate (scripts/defrag_smoke.py).
 defrag-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.defrag_smoke
+
+# The policy-learning gate: a tiny seeded CEM run (3 generations on the
+# train-smoke scenario) must keep its best objective at or above the
+# generation-0 default-profile objective, reproduce byte-identically from
+# the one seed, and survive the tuned-artifact round-trip
+# (scripts/train_smoke.py).
+train-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.train_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

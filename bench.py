@@ -702,6 +702,82 @@ def incremental_row(backend, profile, pods: int, nodes: int, seed: int, cycles: 
         return {}
 
 
+def policy_row(backend, seed: int, pods: int = 10_000, nodes: int = 1_000) -> dict:
+    """Distilled-policy verdict (tpu_scheduler/learn): the checked-in tuned
+    artifact vs the default profile, two ways.  OBJECTIVE — each provenance
+    scenario re-runs on the artifact's first held-out seed under both
+    profiles (per-scenario scorecard objectives + the mean delta the PR
+    reports), and every pass gate must stay green under the tuned weights.
+    LATENCY — the zero-inference-cost contract: the steady-state
+    delta-cycle machinery (``incremental_row``) runs under tuned and
+    default weights at the same downscaled shape; the tuned weights ride
+    the identical fused choose path, so ``policy_latency_ratio`` must sit
+    at ~1.0, and the ``policy_delta_cycle_seconds_min``/``policy_shape``
+    pair rides the same-platform+same-shape cross-round regression gate."""
+    try:
+        from tpu_scheduler.learn.distill import load_profile
+        from tpu_scheduler.learn.objective import OBJECTIVE_VERSION
+        from tpu_scheduler.models.profiles import DEFAULT_PROFILE
+        from tpu_scheduler.sim.harness import run_scenario
+
+        art = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tpu_scheduler", "learn", "profiles", "tuned.json"
+        )
+        if not os.path.exists(art):
+            log("policy row skipped: no tuned artifact (tpu_scheduler/learn/profiles/tuned.json)")
+            return {}
+        with open(art) as f:
+            prov = json.load(f).get("provenance", {})
+        if prov.get("objective_version") != OBJECTIVE_VERSION:
+            log(
+                f"policy row skipped: artifact trained against objective v{prov.get('objective_version')}, "
+                f"this build scores v{OBJECTIVE_VERSION}"
+            )
+            return {}
+        tuned = load_profile(art)
+        scenarios = tuple(prov.get("search", {}).get("scenarios") or ("train-smoke",))
+        held = tuple(prov.get("search", {}).get("held_out_seeds") or (101,))
+        hseed = int(held[0])
+        per: dict = {}
+        tuned_vals: list[float] = []
+        default_vals: list[float] = []
+        gates_green = True
+        for name in scenarios:
+            ct = run_scenario(name, seed=hseed, profile=tuned)
+            cd = run_scenario(name, seed=hseed)
+            per[name] = {"tuned": ct["policy"]["objective"], "default": cd["policy"]["objective"]}
+            tuned_vals.append(ct["policy"]["objective"])
+            default_vals.append(cd["policy"]["objective"])
+            gates_green = gates_green and bool(ct["pass"])
+        row = {
+            "policy_scenarios": per,
+            "policy_objective_tuned": round(sum(tuned_vals) / len(tuned_vals), 6),
+            "policy_objective_default": round(sum(default_vals) / len(default_vals), 6),
+            "policy_gates_green_under_tuned": gates_green,
+        }
+        row["policy_objective_delta"] = round(row["policy_objective_tuned"] - row["policy_objective_default"], 6)
+        # Zero inference cost: tuned weights are just different floats in
+        # the same weight vector — the delta-cycle wall must not move.
+        lat_tuned = incremental_row(backend, tuned, pods, nodes, seed, cycles=6)
+        lat_default = incremental_row(backend, DEFAULT_PROFILE, pods, nodes, seed, cycles=6)
+        t_min = lat_tuned.get("delta_cycle_seconds_min")
+        d_min = lat_default.get("delta_cycle_seconds_min")
+        if t_min and d_min:
+            row["policy_shape"] = lat_tuned["incremental_shape"]
+            row["policy_delta_cycle_seconds_min"] = t_min
+            row["policy_default_delta_cycle_seconds_min"] = d_min
+            row["policy_latency_ratio"] = round(t_min / d_min, 3)
+        log(
+            f"policy row: tuned {row['policy_objective_tuned']} vs default {row['policy_objective_default']} "
+            f"(delta {row['policy_objective_delta']}, gates green {gates_green}), "
+            f"latency ratio {row.get('policy_latency_ratio')}"
+        )
+        return row
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"policy row skipped: {type(e).__name__}: {str(e)[:300]}")
+        return {}
+
+
 def rebalance_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
     """Background rebalancer (tpu_scheduler/rebalance) at the topology-row
     shape: a round-robin-bound synthetic cluster is deliberately
@@ -1355,6 +1431,7 @@ def apply_secondary_regression_checks(out: dict, platform: str, repo_dir: str, t
         ("constrained_seconds_min", "constrained_shape"),
         ("delta_cycle_seconds_min", "incremental_shape"),
         ("rebalance_solve_seconds_min", "rebalance_shape"),
+        ("policy_delta_cycle_seconds_min", "policy_shape"),
     ):
         val = out.get(field)
         if val is None:
@@ -1406,6 +1483,7 @@ def main() -> int:
     ap.add_argument("--no-sim-row", action="store_true")
     ap.add_argument("--no-topology-row", action="store_true")
     ap.add_argument("--no-rebalance-row", action="store_true")
+    ap.add_argument("--no-policy-row", action="store_true")
     ap.add_argument("--no-sim-sweep", action="store_true")
     ap.add_argument("--no-multi-replica-row", action="store_true")
     ap.add_argument("--no-multi-mesh-row", action="store_true")
@@ -1532,6 +1610,12 @@ def main() -> int:
     # and the background packing-solve seconds, gated cross-round below.
     if not args.no_rebalance_row and _remaining() > (300 if platform == "tpu" else 90):
         out.update(rebalance_row(backend, profile, 8_192, 512, args.seed))
+    # Distilled policy (tpu_scheduler/learn): tuned-vs-default objective on
+    # the artifact's held-out seed + the zero-inference-cost latency check
+    # (delta-cycle wall under tuned weights must match default), gated
+    # cross-round below via policy_delta_cycle_seconds_min/policy_shape.
+    if not args.no_policy_row and _remaining() > (300 if platform == "tpu" else 90):
+        out.update(policy_row(backend, args.seed))
     # Simulation mode (sim-smoke scenario): chaos-resilience SLOs in virtual
     # time — cheap (seconds of wall), deterministic in the seed.
     if not args.no_sim_row and _remaining() > 120:

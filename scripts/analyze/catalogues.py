@@ -42,6 +42,13 @@ FLET  — every multi-mesh fleet keyer mode (``fleet/keyer.KEYER_MODES``),
         and fleet lease name/prefix (``fleet/reservation.
         GANG_RESERVATION_PREFIX``, ``fleet/resize.SHARD_MAP_LEASE``) must
         appear in the README "Multi-mesh fleet" catalogue.
+LERN  — every policy-objective component (``learn/objective.
+        OBJECTIVE_COMPONENTS``), policy-scorecard field (``learn/objective.
+        POLICY_FIELDS``), observation field (``learn/env.
+        OBSERVATION_FIELDS``), action knob (``learn/env.ACTION_KNOBS``),
+        search knob (``learn/search.SearchConfig`` fields), and artifact
+        field (``models/profiles.ARTIFACT_FIELDS``) must appear in the
+        README "Learned policy & tuning" catalogue.
 """
 
 from __future__ import annotations
@@ -62,6 +69,7 @@ CODES = {
     "DLTA": "a delta-engine escalation trigger/incremental scorecard field missing from the README \"Incremental scheduling\" catalogue",
     "REBL": "a rebalancer migration/skip reason/config knob/scorecard field/scenario missing from the README \"Rebalancing & defragmentation\" catalogue",
     "FLET": "a fleet keyer mode/reservation state/lease name missing from the README \"Multi-mesh fleet\" catalogue",
+    "LERN": "a policy objective component/observation field/action knob/search knob/artifact field missing from the README \"Learned policy & tuning\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -444,6 +452,54 @@ def _run_flet(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_lern(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/learn/objective.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if t.id == "OBJECTIVE_COMPONENTS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("objective component",)))
+                        elif t.id == "POLICY_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("policy scorecard field",)))
+        elif f.rel == "tpu_scheduler/learn/env.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if t.id == "OBSERVATION_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("observation field",)))
+                        elif t.id == "ACTION_KNOBS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("action knob",)))
+        elif f.rel == "tpu_scheduler/learn/search.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef) and node.name == "SearchConfig":
+                    for stmt in node.body:
+                        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                            tokens.append(("search knob", stmt.target.id))
+        elif f.rel == "tpu_scheduler/models/profiles.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "ARTIFACT_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("artifact field",)))
+    return [
+        Finding(
+            "LERN",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the policy-learning subsystem but is missing from the README "
+            f"\"Learned policy & tuning\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
     return (
         _run_metr(ctx)
@@ -456,4 +512,5 @@ def run(ctx: Context) -> list[Finding]:
         + _run_dlta(ctx)
         + _run_rebl(ctx)
         + _run_flet(ctx)
+        + _run_lern(ctx)
     )

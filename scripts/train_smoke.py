@@ -1,0 +1,86 @@
+"""train-smoke — the policy-learning subsystem's standing gate (make check).
+
+Three contracts, runnable standalone for a verdict (exit 0 = green), the
+`make defrag-smoke` / `make delta-smoke` pattern:
+
+  1. FLOOR — a tiny seeded CEM run (the ``train-smoke`` scenario, 3
+     generations) must end with its best train objective >= the
+     generation-0 default-profile objective.  The search injects the
+     current mean as candidate 0 of every generation, so a violation
+     means the evaluator itself went non-deterministic.
+  2. REPRODUCIBLE — repeating the identical ``SearchConfig`` must
+     reproduce the byte-identical generation history and chosen vector:
+     one seed fully determines a training run.
+  3. DISTILL ROUND-TRIP — the winning profile must survive the artifact
+     round-trip (``to_file`` → ``from_file`` equality) and the artifact
+     must re-evaluate to the SAME objective it was selected on — the
+     zero-cost distillation contract at smoke scale.
+
+Off the tier-1 clock (seconds of wall); wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    import logging
+
+    from tpu_scheduler.learn.distill import distill, load_profile
+    from tpu_scheduler.learn.env import ACTION_KNOBS
+    from tpu_scheduler.learn.search import SearchConfig, episode_objective, train_profile
+
+    logging.getLogger("tpu_scheduler").setLevel(logging.WARNING)
+
+    cfg = SearchConfig(
+        scenarios=("train-smoke",),
+        train_seeds=(0,),
+        held_out_seeds=(101,),
+        generations=3,
+        population=6,
+        seed=0,
+    )
+    a = train_profile(cfg)
+    print(
+        f"train-smoke: best train objective {a.train_objective} "
+        f"(generation-0 default {a.default_train_objective}), improved={a.improved}, "
+        f"held-out tuned={a.held_out} default={a.default_held_out}"
+    )
+    if a.train_objective < a.default_train_objective:
+        print("FAIL: best objective fell below the generation-0 default-profile objective", file=sys.stderr)
+        return 1
+
+    b = train_profile(cfg)
+    if json.dumps(a.history, sort_keys=True) != json.dumps(b.history, sort_keys=True) or a.vector != b.vector:
+        print("FAIL: identical SearchConfig produced a different run — training is not seed-reproducible", file=sys.stderr)
+        return 1
+    print("train-smoke: history + chosen vector reproduce from the one seed")
+
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="train-smoke-")
+    os.close(fd)
+    try:
+        distill(a, path)
+        loaded = load_profile(path)
+        if loaded != a.profile:
+            print("FAIL: artifact round-trip changed the profile", file=sys.stderr)
+            return 1
+        vec = [float(getattr(loaded, name)) for name, _lo, _hi in ACTION_KNOBS]
+        replayed = episode_objective(vec, "train-smoke", cfg.held_out_seeds[0])
+        expected = a.held_out["train-smoke"]
+        if replayed != expected:
+            print(f"FAIL: distilled artifact re-evaluates to {replayed}, selection saw {expected}", file=sys.stderr)
+            return 1
+        print(f"train-smoke: distilled artifact re-evaluates to its selection objective ({replayed})")
+    finally:
+        os.unlink(path)
+
+    print("train-smoke green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

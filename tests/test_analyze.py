@@ -375,6 +375,58 @@ def test_flet_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_lern_drift_and_guard():
+    obj_mod = (
+        "tpu_scheduler/learn/objective.py",
+        'OBJECTIVE_COMPONENTS = (("ghost-objective-component", 1.0),)\n'
+        'POLICY_FIELDS = ("ghost_policy_field",)\n'
+        'OTHER = ("not-a-component",)\n',
+    )
+    env_mod = (
+        "tpu_scheduler/learn/env.py",
+        'OBSERVATION_FIELDS = ("ghost_observation_field",)\n'
+        'ACTION_KNOBS = (("ghost_action_knob", 0.0, 1.0),)\n',
+    )
+    search_mod = (
+        "tpu_scheduler/learn/search.py",
+        "class SearchConfig:\n    ghost_search_knob: int = 3\n\n\nclass Other:\n    not_a_knob: int = 1\n",
+    )
+    prof_mod = (
+        "tpu_scheduler/models/profiles.py",
+        'ARTIFACT_FIELDS = ("ghost_artifact_field",)\nNOT_AN_ENVELOPE = ("plain",)\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(obj_mod, env_mod, search_mod, prof_mod, readme="")), "LERN")
+    # OTHER / Other.not_a_knob / NOT_AN_ENVELOPE are not catalogue surface.
+    assert {h.message.split("'")[1] for h in hits} == {
+        "ghost-objective-component",
+        "ghost_policy_field",
+        "ghost_observation_field",
+        "ghost_action_knob",
+        "ghost_search_knob",
+        "ghost_artifact_field",
+    }
+    ok = (
+        "ghost-objective-component ghost_policy_field ghost_observation_field "
+        "ghost_action_knob ghost_search_knob ghost_artifact_field"
+    )
+    assert not rule_hits(catalogues.run(make_ctx(obj_mod, env_mod, search_mod, prof_mod, readme=ok)), "LERN")
+
+
+def test_lern_real_tree_is_catalogued():
+    files = load_files(
+        [
+            "tpu_scheduler/learn/objective.py",
+            "tpu_scheduler/learn/env.py",
+            "tpu_scheduler/learn/search.py",
+            "tpu_scheduler/models/profiles.py",
+        ]
+    )
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "LERN")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")

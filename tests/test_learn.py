@@ -1,0 +1,289 @@
+"""Policy-learning subsystem (tpu_scheduler/learn/) contracts.
+
+Pins the five contracts ISSUE/README promise:
+  • episodes — SchedulerEnv trajectories are pure functions of
+    (scenario, seed, action sequence): byte-identical in-process AND
+    across subprocesses; a None-only episode reproduces run_scenario's
+    card exactly; a real action changes the binding fingerprint.
+  • objective — every scorecard carries the closed `policy` block,
+    recomputed from blocks already on the card; `policy_required`
+    pass-gates against `policy_objective_floor`.
+  • search — the seeded CEM converges on a synthetic quadratic and
+    reproduces its history from the one seed; held-out selection falls
+    back to the default vector when tuned does not beat it.
+  • artifacts — SchedulingProfile.to_file/from_file round-trip exactly,
+    reject unknown keys and foreign schema versions; the checked-in
+    learn/profiles/default.json IS the runtime default.
+  • zero inference cost — the distilled (tuned) profile is just
+    weights: native and TPU backends still agree bindingly under it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import random
+import subprocess
+import sys
+
+import pytest
+
+from tpu_scheduler.learn.env import ACTION_KNOBS, OBSERVATION_FIELDS, SchedulerEnv, action_profile
+from tpu_scheduler.learn.objective import OBJECTIVE_COMPONENTS, POLICY_FIELDS
+from tpu_scheduler.learn.search import (
+    SearchConfig,
+    cem_optimize,
+    default_vector,
+    episode_objective,
+    evaluate_vectors,
+    held_out_table,
+    train_profile,
+)
+from tpu_scheduler.models.profiles import DEFAULT_PROFILE, SchedulingProfile
+from tpu_scheduler.sim import Scenario, WorkloadSpec, run_scenario
+
+logging.getLogger("tpu_scheduler").setLevel(logging.ERROR)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PROFILES_DIR = ROOT / "tpu_scheduler" / "learn" / "profiles"
+
+# A mid-box action distinct from the default vector on several knobs.
+PROBE_ACTION = [0.5, 4.0, 48.0, 2.0, 20.0, 6.0, 200.0]
+
+
+def _drive(env: SchedulerEnv, actions=()):
+    """Run one full episode; returns (trajectory, card).  ``actions`` maps
+    step index -> action vector (None steps keep the profile)."""
+    traj = [env.reset()]
+    acts = dict(enumerate(actions)) if not isinstance(actions, dict) else actions
+    done, i = False, 0
+    while not done:
+        obs, reward, done, _info = env.step(acts.get(i))
+        traj.append({"obs": obs, "reward": reward, "done": done})
+        i += 1
+    return traj, env.card
+
+
+# --- episodes ---------------------------------------------------------------
+
+
+def test_none_action_episode_matches_run_scenario():
+    _traj, card = _drive(SchedulerEnv("train-smoke", seed=0))
+    plain = run_scenario("train-smoke", seed=0)
+    assert json.dumps(card, sort_keys=True) == json.dumps(plain, sort_keys=True)
+
+
+def test_observation_schema_and_inprocess_determinism():
+    t1, c1 = _drive(SchedulerEnv("train-smoke", seed=0, window=4), {1: PROBE_ACTION})
+    t2, c2 = _drive(SchedulerEnv("train-smoke", seed=0, window=4), {1: PROBE_ACTION})
+    assert json.dumps(t1, sort_keys=True) == json.dumps(t2, sort_keys=True)
+    assert c1["fingerprint"] == c2["fingerprint"]
+    for entry in t1:
+        obs = entry["obs"] if isinstance(entry, dict) and "obs" in entry else entry
+        assert tuple(obs) == OBSERVATION_FIELDS
+    # terminal reward is the card's policy objective; non-terminal steps 0.0
+    assert t1[-1]["reward"] == c1["policy"]["objective"]
+    assert all(e["reward"] == 0.0 for e in t1[1:-1])
+
+
+_SUBPROC = """
+import json, logging
+logging.getLogger("tpu_scheduler").setLevel(logging.ERROR)
+from tpu_scheduler.learn.env import SchedulerEnv
+env = SchedulerEnv("train-smoke", seed=3, window=5)
+traj = [env.reset()]
+done, i = False, 0
+while not done:
+    obs, reward, done, _ = env.step([0.5, 4.0, 48.0, 2.0, 20.0, 6.0, 200.0] if i == 1 else None)
+    traj.append([obs, reward, done])
+    i += 1
+print(json.dumps(traj, sort_keys=True))
+"""
+
+
+def test_episode_determinism_across_subprocesses():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    outs = [
+        subprocess.run([sys.executable, "-c", _SUBPROC], capture_output=True, cwd=ROOT, env=env, check=True).stdout
+        for _ in range(2)
+    ]
+    assert outs[0] == outs[1]
+    assert json.loads(outs[0])  # non-empty trajectory, valid JSON
+
+
+def test_action_changes_binding_fingerprint():
+    _t1, none_card = _drive(SchedulerEnv("train-smoke", seed=0, window=4))
+    _t2, act_card = _drive(SchedulerEnv("train-smoke", seed=0, window=4), {0: PROBE_ACTION})
+    assert none_card["fingerprint"] != act_card["fingerprint"]
+
+
+def test_action_profile_clips_into_knob_box():
+    p = action_profile(DEFAULT_PROFILE, [1e9, -1e9, 12.0, 1.0, 2.0, 3.0, 4.0])
+    for (name, lo, hi), sent in zip(ACTION_KNOBS, [1e9, -1e9, 12.0, 1.0, 2.0, 3.0, 4.0]):
+        got = getattr(p, name)
+        assert lo <= got <= hi
+        assert got == round(min(hi, max(lo, sent)), 6)
+    assert p.preemption == DEFAULT_PROFILE.preemption  # untouched surface
+    with pytest.raises(ValueError):
+        action_profile(DEFAULT_PROFILE, [1.0])
+
+
+# --- objective / policy block ----------------------------------------------
+
+
+def test_policy_block_is_closed_and_recomputable():
+    card = run_scenario("train-smoke", seed=0)
+    policy = card["policy"]
+    assert tuple(policy) == POLICY_FIELDS
+    assert policy["enabled"] and policy["required"] and policy["ok"]
+    recomputed = round(sum(w * policy["components"][name] for name, w in OBJECTIVE_COMPONENTS), 6)
+    assert policy["objective"] == recomputed
+    assert set(policy["components"]) == {name for name, _w in OBJECTIVE_COMPONENTS}
+
+
+def test_policy_floor_gates_the_verdict():
+    base = Scenario(
+        name="policy-floor-test",
+        description="test-only",
+        duration=12.0,
+        workload=WorkloadSpec(initial_nodes=6, arrival_rate=4.0, lifetime_mean_s=6.0),
+        policy_required=True,
+        policy_objective_floor=0.1,
+    )
+    ok = run_scenario(base, seed=0)
+    assert ok["policy"]["ok"] and ok["pass"]
+    # An unreachable floor (components are bounded ~ <= 2) must fail the run.
+    import dataclasses
+
+    bad = dataclasses.replace(base, policy_objective_floor=100.0)
+    failed = run_scenario(bad, seed=0)
+    assert not failed["policy"]["ok"] and not failed["pass"]
+    # Same episode otherwise — the gate is a verdict, not a behavior change.
+    assert failed["fingerprint"] == ok["fingerprint"]
+
+
+# --- search -----------------------------------------------------------------
+
+
+def test_cem_converges_on_quadratic_and_reproduces():
+    target = [1.5, -0.75, 3.0]
+
+    def fn(pop):
+        return [-sum((x - t) ** 2 for x, t in zip(vec, target)) for vec in pop]
+
+    def run():
+        return cem_optimize(
+            fn,
+            lo=[-5.0] * 3,
+            hi=[5.0] * 3,
+            mean0=[0.0] * 3,
+            sigma0=[1.5] * 3,
+            generations=30,
+            population=32,
+            elite_frac=0.25,
+            rng=random.Random("quadratic:0"),
+        )
+
+    best_vec, best_val, history = run()
+    assert best_val > -1e-3
+    assert all(abs(x - t) < 0.1 for x, t in zip(best_vec, target))
+    # best-so-far is the max over generation bests (mean injected as
+    # candidate 0, so generation 0 already contains mean0's value)
+    assert round(best_val, 6) == max(g["best"] for g in history)
+    b2, v2, h2 = run()
+    assert (b2, v2) == (best_vec, best_val)
+    assert json.dumps(h2, sort_keys=True) == json.dumps(history, sort_keys=True)
+
+
+def test_evaluate_vectors_parallel_matches_serial():
+    vecs = [default_vector(), PROBE_ACTION]
+    serial = evaluate_vectors(vecs, ("train-smoke",), (0, 1), workers=0)
+    fanned = evaluate_vectors(vecs, ("train-smoke",), (0, 1), workers=4)
+    assert serial == fanned
+    # and each entry is the plain per-episode mean
+    means = [
+        round(sum(episode_objective(v, "train-smoke", s) for s in (0, 1)) / 2, 6) for v in vecs
+    ]
+    assert serial == means
+
+
+def test_held_out_selection_and_fallback():
+    cfg = SearchConfig(
+        scenarios=("train-smoke",),
+        train_seeds=(0,),
+        held_out_seeds=(101,),
+        generations=1,
+        population=3,
+        seed=0,
+    )
+    res = train_profile(cfg)
+    assert set(res.held_out) == set(res.default_held_out) == {"train-smoke"}
+    assert res.held_out["train-smoke"] == held_out_table(res.vector, ("train-smoke",), (101,))["train-smoke"]
+    tuned_mean = sum(res.held_out.values()) / len(res.held_out)
+    default_mean = sum(res.default_held_out.values()) / len(res.default_held_out)
+    assert res.improved == (tuned_mean > default_mean)
+    if not res.improved:
+        # fallback: the shipped vector IS the default profile's coordinates
+        assert res.vector == [round(x, 6) for x in default_vector()]
+        assert res.profile.name == "default"
+    else:
+        assert res.profile.name == "tuned"
+    # the chosen profile is the chosen vector grafted onto the default
+    for (name, _lo, _hi), x in zip(ACTION_KNOBS, res.vector):
+        assert getattr(res.profile, name) == x
+
+
+# --- artifacts --------------------------------------------------------------
+
+
+def test_profile_roundtrip_and_rejections(tmp_path):
+    tuned = DEFAULT_PROFILE.with_(name="rt", gang_locality_weight=99.5)
+    path = tmp_path / "p.json"
+    tuned.to_file(path, provenance={"source": "test"})
+    assert SchedulingProfile.from_file(path) == tuned
+
+    doc = json.loads(path.read_text())
+    for mutate, match in [
+        (lambda d: d.update(schema_version=2), "schema_version"),
+        (lambda d: d.update(extra_top=1), "unknown"),
+        (lambda d: d["profile"].update(ghost_knob=1.0), "ghost_knob"),
+    ]:
+        bad = json.loads(json.dumps(doc))
+        mutate(bad)
+        path.write_text(json.dumps(bad))
+        with pytest.raises(ValueError, match=match):
+            SchedulingProfile.from_file(path)
+
+
+def test_checked_in_default_artifact_is_the_runtime_default():
+    assert SchedulingProfile.from_file(PROFILES_DIR / "default.json") == DEFAULT_PROFILE
+
+
+def test_distilled_profile_backend_parity():
+    # Zero inference cost: a tuned artifact is just weights, so the native
+    # and TPU backends must still produce identical assignments under it.
+    from tpu_scheduler.backends.native import NativeBackend
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.ops.pack import pack_snapshot
+    from tpu_scheduler.testing import synth_cluster
+
+    tuned_path = PROFILES_DIR / "tuned.json"
+    profile = (
+        SchedulingProfile.from_file(tuned_path)
+        if tuned_path.exists()
+        else action_profile(DEFAULT_PROFILE, PROBE_ACTION)
+    )
+    snap = synth_cluster(n_nodes=16, n_pending=120, n_bound=16, seed=7)
+    packed = pack_snapshot(snap)
+    native = NativeBackend().schedule(packed, profile)
+    tpu = TpuBackend().schedule(packed, profile)
+    assert (native.assigned == tpu.assigned).all()
+
+
+def test_train_cli_rejects_overlapping_seed_sets(capsys):
+    from tpu_scheduler.learn.cli import main as train_main
+
+    rc = train_main(["--train-seeds", "0,1", "--held-out-seeds", "1,2"])
+    assert rc == 2

@@ -42,6 +42,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--policy", choices=["batch", "sample"], default="batch", help="batched cycle vs reference-style per-pod random sampling")
     p.add_argument("--profile", choices=sorted(PROFILES), default="default", help="scoring profile")
     p.add_argument(
+        "--profile-file",
+        default=None,
+        metavar="PATH",
+        help="load the scoring profile from a tuned-profile JSON artifact (learn/profiles schema; "
+        "overrides --profile; --driver/--max-rounds/--pool-key/--preemption still apply on top)",
+    )
+    p.add_argument(
         "--driver",
         choices=["auto", "monolithic", "epochs"],
         default=None,
@@ -289,7 +296,14 @@ def main(argv: list[str] | None = None) -> int:
         backend = TpuBackend()
         fallback = None if args.no_fallback else NativeBackend()
 
-    profile = PROFILES[args.profile]
+    if args.profile_file:
+        # Distilled tuned weights (tpu_scheduler/learn): same dataclass,
+        # same fused choose path — zero inference cost by construction.
+        from .models.profiles import SchedulingProfile
+
+        profile = SchedulingProfile.from_file(args.profile_file)
+    else:
+        profile = PROFILES[args.profile]
     if args.driver is not None:
         profile = profile.with_(driver=args.driver)
     if args.max_rounds is not None:

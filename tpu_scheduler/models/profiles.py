@@ -8,11 +8,22 @@ flagship profile drives the benchmark cycle.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace
+import json
+from dataclasses import dataclass, fields, replace
 
 import numpy as np
 
-__all__ = ["SchedulingProfile", "DEFAULT_PROFILE", "PROFILES"]
+__all__ = ["PROFILE_SCHEMA_VERSION", "SchedulingProfile", "DEFAULT_PROFILE", "PROFILES"]
+
+# Version of the tuned-profile JSON artifact (learn/profiles/*.json).
+# ``from_file`` rejects any other version — a schema change must bump this
+# and ship a migration, never silently reinterpret old artifacts.
+PROFILE_SCHEMA_VERSION = 1
+
+# The closed top-level schema of a profile artifact.  ``provenance`` is
+# free-form (training config echo, held-out scores) and never read back
+# into the profile; unknown top-level or profile keys are rejected.
+ARTIFACT_FIELDS = ("schema_version", "profile", "provenance")
 
 
 @dataclass(frozen=True)
@@ -90,6 +101,51 @@ class SchedulingProfile:
 
     def with_(self, **kw) -> "SchedulingProfile":
         return replace(self, **kw)
+
+    # -- JSON artifact round-trip (learn/profiles/*.json) -------------------
+
+    def to_file(self, path: str, provenance: dict | None = None) -> None:
+        """Write the versioned tuned-profile artifact.  Every dataclass
+        field serializes (the artifact is the FULL policy, not a weight
+        diff); ``provenance`` carries the training config echo and scores
+        and is never read back into the profile."""
+        # shape: (self: obj, path: str, provenance: obj) -> obj
+        doc = {
+            "schema_version": PROFILE_SCHEMA_VERSION,
+            "profile": {f.name: getattr(self, f.name) for f in fields(self)},
+            "provenance": provenance or {},
+        }
+        with open(path, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+
+    @classmethod
+    def from_file(cls, path: str) -> "SchedulingProfile":
+        """Load a tuned-profile artifact, strictly: wrong schema version,
+        unknown top-level keys, or unknown profile keys all raise — a typo'd
+        weight name must never silently fall back to the default."""
+        # shape: (cls: obj, path: str) -> obj
+        with open(path) as fh:
+            doc = json.load(fh)
+        if not isinstance(doc, dict):
+            raise ValueError(f"profile artifact {path!r}: expected a JSON object")
+        version = doc.get("schema_version")
+        if version != PROFILE_SCHEMA_VERSION:
+            raise ValueError(
+                f"profile artifact {path!r}: schema_version {version!r} "
+                f"(this build reads version {PROFILE_SCHEMA_VERSION})"
+            )
+        unknown = sorted(set(doc) - set(ARTIFACT_FIELDS))
+        if unknown:
+            raise ValueError(f"profile artifact {path!r}: unknown top-level keys {unknown}")
+        payload = doc.get("profile")
+        if not isinstance(payload, dict):
+            raise ValueError(f"profile artifact {path!r}: missing 'profile' object")
+        known = {f.name for f in fields(cls)}
+        bad = sorted(set(payload) - known)
+        if bad:
+            raise ValueError(f"profile artifact {path!r}: unknown profile keys {bad}")
+        return cls(**payload)
 
 
 DEFAULT_PROFILE = SchedulingProfile()

@@ -26,6 +26,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--record", default=None, metavar="PATH", help="persist the run as a JSONL trace")
     p.add_argument("--replay", default=None, metavar="PATH", help="re-run a recorded trace and verify bit-identity")
     p.add_argument("--backend", choices=["native", "tpu"], default="native", help="scheduling backend under test")
+    p.add_argument(
+        "--profile-file",
+        default=None,
+        metavar="PATH",
+        help="schedule with a tuned-profile JSON artifact (learn/profiles schema) instead of the default profile",
+    )
     p.add_argument("--events-buffer", type=int, default=4096, help="flight recorder capacity during the run")
     p.add_argument(
         "--profile-check",
@@ -40,6 +46,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv and argv[0] == "train":
+        # Policy training (tpu_scheduler/learn): seeded CEM over the
+        # profile weight surface, distilled to a JSON artifact:
+        #   python -m tpu_scheduler.cli sim train --scenario-set train-smoke --seed 0 --out profile.json
+        from ..learn.cli import main as train_main
+
+        return train_main(argv[1:])
     args = build_parser().parse_args(argv)
     configure_logging(args.log_level, "text")
     if args.list:
@@ -58,6 +71,11 @@ def main(argv: list[str] | None = None) -> int:
         from ..backends.native import NativeBackend
 
         backend = NativeBackend()
+    profile = None
+    if args.profile_file:
+        from ..models.profiles import SchedulingProfile
+
+        profile = SchedulingProfile.from_file(args.profile_file)
     gates: dict | None = {} if args.profile_check else None
     try:
         card = run_scenario(
@@ -68,6 +86,7 @@ def main(argv: list[str] | None = None) -> int:
             replay=args.replay,
             events_buffer=args.events_buffer,
             profile_gates=gates,
+            profile=profile,
         )
     except ReplayMismatchError as e:
         print(json.dumps({"replay_mismatch": True, "expected": e.expected, "got": e.got}))

@@ -42,7 +42,7 @@ from .scorecard import _percentile, build_scorecard, check_invariants, fingerpri
 from .trace import TraceWriter, load_trace
 from .workload import generate_events, initial_nodes
 
-__all__ = ["run_scenario", "ReplayMismatchError"]
+__all__ = ["run_scenario", "scenario_episode", "EpisodeContext", "ReplayMismatchError"]
 
 
 class ReplayMismatchError(RuntimeError):
@@ -52,6 +52,24 @@ class ReplayMismatchError(RuntimeError):
         super().__init__(f"replay fingerprint mismatch: recorded {expected[:16]}…, replayed {got[:16]}…")
         self.expected = expected
         self.got = got
+
+
+class EpisodeContext:
+    """What ``scenario_episode`` yields once per cycle, BEFORE the fleet
+    steps: live references into the run (never copies — one episode, one
+    world).  ``learn/env.py`` derives its observation from these; the plain
+    ``run_scenario`` driver never looks at them, so ordinary runs pay
+    nothing for the episode surface."""
+
+    __slots__ = ("clock", "api", "chaos", "fleet", "state", "cycle")
+
+    def __init__(self, clock, api, chaos, fleet, state, cycle: int):
+        self.clock = clock
+        self.api = api  # the inner FakeApiServer (truth, not the chaos shim)
+        self.chaos = chaos
+        self.fleet = fleet
+        self.state = state
+        self.cycle = cycle  # completed cycles so far (0 on the first yield)
 
 
 class _SimState:
@@ -382,6 +400,7 @@ def run_scenario(
     topology="auto",
     profile_gates: dict | None = None,
     rebalance="auto",
+    profile=None,
 ) -> dict:
     """Run one scenario to its verdict; returns the scorecard dict.
 
@@ -398,7 +417,52 @@ def run_scenario(
     ``rebalance`` mirrors the topology switch for the background defrag
     tier: "auto" (default) follows the scenario's ``rebalance`` knob,
     False forces the rebalancer-OFF baseline the fragmentation scorecard
-    block quantifies against (and must FAIL the efficiency gate)."""
+    block quantifies against (and must FAIL the efficiency gate).
+    ``profile`` overrides the ``SchedulingProfile`` the fleet schedules
+    with (None = the default, exactly as before — fingerprints hold); a
+    scenario's ``preemption`` knob still applies on top."""
+    gen = scenario_episode(
+        scenario,
+        seed=seed,
+        backend=backend,
+        record=record,
+        replay=replay,
+        events_buffer=events_buffer,
+        topology=topology,
+        profile_gates=profile_gates,
+        rebalance=rebalance,
+        profile=profile,
+    )
+    # Drive the episode with no per-cycle actions — byte-identical to the
+    # pre-generator loop; the gym-style surface (learn/env.py) is the only
+    # caller that ever sends one.
+    try:
+        next(gen)
+        while True:
+            gen.send(None)
+    except StopIteration as stop:
+        return stop.value
+
+
+def scenario_episode(
+    scenario: Scenario | str,
+    seed: int = 0,
+    backend=None,
+    record: str | None = None,
+    replay: str | None = None,
+    events_buffer: int = 4096,
+    topology="auto",
+    profile_gates: dict | None = None,
+    rebalance="auto",
+    profile=None,
+):
+    """The discrete-event loop as a generator: yields an ``EpisodeContext``
+    once per cycle (after due ops apply, BEFORE the fleet steps) and accepts
+    an optional ``SchedulingProfile`` in return, installed fleet-wide for
+    the next cycle window (the controller reads its profile fresh every
+    cycle).  Returns the scorecard via ``StopIteration.value``.  Same
+    determinism contract as ``run_scenario`` — the yield exchanges no
+    randomness, so a None-action drive is bit-identical to the plain run."""
     replay_data = load_trace(replay) if replay else None
     if replay_data is not None:
         sc = _resolve_scenario(replay_data["header"]["scenario"])
@@ -416,7 +480,9 @@ def run_scenario(
         replay_decisions=replay_data["chaos"] if replay_data else None,
     )
     backend = backend or NativeBackend()
-    profile = DEFAULT_PROFILE.with_(preemption=True) if sc.preemption else DEFAULT_PROFILE
+    profile = profile if profile is not None else DEFAULT_PROFILE
+    if sc.preemption and not profile.preemption:
+        profile = profile.with_(preemption=True)
     # One harness regardless of replica count: replicas == 1 constructs the
     # scheduler exactly as the single-replica path always did (same rng
     # label, no shard machinery), so pre-sharding fingerprints hold.
@@ -657,6 +723,15 @@ def run_scenario(
                 resolve_event(events[ei])
                 ei += 1
 
+        # The episode surface: hand the cycle to the driver; a returned
+        # profile applies fleet-wide from this cycle on (the controller
+        # reads ``self.profile`` fresh each cycle, so installation is just
+        # attribute assignment — zero cost on the None-action path).
+        action = yield EpisodeContext(clock, inner, chaos, fleet, st, cycles)
+        if action is not None:
+            for sched in fleet.scheds:
+                sched.profile = action
+
         fleet.step()
         cycles += 1
         new_binds = fold_outcomes()
@@ -766,6 +841,8 @@ def run_scenario(
             "recorded_cycles": sum(len(r.recorder.cycles()) for r in fleet.scheds),
         },
         fp=fp,
+        policy_required=bool(sc.policy_required),
+        policy_floor=float(sc.policy_objective_floor),
     )
     if writer:
         for ep, inject, lat in chaos.decision_log:

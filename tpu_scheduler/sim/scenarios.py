@@ -76,6 +76,13 @@ class Scenario:
     rebalance_efficiency_gate: float = 0.0
     rebalance_migration_budget: int = 0
     rebalance_whatif: bool = False
+    # Policy objective (tpu_scheduler/learn): every scorecard carries the
+    # ``policy`` block (the learned-objective scalar + component breakdown);
+    # ``policy_required`` additionally gates the pass on
+    # ``objective >= policy_objective_floor`` — the floor a tuned profile
+    # must clear WITHOUT breaking any other gate.
+    policy_required: bool = False
+    policy_objective_floor: float = 0.0
 
 
 SCENARIOS: dict[str, Scenario] = {}
@@ -439,6 +446,28 @@ _register(
         rebalance_required=True,
         rebalance_whatif=True,
         drain_grace_cycles=10,
+    )
+)
+
+_register(
+    Scenario(
+        name="train-smoke",
+        description="The policy-training gate: a topology-labeled 12-node cluster (2 racks x 2 slices) under mixed single-pod + gang load, sized so one episode costs well under a second on CPU — `sim train` climbs the scorecard policy objective here, and the pass gates on the objective floor the default profile clears (make train-smoke)",
+        duration=24.0,
+        workload=WorkloadSpec(
+            initial_nodes=12,
+            slice_size=3,
+            rack_size=6,
+            arrival_rate=4.0,
+            bursts=((2.0, 24),),
+            gang_fraction=0.3,
+            gang_size_max=3,
+            lifetime_mean_s=15.0,
+            priority_tiers=(0, 0, 5, 50),
+        ),
+        drain_grace_cycles=15,
+        policy_required=True,
+        policy_objective_floor=1.0,
     )
 )
 

@@ -25,6 +25,7 @@ import json
 
 import tpu_scheduler.core.predicates as P
 from ..core.snapshot import ClusterSnapshot, node_allocatable, node_used_resources
+from ..learn.objective import policy_block
 
 __all__ = [
     "SCORECARD_FIELDS",
@@ -53,6 +54,7 @@ SCORECARD_FIELDS = (
     "profile",
     "incremental",
     "rebalance",
+    "policy",
     "flight_recorder",
     "fingerprint",
 )
@@ -234,6 +236,8 @@ def build_scorecard(
     rebalance: dict,
     recorder_stats: dict,
     fp: str,
+    policy_required: bool = False,
+    policy_floor: float = 0.0,
 ) -> dict:
     """Assemble the one-JSON verdict.  Strictly virtual-time quantities —
     wall clock never appears, so the scorecard is bit-identical across runs
@@ -250,6 +254,17 @@ def build_scorecard(
         "requeues": int(metrics_snapshot.get("scheduler_requeues_total", 0)),
         "watch_errors": int(metrics_snapshot.get("scheduler_watch_errors_total", 0)),
     }
+    # The policy objective (learn/objective.py): one scalar folded from the
+    # blocks already computed above — nothing new is measured, so the
+    # record→replay byte-identity contract is untouched.
+    policy = policy_block(
+        slo=slo,
+        pod_counts=pod_counts,
+        locality=locality,
+        rebalance=rebalance,
+        required=policy_required,
+        floor=policy_floor,
+    )
     card = {
         "scenario": scenario,
         "seed": seed,
@@ -285,6 +300,11 @@ def build_scorecard(
             # consistent autoscaler what-if — a fragmentation regression
             # fails the run like an SLO regression does.
             and not (rebalance.get("required") and not rebalance.get("ok"))
+            # Policy-required scenarios additionally gate on the policy
+            # block's ok: the learned-objective scalar must clear the
+            # scenario's floor — a tuning run that wins one component by
+            # wrecking another fails the run like an SLO regression does.
+            and not (policy.get("required") and not policy.get("ok"))
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -298,6 +318,7 @@ def build_scorecard(
         "profile": profile,
         "incremental": incremental,
         "rebalance": rebalance,
+        "policy": policy,
         "flight_recorder": recorder_stats,
         "fingerprint": fp,
     }
