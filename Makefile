@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke clean
 
-check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke
+check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -68,6 +68,13 @@ defrag-smoke:
 # (scripts/train_smoke.py).
 train-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.train_smoke
+
+# The time-to-bind waterfall gate: the steady-state scenario must pass with
+# the scorecard latency block green and segment coverage >= 0.95 of bound
+# pods, and a live controller's /debug/latency route must serve the
+# per-tier decomposition (scripts/latency_smoke.py).
+latency-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.latency_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

@@ -1022,6 +1022,52 @@ def sim_sweep_row(seeds=(0, 1, 2), scenarios=("sim-smoke", "api-brownout-recover
         return {}
 
 
+def latency_row(seed: int, rates=(5.0, 15.0, 40.0)) -> dict:
+    """Arrival-rate sweep over the time-to-bind waterfall (the bench row the
+    ROADMAP event-driven-admission acceptance criterion names): the
+    ``arrival-rate-sweep`` scenario family at each Poisson rate, emitting
+    p50/p99 TTB plus the per-segment decomposition and cadence-wait fraction
+    per rate — the evidence for where admission latency actually goes as
+    load climbs.  Virtual-time quantities, deterministic in the seed;
+    ``latency_wall_seconds`` is the harness cost."""
+    try:
+        from tpu_scheduler.sim import run_scenario
+        from tpu_scheduler.sim.scenarios import arrival_rate_variant
+
+        t0 = time.perf_counter()
+        sweep: dict[str, dict] = {}
+        p99s: list[float] = []
+        for rate in rates:
+            card = run_scenario(arrival_rate_variant(rate), seed=seed)
+            lat = card["latency"]
+            slo = card["slo"]
+            p99s.append(slo["p99_time_to_bind_s"])
+            sweep[f"{rate:g}"] = {
+                "pass": card["pass"],
+                "bound": card["pods"]["bound_total"],
+                "p50_ttb_s": slo["p50_time_to_bind_s"],
+                "p99_ttb_s": slo["p99_time_to_bind_s"],
+                "cadence_wait_fraction": lat["cadence_wait_fraction"],
+                "coverage": lat["coverage"],
+                "segments_p99_s": {seg: v["p99_s"] for seg, v in lat["segments"].items()},
+            }
+            log(
+                f"latency sweep rate {rate:g}/s: p99 ttb {slo['p99_time_to_bind_s']}s, "
+                f"cadence frac {lat['cadence_wait_fraction']}, pass={card['pass']}"
+            )
+        wall = time.perf_counter() - t0
+        return {
+            "latency_shape": f"{len(rates)}rates-{min(rates):g}-{max(rates):g}",
+            "latency_sweep": sweep,
+            "latency_p99_ttb_s_min": round(min(p99s), 4),
+            "latency_p99_ttb_s_max": round(max(p99s), 4),
+            "latency_wall_seconds": round(wall, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"latency row skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
 def topology_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
     """Topology-aware gang placement at a real shape (ROADMAP "topology- and
     gang-aware placement"): a gang-heavy workload (~35% of pods in 4-8
@@ -1432,6 +1478,7 @@ def apply_secondary_regression_checks(out: dict, platform: str, repo_dir: str, t
         ("delta_cycle_seconds_min", "incremental_shape"),
         ("rebalance_solve_seconds_min", "rebalance_shape"),
         ("policy_delta_cycle_seconds_min", "policy_shape"),
+        ("latency_p99_ttb_s_max", "latency_shape"),
     ):
         val = out.get(field)
         if val is None:
@@ -1485,6 +1532,7 @@ def main() -> int:
     ap.add_argument("--no-rebalance-row", action="store_true")
     ap.add_argument("--no-policy-row", action="store_true")
     ap.add_argument("--no-sim-sweep", action="store_true")
+    ap.add_argument("--no-latency-row", action="store_true")
     ap.add_argument("--no-multi-replica-row", action="store_true")
     ap.add_argument("--no-multi-mesh-row", action="store_true")
     ap.add_argument(
@@ -1624,6 +1672,11 @@ def main() -> int:
     # worst-case SLO aggregates a robustness regression shows up in.
     if not args.no_sim_sweep and _remaining() > 300:
         out.update(sim_sweep_row(seeds=tuple(range(args.sim_sweep_seeds))))
+    # Time-to-bind waterfall vs arrival rate (the event-driven-admission
+    # acceptance bench row): per-segment p50/p99 decomposition per rate,
+    # p99 worst case gated cross-round below.
+    if not args.no_latency_row and _remaining() > 180:
+        out.update(latency_row(args.seed))
     # Active-active sharded control plane: K-replica settle throughput +
     # crash-kill takeover latency in virtual time, gated cross-round below.
     if not args.no_multi_replica_row and _remaining() > 90:

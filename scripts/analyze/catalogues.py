@@ -49,6 +49,9 @@ LERN  — every policy-objective component (``learn/objective.
         search knob (``learn/search.SearchConfig`` fields), and artifact
         field (``models/profiles.ARTIFACT_FIELDS``) must appear in the
         README "Learned policy & tuning" catalogue.
+LATN  — every time-to-bind waterfall segment (``utils/events.SEGMENTS``)
+        and latency-scorecard field (``sim/scorecard.LATENCY_FIELDS``)
+        must appear in the README "Latency & time-to-bind" catalogue.
 """
 
 from __future__ import annotations
@@ -70,6 +73,7 @@ CODES = {
     "REBL": "a rebalancer migration/skip reason/config knob/scorecard field/scenario missing from the README \"Rebalancing & defragmentation\" catalogue",
     "FLET": "a fleet keyer mode/reservation state/lease name missing from the README \"Multi-mesh fleet\" catalogue",
     "LERN": "a policy objective component/observation field/action knob/search knob/artifact field missing from the README \"Learned policy & tuning\" catalogue",
+    "LATN": "a time-to-bind waterfall segment/latency scorecard field missing from the README \"Latency & time-to-bind\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -500,6 +504,34 @@ def _run_lern(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_latn(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/utils/events.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "SEGMENTS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("waterfall segment",)))
+        elif f.rel == "tpu_scheduler/sim/scorecard.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "LATENCY_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("latency scorecard field",)))
+    return [
+        Finding(
+            "LATN",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the time-to-bind waterfall but is missing from the README "
+            f"\"Latency & time-to-bind\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
     return (
         _run_metr(ctx)
@@ -513,4 +545,5 @@ def run(ctx: Context) -> list[Finding]:
         + _run_rebl(ctx)
         + _run_flet(ctx)
         + _run_lern(ctx)
+        + _run_latn(ctx)
     )

@@ -1,0 +1,92 @@
+"""latency-smoke — the time-to-bind waterfall's standing gate (make check).
+
+Two contracts, runnable standalone for a verdict (exit 0 = green), the
+`make delta-smoke` pattern:
+
+  1. COVERAGE — the steady-state scenario (seed 0) must pass its scorecard
+     with the ``latency`` block green AND decompose at least 95% of its
+     bound pods into waterfalls whose segments sum to TTB (a pod bound on
+     the final cycle legitimately misses its confirm; anything beyond that
+     tail is an instrumentation regression).
+  2. SERVE — a live Scheduler's /debug/latency route must answer with the
+     per-tier decomposition after a few real cycles (the daemon-side
+     confirm-drain path, not the sim harness's reducer), and the per-pod
+     /debug/pods waterfall block must be populated for a confirmed pod.
+
+Off the tier-1 clock (seconds of wall); wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+MIN_COVERAGE = 0.95
+
+
+def main() -> int:
+    import logging
+
+    from tpu_scheduler.backends.native import NativeBackend
+    from tpu_scheduler.runtime.controller import Scheduler
+    from tpu_scheduler.runtime.fake_api import FakeApiServer
+    from tpu_scheduler.runtime.http_api import HttpApiServer
+    from tpu_scheduler.sim.harness import run_scenario
+    from tpu_scheduler.testing import make_node, make_pod
+    from tpu_scheduler.utils.events import SEGMENTS
+
+    logging.getLogger("tpu_scheduler").setLevel(logging.WARNING)
+
+    # 1. coverage: the scenario's pass gate REQUIRES the latency block ok.
+    card = run_scenario("steady-state", seed=0)
+    lat = card["latency"]
+    print(
+        f"steady-state: pass={card['pass']} measured={lat['measured']}/{card['pods']['bound_total']} "
+        f"coverage={lat['coverage']} sum_ok={lat['sum_to_ttb_ok']} "
+        f"cadence_wait_fraction={lat['cadence_wait_fraction']}"
+    )
+    if not card["pass"] or not lat["ok"]:
+        print("FAIL: steady-state scorecard (latency block) is red", file=sys.stderr)
+        return 1
+    if lat["coverage"] is None or lat["coverage"] < MIN_COVERAGE:
+        print(f"FAIL: waterfall coverage {lat['coverage']} under the {MIN_COVERAGE} bar", file=sys.stderr)
+        return 1
+
+    # 2. serve: a real controller + HTTP server; confirms drain on-cycle.
+    api = FakeApiServer()
+    for i in range(4):
+        api.create_node(make_node(f"n{i}", cpu="8", memory="16Gi"))
+    for i in range(12):
+        api.create_pod(make_pod(f"p{i}", cpu="500m", memory="256Mi"))
+    sched = Scheduler(api, NativeBackend(), requeue_seconds=0.0)
+    server = HttpApiServer(api, recorder=sched.recorder, latency=lambda _r: sched.latency_snapshot()).start()
+    try:
+        for _ in range(3):  # bind cycle + confirm-drain cycle + margin
+            sched.run_cycle()
+        with urllib.request.urlopen(f"{server.base_url}/debug/latency", timeout=10) as resp:
+            snap = json.loads(resp.read())
+        tiers = snap.get("tiers", {})
+        confirmed = snap.get("confirmed", 0)
+        print(f"/debug/latency: confirmed={confirmed} tiers={sorted(tiers)}")
+        if confirmed < 12 or "default" not in tiers:
+            print("FAIL: /debug/latency missing confirmed pods", file=sys.stderr)
+            return 1
+        if set(tiers["default"]["segments_sum_s"]) != set(SEGMENTS):
+            print("FAIL: /debug/latency segment taxonomy drifted", file=sys.stderr)
+            return 1
+        with urllib.request.urlopen(f"{server.base_url}/debug/pods/default/p0", timeout=10) as resp:
+            pod = json.loads(resp.read())
+        wf = pod.get("waterfall")
+        if not wf or set(wf["segments"]) != set(SEGMENTS):
+            print("FAIL: /debug/pods waterfall block missing or malformed", file=sys.stderr)
+            return 1
+    finally:
+        server.stop()
+        sched.close()
+    print("latency-smoke green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

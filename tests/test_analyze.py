@@ -427,6 +427,30 @@ def test_lern_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_latn_drift_and_guard():
+    events_mod = (
+        "tpu_scheduler/utils/events.py",
+        'SEGMENTS = ("ghost-segment",)\nEVENT_KINDS = ("not-a-segment",)\n',
+    )
+    sc_mod = (
+        "tpu_scheduler/sim/scorecard.py",
+        'LATENCY_FIELDS = ("ghost_latency_field",)\nOTHER_FIELDS = ("plain",)\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(events_mod, sc_mod, readme="")), "LATN")
+    # EVENT_KINDS / OTHER_FIELDS are not LATN catalogue surface.
+    assert {h.message.split("'")[1] for h in hits} == {"ghost-segment", "ghost_latency_field"}
+    ok = "ghost-segment ghost_latency_field"
+    assert not rule_hits(catalogues.run(make_ctx(events_mod, sc_mod, readme=ok)), "LATN")
+
+
+def test_latn_real_tree_is_catalogued():
+    files = load_files(["tpu_scheduler/utils/events.py", "tpu_scheduler/sim/scorecard.py"])
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "LATN")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")

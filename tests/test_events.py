@@ -6,7 +6,7 @@ import json
 import logging
 
 from tpu_scheduler.ops.masks import feasibility_breakdown, reason_rejection_counts
-from tpu_scheduler.utils.events import EVENT_KINDS, FlightRecorder
+from tpu_scheduler.utils.events import EVENT_KINDS, SEGMENT_OF_KIND, SEGMENTS, FlightRecorder, waterfall
 from tpu_scheduler.utils.tracing import (
     JsonLogFormatter,
     Trace,
@@ -68,6 +68,125 @@ def test_record_packed_only_touches_tracked_pods():
 
 def test_event_kinds_vocabulary():
     assert {"seen-pending", "packed", "bound", "requeued", "unschedulable"} <= set(EVENT_KINDS)
+    # The waterfall's terminal + reservation edge (PR 16): watch-confirm
+    # time was previously dropped, making the confirm segment unmeasurable.
+    assert {"bind-confirmed", "reservation-opened"} <= set(EVENT_KINDS)
+
+
+# --- time-to-bind waterfall --------------------------------------------------
+
+
+def test_events_stamp_wall_and_scheduler_clock():
+    """Every event carries both a wall ``ts`` and a scheduler-clock ``t``
+    (the virtual clock in sim) — the waterfall reads ``t``, so latency
+    decomposition is deterministic under record/replay."""
+    now = [10.0]
+    fr = FlightRecorder(clock=lambda: now[0])
+    fr.seen("default/a", 1)
+    now[0] = 12.5
+    fr.record("default/a", "bound", 2, node="n1")
+    tl = fr.timeline("default/a")
+    assert [e["t"] for e in tl] == [10.0, 12.5]
+    assert all(isinstance(e["ts"], float) for e in tl)
+    # Without a clock, t falls back to the wall stamp.
+    fr2 = FlightRecorder()
+    fr2.seen("default/b", 1)
+    (ev,) = fr2.timeline("default/b")
+    assert ev["t"] == ev["ts"]
+
+
+def test_deferred_bind_entry_and_flush_stamps_attribute_to_breaker_deferred():
+    """A bind-deferred event stamps buffer entry, bind-flushed stamps the
+    flush — the interval between them is the breaker-deferred segment."""
+    now = [0.0]
+    fr = FlightRecorder(clock=lambda: now[0])
+    fr.seen("default/a", 1)
+    now[0] = 1.0
+    fr.record("default/a", "bind-deferred", 1, node="n1", detail="circuit open")
+    now[0] = 7.0
+    fr.record("default/a", "bind-flushed", 5, node="n1")
+    now[0] = 7.5
+    fr.record("default/a", "bound", 5, node="n1")
+    tl = fr.timeline("default/a")
+    entry = next(e for e in tl if e["kind"] == "bind-deferred")
+    flush = next(e for e in tl if e["kind"] == "bind-flushed")
+    assert entry["t"] == 1.0 and flush["t"] == 7.0  # entry/flush stamps
+    wf = waterfall(tl)
+    assert wf["segments"]["breaker-deferred"] == 6.0
+    assert wf["segments"]["solve"] == 1.0  # seen-pending -> deferred
+    assert wf["segments"]["bind-post"] == 0.5  # flushed -> bound
+    assert wf["ttb"] == 7.5 and wf["unattributed"] == 0.0
+
+
+def test_waterfall_segments_sum_to_ttb():
+    now = [0.0]
+    fr = FlightRecorder(clock=lambda: now[0])
+    fr.seen("default/a", 1)
+    now[0] = 0.25
+    fr.record("default/a", "requeued", 1, detail="create-binding-failed")
+    now[0] = 3.25
+    fr.record("default/a", "packed", 4, detail="native")
+    now[0] = 3.5
+    fr.record("default/a", "bound", 4, node="n1")
+    now[0] = 4.5
+    fr.record("default/a", "bind-confirmed", 5)
+    wf = waterfall(fr.timeline("default/a"), arrival_t=-1.0)
+    assert wf["segments"]["cadence-wait"] == 1.0  # arrival -1.0 -> seen 0.0
+    assert wf["segments"]["solve"] == 0.25 + 0.25  # seen->requeued + packed->bound
+    assert wf["segments"]["backoff"] == 3.0
+    assert wf["segments"]["confirm"] == 1.0
+    assert wf["ttb"] == 5.5
+    assert abs(sum(wf["segments"].values()) + wf["unattributed"] - wf["ttb"]) < 1e-9
+    assert set(wf["segments"]) == set(SEGMENTS)
+
+
+def test_waterfall_unmapped_kind_leaks_to_unattributed():
+    """An interval opened by a kind outside SEGMENT_OF_KIND must surface as
+    unattributed — the leak the scorecard's sum-to-TTB audit catches."""
+    assert "preempted" not in SEGMENT_OF_KIND
+    now = [0.0]
+    fr = FlightRecorder(clock=lambda: now[0])
+    fr.seen("default/a", 1)
+    now[0] = 1.0
+    fr.record("default/a", "preempted", 2, detail="victim")
+    now[0] = 4.0
+    fr.record("default/a", "bound", 3, node="n1")
+    wf = waterfall(fr.timeline("default/a"))
+    assert wf["unattributed"] == 3.0 and wf["segments"]["solve"] == 1.0
+    assert wf["ttb"] == 4.0
+
+
+def test_waterfall_terminal_fallback_and_empty():
+    """Terminal = last bind-confirmed, else last bound, else no waterfall."""
+    now = [0.0]
+    fr = FlightRecorder(clock=lambda: now[0])
+    fr.seen("default/pending", 1)
+    assert waterfall(fr.timeline("default/pending")) is None
+    assert waterfall([]) is None
+    fr.seen("default/a", 1)
+    now[0] = 2.0
+    fr.record("default/a", "bound", 2, node="n1")  # never confirmed
+    wf = waterfall(fr.timeline("default/a"))
+    assert wf["ttb"] == 2.0 and wf["segments"]["confirm"] == 0.0
+
+
+def test_chrome_trace_pod_waterfall_tracks():
+    """Pod timelines export as pid-2 X slices named by segment, one tid per
+    pod, so Perfetto shows the admission waterfall beside the cycle spans."""
+    now = [0.0]
+    fr = FlightRecorder(clock=lambda: now[0])
+    fr.seen("default/a", 1)
+    now[0] = 1.0
+    fr.record("default/a", "bound", 1, node="n1")
+    now[0] = 2.0
+    fr.record("default/a", "bind-confirmed", 2)
+    trace = json.loads(json.dumps(fr.chrome_trace()))
+    pod_slices = [e for e in trace["traceEvents"] if e["ph"] == "X" and e["pid"] == 2]
+    assert {e["name"] for e in pod_slices} == {"solve", "confirm"}
+    for e in pod_slices:
+        assert e["args"]["pod"] == "default/a" and e["dur"] >= 0
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M" and e["pid"] == 2]
+    assert {e["args"]["name"] for e in meta} == {"pod admission waterfall", "default/a"}
 
 
 # --- chrome trace export -----------------------------------------------------

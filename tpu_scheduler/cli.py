@@ -380,10 +380,13 @@ def main(argv: list[str] | None = None) -> int:
         # /debug/profile serves through a replica registry so multi-replica
         # deployments can aggregate (?replica= selects); a single replica
         # registers just itself.
-        from .utils.profiler import ReplicaProfileRegistry
+        from .utils.profiler import ReplicaLatencyRegistry, ReplicaProfileRegistry
 
         profile_registry = ReplicaProfileRegistry()
         profile_registry.register(sched.identity, sched.profile_snapshot)
+        # /debug/latency aggregates the same way (time-to-bind waterfall).
+        latency_registry = ReplicaLatencyRegistry()
+        latency_registry.register(sched.identity, sched.latency_snapshot)
         http_server = HttpApiServer(
             local_api,
             metrics=sched.metrics,
@@ -393,6 +396,7 @@ def main(argv: list[str] | None = None) -> int:
             profile=profile_registry.snapshot,
             pending_ages=sched.pending_age_debug,
             rebalance=sched.rebalance_snapshot if sched.rebalancer is not None else None,
+            latency=latency_registry.snapshot,
             port=args.http_port,
         ).start()
         print(json.dumps({"http": True, "url": http_server.base_url}), file=sys.stderr)

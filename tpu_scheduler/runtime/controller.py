@@ -34,6 +34,7 @@ import random
 import threading
 import time
 
+from collections import OrderedDict
 from dataclasses import replace
 from itertools import chain, groupby
 
@@ -60,7 +61,7 @@ from ..core.snapshot import ClusterSnapshot, node_allocatable, node_net_availabl
 from ..errors import BackendUnavailable, CreateBindingFailed, NoNodeFound, SchedulerError
 from ..models.profiles import DEFAULT_PROFILE, SchedulingProfile
 from ..ops.pack import extend_node_vocabs, pack_snapshot, repack_incremental
-from ..utils.events import FlightRecorder
+from ..utils.events import SEGMENTS, FlightRecorder, waterfall
 from ..utils.metrics import CycleMetrics, MetricsRegistry, cycle_phases
 from ..utils.profiler import SLO_TIERS, ProfileRing, tier_of, tier_target, transfer_bytes_total
 from ..utils.tracing import Trace, current_trace, set_log_cycle, span
@@ -217,7 +218,10 @@ class Scheduler:
         self.metrics = MetricsRegistry()
         # Flight recorder (utils/events.py): bounded per-pod decision
         # timelines + cycle ring, served by /debug; events_buffer=0 disables.
-        self.recorder = FlightRecorder(max_pods=events_buffer)
+        # The scheduler clock rides along so timeline ``t`` stamps share the
+        # latency time base (virtual in the sim — waterfalls replay
+        # bit-identically; monotonic in the daemon).
+        self.recorder = FlightRecorder(max_pods=events_buffer, clock=clock)
         # Continuous cost-attribution profiler (utils/profiler.py): every
         # cycle's hierarchical span tree folds into this bounded ring —
         # always on (the <2% overhead gate is a tier-1 test), served at
@@ -229,6 +233,19 @@ class Scheduler:
         # stance).  Feeds scheduler_pending_age_seconds{tier=,gang=} at
         # exit-from-pending and the per-tier burn-rate gauges every cycle.
         self._pending_meta: dict[str, tuple[float, str, str]] = {}
+        # Watch-confirm tracker (admission-latency waterfall): pod full name
+        # -> SLO tier, entered at every successful binding POST, drained at
+        # the next cycle whose reflector snapshot shows the pod bound — the
+        # ``bind-confirmed`` timeline stamp and the point where the pod's
+        # waterfall is computed and observed into
+        # scheduler_ttb_segment_seconds{segment=,tier=}.  Bounded like the
+        # flush buffer; insertion order = confirm-scan order.
+        self._pending_confirm: OrderedDict[str, str] = OrderedDict()
+        # Per-tier waterfall accumulator backing latency_snapshot() (the
+        # /debug/latency payload): tier -> {count, ttb_sum, unattributed_sum,
+        # segments{name: sum}}.  Written only by the cycle loop; the HTTP
+        # thread reads GIL-atomic copies (resilience_snapshot stance).
+        self._latency_tiers: dict[str, dict] = {}
         # Device-transfer bytes already folded into the counter (the
         # profiler's lifetime total is process-wide; we fold per-cycle
         # deltas so the metric is a counter, not a re-published gauge).
@@ -594,6 +611,62 @@ class Scheduler:
         self.recorder.record(pod_full, "bind-deferred", self._cycle_tag, node=node_name, detail="circuit open")
         return True
 
+    # Watch-confirm tracker capacity (pods awaiting bound-state confirmation).
+    CONFIRM_CAPACITY = 8192
+
+    def _await_confirm(self, pod_full: str) -> None:
+        """Register a successfully POSTed bind for watch confirmation — the
+        ``confirm`` waterfall segment's open edge.  The SLO tier resolves
+        from the pending-age tracker at POST time (the pod leaves that
+        tracker on the confirm cycle).  Bounded: at capacity the oldest
+        entry drops — its confirm segment goes unmeasured, never unbounded
+        memory."""
+        if not self.recorder.enabled:
+            return
+        meta = self._pending_meta.get(pod_full)
+        while len(self._pending_confirm) >= self.CONFIRM_CAPACITY:
+            self._pending_confirm.popitem(last=False)
+        self._pending_confirm[pod_full] = meta[1] if meta is not None else "default"
+
+    def _drain_confirms(self, snapshot: ClusterSnapshot) -> None:
+        """Drain the watch-confirm tracker against the fresh reflector
+        snapshot: a tracked pod now visible as bound records
+        ``bind-confirmed``, gets its waterfall reduced, and observes every
+        segment into ``scheduler_ttb_segment_seconds{segment=,tier=}`` plus
+        the per-tier accumulator ``latency_snapshot`` serves.  Pods deleted
+        before the confirmation arrived just leave the tracker.  All
+        quantities derive from timeline ``t`` stamps (the scheduler clock),
+        so sim runs stay record/replay bit-identical."""
+        want = self._pending_confirm
+        if not want:
+            return
+        present: set[str] = set()
+        confirmed: list[str] = []
+        for p in snapshot.pods:
+            pf = full_name(p)
+            if pf in want:
+                present.add(pf)
+                if is_pod_bound(p):
+                    confirmed.append(pf)
+        for pf in [pf for pf in want if pf not in present]:
+            del want[pf]
+        for pf in confirmed:
+            tier = want.pop(pf)
+            self.recorder.record(pf, "bind-confirmed", self._cycle_tag)
+            wf = waterfall(self.recorder.timeline(pf))
+            if wf is None:
+                continue
+            acc = self._latency_tiers.setdefault(
+                tier,
+                {"count": 0, "ttb_sum": 0.0, "unattributed_sum": 0.0, "segments": {seg: 0.0 for seg in SEGMENTS}},
+            )
+            acc["count"] += 1
+            acc["ttb_sum"] += wf["ttb"]
+            acc["unattributed_sum"] += wf["unattributed"]
+            for seg, v in wf["segments"].items():
+                acc["segments"][seg] += v
+                self.metrics.observe("scheduler_ttb_segment_seconds", v, labels={"segment": seg, "tier": tier})
+
     def _bind(self, namespace: str, name: str, node_name: str) -> bool:
         """Breaker-gated bind: POST when the circuit is closed (or as one of
         the half-open cycle's trial binds); defer into the flush buffer
@@ -627,6 +700,7 @@ class Scheduler:
                 self.metrics.inc("scheduler_flushed_binds_total")
                 self.recorder.record(pod_full, "bind-flushed", self._cycle_tag, node=node_name)
             self.recorder.record(pod_full, "bound", self._cycle_tag, node=node_name)
+            self._await_confirm(pod_full)
             self.requeue_at.pop(pod_full, None)
             return True
         except CreateBindingFailed as e:
@@ -1340,6 +1414,7 @@ class Scheduler:
                 self.breaker.record(True)
                 self.metrics.inc("scheduler_bindings_total")
                 self.recorder.record(pod_full, "bound", self._cycle_tag, node=self._assumed.get(pod_full))
+                self._await_confirm(pod_full)
                 self.requeue_at.pop(pod_full, None)
                 continue
             # Server-health taxonomy mirrors _post_binding: 4xx = healthy
@@ -2094,11 +2169,16 @@ class Scheduler:
                 if self.requeue_at.pop(pf, None) is not None:
                     pruned += 1
                 self._assumed.pop(pf, None)
+                self._pending_confirm.pop(pf, None)
                 if self.deferred_binds.pop(pf, None) is not None:
                     self.metrics.inc("scheduler_deferred_dropped_total")
                     self.metrics.inc("scheduler_pods_bound_total", -1)
             if pruned:
                 self.metrics.inc("scheduler_backoff_pruned_total", pruned)
+        # Confirm-drain BEFORE any overlay: the raw reflector snapshot is
+        # the watch's truth about which POSTed binds the API server has
+        # actually confirmed — overlaid snapshots would self-confirm.
+        self._drain_confirms(snapshot)
         # Control-plane ownership BEFORE any overlay is applied: a
         # takeover (new leadership / a newly acquired shard) must get to
         # revalidate stale assumed-bind state against the fresh
@@ -2777,9 +2857,11 @@ class Scheduler:
                 led.commit(gang)
             return
         gangs: dict[str, int] = {}
+        gang_members: dict[str, list[str]] = {}
         for p in pending_owned:
             if p.spec is not None and p.spec.gang:
                 gangs[p.spec.gang] = gangs.get(p.spec.gang, 0) + 1
+                gang_members.setdefault(p.spec.gang, []).append(full_name(p))
         # Commit the reservations whose gang is done here (two-phase commit:
         # the admission already happened in a previous cycle's solve).
         for gang in list(led.active()):
@@ -2808,6 +2890,10 @@ class Scheduler:
                 continue
             if led.reserve(gang, peers):
                 self.metrics.inc("scheduler_gang_reservations_total")
+                for pf in gang_members.get(gang, ()):
+                    # The reservation-wait segment's open edge: members now
+                    # sit out the cross-shard two-phase hold.
+                    self.recorder.record(pf, "reservation-opened", self._cycle_tag, detail=f"peer shards {peers}")
                 self._cycle_notes.append(f"fleet: reserved shards {peers} for gang {gang} ({size} wide)")
 
     # shape: (self: obj, snapshot: obj) -> obj
@@ -3155,6 +3241,31 @@ class Scheduler:
             "compile": compile_stats(),
             "device_transfer_bytes": transfer_bytes_total(),
             "slo": self.slo_snapshot(),
+        }
+
+    def latency_snapshot(self) -> dict:
+        """The /debug/latency payload for THIS replica: per-tier time-to-bind
+        decomposition sums over every confirm-drained pod, plus how many
+        confirms are still outstanding.  Multi-replica deployments register
+        this callable in a ReplicaLatencyRegistry (utils/profiler.py) so
+        /debug/latency?replica= can select and the default view can merge.
+        Reads take GIL-atomic whole-dict copies of main-loop-owned state —
+        no lock needed (same stance as resilience_snapshot)."""
+        tiers = {
+            tier: {
+                "count": acc["count"],
+                "ttb_sum_s": round(acc["ttb_sum"], 9),
+                "mean_ttb_s": round(acc["ttb_sum"] / acc["count"], 9) if acc["count"] else 0.0,
+                "unattributed_sum_s": round(acc["unattributed_sum"], 9),
+                "segments_sum_s": {seg: round(v, 9) for seg, v in acc["segments"].items()},
+            }
+            for tier, acc in dict(self._latency_tiers).items()
+        }
+        return {
+            "replica": self.identity,
+            "confirmed": sum(t["count"] for t in tiers.values()),
+            "awaiting_confirm": len(self._pending_confirm),
+            "tiers": tiers,
         }
 
     def resilience_snapshot(self) -> dict:

@@ -64,6 +64,7 @@ class HttpApiServer:
         profile=None,
         pending_ages=None,
         rebalance=None,
+        latency=None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
@@ -89,6 +90,11 @@ class HttpApiServer:
         # controller's rebalance_snapshot: background-tier stats, drained
         # node census, throttle config).
         self.rebalance = rebalance
+        # (replica: str | None) -> dict producing the /debug/latency payload
+        # — a ReplicaLatencyRegistry.snapshot (utils/profiler.py) in
+        # multi-replica mode, or the one scheduler's latency_snapshot
+        # wrapped; ``?replica=`` passes through as the argument.
+        self.latency = latency
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -171,9 +177,20 @@ class HttpApiServer:
                 elif not timeline:
                     self._send_json(404, {"message": f"no recorded timeline for pod {full}"})
                     return
+                # The time-to-bind waterfall: the timeline reduced to the
+                # per-segment latency decomposition (None until bound).
+                from ..utils.events import waterfall
+
                 self._send_json(
                     200,
-                    {"pod": full, "timeline": timeline, "why_pending": why, "age": age, "locality": locality},
+                    {
+                        "pod": full,
+                        "timeline": timeline,
+                        "waterfall": waterfall(timeline),
+                        "why_pending": why,
+                        "age": age,
+                        "locality": locality,
+                    },
                 )
                 return
 
@@ -242,6 +259,16 @@ class HttpApiServer:
                             self._send_json(404, {"message": "profiler not attached"})
                         else:
                             self._send_json(200, outer.profile(q.get("replica", [None])[0]))
+                    elif parsed.path == "/debug/latency":
+                        # Time-to-bind waterfall aggregation
+                        # (utils/events.py waterfall over the flight
+                        # recorder): per-tier segment-decomposition sums.
+                        # ?replica= selects one replica in multi-replica
+                        # deployments (ReplicaLatencyRegistry).
+                        if outer.latency is None:
+                            self._send_json(404, {"message": "latency state not attached"})
+                        else:
+                            self._send_json(200, outer.latency(q.get("replica", [None])[0]))
                     elif parsed.path == "/debug/rebalance":
                         # Background rebalancer (tpu_scheduler/rebalance):
                         # migration/skip counters, in-flight ledger size,

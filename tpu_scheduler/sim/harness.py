@@ -33,12 +33,14 @@ from ..runtime.fake_api import FakeApiServer
 from ..testing import make_node, make_pod
 from ..topology.locality import gang_placement_stats
 from ..topology.model import DEFAULT_LEVEL_KEYS
+from ..utils.events import waterfall
+from ..utils.profiler import tier_of
 from ..utils.tracing import base_name
 from .chaos import ChaosApiServer
 from .clock import VirtualClock
 from .multi import MultiReplicaHarness
 from .scenarios import SCENARIOS, Scenario
-from .scorecard import _percentile, build_scorecard, check_invariants, fingerprint
+from .scorecard import _percentile, build_latency_block, build_scorecard, check_invariants, fingerprint
 from .trace import TraceWriter, load_trace
 from .workload import generate_events, initial_nodes
 
@@ -94,6 +96,7 @@ class _SimState:
         self.first_bind: dict[str, str] = {}
         self.counts = {"arrived": 0, "churn_recreated": 0, "completed": 0, "evicted": 0, "migrated": 0}
         self.ttb: list[float] = []
+        self.tier: dict[str, str] = {}  # pod name -> SLO tier (from priority at arrival)
         self.double_bound = 0
 
 
@@ -170,6 +173,39 @@ def _profile_block(sc: Scenario, fleet: MultiReplicaHarness) -> dict:
         "cycles": cycles,
         "span_census": dict(sorted(census.items())),
     }
+
+
+# shape: (sc: obj, fleet: obj, st: obj) -> obj
+def _latency_block(sc: Scenario, fleet: MultiReplicaHarness, st: "_SimState") -> dict:
+    """The scorecard ``latency`` verdict: every undisturbed bound pod's
+    flight-recorder timeline reduced to its waterfall (utils/events.py),
+    anchored at the harness's nominal arrival time, folded by SLO tier.
+
+    Deterministic by construction: the recorder stamps every event with the
+    scheduler clock (``t``, virtual here) and ``waterfall`` reads only those
+    stamps, so the whole block is bit-identical under record/replay.
+    Multi-replica runs concatenate per-replica timelines for the same pod
+    (a migrated pod's history lives on two recorders) in replica order and
+    stably sort by ``t``."""
+    timelines: dict[str, list[dict]] = {}
+    for r in fleet.scheds:
+        for pf in r.recorder.tracked_pods():
+            timelines.setdefault(pf, []).extend(r.recorder.timeline(pf))
+    samples: list[tuple[str, dict]] = []
+    for pf in sorted(timelines):
+        name = pf.rpartition("/")[2]
+        if name in st.disturbed_pods or name not in st.arrival_t:
+            continue
+        tl = sorted(timelines[pf], key=lambda ev: float(ev.get("t", ev.get("ts", 0.0))))
+        wf = waterfall(tl, arrival_t=st.arrival_t[name])
+        if wf is None:
+            continue
+        samples.append((st.tier.get(name, "default"), wf))
+    return build_latency_block(
+        samples,
+        bound_total=len(st.ttb),
+        required=bool(sc.latency_required),
+    )
 
 
 def _incremental_block(sc: Scenario, fleet: MultiReplicaHarness) -> dict:
@@ -520,6 +556,7 @@ def scenario_episode(
             # application: a pod arriving between cycles queues until the
             # next one, and that queueing delay is real time-to-bind.
             st.arrival_t[name] = float(op.get("at", now))
+            st.tier[name] = tier_of(int(p.get("priority", 0)))
             if p.get("lifetime_s"):
                 st.lifetime[name] = float(p["lifetime_s"])
             if p.get("gang"):
@@ -835,6 +872,7 @@ def scenario_episode(
             int(metrics_snapshot.get("scheduler_preemption_victims_total", 0))
             + int(metrics_snapshot.get("scheduler_noexecute_evictions_total", 0)),
         ),
+        latency=_latency_block(sc, fleet, st),
         recorder_stats={
             "tracked_pods": sum(len(r.recorder.tracked_pods()) for r in fleet.scheds),
             "evicted_timelines": sum(r.recorder.evicted_timelines for r in fleet.scheds),

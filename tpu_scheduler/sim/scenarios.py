@@ -17,7 +17,7 @@ from dataclasses import dataclass
 from .chaos import ChaosConfig, ChaosWindow
 from .workload import WorkloadSpec
 
-__all__ = ["Scenario", "SCENARIOS"]
+__all__ = ["Scenario", "SCENARIOS", "arrival_rate_variant"]
 
 
 @dataclass(frozen=True)
@@ -83,6 +83,12 @@ class Scenario:
     # must clear WITHOUT breaking any other gate.
     policy_required: bool = False
     policy_objective_floor: float = 0.0
+    # Time-to-bind waterfall (utils/events.py + the scorecard ``latency``
+    # block): ``latency_required`` gates the scorecard pass on the latency
+    # block's ok — at least one measured pod AND every measured pod's
+    # segment decomposition summing to its TTB within rounding (the
+    # attribution-leak audit).
+    latency_required: bool = False
 
 
 SCENARIOS: dict[str, Scenario] = {}
@@ -107,6 +113,7 @@ _register(
             priority_tiers=(0, 0, 0, 5, 50),
         ),
         profile_required=True,
+        latency_required=True,
     )
 )
 
@@ -123,8 +130,46 @@ _register(
             gang_fraction=0.1,
             priority_tiers=(0, 0, 5),
         ),
+        latency_required=True,
     )
 )
+
+_register(
+    Scenario(
+        name="arrival-rate-sweep",
+        description="The latency bench's scenario family: steady-state's cluster shape at a parameterized Poisson rate (arrival_rate_variant), pass-gated on the time-to-bind waterfall summing to TTB — bench.py latency_row sweeps the rate to put the TTB-vs-load curve on the record",
+        duration=45.0,
+        workload=WorkloadSpec(
+            initial_nodes=60,
+            arrival_rate=12.0,
+            lifetime_mean_s=20.0,
+            gang_fraction=0.05,
+            selector_fraction=0.2,
+            priority_tiers=(0, 0, 0, 5, 50),
+        ),
+        latency_required=True,
+        # An oversubscribing rate variant drains only as lifetimes expire.
+        drain_grace_cycles=25,
+    )
+)
+
+
+# shape: (rate: obj) -> obj
+def arrival_rate_variant(rate: float) -> Scenario:
+    """The ``arrival-rate-sweep`` family member at a given Poisson rate —
+    the parameterization bench.py's latency_row sweeps.  Variants are NOT
+    registered (the registry stays the closed, README-documented set); the
+    harness accepts Scenario objects directly."""
+    from dataclasses import replace
+
+    base = SCENARIOS["arrival-rate-sweep"]
+    return replace(
+        base,
+        name=f"arrival-rate-{rate:g}",
+        description=f"arrival-rate-sweep variant at {rate:g} pods/s",
+        workload=replace(base.workload, arrival_rate=float(rate)),
+    )
+
 
 _register(
     Scenario(

@@ -31,8 +31,10 @@ __all__ = [
     "SCORECARD_FIELDS",
     "INCREMENTAL_FIELDS",
     "REBALANCE_FIELDS",
+    "LATENCY_FIELDS",
     "check_invariants",
     "build_scorecard",
+    "build_latency_block",
     "fingerprint",
 ]
 
@@ -55,6 +57,7 @@ SCORECARD_FIELDS = (
     "incremental",
     "rebalance",
     "policy",
+    "latency",
     "flight_recorder",
     "fingerprint",
 )
@@ -110,6 +113,85 @@ REBALANCE_FIELDS = (
     "whatif",
     "ok",
 )
+
+
+# The closed schema of the ``latency`` block (drift-gated against the README
+# "Latency & time-to-bind" catalogue by the LATN analyze rule).  Strictly
+# virtual-time quantities: every number derives from scheduler-clock ``t``
+# stamps on flight-recorder events plus the harness's arrival ledger — never
+# wall clock, so byte-identity and record→replay hold.
+LATENCY_FIELDS = (
+    "required",
+    "ok",
+    "measured",
+    "coverage",
+    "sum_to_ttb_ok",
+    "max_sum_error_s",
+    "cadence_wait_fraction",
+    "segments",
+    "tiers",
+)
+
+
+# shape: (samples: obj, bound_total: obj, required: obj, tol: obj) -> obj
+def build_latency_block(
+    samples: list[tuple[str, dict]],
+    bound_total: int | None = None,
+    required: bool = False,
+    tol: float = 1e-6,
+) -> dict:
+    """Fold per-pod waterfalls (``utils/events.waterfall`` outputs, paired
+    with their SLO tier) into the closed ``latency`` scorecard block.
+
+    The audit that catches attribution leaks: every sample's segments +
+    unattributed must sum to its TTB within ``tol`` — a timeline whose
+    interval fell through the segment taxonomy fails ``sum_to_ttb_ok`` and,
+    on latency-required scenarios, the run.  ``coverage`` (measured /
+    bound_total) is reported for the latency-smoke gate but never fails the
+    scorecard itself: a pod bound on the final cycle legitimately misses its
+    confirm."""
+    per_seg: dict[str, list[float]] = {}
+    per_tier: dict[str, list[dict]] = {}
+    ttbs: list[float] = []
+    cadence_sum = 0.0
+    max_err = 0.0
+    for tier, wf in samples:
+        err = abs(sum(wf["segments"].values()) + wf["unattributed"] - wf["ttb"])
+        max_err = max(max_err, err)
+        ttbs.append(wf["ttb"])
+        cadence_sum += wf["segments"].get("cadence-wait", 0.0)
+        per_tier.setdefault(tier, []).append(wf)
+        for seg, v in wf["segments"].items():
+            per_seg.setdefault(seg, []).append(v)
+
+    def pcts(vals: list[float]) -> dict:
+        s = sorted(vals)
+        return {"p50_s": round(_percentile(s, 0.50), 6), "p99_s": round(_percentile(s, 0.99), 6)}
+
+    measured = len(samples)
+    ttb_total = sum(ttbs)
+    sum_ok = max_err <= tol
+    tiers = {
+        tier: {
+            "count": len(wfs),
+            "ttb": pcts([w["ttb"] for w in wfs]),
+            "segments": {seg: pcts([w["segments"][seg] for w in wfs]) for seg in sorted(per_seg)},
+        }
+        for tier, wfs in sorted(per_tier.items())
+    }
+    block = {
+        "required": bool(required),
+        "ok": sum_ok and (measured > 0 or not required),
+        "measured": measured,
+        "coverage": round(measured / bound_total, 6) if bound_total else None,
+        "sum_to_ttb_ok": sum_ok,
+        "max_sum_error_s": round(max_err, 9),
+        "cadence_wait_fraction": round(cadence_sum / ttb_total, 6) if ttb_total > 0 else 0.0,
+        "segments": {seg: pcts(vals) for seg, vals in sorted(per_seg.items())},
+        "tiers": tiers,
+    }
+    assert tuple(block) == LATENCY_FIELDS, "latency block schema drifted from LATENCY_FIELDS"
+    return block
 
 
 def fingerprint(bind_log: list[tuple[float, str, str]], placements: list[tuple[str, str]]) -> str:
@@ -234,6 +316,7 @@ def build_scorecard(
     profile: dict,
     incremental: dict,
     rebalance: dict,
+    latency: dict,
     recorder_stats: dict,
     fp: str,
     policy_required: bool = False,
@@ -305,6 +388,11 @@ def build_scorecard(
             # scenario's floor — a tuning run that wins one component by
             # wrecking another fails the run like an SLO regression does.
             and not (policy.get("required") and not policy.get("ok"))
+            # Latency-required scenarios additionally gate on the latency
+            # block's ok: waterfall segments must sum to TTB within
+            # rounding on EVERY measured pod — an attribution leak is an
+            # observability regression and fails the run.
+            and not (latency.get("required") and not latency.get("ok"))
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -319,6 +407,7 @@ def build_scorecard(
         "incremental": incremental,
         "rebalance": rebalance,
         "policy": policy,
+        "latency": latency,
         "flight_recorder": recorder_stats,
         "fingerprint": fp,
     }

@@ -45,6 +45,10 @@ PENDING_AGE_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0
 # Dirty-set size per delta cycle (tpu_scheduler/delta): single-pod watch
 # ripples through flagship-scale churn waves.
 DIRTY_BUCKETS = (1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0)
+# Per-segment time-to-bind attribution (utils/events.SEGMENTS): zero-width
+# same-cycle segments through multi-minute backoff/backlog residency — the
+# low end needs sub-cadence resolution (one cycle interval ~ 1 s).
+TTB_SEGMENT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
 # Histogram name -> bucket bounds; the one registration point the README
 # drift gate (scripts/lint.py) and to_prometheus share.
@@ -57,6 +61,7 @@ HISTOGRAM_BUCKETS = {
     "scheduler_gang_placement_distance": DISTANCE_BUCKETS,
     "scheduler_pending_age_seconds": PENDING_AGE_BUCKETS,
     "scheduler_delta_dirty_pods": DIRTY_BUCKETS,
+    "scheduler_ttb_segment_seconds": TTB_SEGMENT_BUCKETS,
 }
 
 

@@ -53,6 +53,7 @@ __all__ = [
     "coverage",
     "ProfileRing",
     "ReplicaProfileRegistry",
+    "ReplicaLatencyRegistry",
     "install_jax_profile_hooks",
     "record_transfer",
     "transfer_bytes_total",
@@ -380,6 +381,59 @@ class ReplicaProfileRegistry:
         merged["coverage"] = round(1.0 - merged["other_total_s"] / wt, 6) if wt > 0 else 1.0
         merged["wall_total_s"] = round(merged["wall_total_s"], 6)
         merged["other_total_s"] = round(merged["other_total_s"], 6)
+        return {"replicas": per, "merged": merged}
+
+
+class ReplicaLatencyRegistry:
+    """Replica id -> latency_snapshot callable; the /debug/latency route's
+    source in multi-replica deployments (same registration pattern as
+    ReplicaProfileRegistry).  ``snapshot(replica=...)`` selects one replica;
+    without it, per-replica blocks plus a fleet-merged per-tier sum of the
+    time-to-bind decomposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas: dict[str, object] = {}  # guarded-by: _lock — id -> () -> dict
+
+    def register(self, replica_id: str, snapshot_fn) -> None:
+        with self._lock:
+            self._replicas[replica_id] = snapshot_fn
+
+    def replica_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # shape: (self: obj, replica: obj) -> obj
+    def snapshot(self, replica: str | None = None) -> dict:
+        with self._lock:
+            fns = dict(self._replicas)
+        if replica is not None:
+            fn = fns.get(replica)
+            if fn is None:
+                return {"error": f"unknown replica {replica!r}", "replicas": sorted(fns)}
+            return {"replica": replica, **fn()}
+        per = {rid: fn() for rid, fn in sorted(fns.items())}
+        merged_tiers: dict[str, dict] = {}
+        confirmed = 0
+        awaiting = 0
+        for snap in per.values():
+            confirmed += snap.get("confirmed", 0)
+            awaiting += snap.get("awaiting_confirm", 0)
+            for tier, blk in snap.get("tiers", {}).items():
+                acc = merged_tiers.setdefault(
+                    tier, {"count": 0, "ttb_sum_s": 0.0, "unattributed_sum_s": 0.0, "segments_sum_s": {}}
+                )
+                acc["count"] += blk.get("count", 0)
+                acc["ttb_sum_s"] += blk.get("ttb_sum_s", 0.0)
+                acc["unattributed_sum_s"] += blk.get("unattributed_sum_s", 0.0)
+                for seg, v in blk.get("segments_sum_s", {}).items():
+                    acc["segments_sum_s"][seg] = acc["segments_sum_s"].get(seg, 0.0) + v
+        for acc in merged_tiers.values():
+            acc["mean_ttb_s"] = round(acc["ttb_sum_s"] / acc["count"], 9) if acc["count"] else 0.0
+            acc["ttb_sum_s"] = round(acc["ttb_sum_s"], 9)
+            acc["unattributed_sum_s"] = round(acc["unattributed_sum_s"], 9)
+            acc["segments_sum_s"] = {seg: round(v, 9) for seg, v in acc["segments_sum_s"].items()}
+        merged = {"confirmed": confirmed, "awaiting_confirm": awaiting, "tiers": merged_tiers}
         return {"replicas": per, "merged": merged}
 
 
