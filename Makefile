@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke clean
 
-check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke
+check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -75,6 +75,13 @@ train-smoke:
 # per-tier decomposition (scripts/latency_smoke.py).
 latency-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.latency_smoke
+
+# The closed-loop autoscaler gate: the flash-crowd elasticity scenario
+# must pass its joint cost+SLO objective with real scale-ups and zero
+# reclaim orphans, and the autoscaler-off static baseline must FAIL the
+# same gate (scripts/elasticity_smoke.py).
+elasticity-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.elasticity_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

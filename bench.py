@@ -1068,6 +1068,58 @@ def latency_row(seed: int, rates=(5.0, 15.0, 40.0)) -> dict:
         return {}
 
 
+def elasticity_row(seed: int, scenarios=("diurnal-traffic", "flash-crowd-provisioning-lag")) -> dict:
+    """Closed-loop autoscaling evidence (tpu_scheduler/autoscale): each
+    elasticity scenario runs twice — autoscaler ON vs the static-fleet
+    baseline — and the row reports the joint cost+SLO objective for both,
+    the worst provisioning-lag-exposed p99 TTB across scenarios, and the
+    elastic-capacity cost integral (node-hours bought from the simulated
+    provider).  Virtual-time quantities, deterministic in the seed;
+    ``elasticity_wall_seconds`` is the harness cost."""
+    try:
+        from tpu_scheduler.sim import run_scenario
+
+        t0 = time.perf_counter()
+        sweep: dict[str, dict] = {}
+        joints: list[float] = []
+        lags: list[float] = []
+        cost_total = 0.0
+        for name in scenarios:
+            on = run_scenario(name, seed=seed)
+            off = run_scenario(name, seed=seed, autoscale=False)
+            e, eo = on["elasticity"], off["elasticity"]
+            joints.append(e["joint_objective"])
+            lags.append(e["provision_lag_p99_s"] or 0.0)
+            cost_total += e["cost_node_hours"]
+            sweep[name] = {
+                "pass": on["pass"],
+                "static_pass": off["pass"],
+                "joint_objective": e["joint_objective"],
+                "static_joint_objective": eo["joint_objective"],
+                "objective_gate": e["objective_gate"],
+                "scale_ups": sum(e["scale_ups"].values()),
+                "scale_downs": sum(e["scale_downs"].values()),
+                "provision_lag_p99_s": e["provision_lag_p99_s"],
+                "cost_node_hours": e["cost_node_hours"],
+            }
+            log(
+                f"elasticity {name}: joint {e['joint_objective']} (static {eo['joint_objective']}, "
+                f"gate {e['objective_gate']}), cost {e['cost_node_hours']} node-h, pass={on['pass']}"
+            )
+        wall = time.perf_counter() - t0
+        return {
+            "elasticity_shape": f"{len(scenarios)}scen",
+            "elasticity_sweep": sweep,
+            "elasticity_joint_objective_max": round(max(joints), 4),
+            "elasticity_provision_lag_p99_s_max": round(max(lags), 4),
+            "elasticity_cost_node_hours": round(cost_total, 4),
+            "elasticity_wall_seconds": round(wall, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"elasticity row skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
 def topology_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
     """Topology-aware gang placement at a real shape (ROADMAP "topology- and
     gang-aware placement"): a gang-heavy workload (~35% of pods in 4-8
@@ -1479,6 +1531,7 @@ def apply_secondary_regression_checks(out: dict, platform: str, repo_dir: str, t
         ("rebalance_solve_seconds_min", "rebalance_shape"),
         ("policy_delta_cycle_seconds_min", "policy_shape"),
         ("latency_p99_ttb_s_max", "latency_shape"),
+        ("elasticity_joint_objective_max", "elasticity_shape"),
     ):
         val = out.get(field)
         if val is None:
@@ -1534,6 +1587,7 @@ def main() -> int:
     ap.add_argument("--no-sim-sweep", action="store_true")
     ap.add_argument("--no-latency-row", action="store_true")
     ap.add_argument("--no-multi-replica-row", action="store_true")
+    ap.add_argument("--no-elasticity-row", action="store_true")
     ap.add_argument("--no-multi-mesh-row", action="store_true")
     ap.add_argument(
         "--sim-sweep-seeds",
@@ -1677,6 +1731,8 @@ def main() -> int:
     # p99 worst case gated cross-round below.
     if not args.no_latency_row and _remaining() > 180:
         out.update(latency_row(args.seed))
+    if not args.no_elasticity_row and _remaining() > 180:
+        out.update(elasticity_row(args.seed))
     # Active-active sharded control plane: K-replica settle throughput +
     # crash-kill takeover latency in virtual time, gated cross-round below.
     if not args.no_multi_replica_row and _remaining() > 90:

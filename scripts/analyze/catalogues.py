@@ -52,6 +52,13 @@ LERN  — every policy-objective component (``learn/objective.
 LATN  — every time-to-bind waterfall segment (``utils/events.SEGMENTS``)
         and latency-scorecard field (``sim/scorecard.LATENCY_FIELDS``)
         must appear in the README "Latency & time-to-bind" catalogue.
+ELAS  — every autoscaler skip reason / config knob (``autoscale/policy.
+        SKIP_REASONS``, ``AutoscaleConfig`` fields), default-catalog SKU
+        (``autoscale/provider`` ``InstanceSKU(name=...)`` literals),
+        elasticity-scorecard field (``sim/scorecard.ELASTICITY_FIELDS``),
+        and elasticity-exercising sim scenario (a registry entry passing
+        ``autoscale=``) must appear in the README "Autoscaling &
+        elasticity" catalogue.
 """
 
 from __future__ import annotations
@@ -74,6 +81,7 @@ CODES = {
     "FLET": "a fleet keyer mode/reservation state/lease name missing from the README \"Multi-mesh fleet\" catalogue",
     "LERN": "a policy objective component/observation field/action knob/search knob/artifact field missing from the README \"Learned policy & tuning\" catalogue",
     "LATN": "a time-to-bind waterfall segment/latency scorecard field missing from the README \"Latency & time-to-bind\" catalogue",
+    "ELAS": "an autoscaler skip reason/config knob/catalog SKU/scorecard field/scenario missing from the README \"Autoscaling & elasticity\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -532,6 +540,60 @@ def _run_latn(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_elas(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/autoscale/policy.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "SKIP_REASONS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("autoscale skip reason",)))
+                elif isinstance(node, ast.ClassDef) and node.name == "AutoscaleConfig":
+                    for stmt in node.body:
+                        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                            tokens.append(("autoscale knob", stmt.target.id))
+        elif f.rel == "tpu_scheduler/autoscale/provider.py":
+            # Catalog SKUs: every InstanceSKU(name="...") literal — the
+            # default catalog's rows must be documented by name.
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "InstanceSKU"):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "name" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                        tokens.append(("catalog SKU", kw.value.value))
+        elif f.rel == "tpu_scheduler/sim/scorecard.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "ELASTICITY_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("elasticity scorecard field",)))
+        elif f.rel == "tpu_scheduler/sim/scenarios.py":
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "Scenario"):
+                    continue
+                name = None
+                autoscaling = False
+                for kw in node.keywords:
+                    if kw.arg == "name" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                        name = kw.value.value
+                    elif kw.arg == "autoscale":
+                        autoscaling = True
+                if name and autoscaling:
+                    tokens.append(("elasticity scenario", name))
+    return [
+        Finding(
+            "ELAS",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the closed-loop autoscaler but is missing from the README "
+            f"\"Autoscaling & elasticity\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
     return (
         _run_metr(ctx)
@@ -546,4 +608,5 @@ def run(ctx: Context) -> list[Finding]:
         + _run_flet(ctx)
         + _run_lern(ctx)
         + _run_latn(ctx)
+        + _run_elas(ctx)
     )

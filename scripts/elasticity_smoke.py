@@ -1,0 +1,70 @@
+"""elasticity-smoke — the closed-loop autoscaler's standing gate (make check).
+
+Two contracts, runnable standalone for a verdict (exit 0 = green), the
+`make defrag-smoke` / `make latency-smoke` pattern:
+
+  1. ELASTIC — the ``flash-crowd-provisioning-lag`` scenario (seed 0)
+     must pass its scorecard with the ``elasticity`` block green: the
+     joint cost+SLO objective (effective p99 time-to-bind plus the
+     weighted elastic-capacity cost integral) at or under the scenario
+     gate, with zero reclaim-orphaned pods — and the autoscaler must
+     have actually bought capacity.
+  2. BASELINE — the SAME scenario with the autoscaler forced OFF
+     (``run_scenario(..., autoscale=False)``) must FAIL the same joint
+     gate on the static fleet: if the baseline ever passes, the gate
+     stopped measuring elasticity and the scenario must be re-tuned.
+
+Off the tier-1 clock (seconds of wall); wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+SCENARIO = "flash-crowd-provisioning-lag"
+
+
+def main() -> int:
+    import logging
+
+    from tpu_scheduler.sim.harness import run_scenario
+
+    logging.getLogger("tpu_scheduler").setLevel(logging.WARNING)
+
+    card = run_scenario(SCENARIO, seed=0)
+    e = card["elasticity"]
+    print(
+        f"elasticity-smoke ON: pass={card['pass']} joint={e['joint_objective']} "
+        f"(gate {e['objective_gate']}) scale_ups={sum(e['scale_ups'].values())} "
+        f"scale_downs={sum(e['scale_downs'].values())} lag_p99={e['provision_lag_p99_s']}s "
+        f"cost={e['cost_node_hours']} node-h orphans={e['reclaim_orphans']}"
+    )
+    if not card["pass"] or not e["ok"]:
+        print("FAIL: elasticity-smoke scorecard (elasticity block) is red", file=sys.stderr)
+        return 1
+    if sum(e["scale_ups"].values()) == 0:
+        print("FAIL: the autoscaler bought no capacity — the gate proved nothing", file=sys.stderr)
+        return 1
+
+    off = run_scenario(SCENARIO, seed=0, autoscale=False)
+    eo = off["elasticity"]
+    print(
+        f"elasticity-smoke OFF: pass={off['pass']} joint={eo['joint_objective']} "
+        f"(gate {eo['objective_gate']})"
+    )
+    if off["pass"] or eo["ok"]:
+        print(
+            "FAIL: the autoscaler-off baseline passed the joint gate — the scenario no longer "
+            "measures elasticity",
+            file=sys.stderr,
+        )
+        return 1
+    if eo["joint_objective"] <= e["joint_objective"]:
+        print("FAIL: elastic capacity did not improve the joint objective over the static baseline", file=sys.stderr)
+        return 1
+    print("elasticity-smoke green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -451,6 +451,56 @@ def test_latn_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_elas_drift_and_guard():
+    policy_mod = (
+        "tpu_scheduler/autoscale/policy.py",
+        'SKIP_REASONS = ("ghost-elas-skip",)\n'
+        "class AutoscaleConfig:\n    ghost_elas_knob: int = 1\n"
+        'OTHER = ("not-a-reason",)\n',
+    )
+    provider_mod = (
+        "tpu_scheduler/autoscale/provider.py",
+        'DEFAULT_CATALOG = (InstanceSKU(name="ghost-sku", cpu=8),)\n'
+        'OTHER = InstanceSKU(cpu=8)\n',
+    )
+    sc_mod = (
+        "tpu_scheduler/sim/scorecard.py",
+        'ELASTICITY_FIELDS = ("ghost_elasticity_field",)\nSCORECARD_FIELDS = ("simc_business",)\n',
+    )
+    scen_mod = (
+        "tpu_scheduler/sim/scenarios.py",
+        '_register(Scenario(name="ghost-elastic-scenario", autoscale=True))\n'
+        '_register(Scenario(name="plain-scenario", workload=WorkloadSpec(arrival_rate=1.0)))\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(policy_mod, provider_mod, sc_mod, scen_mod, readme="")), "ELAS")
+    # simc_business is SIMC's token and plain-scenario SIMC's scenario;
+    # OTHER and the name-less InstanceSKU are not ELAS catalogue surface.
+    assert {h.message.split("'")[1] for h in hits} == {
+        "ghost-elas-skip",
+        "ghost_elas_knob",
+        "ghost-sku",
+        "ghost_elasticity_field",
+        "ghost-elastic-scenario",
+    }
+    ok = "ghost-elas-skip ghost_elas_knob ghost-sku ghost_elasticity_field ghost-elastic-scenario"
+    assert not rule_hits(catalogues.run(make_ctx(policy_mod, provider_mod, sc_mod, scen_mod, readme=ok)), "ELAS")
+
+
+def test_elas_real_tree_is_catalogued():
+    files = load_files(
+        [
+            "tpu_scheduler/autoscale/policy.py",
+            "tpu_scheduler/autoscale/provider.py",
+            "tpu_scheduler/sim/scorecard.py",
+            "tpu_scheduler/sim/scenarios.py",
+        ]
+    )
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "ELAS")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")

@@ -1,0 +1,421 @@
+"""Closed-loop autoscaler tests (tpu_scheduler/autoscale/).
+
+Provider semantics (determinism, provisioning lag, quota, stockout, spot
+reclaim), the cost-aware catalog FFD, scale-down hysteresis against the
+rebalancer's reserve, the drain protocol's zero-orphan guarantee, sharded
+shard-0 gating + takeover, and the elasticity scenario family: every
+scenario passes its joint cost+SLO gate at seeds {0, 1}, the static
+baseline FAILS the same gate, and record→replay is bit-identical.
+"""
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_scheduler.autoscale import (
+    DEFAULT_CATALOG,
+    PROVIDER_SKU_LABEL,
+    Autoscaler,
+    AutoscaleConfig,
+    InstanceSKU,
+    QuotaExceeded,
+    SimCloudProvider,
+    Stockout,
+    load_catalog,
+    pack_catalog,
+)
+from tpu_scheduler.autoscale.policy import SKIP_REASONS
+from tpu_scheduler.backends.native import NativeBackend
+from tpu_scheduler.core.snapshot import ClusterSnapshot
+from tpu_scheduler.runtime.controller import Scheduler
+from tpu_scheduler.runtime.fake_api import FakeApiServer
+from tpu_scheduler.testing import make_node, make_pod
+
+from conftest import FakeClock
+
+
+def _provider(api=None, seed=7, catalog=DEFAULT_CATALOG, **kw):
+    return SimCloudProvider(
+        api if api is not None else FakeApiServer(),
+        clock=FakeClock(),
+        rng=random.Random(seed),
+        catalog=catalog,
+        **kw,
+    )
+
+
+# -- provider semantics -------------------------------------------------------
+
+
+def test_provider_determinism_same_seed_same_records():
+    def drive(seed):
+        prov = _provider(seed=seed, reclaim_rate=0.05)
+        for t in range(12):
+            try:
+                prov.request("spot-16" if t % 2 else "std-8", float(t))
+            except Stockout:
+                pass
+            prov.pump(float(t) + 0.5)
+        return prov.records
+
+    a, b, other = drive(3), drive(3), drive(4)
+    assert a == b
+    assert a != other  # the seed actually parameterizes the draws
+
+
+def test_provisioning_lag_gates_the_join():
+    api = FakeApiServer()
+    sku = InstanceSKU(name="lab", cpu=8, mem_gi=32, hourly_cost=1.0, provision_s=6.0, provision_jitter_s=0.0)
+    prov = _provider(api, catalog=(sku,))
+    name = prov.request("lab", now=0.0)
+    assert prov.pending_provisions() == 1 and not api.list_nodes()
+    prov.pump(5.9)
+    assert not api.list_nodes()  # still riding the lag
+    prov.pump(6.0)
+    nodes = api.list_nodes()
+    assert [n.name for n in nodes] == [name]
+    assert nodes[0].metadata.labels[PROVIDER_SKU_LABEL] == "lab"
+    assert prov.ready_nodes() == {name: "lab"}
+    assert prov.provision_lags() == [6.0]
+
+
+def test_quota_per_sku_and_account_wide():
+    capped = InstanceSKU(name="cap", cpu=8, mem_gi=32, hourly_cost=1.0, quota=1, provision_jitter_s=0.0)
+    free = InstanceSKU(name="free", cpu=8, mem_gi=32, hourly_cost=1.0, provision_jitter_s=0.0)
+    prov = _provider(catalog=(capped, free), total_quota=2)
+    prov.request("cap", 0.0)
+    with pytest.raises(QuotaExceeded):
+        prov.request("cap", 0.0)  # per-SKU quota
+    assert prov.quota_left()["cap"] == 0
+    prov.request("free", 0.0)
+    with pytest.raises(QuotaExceeded):
+        prov.request("free", 0.0)  # account-wide quota
+    assert prov.quota_errors == 2 and prov.quota_left()["free"] == 0
+
+
+def test_stockout_surfaces_as_live_error():
+    dry = InstanceSKU(name="dry", cpu=8, mem_gi=32, hourly_cost=1.0, stockout_rate=1.0)
+    prov = _provider(catalog=(dry,))
+    with pytest.raises(Stockout):
+        prov.request("dry", 0.0)
+    assert prov.stockout_errors == 1 and not prov.records
+
+
+def test_reclaim_cordons_then_kills_after_grace_without_orphans():
+    api = FakeApiServer()
+    spot = InstanceSKU(name="s16", cpu=16, mem_gi=64, hourly_cost=1.0, provision_s=1.0, provision_jitter_s=0.0, spot=True)
+    prov = _provider(api, catalog=(spot,), reclaim_rate=1e9, reclaim_grace_s=5.0)
+    name = prov.request("s16", 0.0)
+    prov.pump(1.0)
+    api.create_pod(make_pod("victim", node_name=name, cpu="1", memory="1Gi", phase="Running"))
+    # reclaim_at ≈ ready_at under the huge rate: the next pump is the NOTICE.
+    prov.pump(1.1)
+    rec = prov.records[0]
+    assert rec["state"] == "reclaiming" and rec["kill_at"] == pytest.approx(6.0)
+    assert api.list_nodes()[0].spec.unschedulable  # the cordon
+    assert api.list_pods("spec.nodeName=" + name)  # grace: pod still bound
+    prov.pump(5.9)
+    assert rec["state"] == "reclaiming"  # deadline not yet due
+    out = prov.pump(6.0)
+    assert out["reclaim_kills"] == 1 and rec["state"] == "deleted"
+    assert not api.list_nodes()
+    pods = api.list_pods()
+    assert len(pods) == 1 and pods[0].spec.node_name is None  # bounced, not lost
+    assert prov.reclaim_unbound == ["default/victim"]
+
+
+def test_delete_refuses_nonempty_node():
+    api = FakeApiServer()
+    sku = InstanceSKU(name="lab", cpu=8, mem_gi=32, hourly_cost=1.0, provision_s=1.0, provision_jitter_s=0.0)
+    prov = _provider(api, catalog=(sku,))
+    name = prov.request("lab", 0.0)
+    prov.pump(1.0)
+    api.create_pod(make_pod("tenant", node_name=name, cpu="1", memory="1Gi", phase="Running"))
+    assert prov.delete(name, 2.0) is False
+    assert [n.name for n in api.list_nodes()] == [name]
+    api.delete_pod("default", "tenant")
+    assert prov.delete(name, 3.0) is True and not api.list_nodes()
+
+
+def test_cost_node_hours_integrates_joined_time():
+    api = FakeApiServer()
+    sku = InstanceSKU(name="lab", cpu=8, mem_gi=32, hourly_cost=3.6, provision_s=0.0, provision_jitter_s=0.0)
+    prov = _provider(api, catalog=(sku,))
+    name = prov.request("lab", 0.0)
+    prov.pump(0.0)
+    prov.delete(name, 1800.0)  # half an hour joined
+    assert prov.cost_node_hours(7200.0) == pytest.approx(1.8)  # 3.6/h x 0.5h, deletion stops the meter
+
+
+# -- catalog policy -----------------------------------------------------------
+
+
+def test_pack_catalog_picks_cheapest_per_request_served():
+    # small: 2 requests/node at 2.4 => 1.2 each; big: 4 requests/node at
+    # 4.0 => 1.0 each — FFD must buy the big SKU despite its higher sticker.
+    small = InstanceSKU(name="small", cpu=8, mem_gi=32, hourly_cost=2.4)
+    big = InstanceSKU(name="big", cpu=16, mem_gi=64, hourly_cost=4.0)
+    overflow = [(4000, 8 << 30)] * 4
+    plan, unplaceable = pack_catalog(overflow, (small, big))
+    assert plan == {"big": 1} and unplaceable == 0
+
+
+def test_pack_catalog_respects_quota_and_reports_unplaceable():
+    small = InstanceSKU(name="small", cpu=8, mem_gi=32, hourly_cost=2.4)
+    plan, unplaceable = pack_catalog([(4000, 8 << 30)] * 4, (small,), quota_left={"small": 1})
+    assert plan == {"small": 1} and unplaceable == 2  # one node takes 2, quota stops the rest
+    plan, unplaceable = pack_catalog([(64_000, 8 << 30)], (small,))
+    assert plan == {} and unplaceable == 1  # wider than every SKU
+
+
+def test_load_catalog_round_trips_json(tmp_path):
+    path = tmp_path / "catalog.json"
+    path.write_text(
+        json.dumps(
+            [{"name": "x-8", "cpu": 8, "mem_gi": 32, "hourly_cost": 1.5, "quota": 3, "spot": True}]
+        )
+    )
+    (sku,) = load_catalog(str(path))
+    assert sku == InstanceSKU(name="x-8", cpu=8, mem_gi=32, hourly_cost=1.5, quota=3, spot=True)
+
+
+def test_whatif_catalog_extension_prices_the_plan():
+    from tpu_scheduler.rebalance.whatif import autoscaler_whatif
+
+    api = FakeApiServer()
+    api.create_node(make_node("n0", cpu="2", memory="4Gi"))
+    snap = ClusterSnapshot.build(api.list_nodes(), [])
+    pending = [make_pod(f"p{i}", cpu="4", memory="8Gi") for i in range(4)]
+    out = autoscaler_whatif(snap, pending, catalog=DEFAULT_CATALOG)
+    assert out["sku_plan"] and out["nodes_needed"] == sum(out["sku_plan"].values())
+    assert out["plan_cost_per_hour"] > 0 and out["plan_unplaceable"] == 0
+
+
+# -- the controller loop ------------------------------------------------------
+
+
+def _saturated_world():
+    """A full 1-core node + a pending pod no fleet node can take."""
+    api = FakeApiServer()
+    api.create_node(make_node("tiny", cpu="1", memory="1Gi"))
+    api.create_pod(make_pod("filler", node_name="tiny", cpu="1", memory="1Gi", phase="Running"))
+    snap = ClusterSnapshot.build(api.list_nodes(), api.list_pods())
+    pending = [make_pod("wide", cpu="4", memory="8Gi")]
+    return api, snap, pending
+
+
+def test_scale_up_then_cooldown_then_inflight_skips():
+    api, snap, pending = _saturated_world()
+    auto = Autoscaler(AutoscaleConfig(every=1, cooldown=1), _provider(api))
+    assert auto.tick(snap, pending, burn=1.0, now=0.0) >= 1
+    assert auto.provider.pending_provisions() >= 1 and auto.scale_ups
+    auto.tick(snap, pending, burn=1.0, now=1.0)
+    assert auto.skips.get("cooldown") == 1
+    auto.tick(snap, pending, burn=1.0, now=2.0)
+    assert auto.skips.get("inflight") == 1  # provisions still riding the lag
+
+
+def test_no_scale_up_below_burn_trigger():
+    api, snap, pending = _saturated_world()
+    auto = Autoscaler(AutoscaleConfig(every=1, burn_trigger=0.5), _provider(api))
+    auto.tick(snap, pending, burn=0.0, now=0.0)
+    assert not auto.scale_ups and not auto.provider.records
+
+
+def test_breaker_open_throttles_the_tick():
+    api, snap, pending = _saturated_world()
+    auto = Autoscaler(AutoscaleConfig(every=1), _provider(api))
+    auto.tick(snap, pending, burn=1.0, breaker_mode="open", now=0.0)
+    assert auto.skips == {"breaker-open": 1} and not auto.provider.records
+    assert set(auto.skips) <= set(SKIP_REASONS)
+
+
+def test_scale_down_reserve_counts_rebalancer_drained_nodes():
+    api = FakeApiServer()
+    sku = InstanceSKU(name="lab", cpu=8, mem_gi=32, hourly_cost=1.0, provision_s=0.0, provision_jitter_s=0.0)
+    prov = _provider(api, catalog=(sku,))
+    for t in (0.0, 0.1):
+        prov.request("lab", t)
+    prov.pump(1.0)
+    snap = ClusterSnapshot.build(api.list_nodes(), [])
+    auto = Autoscaler(AutoscaleConfig(every=1, reserve=2), prov)
+    # Two empties, reserve 2, nothing parked by the rebalancer: hold.
+    auto.tick(snap, [], burn=0.0, drained_labeled=0, now=2.0)
+    assert auto.skips.get("reserve") == 1 and len(prov.ready_nodes()) == 2
+    # One rebalancer-drained node fills half the reserve: sell exactly one.
+    auto.tick(snap, [], burn=0.0, drained_labeled=1, now=3.0)
+    assert sum(auto.scale_downs.values()) == 1 and len(prov.ready_nodes()) == 1
+
+
+def test_scale_down_drains_loaded_node_through_unbind_with_zero_orphans():
+    api = FakeApiServer()
+    api.create_node(make_node("static-big", cpu="32", memory="128Gi"))
+    sku = InstanceSKU(name="lab", cpu=8, mem_gi=32, hourly_cost=1.0, provision_s=0.0, provision_jitter_s=0.0)
+    prov = _provider(api, catalog=(sku,))
+    name = prov.request("lab", 0.0)
+    prov.pump(0.0)
+    for i in range(2):
+        api.create_pod(make_pod(f"tenant{i}", node_name=name, cpu="1", memory="1Gi", phase="Running"))
+    snap = ClusterSnapshot.build(api.list_nodes(), api.list_pods())
+
+    def unbind(pod_full, node):
+        ns, _, pod = pod_full.rpartition("/")
+        api.unbind_pod(ns or "default", pod, expect_node=node)
+        return True
+
+    auto = Autoscaler(AutoscaleConfig(every=1, reserve=0, drain_max_pods=4), prov)
+    assert auto.tick(snap, [], burn=0.0, drained_labeled=0, unbind=unbind, now=1.0) == 1
+    assert sum(auto.scale_downs.values()) == 1 and len(auto.drain_unbound) == 2
+    assert name not in {n.name for n in api.list_nodes()}
+    # Every tenant survived the drain as a fresh Pending pod — zero orphans.
+    assert sorted(p.metadata.name for p in api.list_pods()) == ["tenant0", "tenant1"]
+    assert all(p.spec.node_name is None for p in api.list_pods())
+
+
+def test_scale_down_refuses_undrainable_node():
+    api = FakeApiServer()  # no receiver capacity anywhere
+    sku = InstanceSKU(name="lab", cpu=8, mem_gi=32, hourly_cost=1.0, provision_s=0.0, provision_jitter_s=0.0)
+    prov = _provider(api, catalog=(sku,))
+    name = prov.request("lab", 0.0)
+    prov.pump(0.0)
+    api.create_pod(make_pod("tenant", node_name=name, cpu="1", memory="1Gi", phase="Running"))
+    snap = ClusterSnapshot.build(api.list_nodes(), api.list_pods())
+    auto = Autoscaler(AutoscaleConfig(every=1, reserve=0), prov)
+    auto.tick(snap, [], burn=0.0, drained_labeled=0, unbind=lambda *a: True, now=1.0)
+    assert auto.skips.get("not-empty") == 1 and not auto.scale_downs
+    assert api.list_pods("spec.nodeName=" + name)  # nothing was touched
+
+
+def test_scheduler_wires_autoscale_phase_and_metrics():
+    api, _, _ = _saturated_world()
+    api.create_pod(make_pod("wide", cpu="4", memory="8Gi"))
+    sched = Scheduler(
+        api, NativeBackend(), clock=FakeClock(), requeue_seconds=0.0,
+        autoscale=AutoscaleConfig(every=1), autoscale_provider=_provider(api),
+    )
+    m = sched.run_cycle()
+    assert m.autoscale_seconds >= 0.0  # the phase exists on CycleMetrics
+    assert sched.autoscaler.stats()["ticks"] == 1
+    counters = sched.metrics.snapshot()
+    assert any(k.startswith("scheduler_autoscale_skips_total") for k in counters)
+    gauges = sched.metrics._snapshot_full()["gauges"]
+    assert "scheduler_autoscale_pending_provisions" in gauges
+
+
+def test_debug_autoscale_route_and_snapshot():
+    api, _, _ = _saturated_world()
+    sched = Scheduler(
+        api, NativeBackend(), clock=FakeClock(), requeue_seconds=0.0,
+        autoscale=AutoscaleConfig(every=1), autoscale_provider=_provider(api),
+    )
+    sched.run_cycle()
+    snap = sched.autoscale_snapshot()
+    assert snap["enabled"] and snap["ticks"] >= 1
+    assert snap["provider"]["requested"] == 0 and snap["catalog"]
+    from tpu_scheduler.runtime.http_api import HttpApiServer
+
+    srv = HttpApiServer(api, autoscale=sched.autoscale_snapshot).start()
+    try:
+        with urllib.request.urlopen(f"{srv.base_url}/debug/autoscale") as r:
+            body = json.loads(r.read())
+        assert body["enabled"] and body["ticks"] == snap["ticks"]
+        bare = HttpApiServer(api).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{bare.base_url}/debug/autoscale")
+            assert e.value.code == 404
+        finally:
+            bare.stop()
+    finally:
+        srv.stop()
+
+
+def test_sharded_only_shard0_owner_autoscales_and_takeover_inherits_provider():
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)  # leases expire on the same clock
+    api.create_node(make_node("tiny", cpu="1", memory="1Gi"))
+    provider = _provider(api)
+    scheds = [
+        Scheduler(
+            api, NativeBackend(), clock=clock, requeue_seconds=0.0,
+            shards=2, identity=f"r{i}", lease_duration=10.0,
+            autoscale=AutoscaleConfig(every=1), autoscale_provider=provider,
+        )
+        for i in range(2)
+    ]
+    for _ in range(4):
+        for sched in scheds:
+            sched.run_cycle()
+    owner = next(s for s in scheds if 0 in s.shard_set.owned)
+    standby = next(s for s in scheds if s is not owner)
+    assert owner.autoscaler.stats()["ticks"] >= 1
+    # Once leases settle, ONE decision stream: more cycles advance only the
+    # shard-0 owner's autoscaler.
+    before = standby.autoscaler.stats()["ticks"]
+    owner_before = owner.autoscaler.stats()["ticks"]
+    for _ in range(3):
+        for sched in scheds:
+            sched.run_cycle()
+    assert standby.autoscaler.stats()["ticks"] == before
+    assert owner.autoscaler.stats()["ticks"] > owner_before
+    # Owner dies (never cycles again, leases never released); past 2x the
+    # lease the survivor absorbs shard 0 and the SAME provider ledger.
+    clock.t += 25.0
+    for _ in range(6):
+        standby.run_cycle()
+    assert 0 in standby.shard_set.owned
+    assert standby.autoscaler.stats()["ticks"] >= 1
+    assert standby.autoscaler.provider is provider
+
+
+# -- the elasticity scenario family ------------------------------------------
+
+ELASTICITY_SCENARIOS = (
+    "diurnal-traffic",
+    "flash-crowd-provisioning-lag",
+    "spot-reclaim-storm",
+    "quota-capped-surge",
+)
+
+
+@pytest.mark.parametrize("name", ELASTICITY_SCENARIOS)
+@pytest.mark.parametrize("seed", (0, 1))
+def test_elasticity_scenarios_pass_and_static_baselines_fail(name, seed):
+    from tpu_scheduler.sim.harness import run_scenario
+
+    card = run_scenario(name, seed=seed)
+    e = card["elasticity"]
+    assert card["pass"] and e["ok"], json.dumps(e)
+    assert e["joint_objective"] <= e["objective_gate"]
+    assert sum(e["scale_ups"].values()) > 0  # the autoscaler did real work
+    assert e["reclaim_orphans"] == 0
+    assert card["pods"]["double_bound"] == 0 and card["pods"]["lost"] == 0
+    if name == "spot-reclaim-storm":
+        assert e["reclaims"] > 0  # the storm actually happened
+        assert set(e["scale_ups"]) == {"spot-16"}  # bought from the spot pool only
+    if name == "quota-capped-surge":
+        assert e["quota_errors"] > 0  # live provider refusals surfaced
+        assert sum(e["scale_ups"].values()) <= 2  # never past the account cap
+    if name == "diurnal-traffic":
+        assert sum(e["scale_downs"].values()) > 0  # sold in the trough
+
+    off = run_scenario(name, seed=seed, autoscale=False)
+    eo = off["elasticity"]
+    assert not off["pass"] and not eo["ok"]
+    assert eo["joint_objective"] > eo["objective_gate"]  # fails on merit
+    assert not eo["scale_ups"] and eo["cost_node_hours"] == 0.0
+
+
+@pytest.mark.parametrize("name", ELASTICITY_SCENARIOS)
+@pytest.mark.parametrize("seed", (0, 1))
+def test_elasticity_record_replay_bit_identical(name, seed, tmp_path):
+    from tpu_scheduler.sim.harness import run_scenario
+
+    p = str(tmp_path / f"{name}-{seed}.jsonl")
+    live = run_scenario(name, seed=seed, record=p)
+    replayed = run_scenario(name, seed=seed, replay=p)  # raises on mismatch
+    assert replayed["fingerprint"] == live["fingerprint"]
+    assert {**replayed, "mode": "live"} == live

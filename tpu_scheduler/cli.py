@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import random
 import sys
 
 from .backends.native import NativeBackend
@@ -167,6 +168,18 @@ def build_parser() -> argparse.ArgumentParser:
         default=8,
         metavar="N",
         help="max migrations issued per rebalancer tick (whole-node drain groups; with --rebalance)",
+    )
+    p.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="enable the closed-loop autoscaler (tpu_scheduler/autoscale) against the simulated cloud provider: "
+        "cost-aware SKU packing on SLO burn, scale-down through the drain protocol (synthetic cluster only)",
+    )
+    p.add_argument(
+        "--catalog-file",
+        default=None,
+        metavar="PATH",
+        help="JSON SKU catalog for --autoscale (name/cpu/mem_gi/hourly_cost/quota/provision_s/...); default: built-in catalog",
     )
     p.add_argument("--log-level", default="INFO")
     p.add_argument(
@@ -336,6 +349,25 @@ def main(argv: list[str] | None = None) -> int:
         # Daemon mode runs the packing solve on a worker thread so the
         # background tier stays off the cycle critical path.
         rebalance_cfg = RebalanceConfig(every=args.rebalance_every, batch=args.rebalance_batch, background=True)
+    autoscale_cfg = None
+    autoscale_provider = None
+    if args.autoscale:
+        if args.api_server or args.kubeconfig is not None:
+            # The simulated provider joins nodes through the in-process
+            # apiserver; a remote cluster owns its own node lifecycle.
+            print(json.dumps({"autoscale": False, "reason": "remote cluster"}), file=sys.stderr)
+        else:
+            import time as _time
+
+            from .autoscale import DEFAULT_CATALOG, AutoscaleConfig, SimCloudProvider, load_catalog
+
+            catalog = load_catalog(args.catalog_file) if args.catalog_file else DEFAULT_CATALOG
+            autoscale_provider = SimCloudProvider(
+                api, clock=_time.monotonic, rng=random.Random(args.seed), catalog=catalog
+            )
+            # Daemon mode plans the catalog what-if on a worker thread so
+            # the elastic tier stays off the cycle critical path.
+            autoscale_cfg = AutoscaleConfig(background=True)
     sched = Scheduler(
         api,
         backend,
@@ -356,6 +388,8 @@ def main(argv: list[str] | None = None) -> int:
         flush_capacity=args.flush_capacity,
         delta=not args.no_delta,
         rebalance=rebalance_cfg,
+        autoscale=autoscale_cfg,
+        autoscale_provider=autoscale_provider,
     )
     if args.profile_dir:
         # Link the device trace from /debug/trace's Chrome-trace JSON so the
@@ -396,6 +430,7 @@ def main(argv: list[str] | None = None) -> int:
             profile=profile_registry.snapshot,
             pending_ages=sched.pending_age_debug,
             rebalance=sched.rebalance_snapshot if sched.rebalancer is not None else None,
+            autoscale=sched.autoscale_snapshot if sched.autoscaler is not None else None,
             latency=latency_registry.snapshot,
             port=args.http_port,
         ).start()

@@ -23,12 +23,19 @@ from .solver import solve_packing
 __all__ = ["autoscaler_whatif"]
 
 
-# shape: (snapshot: obj, pending: obj, drained_labeled: int, topo: obj) -> dict
-def autoscaler_whatif(snapshot, pending, drained_labeled: int = 0, topo=None) -> dict:
+# shape: (snapshot: obj, pending: obj, drained_labeled: int, topo: obj,
+#   catalog: obj, quota_left: obj) -> dict
+def autoscaler_whatif(snapshot, pending, drained_labeled: int = 0, topo=None, catalog=None, quota_left=None) -> dict:
     """The what-if block: ``nodes_needed`` (node-add recommendation for the
     current backlog), ``nodes_removable`` (scale-down headroom), and the
     backlog accounting behind them.  ``pending`` is the pending Pod list;
-    ``drained_labeled`` counts already-drained (cordoned, empty) nodes."""
+    ``drained_labeled`` counts already-drained (cordoned, empty) nodes.
+
+    With a heterogeneous ``catalog`` (InstanceSKU tuple, optionally bounded
+    by ``quota_left``), the overflow additionally packs by cost-aware FFD
+    over the catalog: ``sku_plan`` ({sku: count} — WHICH shapes to buy),
+    ``plan_cost_per_hour``, and ``nodes_needed`` becomes the plan's node
+    total so the autoscale policy never re-derives shape choice."""
     from ..api.objects import total_pod_resources
 
     rs = RebalanceSnapshot.build(snapshot)
@@ -72,7 +79,7 @@ def autoscaler_whatif(snapshot, pending, drained_labeled: int = 0, topo=None) ->
                 room[0] -= cpu
                 room[1] -= mem
     plan = solve_packing(rs, topo)
-    return {
+    out = {
         "pending_pods": len(reqs),
         "pending_unplaceable": len(overflow),
         "nodes_needed": nodes_needed,
@@ -80,3 +87,17 @@ def autoscaler_whatif(snapshot, pending, drained_labeled: int = 0, topo=None) ->
         "drained_now": int(drained_labeled),
         "drainable_projected": len(plan.drained),
     }
+    if catalog is not None:
+        from ..autoscale.policy import pack_catalog
+
+        sku_plan, unplaceable = pack_catalog(overflow, catalog, quota_left)
+        by_name = {s.name: s for s in catalog}
+        out["sku_plan"] = sku_plan
+        out["plan_cost_per_hour"] = round(
+            sum(by_name[sku].hourly_cost * n for sku, n in sku_plan.items()), 9
+        )
+        # Overflow the catalog cannot serve (quota-capped or oversized) —
+        # the fleet-fit overflow itself stays in pending_unplaceable.
+        out["plan_unplaceable"] = unplaceable
+        out["nodes_needed"] = sum(sku_plan.values())
+    return out

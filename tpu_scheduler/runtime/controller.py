@@ -185,6 +185,8 @@ class Scheduler:
         delta: bool = True,
         delta_shadow_every: int = 0,
         rebalance=None,
+        autoscale=None,
+        autoscale_provider=None,
     ):
         if policy not in ("batch", "sample"):
             raise ValueError(f"unknown policy {policy!r} (expected 'batch' or 'sample')")
@@ -405,6 +407,18 @@ class Scheduler:
 
             cfg = rebalance if isinstance(rebalance, RebalanceConfig) else RebalanceConfig()
             self.rebalancer = Rebalancer(cfg, metrics=self.metrics)
+        # Closed-loop autoscaler (tpu_scheduler/autoscale): the elastic-
+        # capacity tier — a cadence-gated tick AFTER the rebalancer's that
+        # buys SKUs against the pending backlog (cost-aware catalog FFD,
+        # SLO-burn driven) and retires empty elastic nodes through the
+        # drain protocol.  Needs a provider (SimCloudProvider in the sim);
+        # batch-policy only, same reasoning as the rebalancer.
+        self.autoscaler = None
+        if autoscale is not None and autoscale is not False and autoscale_provider is not None and policy == "batch":
+            from ..autoscale import Autoscaler, AutoscaleConfig
+
+            acfg = autoscale if isinstance(autoscale, AutoscaleConfig) else AutoscaleConfig()
+            self.autoscaler = Autoscaler(acfg, autoscale_provider, metrics=self.metrics)
         # Sim-only shadow parity sampling: every Nth delta cycle also runs
         # the full-wave solve and asserts both placed the same pod set.
         self.delta_shadow_every = int(delta_shadow_every)
@@ -2483,6 +2497,12 @@ class Scheduler:
                     # never competes with the fast path for the cycle.
                     with span("rebalance"):
                         self._rebalance_tick(snapshot, pending_all)
+                if self.autoscaler is not None:
+                    # Elastic-capacity tier (tpu_scheduler/autoscale):
+                    # AFTER the rebalancer so its drains are visible to the
+                    # reserve hysteresis before any capacity decision.
+                    with span("autoscale"):
+                        self._autoscale_tick(snapshot, pending_all)
 
         self._cycle_count += 1
         wall = time.perf_counter() - t0
@@ -3189,6 +3209,72 @@ class Scheduler:
         }
         return out
 
+    def _autoscale_tick(self, snapshot: ClusterSnapshot, pending_all: list[Pod]) -> None:
+        """Assemble one tick's inputs and hand off to the Autoscaler.  In
+        sharded mode only the shard-0 owner autoscales (one cluster-wide
+        provider ledger; a takeover of shard 0 IS the autoscaler failover —
+        the shared provider's in-flight provisions ride along)."""
+        if self.sharded and 0 not in self.shard_set.owned:
+            return
+        now = self.clock()
+        burn = 0.0
+        for _pf, (since, tier, _g) in self._pending_meta.items():
+            target = tier_target(tier)
+            if target > 0:
+                burn = max(burn, (now - since) / target)
+        from ..rebalance import REBALANCE_CORDON_LABEL
+
+        drained_labeled = sum(
+            1 for n in snapshot.nodes if (n.metadata.labels or {}).get(REBALANCE_CORDON_LABEL)
+        )
+        # Same residual-backlog stance as the rebalancer: demand is what
+        # this very cycle's solve left unplaced, not the pre-cycle list.
+        placed_names = {full_name(p) for p, _n in self._cycle_placed}
+        backlog = [p for p in pending_all if full_name(p) not in placed_names]
+        self.autoscaler.tick(
+            snapshot,
+            backlog,
+            topo=self._compiled_topology(snapshot),
+            burn=burn,
+            breaker_mode=self.breaker.mode(),
+            drained_labeled=drained_labeled,
+            unbind=self._unbind,
+            now=now,
+        )
+
+    def autoscale_snapshot(self) -> dict:
+        """The /debug/autoscale payload (GIL-atomic copies — the
+        resilience_snapshot stance): lifetime stats + last decision + skip
+        taxonomy from the Autoscaler, the provider's catalog and in-flight
+        provision/reclaim census, and the effective config."""
+        if self.autoscaler is None:
+            return {"enabled": False}
+        out = self.autoscaler.stats()
+        provider = self.autoscaler.provider
+        out["provider"] = provider.stats()
+        out["catalog"] = [
+            {
+                "name": s.name,
+                "cpu": s.cpu,
+                "mem_gi": s.mem_gi,
+                "hourly_cost": s.hourly_cost,
+                "quota": s.quota,
+                "provision_s": s.provision_s,
+                "spot": s.spot,
+            }
+            for s in provider.catalog
+        ]
+        cfg = self.autoscaler.config
+        out["config"] = {
+            "every": cfg.every,
+            "burn_trigger": cfg.burn_trigger,
+            "max_per_tick": cfg.max_per_tick,
+            "cooldown": cfg.cooldown,
+            "reserve": cfg.reserve,
+            "background": cfg.background,
+        }
+        return out
+
     def pending_age_debug(self, pod_full: str) -> dict | None:
         """The /debug/pods why-pending ``age`` block: how long this pod has
         been in the queue and which SLO tier it burns against.  Called from
@@ -3291,6 +3377,8 @@ class Scheduler:
         self._join_binds()
         if self.rebalancer is not None:
             self.rebalancer.close()  # stop the background solve worker
+        if self.autoscaler is not None:
+            self.autoscaler.close()  # stop the background plan worker
         if self._renew_stop is not None:
             # Stop AND JOIN the renewal thread BEFORE releasing: a renew
             # already past its stop-check would otherwise re-acquire the

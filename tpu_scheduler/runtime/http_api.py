@@ -64,6 +64,7 @@ class HttpApiServer:
         profile=None,
         pending_ages=None,
         rebalance=None,
+        autoscale=None,
         latency=None,
         host: str = "127.0.0.1",
         port: int = 0,
@@ -90,6 +91,10 @@ class HttpApiServer:
         # controller's rebalance_snapshot: background-tier stats, drained
         # node census, throttle config).
         self.rebalance = rebalance
+        # () -> dict producing the /debug/autoscale payload (the
+        # controller's autoscale_snapshot: scale-up/down counters, skip
+        # taxonomy, provider ledger, catalog, throttle config).
+        self.autoscale = autoscale
         # (replica: str | None) -> dict producing the /debug/latency payload
         # — a ReplicaLatencyRegistry.snapshot (utils/profiler.py) in
         # multi-replica mode, or the one scheduler's latency_snapshot
@@ -278,6 +283,15 @@ class HttpApiServer:
                             self._send_json(404, {"message": "rebalancer state not attached"})
                         else:
                             self._send_json(200, outer.rebalance())
+                    elif parsed.path == "/debug/autoscale":
+                        # Closed-loop autoscaler (tpu_scheduler/autoscale):
+                        # scale decisions, skip taxonomy, provider ledger
+                        # (pending provisions, reclaims, cost), catalog —
+                        # controller state, served sans flight recorder.
+                        if outer.autoscale is None:
+                            self._send_json(404, {"message": "autoscaler state not attached"})
+                        else:
+                            self._send_json(200, outer.autoscale())
                     elif parsed.path == "/debug/resilience":
                         # Backoff queue + circuit breaker + deferred-bind
                         # buffer — served even with the flight recorder

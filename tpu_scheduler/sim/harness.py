@@ -40,7 +40,7 @@ from .chaos import ChaosApiServer
 from .clock import VirtualClock
 from .multi import MultiReplicaHarness
 from .scenarios import SCENARIOS, Scenario
-from .scorecard import _percentile, build_latency_block, build_scorecard, check_invariants, fingerprint
+from .scorecard import ELASTICITY_FIELDS, _percentile, build_latency_block, build_scorecard, check_invariants, fingerprint
 from .trace import TraceWriter, load_trace
 from .workload import generate_events, initial_nodes
 
@@ -426,6 +426,92 @@ def _locality_block(sc: Scenario, st: "_SimState") -> dict:
     return out
 
 
+def _elasticity_block(
+    sc: Scenario,
+    fleet: MultiReplicaHarness,
+    pending_final,
+    lost_names,
+    end_t: float,
+    st: "_SimState",
+    enabled: bool,
+) -> dict:
+    """The scorecard ``elasticity`` verdict (tpu_scheduler/autoscale).
+
+    The joint objective is computed from the SAME surface whether the
+    autoscaler ran or not: effective p99 time-to-bind — every bound pod's
+    TTB plus every still-pending pod charged its unmet age at episode end —
+    plus ``autoscale_cost_weight`` × the provider's elastic node-hour cost
+    integral (zero for the static fleet).  So the ``autoscale=False``
+    baseline gets the identical verdict surface and must fail the same
+    gate on merit: it pays no cost but its unserved backlog's effective
+    p99 blows the objective.  Reclaim-orphan evidence: any pod the
+    provider force-unbound at a reclaim deadline (or the autoscaler
+    unbound while draining a scale-down candidate) that ends the run
+    neither bound nor legitimately gone — REQUIRED zero whenever the
+    block gates at all."""
+    autos = [r.autoscaler for r in fleet.scheds if r.autoscaler is not None]
+    provider = fleet.provider
+    out = {
+        "enabled": bool(enabled and provider is not None),
+        "required": bool(sc.autoscale_required),
+        "scale_ups": {},
+        "scale_downs": {},
+        "skus": {},
+        "pending_provisions": 0,
+        "provision_lag_p99_s": 0.0,
+        "reclaims": 0,
+        "reclaim_orphans": 0,
+        "quota_errors": 0,
+        "stockout_errors": 0,
+        "skips": {},
+        "cost_node_hours": 0.0,
+        "joint_objective": 0.0,
+        "objective_gate": round(float(sc.autoscale_objective_gate), 6),
+        "ok": True,
+    }
+    ups: dict[str, int] = {}
+    downs: dict[str, int] = {}
+    skips: dict[str, int] = {}
+    unbound_short: set[str] = set()
+    for auto in autos:
+        s = auto.stats()
+        for k, v in s["scale_ups"].items():
+            ups[k] = ups.get(k, 0) + v
+        for k, v in s["scale_downs"].items():
+            downs[k] = downs.get(k, 0) + v
+        for k, v in s["skips"].items():
+            skips[k] = skips.get(k, 0) + v
+        unbound_short.update(pf.rpartition("/")[2] for pf in auto.drain_unbound)
+    out["scale_ups"] = dict(sorted(ups.items()))
+    out["scale_downs"] = dict(sorted(downs.items()))
+    out["skips"] = dict(sorted(skips.items()))
+    if provider is not None:
+        pstats = provider.stats()
+        out["skus"] = pstats["skus"]
+        out["pending_provisions"] = pstats["pending_provisions"]
+        out["reclaims"] = pstats["reclaim_notices"]
+        out["quota_errors"] = pstats["quota_errors"]
+        out["stockout_errors"] = pstats["stockout_errors"]
+        lags = sorted(provider.provision_lags())
+        out["provision_lag_p99_s"] = round(_percentile(lags, 0.99), 6)
+        out["cost_node_hours"] = round(provider.cost_node_hours(end_t), 6)
+        unbound_short.update(pf.rpartition("/")[2] for pf in provider.reclaim_unbound)
+    pending_names = {p.metadata.name for p in pending_final}
+    out["reclaim_orphans"] = len(unbound_short & (pending_names | set(lost_names)))
+    # Effective p99 TTB: the SLO term no fleet can game by refusing to
+    # bind — unserved demand is charged its full unmet age.
+    eff = sorted(
+        st.ttb
+        + [end_t - st.arrival_t[p.metadata.name] for p in pending_final if p.metadata.name in st.arrival_t]
+    )
+    joint = _percentile(eff, 0.99) + float(sc.autoscale_cost_weight) * out["cost_node_hours"]
+    out["joint_objective"] = round(joint, 6)
+    gate = out["objective_gate"]
+    out["ok"] = bool((gate <= 0 or out["joint_objective"] <= gate) and out["reclaim_orphans"] == 0)
+    assert tuple(out) == ELASTICITY_FIELDS, "elasticity block drifted from ELASTICITY_FIELDS"
+    return out
+
+
 def run_scenario(
     scenario: Scenario | str,
     seed: int = 0,
@@ -436,6 +522,7 @@ def run_scenario(
     topology="auto",
     profile_gates: dict | None = None,
     rebalance="auto",
+    autoscale="auto",
     profile=None,
 ) -> dict:
     """Run one scenario to its verdict; returns the scorecard dict.
@@ -454,6 +541,10 @@ def run_scenario(
     tier: "auto" (default) follows the scenario's ``rebalance`` knob,
     False forces the rebalancer-OFF baseline the fragmentation scorecard
     block quantifies against (and must FAIL the efficiency gate).
+    ``autoscale`` is the same switch for the elastic-capacity tier:
+    "auto" follows the scenario's ``autoscale`` knob, False forces the
+    static-fleet baseline the elasticity scorecard block quantifies
+    against (and must FAIL the joint cost+SLO objective gate).
     ``profile`` overrides the ``SchedulingProfile`` the fleet schedules
     with (None = the default, exactly as before — fingerprints hold); a
     scenario's ``preemption`` knob still applies on top."""
@@ -467,6 +558,7 @@ def run_scenario(
         topology=topology,
         profile_gates=profile_gates,
         rebalance=rebalance,
+        autoscale=autoscale,
         profile=profile,
     )
     # Drive the episode with no per-cycle actions — byte-identical to the
@@ -490,6 +582,7 @@ def scenario_episode(
     topology="auto",
     profile_gates: dict | None = None,
     rebalance="auto",
+    autoscale="auto",
     profile=None,
 ):
     """The discrete-event loop as a generator: yields an ``EpisodeContext``
@@ -523,8 +616,18 @@ def scenario_episode(
     # scheduler exactly as the single-replica path always did (same rng
     # label, no shard machinery), so pre-sharding fingerprints hold.
     rebalance_on = bool(getattr(sc, "rebalance", False)) and rebalance is not False
+    autoscale_on = bool(getattr(sc, "autoscale", False)) and autoscale is not False
     fleet = MultiReplicaHarness(
-        sc, seed, clock, chaos, backend, profile, events_buffer, topology, rebalance_on=rebalance_on
+        sc,
+        seed,
+        clock,
+        chaos,
+        backend,
+        profile,
+        events_buffer,
+        topology,
+        rebalance_on=rebalance_on,
+        autoscale_on=autoscale_on,
     )
 
     writer = TraceWriter(record) if record else None
@@ -717,12 +820,33 @@ def scenario_episode(
         # runs at cycle end), so draining them after the bind fold keeps
         # intra-cycle order: unbound pods re-enter pending and their next
         # bind re-adds them above.
+        restarts = _forced_restarts()
         for _t, pod_full in chaos.unbind_log[unbind_cursor:]:
             name = pod_full.rpartition("/")[2]
             st.bound_live.discard(name)
             st.counts["migrated"] += 1
+            # A spot reclaim or autoscale drain is a forced RESTART, not a
+            # scheduling decision: the TTB clock restarts at eviction, so
+            # the scorecard judges how fast the fleet re-places the pod —
+            # not the cloud's choice of when to take the node away.
+            # (Rebalancer migrations keep the original clock: the
+            # scheduler chose those.)
+            if pod_full in restarts and name in st.arrival_t:
+                st.arrival_t[name] = _t
+                st.disturbed_pods.add(name)
         unbind_cursor = len(chaos.unbind_log)
         return new_binds
+
+    def _forced_restarts() -> set[str]:
+        # shape: () -> set[str]  (full pod names force-unbound by the
+        # provider's reclaim kill path or an autoscaler scale-down drain)
+        out: set[str] = set()
+        if getattr(fleet, "provider", None) is not None:
+            out.update(fleet.provider.reclaim_unbound)
+        for sched in fleet.scheds:
+            if sched.autoscaler is not None:
+                out.update(sched.autoscaler.drain_unbound)
+        return out
 
     # -- the discrete-event loop --------------------------------------------
 
@@ -801,6 +925,13 @@ def scenario_episode(
         "lost_names": lost[:20],
         "double_bound": st.double_bound,
     }
+    if getattr(fleet, "provider", None) is not None:
+        # A reclaim notice is cluster churn: the run can end inside the
+        # notice→kill grace window with pods still bound on the cordoned
+        # node — the provider took it, the scheduler didn't misplace them.
+        for rec in fleet.provider.records:
+            if rec["state"] == "reclaiming":
+                st.disturbed_nodes.add(rec["name"])
     invariants = check_invariants(inner, st.scheduled_names, st.disturbed_pods, st.disturbed_nodes, st.gangs)
     placements = [
         (p.metadata.name, p.spec.node_name) for p in api_pods.values() if p.spec is not None and p.spec.node_name
@@ -872,6 +1003,7 @@ def scenario_episode(
             int(metrics_snapshot.get("scheduler_preemption_victims_total", 0))
             + int(metrics_snapshot.get("scheduler_noexecute_evictions_total", 0)),
         ),
+        elasticity=_elasticity_block(sc, fleet, pending_final, lost, end_t, st, autoscale_on),
         latency=_latency_block(sc, fleet, st),
         recorder_stats={
             "tracked_pods": sum(len(r.recorder.tracked_pods()) for r in fleet.scheds),

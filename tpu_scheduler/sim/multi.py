@@ -71,12 +71,35 @@ class MultiReplicaHarness:
         events_buffer: int,
         topology,
         rebalance_on: bool = False,
+        autoscale_on: bool = False,
     ):
         self.sc = sc
         self.clock = clock
         self.chaos = chaos
         self.replicas = max(1, int(sc.replicas))
         self.shards = int(sc.shards) if sc.shards > 0 else 2 * self.replicas
+        # ONE provider per cluster (the cloud account), shared by every
+        # replica: the shard-0 owner's autoscaler drives it, and a shard-0
+        # takeover inherits the in-flight provisions and reclaim deadlines
+        # because the ledger lives here, not in the dead replica.  Its rng
+        # label ("provider") is its own stream — scheduler/chaos/workload
+        # draw sequences are untouched, so old fingerprints hold.
+        self.provider = None
+        if autoscale_on:
+            from ..autoscale import DEFAULT_CATALOG, SimCloudProvider
+
+            catalog = tuple(
+                s for s in DEFAULT_CATALOG if not sc.autoscale_skus or s.name in sc.autoscale_skus
+            )
+            self.provider = SimCloudProvider(
+                chaos,
+                clock=clock,
+                rng=random.Random(f"{seed}:provider"),
+                catalog=catalog,
+                total_quota=int(sc.autoscale_quota),
+                reclaim_rate=float(sc.autoscale_reclaim_rate),
+                reclaim_grace_s=float(sc.autoscale_reclaim_grace_s),
+            )
         self.scheds: list[Scheduler] = []
         for i in range(self.replicas):
             kwargs = dict(
@@ -107,6 +130,24 @@ class MultiReplicaHarness:
                         batch=int(sc.rebalance_batch),
                         max_migrations=int(sc.rebalance_migration_budget),
                     )
+                )
+            if autoscale_on:
+                # Closed-loop autoscaler (tpu_scheduler/autoscale), INLINE
+                # plan mode for the same VirtualClock reason as above.
+                # Every replica gets an Autoscaler but only the shard-0
+                # owner ticks (runtime/controller.py gates), so the shared
+                # provider sees exactly one decision stream.
+                from ..autoscale import AutoscaleConfig
+
+                kwargs.update(
+                    autoscale=AutoscaleConfig(
+                        every=int(sc.autoscale_every),
+                        burn_trigger=float(sc.autoscale_burn_trigger),
+                        max_per_tick=int(sc.autoscale_max_per_tick),
+                        cooldown=int(sc.autoscale_cooldown),
+                        reserve=int(sc.autoscale_reserve),
+                    ),
+                    autoscale_provider=self.provider,
                 )
             if self.replicas > 1:
                 kwargs.update(shards=self.shards, identity=f"replica-{i}", lease_duration=sc.lease_duration)

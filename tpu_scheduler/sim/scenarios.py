@@ -89,6 +89,34 @@ class Scenario:
     # segment decomposition summing to its TTB within rounding (the
     # attribution-leak audit).
     latency_required: bool = False
+    # Closed-loop autoscaler (tpu_scheduler/autoscale): ``autoscale`` runs
+    # the elastic-capacity tier inline after the rebalancer's tick
+    # (``autoscale_every`` cycles between decisions) against a shared
+    # seeded SimCloudProvider; ``autoscale_required`` gates the scorecard
+    # pass on the ``elasticity`` block's ok — the joint cost+SLO objective
+    # (effective p99 TTB + ``autoscale_cost_weight`` × elastic node-hours)
+    # <= ``autoscale_objective_gate`` (0 disables the gate) AND zero
+    # reclaim orphans.  ``autoscale_skus`` restricts the DEFAULT_CATALOG
+    # by name (empty = full catalog); ``autoscale_quota`` caps the
+    # account-wide concurrent elastic node count (0 = unbounded);
+    # ``autoscale_reclaim_rate`` is the spot-reclaim hazard (reclaims per
+    # virtual second per spot node, 0 = never) with
+    # ``autoscale_reclaim_grace_s`` of notice; ``autoscale_burn_trigger``,
+    # ``autoscale_max_per_tick``, ``autoscale_reserve``, and
+    # ``autoscale_cooldown`` are the AutoscaleConfig knobs.
+    autoscale: bool = False
+    autoscale_every: int = 2
+    autoscale_required: bool = False
+    autoscale_burn_trigger: float = 0.02
+    autoscale_cost_weight: float = 0.0
+    autoscale_objective_gate: float = 0.0
+    autoscale_quota: int = 0
+    autoscale_reclaim_rate: float = 0.0
+    autoscale_reclaim_grace_s: float = 5.0
+    autoscale_max_per_tick: int = 8
+    autoscale_reserve: int = 1
+    autoscale_cooldown: int = 4
+    autoscale_skus: tuple[str, ...] = ()
 
 
 SCENARIOS: dict[str, Scenario] = {}
@@ -491,6 +519,99 @@ _register(
         rebalance_required=True,
         rebalance_whatif=True,
         drain_grace_cycles=10,
+    )
+)
+
+_register(
+    Scenario(
+        name="diurnal-traffic",
+        description="The autoscaling steady-state gate: a 4-node base fleet sized for the trough rides two full diurnal waves (rate 2/s ± 100%, 60 s period) of chunky pods — the closed loop must buy capacity into each crest and retire it in each trough, and the pass gates on the joint cost+SLO objective the static fleet cannot reach (elasticity block, autoscale=False must FAIL)",
+        duration=120.0,
+        workload=WorkloadSpec(
+            initial_nodes=4,
+            arrival_rate=2.0,
+            diurnal_period=60.0,
+            diurnal_amplitude=1.0,
+            pod_cpu_m=(1000, 2000, 4000),
+            pod_mem_mi=(1024, 2048, 4096),
+            lifetime_mean_s=12.0,
+        ),
+        autoscale=True,
+        autoscale_required=True,
+        autoscale_burn_trigger=0.01,
+        autoscale_cost_weight=10.0,
+        autoscale_objective_gate=30.0,
+        autoscale_cooldown=2,
+        drain_grace_cycles=20,
+    )
+)
+
+_register(
+    Scenario(
+        name="flash-crowd-provisioning-lag",
+        description="The provisioning-lag gate: a 4-node fleet takes a 90-pod flash crowd at t=8 — capacity bought at the crest lands only after the SKU's seeded provisioning latency, so the p99 time-to-bind is lag-exposed by construction; the pass gates on the joint cost+SLO objective (elasticity block, autoscale=False must FAIL)",
+        duration=60.0,
+        workload=WorkloadSpec(
+            initial_nodes=4,
+            arrival_rate=0.5,
+            bursts=((8.0, 90),),
+            pod_cpu_m=(1000, 2000),
+            pod_mem_mi=(1024, 2048),
+            lifetime_mean_s=25.0,
+        ),
+        autoscale=True,
+        autoscale_required=True,
+        autoscale_cost_weight=10.0,
+        autoscale_objective_gate=30.0,
+        drain_grace_cycles=25,
+    )
+)
+
+_register(
+    Scenario(
+        name="spot-reclaim-storm",
+        description="The reclaim-safety gate: the catalog is restricted to the cheap preemptible SKU and the provider reclaims spot nodes at hazard 0.02/s with 4 s of notice — every reclaimed node's pods must be force-unbound through the faultable unbind path and re-placed by the delta engine; the pass gates on ZERO reclaim orphans plus the joint objective (elasticity block, autoscale=False must FAIL)",
+        duration=90.0,
+        workload=WorkloadSpec(
+            initial_nodes=3,
+            arrival_rate=1.0,
+            bursts=((5.0, 80),),
+            pod_cpu_m=(1000, 2000),
+            pod_mem_mi=(1024, 2048),
+            lifetime_mean_s=20.0,
+        ),
+        autoscale=True,
+        autoscale_required=True,
+        autoscale_burn_trigger=0.01,
+        autoscale_cost_weight=10.0,
+        autoscale_objective_gate=35.0,
+        autoscale_reclaim_rate=0.02,
+        autoscale_reclaim_grace_s=4.0,
+        autoscale_cooldown=2,
+        autoscale_skus=("spot-16",),
+        drain_grace_cycles=25,
+    )
+)
+
+_register(
+    Scenario(
+        name="quota-capped-surge",
+        description="The quota-pressure gate: a 100-pod surge against an account-wide quota of TWO elastic nodes — the cost-aware plan buys to the cap, further asks are refused live (quota-exceeded provider errors + counted `quota` skips), and the two nodes it did win must still clear the joint objective a static fleet cannot (elasticity block, autoscale=False must FAIL)",
+        duration=60.0,
+        workload=WorkloadSpec(
+            initial_nodes=3,
+            arrival_rate=0.5,
+            bursts=((5.0, 100),),
+            pod_cpu_m=(1000, 2000),
+            pod_mem_mi=(1024, 2048),
+            lifetime_mean_s=20.0,
+        ),
+        autoscale=True,
+        autoscale_required=True,
+        autoscale_cost_weight=10.0,
+        autoscale_objective_gate=35.0,
+        autoscale_quota=2,
+        drain_grace_cycles=25,
     )
 )
 

@@ -31,6 +31,7 @@ __all__ = [
     "SCORECARD_FIELDS",
     "INCREMENTAL_FIELDS",
     "REBALANCE_FIELDS",
+    "ELASTICITY_FIELDS",
     "LATENCY_FIELDS",
     "check_invariants",
     "build_scorecard",
@@ -56,6 +57,7 @@ SCORECARD_FIELDS = (
     "profile",
     "incremental",
     "rebalance",
+    "elasticity",
     "policy",
     "latency",
     "flight_recorder",
@@ -111,6 +113,35 @@ REBALANCE_FIELDS = (
     "migration_budget",
     "preemption_churn",
     "whatif",
+    "ok",
+)
+
+
+# The closed schema of the ``elasticity`` block (drift-gated against the
+# README "Autoscaling & elasticity" catalogue by the ELAS analyze rule).
+# Strictly deterministic quantities: lifetime counts from the Autoscaler
+# and SimCloudProvider ledgers, virtual provisioning lag, the node-hour
+# cost integral of elastic capacity, and a joint cost+SLO objective whose
+# SLO term charges still-pending pods their unmet age — so the static
+# baseline fails the gate on merit.  The reclaim-orphan count (provider
+# reclaim unbinds ∪ scale-down drain unbinds that ended pending or lost)
+# is REQUIRED zero whenever the block gates at all.
+ELASTICITY_FIELDS = (
+    "enabled",
+    "required",
+    "scale_ups",
+    "scale_downs",
+    "skus",
+    "pending_provisions",
+    "provision_lag_p99_s",
+    "reclaims",
+    "reclaim_orphans",
+    "quota_errors",
+    "stockout_errors",
+    "skips",
+    "cost_node_hours",
+    "joint_objective",
+    "objective_gate",
     "ok",
 )
 
@@ -316,6 +347,7 @@ def build_scorecard(
     profile: dict,
     incremental: dict,
     rebalance: dict,
+    elasticity: dict,
     latency: dict,
     recorder_stats: dict,
     fp: str,
@@ -383,6 +415,13 @@ def build_scorecard(
             # consistent autoscaler what-if — a fragmentation regression
             # fails the run like an SLO regression does.
             and not (rebalance.get("required") and not rebalance.get("ok"))
+            # Elasticity-required scenarios additionally gate on the
+            # elasticity block's ok: the joint cost+SLO objective must
+            # clear the scenario's gate AND the reclaim-orphan count must
+            # be zero — a static fleet (or an autoscaler that buys its way
+            # to the SLO at unbounded cost, or orphans a reclaimed pod)
+            # fails the run like an SLO regression does.
+            and not (elasticity.get("required") and not elasticity.get("ok"))
             # Policy-required scenarios additionally gate on the policy
             # block's ok: the learned-objective scalar must clear the
             # scenario's floor — a tuning run that wins one component by
@@ -406,6 +445,7 @@ def build_scorecard(
         "profile": profile,
         "incremental": incremental,
         "rebalance": rebalance,
+        "elasticity": elasticity,
         "policy": policy,
         "latency": latency,
         "flight_recorder": recorder_stats,

@@ -50,6 +50,12 @@ class WorkloadSpec:
     pod_cpu_m: tuple[int, ...] = (100, 250, 500, 1000)
     pod_mem_mi: tuple[int, ...] = (128, 256, 512, 1024)
     lifetime_mean_s: float = 0.0  # Exp(mean) run time after bind; 0 = forever
+    # Diurnal traffic: when ``diurnal_period`` > 0 the Poisson arrival rate
+    # becomes rate(t) = arrival_rate * (1 + amplitude * sin(2πt/period)) —
+    # sampled by thinning at the peak rate, so the elastic-capacity wave
+    # the autoscaler must ride is itself seeded and deterministic.
+    diurnal_period: float = 0.0  # virtual seconds per wave (0 = flat rate)
+    diurnal_amplitude: float = 0.0  # fractional swing around arrival_rate
     node_add_rate: float = 0.0  # churn processes, events per virtual second
     node_drain_rate: float = 0.0
     node_fail_rate: float = 0.0
@@ -121,7 +127,26 @@ def generate_events(spec: WorkloadSpec, duration: float, rng: random.Random) -> 
     # Poisson arrivals (stream 0).
     arr_rng = random.Random(rng.randrange(1 << 62))
     t, seq, idx = 0.0, 0, 0
-    if spec.arrival_rate > 0:
+    if spec.arrival_rate > 0 and spec.diurnal_period > 0:
+        # Thinning (Lewis–Shedler): draw at the peak rate, accept with
+        # probability rate(t)/peak.  Gated on diurnal_period so the flat
+        # path below stays draw-for-draw identical to every older trace.
+        import math
+
+        peak = spec.arrival_rate * (1.0 + abs(spec.diurnal_amplitude))
+        while True:
+            t += arr_rng.expovariate(peak)
+            if t >= duration:
+                break
+            rate_t = spec.arrival_rate * (
+                1.0 + spec.diurnal_amplitude * math.sin(2.0 * math.pi * t / spec.diurnal_period)
+            )
+            if arr_rng.random() * peak > rate_t:
+                continue
+            pods, seq = _arrival_group(arr_rng, spec, seq)
+            streams.append((t, 0, idx, SimEvent(round(t, 6), "pods", {"pods": pods})))
+            idx += 1
+    elif spec.arrival_rate > 0:
         while True:
             t += arr_rng.expovariate(spec.arrival_rate)
             if t >= duration:

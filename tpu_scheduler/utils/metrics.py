@@ -139,6 +139,10 @@ class CycleMetrics:
     # packing snapshot/solve (inline mode), batch planning, migrations —
     # its own phase so background-tier cost can never hide in `other`.
     rebalance_seconds: float = 0.0
+    # Autoscaler tick (tpu_scheduler/autoscale): provider pump, catalog
+    # what-if plan, scale-up requests / scale-down drains — its own phase
+    # so elastic-capacity cost can never hide in `other`.
+    autoscale_seconds: float = 0.0
     other_seconds: float = 0.0  # wall minus every attributed phase
 
     @property

@@ -80,6 +80,7 @@ SPAN_CATALOGUE = (
     "slo",         # pending-age tracker + burn-rate gauges
     "delta",       # incremental engine: classification/closure/commit (tpu_scheduler/delta)
     "rebalance",   # background defrag tier: reconcile/solve/plan/migrate (tpu_scheduler/rebalance)
+    "autoscale",   # elastic-capacity tier: pump/plan/scale (tpu_scheduler/autoscale)
     # nested cost centers
     "index",       # delta sub-span: watch-event fold into the SolveState
     "close",       # delta sub-span: invalidation closure over standing verdicts
@@ -95,8 +96,10 @@ SPAN_CATALOGUE = (
     "spread",      # filter sub-span: spread rank-prefix admission + cascade
     "commit",      # choose sub-span: domain-state commit of accepted claims
     "snapshot",    # rebalance sub-span: consistent packing-view build
-    "plan",        # rebalance sub-span: bounded whole-node batch selection
+    "plan",        # rebalance sub-span: batch selection / autoscale sub-span: catalog what-if
     "migrate",     # rebalance sub-span: breaker-gated unbinds + cordons
+    "pump",        # autoscale sub-span: provider lifecycle pump (joins, reclaims, kills)
+    "scale",       # autoscale sub-span: scale-up requests / scale-down drains
     "epoch",       # one epoch of the host-driven size-shrinking driver
     "dispatch",    # epoch dispatch (async jit call; Python + trace time)
     "host-sync",   # the one per-epoch device fetch (device execute + transfer)
