@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke clean
 
-check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke
+check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -82,6 +82,13 @@ latency-smoke:
 # same gate (scripts/elasticity_smoke.py).
 elasticity-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.elasticity_smoke
+
+# The protocol-verification gate: model-check every committed # protocol:
+# spec against its crash/retry environment — all six protocol sites parse,
+# zero invariant/progress violations, every composite state space within
+# bounds, inside a pinned wall budget (scripts/protocol_smoke.py).
+protocol-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.protocol_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

@@ -928,6 +928,15 @@ def provenance(platform: str) -> dict:
         out["analyze_new"] = len(rep.get("new", []))
         out["analyze_stale"] = len(rep.get("stale", []))
         out["analyze_elapsed_s"] = rep.get("elapsed_s")
+        # Per-machine model-check verdict (the MODL pass): machines
+        # verified, composite states explored, violations — a row from a
+        # tree whose protocol specs don't verify must show it.
+        mc = rep.get("modelcheck") or {}
+        out["analyze_modelcheck"] = {
+            "machines": len(mc),
+            "states": sum(m.get("states", 0) for m in mc.values()),
+            "violations": sum(m.get("violations", 0) for m in mc.values()),
+        }
     except Exception:  # noqa: BLE001 — no artifact: provenance records that
         out["analyze_findings"] = None
     return out

@@ -37,13 +37,14 @@ import subprocess
 import sys
 import time
 
-from . import catalogues, determinism, excp, exports, hygiene, jitpure, locks, shapes
+from . import catalogues, determinism, excp, exports, hygiene, jitpure, locks, modelcheck, protocol, shapes
 from .baseline import BASELINE_PATH, compare, load_baseline, write_baseline
 from .core import DEFAULT_PATHS, ROOT, Context, Finding, load_files
 
 # Fixed pass order: cheap mechanical hygiene first, repo-invariant passes
-# last (their reports are the ones a human digs into).
-PASSES = (hygiene, exports, catalogues, excp, locks, jitpure, determinism, shapes)
+# last (their reports are the ones a human digs into).  protocol precedes
+# modelcheck so spec parse errors surface as PROT before MODL explores.
+PASSES = (hygiene, exports, catalogues, excp, locks, jitpure, determinism, shapes, protocol, modelcheck)
 
 
 def all_codes() -> dict[str, str]:
@@ -235,6 +236,9 @@ def main(argv: list[str]) -> int:
             "elapsed_s": round(elapsed, 3),
             "budget_s": budget,
             "changed_only": changed_only,
+            # Per-machine model-check stats (empty when MODL did not run,
+            # e.g. --changed-only or a --rule subset); bench.py provenance.
+            "modelcheck": dict(modelcheck.LAST_STATS),
         }
     if json_out and report is not None:
         pathlib.Path(json_out).write_text(json.dumps(report, indent=2, sort_keys=True) + "\n")

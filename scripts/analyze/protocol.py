@@ -1,0 +1,828 @@
+"""PROT — protocol state-machine contracts over the distributed control
+plane, statically checked against the code.
+
+The control plane is a set of small protocols — the circuit breaker
+(closed→open→half-open), shard/replica leases, two-phase gang
+reservations, the rebalancer's unbind→cordon→re-place drain, the provider
+node lifecycle, the delta engine's commit-exactly-once ledger — each with
+a closed state vocabulary and crash-safety claims that used to live only
+in docstrings and sampled sim scenarios.  A ``# protocol:`` contract in
+the comment block directly above the owning class makes the state machine
+machine-readable; this pass proves the CODE stays inside it, and
+``modelcheck.py`` (the MODL rule) proves the MACHINE itself keeps its
+invariants under a crash/retry/timeout environment.
+
+Grammar (authoring guide in the README "Protocol contracts" section; every
+line of the block starts ``# protocol:``)::
+
+    machine <name> field=<f> [states=<CONST>] init=<state>
+    states: a | b | c                 explicit vocabulary (or states=CONST,
+                                      a module-level tuple of strings —
+                                      the single source of truth)
+    <from> -> <to> | <to>             the legal transition relation
+    var <v>: <lo>..<hi> = <init>      bounded model variable (saturating)
+    action <n>: <from> -> <to> [requires <cond>] [effect <v> += 1, ...]
+    env <n>: ...                      same shape; an ENVIRONMENT event
+                                      (crash, TTL firing, duplicated
+                                      delivery) the model composes in
+    invariant <n>: <cond>             safety: must hold in every reachable
+                                      composite state (checked by MODL)
+    progress <n>: <cond>              no reachable state satisfying <cond>
+                                      may be stuck (zero enabled actions)
+
+``field=`` selects the AST checking mode: a plain name checks both
+``self.<f>`` attribute and ``rec["<f>"]`` dict-record accesses; ``<f>[]``
+is the keyed-counter form (state names are the subscript keys of
+``self.<f>``, vocabulary/coverage checked, no transition semantics); ``-``
+declares a model-only machine (no literal state field in the code — the
+machine exists for MODL).  ``<cond>`` is ``atom (and atom)*`` /
+``... or ...`` / ``A implies B`` over atoms ``term op value`` with term
+``state`` or a declared var, op one of ``== != < <= > >=``.
+
+The AST checker resolves every assignment/compare on a declared state
+field — including sink methods (a method assigning the field from its own
+parameter makes ``self._transition("open")`` a checked write at the call
+site) and accessor aliases (``st = self.mode()`` narrows later branches
+when every return of ``mode`` is the bare field) — and flags undeclared
+state names, undeclared transitions (the write's from-set is narrowed by
+enclosing/early-return guards), init drift, and vocabulary members the
+class never uses (coverage, both directions).
+
+A second standalone form gates closed reason taxonomies::
+
+    # protocol: taxonomy <CONST> producers=<fn>,<fn> scope=<path-prefix>
+
+Every string literal fed to (or returned by) a producer inside the scope
+must be a member, and — when the full scope is loaded, so the check is
+sound under --changed-only — every member must be produced somewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field as dc_field
+
+from .core import Context, Finding, SourceFile
+
+CODES = {
+    "PROT": "code contradicts a # protocol: contract — undeclared state/transition, init drift, or a closed vocabulary not covered both directions",
+}
+
+# Machine contracts live in the same file as their class; taxonomy coverage
+# only runs when the declared scope is fully loaded.  Both are sound on a
+# partial (--changed-only) context.
+FILE_SCOPED = True
+
+_PROT_RE = re.compile(r"#\s*protocol:\s?(.*)$")
+
+_KEYWORDS = ("states:", "var ", "action ", "env ", "invariant ", "progress ")
+
+
+# -- spec model ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Var:
+    name: str
+    lo: int
+    hi: int
+    init: int
+
+
+@dataclass(frozen=True)
+class Action:
+    name: str
+    frm: str  # state name or "*" (any)
+    to: str  # state name or "*" (stay)
+    requires: tuple | None
+    effects: tuple  # ((var, op, value), ...) with op in {"=", "+=", "-="}
+    env: bool
+    line: int
+
+
+@dataclass
+class MachineSpec:
+    name: str
+    rel: str
+    line: int
+    cls_name: str
+    field: str | None  # None => model-only (field=-)
+    keyed: bool  # field=<f>[] keyed-counter form
+    states: tuple = ()
+    states_const: str | None = None
+    init: str = ""
+    edges: dict = dc_field(default_factory=dict)  # frm -> set of to
+    vars: tuple = ()
+    actions: tuple = ()
+    invariants: tuple = ()  # ((name, cond, line), ...)
+    progress: tuple = ()
+
+
+@dataclass(frozen=True)
+class TaxonomySpec:
+    const: str
+    rel: str
+    line: int
+    members: tuple
+    producers: tuple
+    scope: str
+
+
+# -- condition mini-language --------------------------------------------------
+
+_ATOM_RE = re.compile(r"^([\w-]+)\s*(==|!=|<=|>=|<|>)\s*([\w-]+)$")
+
+
+def parse_cond(text: str, states: tuple, var_names: set) -> tuple:
+    """``A implies B`` over or/and chains of ``term op value`` atoms."""
+    t = text.strip()
+    if " implies " in t:
+        lhs, rhs = t.split(" implies ", 1)
+        return ("implies", parse_cond(lhs, states, var_names), parse_cond(rhs, states, var_names))
+    if " or " in t:
+        return ("or", tuple(parse_cond(p, states, var_names) for p in t.split(" or ")))
+    if " and " in t:
+        return ("and", tuple(parse_cond(p, states, var_names) for p in t.split(" and ")))
+    m = _ATOM_RE.match(t)
+    if not m:
+        raise ValueError(f"bad condition atom {t!r}")
+    term, op, value = m.group(1), m.group(2), m.group(3)
+    if term == "state":
+        if op not in ("==", "!="):
+            raise ValueError(f"state only compares ==/!= (got {op!r})")
+        if value not in states:
+            raise ValueError(f"condition names unknown state {value!r}")
+        return ("atom", term, op, value)
+    if term not in var_names:
+        raise ValueError(f"condition names unknown var {term!r}")
+    if not re.fullmatch(r"-?\d+", value):
+        raise ValueError(f"var {term!r} compares against an int (got {value!r})")
+    return ("atom", term, op, int(value))
+
+
+def eval_cond(cond: tuple, state: str, env: dict) -> bool:
+    kind = cond[0]
+    if kind == "implies":
+        return (not eval_cond(cond[1], state, env)) or eval_cond(cond[2], state, env)
+    if kind == "or":
+        return any(eval_cond(c, state, env) for c in cond[1])
+    if kind == "and":
+        return all(eval_cond(c, state, env) for c in cond[1])
+    _, term, op, value = cond
+    lhs = state if term == "state" else env[term]
+    return {
+        "==": lhs == value,
+        "!=": lhs != value,
+        "<": lhs < value,
+        "<=": lhs <= value,
+        ">": lhs > value,
+        ">=": lhs >= value,
+    }[op]
+
+
+# -- contract collection ------------------------------------------------------
+
+
+def _module_str_tuple(tree: ast.Module, name: str) -> tuple | None:
+    """Module-level ``NAME = ("a", "b", ...)`` -> its members, else None."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, (ast.Tuple, ast.List)):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    vals = [
+                        e.value
+                        for e in node.value.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    ]
+                    if len(vals) == len(node.value.elts):
+                        return tuple(vals)
+    return None
+
+
+def _protocol_block(sf: SourceFile, node: ast.ClassDef) -> list:
+    """(lineno, payload) for every ``# protocol:`` line in the comment block
+    directly above the class (decorators may sit between), top-down."""
+    start = min([node.lineno] + [d.lineno for d in node.decorator_list])
+    i = start - 2  # 0-indexed line above the def/decorator block
+    block: list = []
+    while i >= 0 and sf.lines[i].strip().startswith("#"):
+        block.append((i + 1, sf.lines[i].strip()))
+        i -= 1
+    out = []
+    for lineno, text in reversed(block):  # top-down
+        m = _PROT_RE.match(text)
+        if m:
+            out.append((lineno, m.group(1).strip()))
+    return out
+
+
+def _parse_effects(text: str, var_names: set) -> tuple:
+    effects = []
+    for part in text.split(","):
+        m = re.match(r"^\s*([\w-]+)\s*(\+=|-=|=)\s*(-?\d+)\s*$", part)
+        if not m:
+            raise ValueError(f"bad effect {part.strip()!r}")
+        var, op, val = m.group(1), m.group(2), int(m.group(3))
+        if var not in var_names:
+            raise ValueError(f"effect names unknown var {var!r}")
+        effects.append((var, op, val))
+    return tuple(effects)
+
+
+def _parse_action(payload: str, env: bool, lineno: int, states: tuple, var_names: set) -> Action:
+    head, _, rest = payload.partition(":")
+    name = head.split(None, 1)[1].strip()
+    if not name:
+        raise ValueError("action needs a name")
+    rest = rest.strip()
+    eff_txt = None
+    if " effect " in rest:
+        rest, eff_txt = rest.split(" effect ", 1)
+    req_txt = None
+    if " requires " in rest:
+        rest, req_txt = rest.split(" requires ", 1)
+    m = re.match(r"^([\w*-]+)\s*->\s*([\w*-]+)$", rest.strip())
+    if not m:
+        raise ValueError(f"action {name!r} needs '<from> -> <to>'")
+    frm, to = m.group(1), m.group(2)
+    for s in (frm, to):
+        if s != "*" and s not in states:
+            raise ValueError(f"action {name!r} names unknown state {s!r}")
+    requires = parse_cond(req_txt, states, var_names) if req_txt else None
+    effects = _parse_effects(eff_txt, var_names) if eff_txt else ()
+    return Action(name=name, frm=frm, to=to, requires=requires, effects=effects, env=env, line=lineno)
+
+
+def parse_machine(payloads: list, sf: SourceFile, cls: ast.ClassDef) -> tuple:
+    """The ``# protocol:`` block of one class -> (MachineSpec | None,
+    findings).  Header errors drop the machine; line errors drop the line."""
+    findings: list[Finding] = []
+    first_line = payloads[0][0]
+    head = payloads[0][1]
+    m = re.match(r"^machine\s+([\w-]+)\s+(.*)$", head)
+    if not m:
+        findings.append(
+            Finding("PROT", sf.rel, first_line, f"protocol block on '{cls.name}' must open with 'machine <name> ...'")
+        )
+        return None, findings
+    name, kv_txt = m.group(1), m.group(2)
+    kv = {}
+    for tok in kv_txt.split():
+        if "=" not in tok:
+            findings.append(Finding("PROT", sf.rel, first_line, f"machine '{name}': bad token {tok!r} (want key=value)"))
+            return None, findings
+        k, v = tok.split("=", 1)
+        kv[k] = v
+    unknown = set(kv) - {"field", "states", "init"}
+    if unknown or "field" not in kv or "init" not in kv:
+        findings.append(
+            Finding("PROT", sf.rel, first_line, f"machine '{name}': header needs field= and init= (optional states=CONST)")
+        )
+        return None, findings
+
+    field_txt = kv["field"]
+    keyed = field_txt.endswith("[]")
+    fld = None if field_txt == "-" else (field_txt[:-2] if keyed else field_txt)
+
+    # Two-phase: gather raw lines, resolve the vocabulary, then validate.
+    explicit_states: tuple | None = None
+    raw: list = []
+    for lineno, payload in payloads[1:]:
+        if payload.startswith("states:"):
+            explicit_states = tuple(s.strip() for s in payload[len("states:"):].split("|") if s.strip())
+        else:
+            raw.append((lineno, payload))
+
+    states_const = kv.get("states")
+    states: tuple | None = explicit_states
+    if states_const is not None:
+        resolved = _module_str_tuple(sf.tree, states_const)
+        if resolved is None:
+            findings.append(
+                Finding(
+                    "PROT", sf.rel, first_line,
+                    f"machine '{name}': states={states_const} does not resolve to a module-level tuple of strings",
+                )
+            )
+            return None, findings
+        if explicit_states is not None and explicit_states != resolved:
+            findings.append(
+                Finding(
+                    "PROT", sf.rel, first_line,
+                    f"machine '{name}': explicit states differ from {states_const} = {resolved}",
+                )
+            )
+            return None, findings
+        states = resolved
+    if not states:
+        findings.append(Finding("PROT", sf.rel, first_line, f"machine '{name}': no state vocabulary (states: or states=CONST)"))
+        return None, findings
+    if kv["init"] not in states:
+        findings.append(Finding("PROT", sf.rel, first_line, f"machine '{name}': init={kv['init']} is not a declared state"))
+        return None, findings
+
+    spec = MachineSpec(
+        name=name, rel=sf.rel, line=first_line, cls_name=cls.name,
+        field=fld, keyed=keyed, states=states, states_const=states_const, init=kv["init"],
+    )
+    edges: dict = {}
+    vars_: list = []
+    actions: list = []
+    invariants: list = []
+    progress: list = []
+    var_names: set = set()
+    edge_re = re.compile(r"^([\w-]+)\s*->\s*([\w|\s-]+)$")
+
+    # vars first: actions/invariants reference them regardless of line order
+    for lineno, payload in raw:
+        if payload.startswith("var "):
+            m2 = re.match(r"^var\s+([\w-]+)\s*:\s*(-?\d+)\s*\.\.\s*(-?\d+)\s*=\s*(-?\d+)\s*$", payload)
+            if not m2:
+                findings.append(Finding("PROT", sf.rel, lineno, f"machine '{name}': bad var line {payload!r}"))
+                continue
+            v = Var(m2.group(1), int(m2.group(2)), int(m2.group(3)), int(m2.group(4)))
+            if not (v.lo <= v.init <= v.hi):
+                findings.append(Finding("PROT", sf.rel, lineno, f"machine '{name}': var {v.name} init outside {v.lo}..{v.hi}"))
+                continue
+            vars_.append(v)
+            var_names.add(v.name)
+
+    for lineno, payload in raw:
+        try:
+            if payload.startswith("var "):
+                continue
+            if payload.startswith(("action ", "env ")):
+                a = _parse_action(payload, payload.startswith("env "), lineno, states, var_names)
+                actions.append(a)
+            elif payload.startswith("invariant "):
+                m2 = re.match(r"^invariant\s+([\w-]+)\s*:\s*(.+)$", payload)
+                if not m2:
+                    raise ValueError(f"bad invariant line {payload!r}")
+                invariants.append((m2.group(1), parse_cond(m2.group(2), states, var_names), lineno))
+            elif payload.startswith("progress "):
+                m2 = re.match(r"^progress\s+([\w-]+)\s*:\s*(.+)$", payload)
+                if not m2:
+                    raise ValueError(f"bad progress line {payload!r}")
+                progress.append((m2.group(1), parse_cond(m2.group(2), states, var_names), lineno))
+            else:
+                m2 = edge_re.match(payload)
+                if not m2:
+                    raise ValueError(f"unrecognized protocol line {payload!r}")
+                frm = m2.group(1)
+                tos = [t.strip() for t in m2.group(2).split("|")]
+                if frm not in states or any(t not in states for t in tos):
+                    raise ValueError(f"transition line names unknown state: {payload!r}")
+                edges.setdefault(frm, set()).update(tos)
+        except ValueError as e:
+            findings.append(Finding("PROT", sf.rel, lineno, f"machine '{name}': {e}"))
+
+    # Spec self-consistency: every action edge must lie inside the declared
+    # relation (wildcards and self-loops excepted) — the model can never
+    # legitimize a transition the relation forbids.
+    for a in actions:
+        if a.frm != "*" and a.to != "*" and a.frm != a.to and a.to not in edges.get(a.frm, set()):
+            findings.append(
+                Finding(
+                    "PROT", sf.rel, a.line,
+                    f"machine '{name}': action '{a.name}' takes undeclared transition {a.frm} -> {a.to}",
+                )
+            )
+
+    spec.edges = edges
+    spec.vars = tuple(vars_)
+    spec.actions = tuple(actions)
+    spec.invariants = tuple(invariants)
+    spec.progress = tuple(progress)
+    return spec, findings
+
+
+def collect_machines(sf: SourceFile) -> tuple:
+    """Every ``# protocol: machine`` contract in the file ->
+    ([(MachineSpec, ClassDef)], findings)."""
+    out: list = []
+    findings: list[Finding] = []
+    if sf.tree is None or "# protocol:" not in sf.text:
+        return out, findings
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        payloads = _protocol_block(sf, node)
+        if not payloads:
+            continue
+        spec, errs = parse_machine(payloads, sf, node)
+        findings.extend(errs)
+        if spec is not None:
+            out.append((spec, node))
+    return out, findings
+
+
+def _comment_lines(sf: SourceFile) -> list:
+    """(lineno, text) for every real COMMENT token — a grammar example in a
+    docstring must not parse as a contract."""
+    out: list = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(sf.text).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def collect_taxonomies(sf: SourceFile) -> tuple:
+    """Every standalone ``# protocol: taxonomy`` comment -> (specs, findings)."""
+    out: list = []
+    findings: list[Finding] = []
+    if sf.tree is None or "# protocol:" not in sf.text:
+        return out, findings
+    for lineno, line in _comment_lines(sf):
+        m = _PROT_RE.match(line.strip())
+        if not m or not m.group(1).strip().startswith("taxonomy "):
+            continue
+        m2 = re.match(r"^taxonomy\s+(\w+)\s+producers=([\w,-]+)\s+scope=(\S+)$", m.group(1).strip())
+        if not m2:
+            findings.append(
+                Finding("PROT", sf.rel, lineno, "bad taxonomy line (want: taxonomy CONST producers=a,b scope=path)")
+            )
+            continue
+        const, producers, scope = m2.group(1), tuple(p for p in m2.group(2).split(",") if p), m2.group(3)
+        members = _module_str_tuple(sf.tree, const)
+        if members is None:
+            findings.append(
+                Finding("PROT", sf.rel, lineno, f"taxonomy {const}: no module-level tuple of strings with that name")
+            )
+            continue
+        out.append(TaxonomySpec(const=const, rel=sf.rel, line=lineno, members=members, producers=producers, scope=scope))
+    return out, findings
+
+
+# -- AST transition checker ---------------------------------------------------
+
+
+def _is_self_attr(node: ast.expr, attr: str) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == attr
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _is_field_read(node: ast.expr, fld: str) -> bool:
+    """``self.<fld>`` or ``<expr>["<fld>"]`` (the dict-record form)."""
+    if _is_self_attr(node, fld):
+        return True
+    return (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.slice, ast.Constant)
+        and node.slice.value == fld
+    )
+
+
+def _target_value_pairs(node: ast.Assign) -> list:
+    pairs = []
+    for t in node.targets:
+        if (
+            isinstance(t, ast.Tuple)
+            and isinstance(node.value, ast.Tuple)
+            and len(t.elts) == len(node.value.elts)
+        ):
+            pairs.extend(zip(t.elts, node.value.elts))
+        else:
+            pairs.append((t, node.value))
+    return pairs
+
+
+def _terminates(body: list) -> bool:
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+class _ClassChecker:
+    """Checks one annotated class body against its MachineSpec."""
+
+    def __init__(self, spec: MachineSpec, cls: ast.ClassDef, sf: SourceFile):
+        self.spec = spec
+        self.cls = cls
+        self.sf = sf
+        self.findings: list[Finding] = []
+        self.mentioned: set = set()
+        self.fns = [n for n in cls.body if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        self.sinks: dict = {}  # method name -> positional index after self
+        self.accessors: set = set()
+        if spec.field is not None and not spec.keyed:
+            self._find_sinks_and_accessors()
+
+    def emit(self, lineno: int, message: str) -> None:
+        self.findings.append(Finding("PROT", self.sf.rel, lineno, message))
+
+    def _find_sinks_and_accessors(self) -> None:
+        fld = self.spec.field
+        for fn in self.fns:
+            params = [a.arg for a in fn.args.args]
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for tgt, val in _target_value_pairs(node):
+                        if _is_field_read(tgt, fld) and isinstance(val, ast.Name) and val.id in params:
+                            idx = params.index(val.id) - 1  # after self
+                            if idx >= 0:
+                                self.sinks[fn.name] = idx
+            rets = [n for n in ast.walk(fn) if isinstance(n, ast.Return)]
+            if rets and all(r.value is not None and _is_self_attr(r.value, fld) for r in rets):
+                self.accessors.add(fn.name)
+
+    # -- the walk ------------------------------------------------------------
+
+    def check(self) -> list[Finding]:
+        if self.spec.keyed:
+            self._check_keyed()
+        else:
+            for fn in self.fns:
+                self._visit_fn(fn)
+        for s in self.spec.states:
+            if s not in self.mentioned:
+                src = self.spec.states_const or "the states line"
+                self.emit(
+                    self.spec.line,
+                    f"machine '{self.spec.name}': state '{s}' declared in {src} is never used by {self.cls.name}",
+                )
+        return self.findings
+
+    def _check_keyed(self) -> None:
+        base = self.spec.field
+        for node in ast.walk(self.cls):
+            if (
+                isinstance(node, ast.Subscript)
+                and _is_self_attr(node.value, base)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+            ):
+                self._mention(node.slice.value, node.lineno)
+
+    def _mention(self, state: str, lineno: int) -> None:
+        self.mentioned.add(state)
+        if state not in self.spec.states:
+            self.emit(
+                lineno,
+                f"machine '{self.spec.name}': '{state}' is not a declared state of {self.spec.cls_name}",
+            )
+
+    def _visit_fn(self, fn) -> None:
+        self._block(fn.body, None, set(), fn)
+
+    def _block(self, stmts: list, fromset, aliases: set, fn) -> None:
+        for s in stmts:
+            # Own expressions: compares, sink calls, dict-literal inits.
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child, fromset, aliases, fn)
+            if isinstance(s, ast.Assign):
+                self._handle_assign(s, fromset, aliases, fn)
+            elif isinstance(s, ast.AugAssign):
+                pass  # numeric bumps; keyed form handled separately
+            elif isinstance(s, ast.If):
+                pos, neg = self._narrow(s.test, aliases)
+                self._block(s.body, _inter(fromset, pos, self.spec.states), set(aliases), fn)
+                self._block(s.orelse, _inter(fromset, neg, self.spec.states), set(aliases), fn)
+                if _terminates(s.body) and not s.orelse:
+                    # early-return guard: the rest of the block runs only
+                    # when the test was false
+                    fromset = _inter(fromset, neg, self.spec.states)
+            elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                # a loop body may re-enter with a different state
+                self._block(s.body, None, set(aliases), fn)
+                self._block(s.orelse, None, set(aliases), fn)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                self._block(s.body, fromset, set(aliases), fn)
+            elif isinstance(s, ast.Try):
+                self._block(s.body, fromset, set(aliases), fn)
+                for h in s.handlers:
+                    self._block(h.body, None, set(aliases), fn)
+                self._block(s.orelse, fromset, set(aliases), fn)
+                self._block(s.finalbody, None, set(aliases), fn)
+            elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._block(s.body, None, set(), s)
+
+    def _handle_assign(self, s: ast.Assign, fromset, aliases: set, fn) -> None:
+        fld = self.spec.field
+        params = [a.arg for a in fn.args.args] if hasattr(fn.args, "args") else []
+        for tgt, val in _target_value_pairs(s):
+            if _is_field_read(tgt, fld):
+                if isinstance(val, ast.Constant) and isinstance(val.value, str):
+                    self._check_write(val.value, s.lineno, fromset, fn)
+                elif isinstance(val, ast.Name) and val.id in params:
+                    pass  # the sink definition itself
+                # non-constant write: unknown, conservatively quiet
+            elif isinstance(tgt, ast.Name):
+                if _is_field_read(val, fld) or self._is_accessor_call(val):
+                    aliases.add(tgt.id)
+                else:
+                    aliases.discard(tgt.id)
+
+    def _is_accessor_call(self, node) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in self.accessors
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+        )
+
+    def _is_field_expr(self, node, aliases: set) -> bool:
+        if _is_field_read(node, self.spec.field) or self._is_accessor_call(node):
+            return True
+        return isinstance(node, ast.Name) and node.id in aliases
+
+    def _check_write(self, to: str, lineno: int, fromset, fn) -> None:
+        spec = self.spec
+        self._mention(to, lineno)
+        if to not in spec.states:
+            return
+        if fn.name == "__init__":
+            if to != spec.init:
+                self.emit(lineno, f"machine '{spec.name}': __init__ sets '{to}' but init={spec.init}")
+            return
+        froms = sorted(fromset) if fromset is not None else sorted(spec.states)
+        for frm in froms:
+            if frm != to and to not in spec.edges.get(frm, set()):
+                self.emit(lineno, f"machine '{spec.name}': undeclared transition {frm} -> {to}")
+
+    def _check_init_literal(self, value: str, lineno: int) -> None:
+        self._mention(value, lineno)
+        if value in self.spec.states and value != self.spec.init:
+            self.emit(
+                lineno,
+                f"machine '{self.spec.name}': record created in state '{value}' but init={self.spec.init}",
+            )
+
+    def _scan_expr(self, expr: ast.expr, fromset, aliases: set, fn) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                if any(self._is_field_expr(x, aliases) for x in sides):
+                    for x in sides:
+                        if isinstance(x, ast.Constant) and isinstance(x.value, str):
+                            self._mention(x.value, node.lineno)
+                        elif isinstance(x, (ast.Tuple, ast.List)):
+                            for e in x.elts:
+                                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                                    self._mention(e.value, node.lineno)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in self.sinks
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                ):
+                    idx = self.sinks[f.attr]
+                    if idx < len(node.args) and isinstance(node.args[idx], ast.Constant):
+                        v = node.args[idx].value
+                        if isinstance(v, str):
+                            self._check_write(v, node.lineno, fromset, fn)
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and k.value == self.spec.field
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                    ):
+                        self._check_init_literal(v.value, node.lineno)
+
+    # -- guard narrowing -----------------------------------------------------
+
+    def _narrow(self, test: ast.expr, aliases: set) -> tuple:
+        """(states implied when true, states implied when false); None =
+        no information."""
+        vocab = set(self.spec.states)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            pos, neg = self._narrow(test.operand, aliases)
+            return neg, pos
+        if isinstance(test, ast.BoolOp):
+            parts = [self._narrow(v, aliases) for v in test.values]
+            if isinstance(test.op, ast.And):
+                pos = None
+                for p, _ in parts:
+                    if p is not None:
+                        pos = p if pos is None else (pos & p)
+                return pos, None
+            neg = None
+            for _, n in parts:
+                if n is not None:
+                    neg = n if neg is None else (neg & n)
+            return None, neg
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            left, op, right = test.left, test.ops[0], test.comparators[0]
+            if not self._is_field_expr(left, aliases):
+                return None, None
+            if isinstance(op, (ast.Eq, ast.NotEq)) and isinstance(right, ast.Constant) and isinstance(right.value, str):
+                s = {right.value} & vocab
+                if not s:
+                    return None, None
+                return (s, vocab - s) if isinstance(op, ast.Eq) else (vocab - s, s)
+            if isinstance(op, (ast.In, ast.NotIn)) and isinstance(right, (ast.Tuple, ast.List)):
+                s = {e.value for e in right.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)} & vocab
+                if not s:
+                    return None, None
+                return (s, vocab - s) if isinstance(op, ast.In) else (vocab - s, s)
+        return None, None
+
+
+def _inter(a, b, states) -> set | None:
+    if a is None and b is None:
+        return None
+    if a is None:
+        return set(b)
+    if b is None:
+        return set(a)
+    return set(a) & set(b)
+
+
+# -- taxonomy checking --------------------------------------------------------
+
+
+def _literal_args(node: ast.expr) -> list:
+    """String constants a producer argument can evaluate to: a bare
+    constant, the branches of a conditional, or ``x or "default"``."""
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else []
+    if isinstance(node, ast.IfExp):
+        return _literal_args(node.body) + _literal_args(node.orelse)
+    if isinstance(node, ast.BoolOp):
+        out = []
+        for v in node.values:
+            out.extend(_literal_args(v))
+        return out
+    return []
+
+
+def _check_taxonomy(tax: TaxonomySpec, ctx: Context) -> list:
+    findings: list[Finding] = []
+    members = set(tax.members)
+    prefix = tax.scope.rstrip("/") + "/"
+    in_scope = [f for f in ctx.parsed() if f.rel.startswith(prefix) or f.rel == tax.scope]
+    used: set = set()
+    for f in in_scope:
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = fn.id if isinstance(fn, ast.Name) else (fn.attr if isinstance(fn, ast.Attribute) else None)
+                if name in tax.producers and node.args:
+                    for lit in _literal_args(node.args[0]):
+                        used.add(lit)
+                        if lit not in members:
+                            findings.append(
+                                Finding(
+                                    "PROT", f.rel, node.lineno,
+                                    f"'{lit}' passed to {name}() is not in {tax.const} ({tax.rel})",
+                                )
+                            )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name in tax.producers:
+                for ret in ast.walk(node):
+                    if isinstance(ret, ast.Return) and ret.value is not None:
+                        for lit in _literal_args(ret.value):
+                            used.add(lit)
+                            if lit not in members:
+                                findings.append(
+                                    Finding(
+                                        "PROT", f.rel, ret.lineno,
+                                        f"'{lit}' returned by {node.name}() is not in {tax.const} ({tax.rel})",
+                                    )
+                                )
+    # Coverage direction only when the whole scope is loaded (sound under
+    # --changed-only: a partial context skips it rather than lying).
+    scope_dir = ctx.root / tax.scope
+    if scope_dir.is_dir():
+        on_disk = {p.relative_to(ctx.root).as_posix() for p in scope_dir.rglob("*.py")}
+        loaded = {f.rel for f in ctx.files}
+        if on_disk <= loaded:
+            for m in tax.members:
+                if m not in used:
+                    findings.append(
+                        Finding(
+                            "PROT", tax.rel, tax.line,
+                            f"taxonomy {tax.const}: member '{m}' is never produced by {'/'.join(tax.producers)} under {tax.scope}",
+                        )
+                    )
+    return findings
+
+
+# -- pass entry ---------------------------------------------------------------
+
+
+def run(ctx: Context) -> list:
+    findings: list[Finding] = []
+    for f in ctx.parsed():
+        machines, errs = collect_machines(f)
+        findings.extend(errs)
+        for spec, cls in machines:
+            if spec.field is not None:
+                findings.extend(_ClassChecker(spec, cls, f).check())
+        taxes, errs = collect_taxonomies(f)
+        findings.extend(errs)
+        for tax in taxes:
+            findings.extend(_check_taxonomy(tax, ctx))
+    return findings

@@ -1050,10 +1050,11 @@ def test_driver_rule_filter_and_json_output():
     proc = run_cli("-m", "scripts.analyze", "--rule", "THRD", "--json")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(proc.stdout)
-    assert {"files", "findings", "new", "stale", "elapsed_s", "budget_s", "changed_only"} == set(report)
+    assert {"files", "findings", "new", "stale", "elapsed_s", "budget_s", "changed_only", "modelcheck"} == set(report)
     assert report["new"] == [] and report["stale"] == []
     assert all(f["rule"] == "THRD" for f in report["findings"])
     assert all(f["baselined"] for f in report["findings"])
+    assert report["modelcheck"] == {}  # MODL did not run under --rule THRD
 
 
 def test_driver_rejects_unknown_rule():
@@ -1259,3 +1260,399 @@ def test_shpe_delta_candidate_mask_broadcast_caught():
     assert any("[N]" in h.message and "[R]" in h.message for h in hits), "; ".join(
         h.render() for h in hits
     )
+
+
+# -- PROT protocol contracts + MODL model checking ---------------------------
+
+from scripts.analyze import modelcheck, protocol  # noqa: E402
+
+PROT_SYNTH = '''STATES = ("idle", "running", "done")
+
+
+# protocol: machine widget field=state states=STATES init=idle
+# protocol: idle -> running
+# protocol: running -> done
+# protocol: var work: 0..1 = 0
+# protocol: action start: idle -> running effect work = 1
+# protocol: action finish: running -> done effect work = 0
+# protocol: invariant done-clean: state == done implies work == 0
+class Widget:
+    def __init__(self):
+        self.state = "idle"
+
+    def start(self):
+        if self.state == "idle":
+            self.state = "running"
+
+    def finish(self):
+        if self.state == "running":
+            self.state = "done"
+'''
+
+
+def test_prot_clean_synthetic_machine_and_transition_mutations():
+    ctx = make_ctx(("tpu_scheduler/w.py", PROT_SYNTH))
+    assert not rule_hits(protocol.run(ctx), "PROT")
+    # TP 1: an undeclared transition (done -> running restart).
+    mutated = PROT_SYNTH.replace(
+        'if self.state == "running":\n            self.state = "done"',
+        'if self.state == "running":\n            self.state = "done"\n'
+        '        elif self.state == "done":\n            self.state = "running"',
+    )
+    assert mutated != PROT_SYNTH
+    hits = rule_hits(protocol.run(make_ctx(("tpu_scheduler/w.py", mutated))), "PROT")
+    assert len(hits) == 1 and "undeclared transition done -> running" in hits[0].message
+    # TP 2: a state name outside the closed vocabulary.
+    mutated = PROT_SYNTH.replace('self.state = "done"', 'self.state = "finished"')
+    hits = rule_hits(protocol.run(make_ctx(("tpu_scheduler/w.py", mutated))), "PROT")
+    # the typo is flagged AND 'done' loses its only mention (coverage).
+    assert any("'finished' is not a declared state" in h.message for h in hits)
+    assert any("state 'done'" in h.message and "never used" in h.message for h in hits)
+    # TP 3: __init__ drift against init=.
+    mutated = PROT_SYNTH.replace('self.state = "idle"', 'self.state = "running"')
+    hits = rule_hits(protocol.run(make_ctx(("tpu_scheduler/w.py", mutated))), "PROT")
+    assert len(hits) == 1 and "__init__ sets 'running' but init=idle" in hits[0].message
+
+
+def test_prot_sink_and_accessor_resolution():
+    """The breaker shape: writes routed through a sink method and compares
+    through an accessor alias are still transition-checked — no special
+    cases, the promotion is simply a declared edge."""
+    code = '''# protocol: machine m field=state init=a
+# protocol: states: a | b | c
+# protocol: a -> b
+# protocol: b -> c
+# protocol: action go: a -> b
+# protocol: action fin: b -> c
+# protocol: invariant vacuous: state != a or state == a
+class M:
+    def __init__(self):
+        self.state = "a"
+
+    def mode(self):
+        if self.state == "a":
+            return self.state
+        return self.state
+
+    def _transition(self, to):
+        self.state = to
+
+    def is_done(self):
+        return self.state == "c"
+
+    def poke(self):
+        st = self.mode()
+        if st == "a":
+            self._transition("b")
+'''
+    # Clean ONLY because the accessor alias narrows the sink call's
+    # from-set to {a}: un-narrowed, c -> b would be an undeclared edge.
+    ctx = make_ctx(("tpu_scheduler/m.py", code))
+    assert not rule_hits(protocol.run(ctx), "PROT")
+    # Guarding the same sink call on the wrong branch is caught.
+    bad = code.replace('if st == "a":', 'if st == "c":')
+    hits = rule_hits(protocol.run(make_ctx(("tpu_scheduler/m.py", bad))), "PROT")
+    assert len(hits) == 1 and "undeclared transition c -> b" in hits[0].message
+    # And so is removing the guard entirely (the from-set widens to all).
+    bad = code.replace('        st = self.mode()\n        if st == "a":\n            self._transition("b")',
+                       '        self._transition("b")')
+    hits = rule_hits(protocol.run(make_ctx(("tpu_scheduler/m.py", bad))), "PROT")
+    assert len(hits) == 1 and "undeclared transition c -> b" in hits[0].message
+
+
+def test_prot_seeded_provider_resurrect_caught_exactly_once():
+    """ISSUE 18 satellite: the canonical seeded bug — a deleted->ready
+    resurrect method in provider.py — must produce exactly one PROT
+    finding naming the undeclared transition."""
+    path = ROOT / "tpu_scheduler" / "autoscale" / "provider.py"
+    text = path.read_text()
+    rel = "tpu_scheduler/autoscale/provider.py"
+    assert not rule_hits(protocol.run(make_ctx((rel, text))), "PROT")
+    mutated = text.replace(
+        "    def _kill(self, rec: dict, out: dict) -> bool:",
+        '    def _resurrect(self, rec: dict) -> None:\n'
+        '        if rec["state"] == "deleted":\n'
+        '            rec["state"] = "ready"\n'
+        "\n"
+        "    def _kill(self, rec: dict, out: dict) -> bool:",
+    )
+    assert mutated != text, "_kill went missing from provider.py"
+    hits = rule_hits(protocol.run(make_ctx((rel, mutated))), "PROT")
+    assert len(hits) == 1, "; ".join(h.render() for h in hits)
+    assert "undeclared transition deleted -> ready" in hits[0].message
+
+
+def test_prot_keyed_counter_coverage_both_directions():
+    """The RESERVATION_STATES exhaustiveness gate: a counts[] key outside
+    the vocabulary is flagged, and dropping the only `expired` bump makes
+    the member uncovered (the hand-maintained-in-parallel drift class)."""
+    path = ROOT / "tpu_scheduler" / "fleet" / "reservation.py"
+    text = path.read_text()
+    rel = "tpu_scheduler/fleet/reservation.py"
+    assert not rule_hits(protocol.run(make_ctx((rel, text))), "PROT")
+    # Direction 1: a key the vocabulary does not declare.
+    mutated = text.replace('self.counts["committed"] += 1', 'self.counts["comitted"] += 1')
+    assert mutated != text
+    hits = rule_hits(protocol.run(make_ctx((rel, mutated))), "PROT")
+    assert any("'comitted' is not a declared state" in h.message for h in hits)
+    assert any("state 'committed'" in h.message and "never used" in h.message for h in hits)
+    # Direction 2: a declared member the class never touches.
+    mutated = text.replace('self.counts["expired"] += 1', "pass")
+    assert mutated != text
+    hits = rule_hits(protocol.run(make_ctx((rel, mutated))), "PROT")
+    assert len(hits) == 1 and "state 'expired'" in hits[0].message and "never used" in hits[0].message
+
+
+def test_prot_taxonomy_membership_and_coverage(tmp_path):
+    decl = '''# protocol: taxonomy REASONS producers=_skip scope=pkg
+REASONS = ("alpha", "beta")
+'''
+    user_ok = '''class C:
+    def _skip(self, reason):
+        pass
+
+    def f(self):
+        self._skip("alpha")
+        self._skip("beta")
+        self._skip("beta" if self.x else "alpha")
+'''
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "decl.py").write_text(decl)
+    (pkg / "user.py").write_text(user_ok)
+
+    def tax_ctx(decl_text, user_text):
+        files = []
+        for rel, code in (("pkg/decl.py", decl_text), ("pkg/user.py", user_text)):
+            (tmp_path / rel).write_text(code)
+            files.append(
+                SourceFile(path=tmp_path / rel, rel=rel, text=code, lines=code.splitlines(), tree=ast.parse(code))
+            )
+        return Context(files=files, root=tmp_path, readme="")
+
+    assert not rule_hits(protocol.run(tax_ctx(decl, user_ok)), "PROT")
+    # Membership: a produced literal outside the tuple (IfExp branch too).
+    bad = user_ok.replace('"beta" if self.x else "alpha"', '"gamma" if self.x else "alpha"')
+    hits = rule_hits(protocol.run(tax_ctx(decl, bad)), "PROT")
+    assert len(hits) == 1 and "'gamma'" in hits[0].message and "REASONS" in hits[0].message
+    # Coverage: a member no producer ever emits (scope fully loaded).
+    bad = user_ok.replace('self._skip("beta")\n        self._skip("beta" if self.x else "alpha")', "pass")
+    assert bad != user_ok
+    hits = rule_hits(protocol.run(tax_ctx(decl, bad)), "PROT")
+    assert len(hits) == 1 and "member 'beta' is never produced" in hits[0].message
+
+
+def test_prot_taxonomy_coverage_silent_on_partial_context(tmp_path):
+    """--changed-only soundness: with part of the scope missing from the
+    context, the coverage direction must stay silent, not lie."""
+    decl = '''# protocol: taxonomy REASONS producers=_skip scope=pkg
+REASONS = ("alpha", "beta")
+'''
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "decl.py").write_text(decl)
+    (pkg / "user.py").write_text("def f(_skip):\n    _skip('alpha')\n")
+    files = [
+        SourceFile(
+            path=pkg / "decl.py", rel="pkg/decl.py", text=decl, lines=decl.splitlines(), tree=ast.parse(decl)
+        )
+    ]
+    ctx = Context(files=files, root=tmp_path, readme="")
+    assert not rule_hits(protocol.run(ctx), "PROT")
+
+
+def test_prot_spec_errors_are_findings():
+    bad = '''# protocol: machine m field=state init=a
+# protocol: states: a | b
+# protocol: a -> b
+# protocol: action go: a -> c
+# protocol: invariant x: bogus ~ 3
+class M:
+    def __init__(self):
+        self.state = "a"
+'''
+    hits = rule_hits(protocol.run(make_ctx(("tpu_scheduler/m.py", bad))), "PROT")
+    msgs = "; ".join(h.message for h in hits)
+    assert "unknown state 'c'" in msgs and "bad condition atom" in msgs
+    # And an action edge outside the declared relation is spec-inconsistent.
+    bad2 = bad.replace("action go: a -> c", "action go: b -> a").replace("invariant x: bogus ~ 3", "invariant x: state == a")
+    hits = rule_hits(protocol.run(make_ctx(("tpu_scheduler/m.py", bad2))), "PROT")
+    assert any("undeclared transition b -> a" in h.message for h in hits)
+
+
+def test_prot_real_tree_is_clean_with_all_six_sites():
+    """FP guard over the real annotated tree (the acceptance bar): all six
+    protocol sites parse, all three taxonomies parse, zero findings."""
+    files = load_files(DEFAULT_PATHS)
+    ctx = Context(files=files, root=ROOT, readme="")
+    machines, taxes = [], []
+    for f in ctx.parsed():
+        specs, _ = protocol.collect_machines(f)
+        machines.extend(s for s, _cls in specs)
+        tx, _ = protocol.collect_taxonomies(f)
+        taxes.extend(tx)
+    assert {m.name for m in machines} >= {
+        "circuit-breaker", "shard-lease", "gang-reservation",
+        "drain-migration", "provider-node", "placement-ledger",
+    }
+    assert len(taxes) >= 3
+    hits = rule_hits(protocol.run(ctx), "PROT")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
+def _machine_from(rel, mutated_text):
+    sf = SourceFile(
+        path=ROOT / rel, rel=rel, text=mutated_text, lines=mutated_text.splitlines(), tree=ast.parse(mutated_text)
+    )
+    machines, errs = protocol.collect_machines(sf)
+    assert not errs, "; ".join(e.render() for e in errs)
+    assert len(machines) == 1
+    return machines[0][0]
+
+
+def _mutate_and_check(rel, old, new, prop):
+    """Apply one contract mutation, model-check, and return the single
+    violation of ``prop`` (asserting it is reported exactly once)."""
+    text = (ROOT / rel).read_text()
+    mutated = text.replace(old, new)
+    assert mutated != text, f"contract line went missing from {rel}: {old!r}"
+    clean = modelcheck.explore(_machine_from(rel, text))
+    assert clean["violations"] == [], f"{rel} spec no longer verifies clean"
+    result = modelcheck.explore(_machine_from(rel, mutated))
+    hits = [v for v in result["violations"] if v[1] == prop]
+    assert len(hits) == 1, f"{prop}: {result['violations']}"
+    return hits[0]
+
+
+def test_modl_breaker_double_bind_mutation_caught_once():
+    """Dropping the overlay latch from defer lets the deferred pod place
+    twice — the assumed-overlay double-bind the invariant exists for."""
+    kind, name, trace, _ = _mutate_and_check(
+        "tpu_scheduler/runtime/resilience.py",
+        "# protocol: action defer: open -> open requires pending == 1 and overlaid == 0 effect overlaid = 1, placed += 1",
+        "# protocol: action defer: open -> open requires pending == 1 effect placed += 1",
+        "no-double-bind",
+    )
+    assert kind == "invariant" and trace and trace.count("defer") >= 2
+
+
+def test_modl_lease_release_is_final_mutation_caught_once():
+    """Un-guarding the stale renew thread resurrects the PR-7 race: a
+    voluntarily released lease gets re-acquired by the dead thread."""
+    kind, name, trace, _ = _mutate_and_check(
+        "tpu_scheduler/runtime/shards.py",
+        "# protocol: env thread-renew: free -> held requires released == 0",
+        "# protocol: env thread-renew: free -> held",
+        "release-is-final",
+    )
+    assert kind == "invariant" and trace == ["acquire", "release", "thread-renew"]
+
+
+def test_modl_drain_orphan_mutation_caught_once():
+    """Breaking unbind's atomic CAS (bound cleared without pending set)
+    orphans the victim immediately — a one-step violating trace."""
+    kind, name, trace, _ = _mutate_and_check(
+        "tpu_scheduler/rebalance/executor.py",
+        "# protocol: action unbind: verify -> unbound requires bound == 1 effect bound = 0, pending = 1",
+        "# protocol: action unbind: verify -> unbound requires bound == 1 effect bound = 0",
+        "never-orphaned",
+    )
+    assert kind == "invariant" and trace == ["unbind"]
+
+
+def test_modl_provider_delete_over_pod_mutation_caught_once():
+    """Un-guarding kill deletes a node still holding a pod; the minimal
+    trace walks the full lifecycle to the racing state."""
+    kind, name, trace, _ = _mutate_and_check(
+        "tpu_scheduler/autoscale/provider.py",
+        "# protocol: action kill: reclaiming -> deleted requires pods == 0",
+        "# protocol: action kill: reclaiming -> deleted",
+        "delete-only-when-empty",
+    )
+    assert kind == "invariant" and trace == ["join", "bind", "notice", "kill"]
+
+
+def test_modl_ledger_flush_twice_mutation_caught_once():
+    """Giving the duplicated commit delivery a capacity effect breaks
+    exactly-once accounting — the two-phase-commit double-consume."""
+    kind, name, trace, _ = _mutate_and_check(
+        "tpu_scheduler/delta/state.py",
+        "# protocol: env dup-commit: committed -> committed",
+        "# protocol: env dup-commit: committed -> committed effect used += 1",
+        "flush-at-most-once",
+    )
+    assert kind == "invariant" and trace == ["commit", "dup-commit"]
+
+
+def test_modl_trace_minimality_on_seeded_two_phase_commit_bug():
+    """ISSUE 18 satellite: the trace-minimality contract.  Seeding the
+    two-phase reservation protocol with a TTL that forgets to reclaim the
+    peer leases must produce the MINIMAL trace — crash then ttl, exactly
+    two environment steps, nothing extra prepended or interleaved."""
+    kind, name, trace, _ = _mutate_and_check(
+        "tpu_scheduler/fleet/reservation.py",
+        "# protocol: env ttl: reserved -> expired requires alive == 0 effect leases = 0",
+        "# protocol: env ttl: reserved -> expired requires alive == 0",
+        "expired-clean",
+    )
+    assert kind == "invariant"
+    assert trace == ["crash", "ttl"], f"non-minimal or non-deterministic trace: {trace}"
+
+
+def test_modl_progress_violation_and_state_space_cap():
+    # A machine whose declared-stuck state trips the progress property.
+    stuck = '''# protocol: machine m field=- init=a
+# protocol: states: a | b
+# protocol: a -> b
+# protocol: action go: a -> b
+# protocol: progress alive: state == b
+class M:
+    pass
+'''
+    hits = rule_hits(modelcheck.run(make_ctx(("tpu_scheduler/m.py", stuck))), "MODL")
+    assert len(hits) == 1 and "progress 'alive' stuck" in hits[0].message and "go" in hits[0].message
+    # A runaway var blows the composite-space cap loudly, never hangs.
+    runaway = '''# protocol: machine m field=- init=a
+# protocol: states: a | b
+# protocol: a -> b
+# protocol: var x: 0..99999 = 0
+# protocol: action inc: * -> * effect x += 1
+# protocol: invariant fine: x >= 0
+class M:
+    pass
+'''
+    hits = rule_hits(modelcheck.run(make_ctx(("tpu_scheduler/m.py", runaway))), "MODL")
+    assert len(hits) == 1 and "exceeds" in hits[0].message
+
+
+def test_modl_real_tree_verifies_and_exports_stats():
+    """The acceptance bar: every committed spec verifies against its
+    environment, and LAST_STATS carries the per-machine evidence the
+    driver folds into --json-out for bench.py provenance."""
+    files = load_files(DEFAULT_PATHS)
+    ctx = Context(files=files, root=ROOT, readme="")
+    hits = rule_hits(modelcheck.run(ctx), "MODL")
+    assert not hits, "; ".join(h.render() for h in hits)
+    stats = modelcheck.LAST_STATS
+    assert len(stats) >= 6
+    for name, row in stats.items():
+        assert row["states"] >= 2, f"{name} explores a vacuous space"
+        assert row["violations"] == 0
+        assert row["invariants"] + row["progress"] >= 1, f"{name} proves nothing"
+
+
+def test_driver_json_out_carries_modelcheck_stats(tmp_path):
+    out = tmp_path / "report.json"
+    proc = run_cli("-m", "scripts.analyze", "--rule", "MODL", "--json-out", str(out), "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(out.read_text())
+    assert len(report["modelcheck"]) >= 6
+    assert all(m["violations"] == 0 for m in report["modelcheck"].values())
+
+
+def test_prot_and_modl_are_registered_and_scoped():
+    codes = all_codes()
+    assert "PROT" in codes and "MODL" in codes
+    # PROT rides --changed-only; MODL is full-context like EXCP.
+    scoped = file_scoped_codes()
+    assert "PROT" in scoped and "MODL" not in scoped

@@ -206,6 +206,32 @@ def test_crashed_owner_reservations_expire_within_one_ttl():
     assert count_orphaned_reservations(api, clock.t, {"r2"}) == 0
 
 
+def test_partial_lease_loss_expires_once_and_releases_survivors():
+    """The `expired` ledger-count regression: losing ANY peer lease expires
+    the whole reservation exactly once (per gang, not per lost lease), the
+    surviving rows are handed back in the same round, and the counts dict
+    keeps the full RESERVATION_STATES key set — one source of truth."""
+    clock = FakeClock()
+    api = FakeApiServer(clock=clock)
+    led = GangReservationLedger(api, "r1", 6.0, clock)
+    assert led.reserve("wide", [0, 1, 2]) is True
+    # A rival steals exactly one row (its TTL lapsed under brownout while
+    # the others were renewed out-of-band) — the reservation is no longer
+    # all-or-nothing and must expire as a unit.
+    api.release_lease(reservation_lease_name("wide", 1), "r1")
+    api.acquire_lease(reservation_lease_name("wide", 1), "r2", 60.0)
+    assert led.renew() == 1
+    assert led.counts["expired"] == 1  # once per gang, not per lost lease
+    assert led.active() == {}
+    # The survivors (shards 0 and 2) were released, not left to the TTL.
+    assert api.get_lease(reservation_lease_name("wide", 0)) is None
+    assert api.get_lease(reservation_lease_name("wide", 2)) is None
+    # A second renew finds nothing active and counts nothing new.
+    assert led.renew() == 0
+    assert led.counts["expired"] == 1
+    assert set(led.counts) == set(RESERVATION_STATES)
+
+
 def test_abort_and_release_all_hand_rows_back_immediately():
     clock = FakeClock()
     api = FakeApiServer(clock=clock)

@@ -44,6 +44,7 @@ from dataclasses import dataclass
 
 __all__ = ["SKIP_REASONS", "AutoscaleConfig", "pack_catalog", "throttle_reason"]
 
+# protocol: taxonomy SKIP_REASONS producers=_skip,throttle_reason scope=tpu_scheduler/autoscale
 SKIP_REASONS = (
     "breaker-open",
     "cooldown",

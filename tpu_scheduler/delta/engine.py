@@ -46,7 +46,9 @@ logger = logging.getLogger("tpu_scheduler.delta")
 __all__ = ["ESCALATION_REASONS", "DeltaPlan", "DeltaEngine"]
 
 # The closed escalation vocabulary (drift-gated against the README
-# "Incremental scheduling" catalogue by the DLTA analyze rule).
+# "Incremental scheduling" catalogue by the DLTA analyze rule; producer
+# coverage gated both directions by the PROT taxonomy below).
+# protocol: taxonomy ESCALATION_REASONS producers=_escalate,invalidate scope=tpu_scheduler
 ESCALATION_REASONS = (
     "cold",
     "restore",

@@ -66,6 +66,18 @@ def req64_of(pod: Pod, res_vocab: tuple[str, ...], res_memo: dict | None = None)
     return out
 
 
+# protocol: machine placement-ledger field=- init=absent
+# protocol: states: absent | committed
+# protocol: absent -> committed
+# protocol: committed -> absent
+# protocol: var used: 0..2 = 0
+# protocol: action commit: absent -> committed effect used += 1
+# protocol: env dup-commit: committed -> committed
+# protocol: action release: committed -> absent effect used -= 1
+# protocol: env dup-release: absent -> absent
+# protocol: invariant flush-at-most-once: used <= 1
+# protocol: invariant exact-accounting: state == absent implies used == 0
+# protocol: invariant committed-counted: state == committed implies used == 1
 @dataclass
 class SolveState:
     """Persisted solve state, aligned to one packed node axis.
@@ -73,6 +85,15 @@ class SolveState:
     Valid only while the node set/order (and therefore ``node_sig``) holds;
     any node-set change escalates to a full-wave rebuild rather than trying
     to remap rows.
+
+    The ``# protocol:`` contract above models one pod's row in the
+    ``placements`` ledger against duplicated deliveries (model-only: the
+    state is ledger membership, not a field).  A deferred-bind flush and
+    the watch event confirming our own POST both re-deliver ``commit``;
+    the membership guard makes the duplicate a no-op (``dup-commit`` has
+    no capacity effect), so MODL proves ``flush-at-most-once`` — capacity
+    is consumed exactly once per committed pod and returned exactly once
+    on release, whatever the delivery interleaving.
     """
 
     node_names: tuple[str, ...]

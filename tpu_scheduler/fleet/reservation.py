@@ -46,12 +46,34 @@ def reservation_lease_name(gang: str, shard: int) -> str:
     return f"{GANG_RESERVATION_PREFIX}{gang}-{shard}"
 
 
+# protocol: machine gang-reservation field=counts[] states=RESERVATION_STATES init=reserved
+# protocol: reserved -> committed | aborted | expired
+# protocol: var leases: 0..2 = 2
+# protocol: var alive: 0..1 = 1
+# protocol: action commit: reserved -> committed requires alive == 1
+# protocol: action abort: reserved -> aborted requires alive == 1
+# protocol: action release: committed -> committed requires leases > 0 effect leases -= 1
+# protocol: action release-abort: aborted -> aborted requires leases > 0 effect leases -= 1
+# protocol: env crash: reserved -> reserved effect alive = 0
+# protocol: env ttl: reserved -> expired requires alive == 0 effect leases = 0
+# protocol: env ttl-sweep: committed -> committed requires leases > 0 effect leases -= 1
+# protocol: env ttl-sweep-abort: aborted -> aborted requires leases > 0 effect leases -= 1
+# protocol: invariant expired-clean: state == expired implies leases == 0
+# protocol: progress no-orphaned-reservation: leases > 0
 class GangReservationLedger:
     """Per-replica ledger of in-flight gang reservations.
 
     Main-thread state driven from the controller's cycle loop (the ShardSet
     stance): reserve/renew/commit/abort all happen between solve phases, and
     the injected clock keeps simulated replicas bit-identical.
+
+    The ``# protocol:`` contract above binds ``counts`` (keyed-counter
+    form: every subscript literal must be a RESERVATION_STATES member, and
+    every member must appear — one source of truth) and models one
+    reservation holding two peer-shard leases.  MODL proves
+    ``no-orphaned-reservation`` (a held lease always has an enabled
+    release or TTL path — never wedged) and ``expired-clean`` (the TTL
+    reclaim leaves nothing behind), including across owner crash.
     """
 
     def __init__(self, api, identity: str, lease_duration: float, clock):

@@ -51,10 +51,38 @@ __all__ = ["REBALANCE_CORDON_LABEL", "Rebalancer"]
 REBALANCE_CORDON_LABEL = "rebalance.tpu-scheduler/drained"
 
 
+# protocol: machine drain-migration field=- init=verify
+# protocol: states: verify | unbound | cordoned | replaced | aborted
+# protocol: verify -> unbound | aborted
+# protocol: unbound -> cordoned | replaced | aborted
+# protocol: cordoned -> replaced | aborted
+# protocol: var bound: 0..1 = 1
+# protocol: var pending: 0..1 = 0
+# protocol: action unbind: verify -> unbound requires bound == 1 effect bound = 0, pending = 1
+# protocol: action skip: verify -> aborted
+# protocol: action cordon: unbound -> cordoned
+# protocol: action replace: unbound -> replaced requires pending == 1 effect pending = 0, bound = 1
+# protocol: action replace-cordoned: cordoned -> replaced requires pending == 1 effect pending = 0, bound = 1
+# protocol: env crash: verify -> aborted
+# protocol: env crash-unbound: unbound -> aborted
+# protocol: env crash-cordoned: cordoned -> aborted
+# protocol: action rescue: aborted -> aborted requires pending == 1 effect pending = 0, bound = 1
+# protocol: invariant never-orphaned: bound == 1 or pending == 1
+# protocol: progress pending-replaced: pending == 1
 class Rebalancer:
     """Owns the cadence, throttles, in-flight ledger, and lifetime stats.
     Written only by the owning scheduler's cycle loop; the HTTP debug
-    thread reads GIL-atomic copies via ``stats()``."""
+    thread reads GIL-atomic copies via ``stats()``.
+
+    The ``# protocol:`` contract above models one victim pod through the
+    verify→unbind→cordon→re-place drain (model-only: per-pod state lives
+    in the ``inflight`` ledger rows, not a field).  The unbind CAS
+    atomically turns a bound pod into a pending one (``effect bound = 0,
+    pending = 1``), so MODL proves ``never-orphaned`` — at every reachable
+    point, including a scheduler crash between any two steps, the pod is
+    either still bound or pending for the normal scheduling path
+    (``rescue``) to place.  ``pending-replaced`` proves a pending victim
+    can never wedge."""
 
     def __init__(self, config: RebalanceConfig | None = None, metrics=None):
         self.config = config or RebalanceConfig()

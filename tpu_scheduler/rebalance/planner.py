@@ -38,6 +38,7 @@ MIGRATION_REASONS = (
     "rack-defrag",
 )
 
+# protocol: taxonomy SKIP_REASONS producers=_skip,throttle_reason scope=tpu_scheduler/rebalance
 SKIP_REASONS = (
     "breaker-open",
     "slo-burn",

@@ -204,8 +204,34 @@ class BreakerConfig:
     probe_successes: int = 2  # consecutive probe successes that close
 
 
+# protocol: machine circuit-breaker field=state states=STATES init=closed
+# protocol: closed -> open
+# protocol: open -> half-open
+# protocol: half-open -> closed | open
+# protocol: var pending: 0..1 = 1
+# protocol: var overlaid: 0..1 = 0
+# protocol: var placed: 0..2 = 0
+# protocol: action trip: closed -> open
+# protocol: env timeout: open -> half-open
+# protocol: action probe-fail: half-open -> open
+# protocol: action probe-ok: half-open -> closed
+# protocol: action bind: closed -> closed requires pending == 1 and overlaid == 0 effect pending = 0, placed += 1
+# protocol: action defer: open -> open requires pending == 1 and overlaid == 0 effect overlaid = 1, placed += 1
+# protocol: action flush: half-open -> half-open requires overlaid == 1 effect pending = 0, overlaid = 0
+# protocol: action flush-closed: closed -> closed requires overlaid == 1 effect pending = 0, overlaid = 0
+# protocol: invariant no-double-bind: placed <= 1
+# protocol: invariant overlay-pending: overlaid == 1 implies pending == 1
+# protocol: progress deferred-flushable: overlaid == 1
 class CircuitBreaker:
     """Closed→open→half-open breaker over API-server health.
+
+    The ``# protocol:`` contract above is the machine's source of truth:
+    the PROT pass proves every state write/compare in this class stays
+    inside it (the timed open→half-open promotion in ``mode()`` is a
+    DECLARED env transition, not a checker special case), and the MODL
+    pass composes it with one pod's bind/defer/flush lifecycle to prove
+    the assumed-overlay can never double-place (``no-double-bind``) and a
+    deferred pod can always still flush (``deferred-flushable``).
 
     Fed every bind POST outcome, pipelined-drain outcome, and watch
     sync verdict.  ``mode()`` is the controller's per-call gate: it also

@@ -97,8 +97,31 @@ class ShardDelta:
     resized: bool = False  # a newer shard-map generation was adopted this round (fleet/resize.py)
 
 
+# protocol: machine shard-lease field=- init=free
+# protocol: states: free | held | expired
+# protocol: free -> held
+# protocol: held -> free | expired
+# protocol: expired -> held
+# protocol: var released: 0..1 = 0
+# protocol: action acquire: free -> held requires released == 0
+# protocol: action renew: held -> held requires released == 0
+# protocol: action release: held -> free effect released = 1
+# protocol: env crash-ttl: held -> expired
+# protocol: action takeover: expired -> held effect released = 0
+# protocol: env thread-renew: free -> held requires released == 0
+# protocol: invariant release-is-final: released == 1 implies state == free
+# protocol: progress reclaimable: state == expired
 class ShardSet:
     """Per-replica shard-ownership ledger over the lease API.
+
+    The ``# protocol:`` contract above models one shard's lease from this
+    replica's point of view (model-only, ``field=-``: the state lives in
+    the API server, not in a field here).  ``release-is-final`` is the
+    PR-7 race, now proved instead of regression-sampled: after a voluntary
+    ``release_all`` the stale renew thread (``thread-renew``) must never
+    re-acquire — only a fresh ``takeover`` by a live replica clears the
+    released latch.  ``reclaimable`` proves a crash-expired lease can
+    always be taken over.
 
     ``api`` needs ``acquire_lease(name, holder, duration)``,
     ``release_lease(name, holder)``, and ``get_lease(name)`` — the surface
