@@ -35,37 +35,39 @@ FILE_SCOPED = True
 _AMBIGUOUS = ("l", "O", "I")
 
 
-class _ImportUsage(ast.NodeVisitor):
-    """Collect imported names and every name usage."""
-
-    def __init__(self):
-        self.imports: dict[str, int] = {}  # bound name -> lineno
-        self.used: set[str] = set()
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = a.asname or a.name.split(".")[0]
-            self.imports[name] = node.lineno
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return  # future imports act by existing, never by reference
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imports[a.asname or a.name] = node.lineno
-
-    def visit_Name(self, node):
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-
-
-class _FunctionScopeChecks(ast.NodeVisitor):
+class _FunctionScopeChecks:
     """Per-function rules: F841 unused locals, B006 mutable defaults."""
 
     def __init__(self, relpath: str, findings: list[Finding]):
         self.relpath = relpath
         self.findings = findings
+        self._reads_cache: dict[int, set[str]] = {}
+
+    def _subtree_reads(self, root) -> set:
+        """Every name READ in the subtree (Name Loads plus AugAssign
+        targets, which mutate in place).  Memoized at nested-scope roots so
+        an enclosing function reuses its inner functions' sets instead of
+        re-walking them — the walk stays linear in the module, not
+        quadratic in nesting depth."""
+        cached = self._reads_cache.get(id(root))
+        if cached is not None:
+            return cached
+        reads: set[str] = set()
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            if n is not root and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+                reads |= self._subtree_reads(n)
+                continue
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Load):
+                    reads.add(n.id)
+                continue  # Name nodes are leaves bar the ctx
+            if isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Name):
+                reads.add(n.target.id)
+            stack.extend(ast.iter_child_nodes(n))
+        self._reads_cache[id(root)] = reads
+        return reads
 
     def _check_function(self, node):
         # B006 — mutable literals/constructors as parameter defaults.
@@ -95,17 +97,11 @@ class _FunctionScopeChecks(ast.NodeVisitor):
                 yield from own_scope(child)
 
         assigned: dict[str, int] = {}
-        read: set[str] = set()
+        # READS (including AugAssign in-place mutation — the
+        # ledger-accumulator pattern is a use, not a dead store) come from
+        # the full subtree so a closure's use of an outer local counts.
+        read: set[str] = self._subtree_reads(node)
         exempt: set[str] = set()
-        for sub in ast.walk(node):
-            if sub is node:
-                continue
-            if isinstance(sub, ast.AugAssign) and isinstance(sub.target, ast.Name):
-                # x += v mutates x in place — a use, not a dead store (the
-                # ledger-accumulator pattern).
-                read.add(sub.target.id)
-            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
-                read.add(sub.id)
         for sub in own_scope(node):
             if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
                 assigned.setdefault(sub.id, sub.lineno)
@@ -137,63 +133,68 @@ class _FunctionScopeChecks(ast.NodeVisitor):
                 continue
             self.findings.append(Finding("F841", self.relpath, lineno, f"local variable '{name}' assigned but never used"))
 
-    def visit_FunctionDef(self, node):
-        self._check_function(node)
-        self.generic_visit(node)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-
-def _comparison_checks(tree: ast.Module, relpath: str, findings: list[Finding]) -> None:
-    """E711 (== None) / E712 (== True/False) — either side of the ==."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Compare):
-            continue
-        # Operand i of op i is left for i == 0, else comparators[i-1]; check
-        # both sides so Yoda comparisons (None == x) are caught too.
-        operands = [node.left] + list(node.comparators)
-        for i, op in enumerate(node.ops):
-            if not isinstance(op, (ast.Eq, ast.NotEq)):
-                continue
-            for side in (operands[i], operands[i + 1]):
-                if not isinstance(side, ast.Constant):
-                    continue
-                if side.value is None:
-                    findings.append(Finding("E711", relpath, node.lineno, "comparison to None (use 'is'/'is not')"))
-                elif side.value is True or side.value is False:
-                    findings.append(
-                        Finding("E712", relpath, node.lineno, f"comparison to {side.value} (use the value or 'is')")
-                    )
-
-
-def _ast_checks(tree: ast.Module, relpath: str, findings: list[Finding]) -> None:
-    """E722 bare except + E741 ambiguous single-char bindings."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(Finding("E722", relpath, node.lineno, "bare 'except:' — name the exception"))
-        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store) and node.id in _AMBIGUOUS:
-            findings.append(Finding("E741", relpath, node.lineno, f"ambiguous variable name '{node.id}'"))
-        elif isinstance(node, ast.arg) and node.arg in _AMBIGUOUS:
-            findings.append(Finding("E741", relpath, node.lineno, f"ambiguous argument name '{node.arg}'"))
-
 
 def _check_module(f: SourceFile, findings: list[Finding]) -> None:
     tree = f.tree
     assert tree is not None
-    _ast_checks(tree, f.rel, findings)
+    rel = f.rel
+    imports: dict[str, int] = {}  # bound name -> lineno
+    used: set[str] = set()
+    scopes = _FunctionScopeChecks(rel, findings)
+    # ONE walk of the module drives every per-node rule — E722/E741
+    # (bare except, ambiguous bindings), E711/E712 (None/bool compares,
+    # both sides so Yoda comparisons are caught too), import collection
+    # for F401, and the per-function scope checks (B006/F841) — these
+    # used to be four separate full traversals of the same tree.
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                used.add(node.id)
+            elif isinstance(node.ctx, ast.Store) and node.id in _AMBIGUOUS:
+                findings.append(Finding("E741", rel, node.lineno, f"ambiguous variable name '{node.id}'"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes._check_function(node)
+        elif isinstance(node, ast.Compare):
+            # Operand i of op i is left for i == 0, else comparators[i-1].
+            operands = [node.left] + list(node.comparators)
+            for i, op in enumerate(node.ops):
+                if not isinstance(op, (ast.Eq, ast.NotEq)):
+                    continue
+                for side in (operands[i], operands[i + 1]):
+                    if not isinstance(side, ast.Constant):
+                        continue
+                    if side.value is None:
+                        findings.append(Finding("E711", rel, node.lineno, "comparison to None (use 'is'/'is not')"))
+                    elif side.value is True or side.value is False:
+                        findings.append(
+                            Finding("E712", rel, node.lineno, f"comparison to {side.value} (use the value or 'is')")
+                        )
+        elif isinstance(node, ast.arg):
+            if node.arg in _AMBIGUOUS:
+                findings.append(Finding("E741", rel, node.lineno, f"ambiguous argument name '{node.arg}'"))
+        elif isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                findings.append(Finding("E722", rel, node.lineno, "bare 'except:' — name the exception"))
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            # future imports act by existing, never by reference
+            if node.module != "__future__":
+                for a in node.names:
+                    if a.name != "*":
+                        imports[a.asname or a.name] = node.lineno
     exported = set(module_all(tree))
-    usage = _ImportUsage()
-    usage.visit(tree)
     # Names referenced in string annotations / docstring doctests are out
     # of scope; __init__ re-exports are legitimate when listed in __all__.
     is_init = f.path.name == "__init__.py"
-    for name, lineno in usage.imports.items():
-        if name in usage.used or name == "_":
+    for name, lineno in imports.items():
+        if name in used or name == "_":
             continue
         if is_init or name in exported:
             continue
-        # A conservative text check catches usage forms the AST visitor
-        # does not model (e.g. inside f-string format specs).
+        # A conservative text check catches usage forms the AST walk does
+        # not model (e.g. inside f-string format specs).
         if len(re.findall(rf"\b{re.escape(name)}\b", f.text)) > 1:
             continue
         findings.append(Finding("F401", f.rel, lineno, f"'{name}' imported but unused"))
@@ -201,8 +202,6 @@ def _check_module(f: SourceFile, findings: list[Finding]) -> None:
     for name in exported:
         if name not in defined:
             findings.append(Finding("F822", f.rel, 1, f"undefined name '{name}' in __all__"))
-    _FunctionScopeChecks(f.rel, findings).visit(tree)
-    _comparison_checks(tree, f.rel, findings)
 
 
 def run(ctx: Context) -> list[Finding]:

@@ -759,18 +759,34 @@ def _literal_args(node: ast.expr) -> list:
     return []
 
 
-def _check_taxonomy(tax: TaxonomySpec, ctx: Context) -> list:
+def _check_taxonomies(taxes: list, ctx: Context) -> list:
+    """All taxonomies at once — ONE ast.walk per in-scope file (the
+    taxonomies all scope tpu_scheduler, so per-taxonomy walks would
+    re-traverse the whole tree once per declaration)."""
     findings: list[Finding] = []
-    members = set(tax.members)
-    prefix = tax.scope.rstrip("/") + "/"
-    in_scope = [f for f in ctx.parsed() if f.rel.startswith(prefix) or f.rel == tax.scope]
-    used: set = set()
-    for f in in_scope:
+    # (tax, scope prefix, member set, used set) per declaration.
+    infos = [(tax, tax.scope.rstrip("/") + "/", set(tax.members), set()) for tax in taxes]
+    for f in ctx.parsed():
+        in_scope = [
+            row
+            for row in infos
+            if (f.rel.startswith(row[1]) or f.rel == row[0].scope)
+            # a producer call/def needs the literal name in the source —
+            # the text probe skips walking the (many) files that lack all
+            # of them
+            and any(p in f.text for p in row[0].producers)
+        ]
+        if not in_scope:
+            continue
         for node in ast.walk(f.tree):
             if isinstance(node, ast.Call):
                 fn = node.func
                 name = fn.id if isinstance(fn, ast.Name) else (fn.attr if isinstance(fn, ast.Attribute) else None)
-                if name in tax.producers and node.args:
+                if name is None or not node.args:
+                    continue
+                for tax, _, members, used in in_scope:
+                    if name not in tax.producers:
+                        continue
                     for lit in _literal_args(node.args[0]):
                         used.add(lit)
                         if lit not in members:
@@ -780,24 +796,29 @@ def _check_taxonomy(tax: TaxonomySpec, ctx: Context) -> list:
                                     f"'{lit}' passed to {name}() is not in {tax.const} ({tax.rel})",
                                 )
                             )
-            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name in tax.producers:
-                for ret in ast.walk(node):
-                    if isinstance(ret, ast.Return) and ret.value is not None:
-                        for lit in _literal_args(ret.value):
-                            used.add(lit)
-                            if lit not in members:
-                                findings.append(
-                                    Finding(
-                                        "PROT", f.rel, ret.lineno,
-                                        f"'{lit}' returned by {node.name}() is not in {tax.const} ({tax.rel})",
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for tax, _, members, used in in_scope:
+                    if node.name not in tax.producers:
+                        continue
+                    for ret in ast.walk(node):
+                        if isinstance(ret, ast.Return) and ret.value is not None:
+                            for lit in _literal_args(ret.value):
+                                used.add(lit)
+                                if lit not in members:
+                                    findings.append(
+                                        Finding(
+                                            "PROT", f.rel, ret.lineno,
+                                            f"'{lit}' returned by {node.name}() is not in {tax.const} ({tax.rel})",
+                                        )
                                     )
-                                )
     # Coverage direction only when the whole scope is loaded (sound under
     # --changed-only: a partial context skips it rather than lying).
-    scope_dir = ctx.root / tax.scope
-    if scope_dir.is_dir():
+    loaded = {f.rel for f in ctx.files}
+    for tax, _, _, used in infos:
+        scope_dir = ctx.root / tax.scope
+        if not scope_dir.is_dir():
+            continue
         on_disk = {p.relative_to(ctx.root).as_posix() for p in scope_dir.rglob("*.py")}
-        loaded = {f.rel for f in ctx.files}
         if on_disk <= loaded:
             for m in tax.members:
                 if m not in used:
@@ -815,6 +836,7 @@ def _check_taxonomy(tax: TaxonomySpec, ctx: Context) -> list:
 
 def run(ctx: Context) -> list:
     findings: list[Finding] = []
+    all_taxes: list = []
     for f in ctx.parsed():
         machines, errs = collect_machines(f)
         findings.extend(errs)
@@ -823,6 +845,7 @@ def run(ctx: Context) -> list:
                 findings.extend(_ClassChecker(spec, cls, f).check())
         taxes, errs = collect_taxonomies(f)
         findings.extend(errs)
-        for tax in taxes:
-            findings.extend(_check_taxonomy(tax, ctx))
+        all_taxes.extend(taxes)
+    if all_taxes:
+        findings.extend(_check_taxonomies(all_taxes, ctx))
     return findings

@@ -112,9 +112,37 @@ def load_catalog(path: str) -> tuple[InstanceSKU, ...]:
     return tuple(skus)
 
 
+# protocol: machine provider-node field=state init=provisioning
+# protocol: states: provisioning | ready | reclaiming | deleted
+# protocol: provisioning -> ready | deleted
+# protocol: ready -> reclaiming | deleted
+# protocol: reclaiming -> deleted
+# protocol: var pods: 0..2 = 0
+# protocol: action join: provisioning -> ready
+# protocol: env bind: ready -> ready effect pods += 1
+# protocol: env notice: ready -> reclaiming
+# protocol: env bind-raced: reclaiming -> reclaiming effect pods += 1
+# protocol: action unbind: reclaiming -> reclaiming requires pods >= 1 effect pods -= 1
+# protocol: action kill: reclaiming -> deleted requires pods == 0
+# protocol: action delete: ready -> deleted requires pods == 0
+# protocol: action delete-pending: provisioning -> deleted
+# protocol: invariant delete-only-when-empty: state == deleted implies pods == 0
+# protocol: progress reclaim-completes: state == reclaiming
 class SimCloudProvider:
     """The deterministic cloud: catalog, quotas, provisioning queue, spot
     reclaim schedule, and the node-hour cost ledger.
+
+    The ``# protocol:`` contract above models one provider node's
+    lifecycle composed with the scheduler environment: ``bind`` is a pod
+    landing on the node (it keeps landing right through the reclaim grace
+    — ``bind-raced`` is the bind that slips in under ``_kill``'s unbind
+    loop), ``notice`` is the spot reclaim condemning the node, and both
+    delete paths (``kill`` at the reclaim deadline, ``delete`` at
+    scale-down) carry the structural guard the docstrings promise: a node
+    is deleted only when verifiably empty — MODL proves
+    ``delete-only-when-empty`` holds in every reachable composite state,
+    and that a reclaiming node can always make progress (unbind until
+    empty, then kill).
 
     ONE instance per cluster (shared across sharded replicas — a shard-0
     takeover inherits in-flight provisions and reclaim deadlines).  All
