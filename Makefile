@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke fuzz-smoke clean
 
-check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke
+check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke fuzz-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -89,6 +89,13 @@ elasticity-smoke:
 # bounds, inside a pinned wall budget (scripts/protocol_smoke.py).
 protocol-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.protocol_smoke
+
+# The chaos-fuzzer gate: every checked-in reproducer in tests/fuzz_corpus/
+# replays bit-identically, a pinned 24-plan seed-0 campaign finds zero
+# violations, and coverage reaches its (fault-op × state-facet) floor —
+# inside a pinned wall budget (scripts/fuzz_smoke.py).
+fuzz-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.fuzz_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

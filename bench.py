@@ -1129,6 +1129,47 @@ def elasticity_row(seed: int, scenarios=("diurnal-traffic", "flash-crowd-provisi
         return {}
 
 
+def fuzz_row(seed: int, budget: int = 16) -> dict:
+    """Chaos-fuzzer throughput evidence (tpu_scheduler/sim/fuzz): a pinned
+    ``budget``-plan campaign from one seed — seconds per judged plan (the
+    search-loop cost, gated cross-round below), distinct (fault-op ×
+    state-facet) coverage pairs the campaign reaches, violations found
+    (expected 0 on a green tree), and the checked-in reproducer-corpus
+    size.  Plan generation and verdicts are deterministic in the seed;
+    only the wall clock is measured here, outside sim/."""
+    try:
+        from tpu_scheduler.sim.fuzz import CoverageMap, PlanGenerator, run_plan
+        from tpu_scheduler.sim.fuzz.corpus import load_corpus
+
+        corpus = load_corpus(os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests", "fuzz_corpus"))
+        coverage = CoverageMap()
+        gen = PlanGenerator(seed=seed, coverage=coverage)
+        violations_found = 0
+        t0 = time.perf_counter()
+        for i in range(budget):
+            plan = gen.next_plan(i)
+            _card, violations = run_plan(plan, seed=seed, coverage=coverage)
+            if violations:
+                violations_found += 1
+        wall = time.perf_counter() - t0
+        log(
+            f"fuzz: {budget} plans in {wall:.1f}s, {coverage.distinct()} coverage pairs "
+            f"({coverage.lease_pairs()} lease), {violations_found} violations, {len(corpus)} corpus entries"
+        )
+        return {
+            "fuzz_shape": f"{budget}plans",
+            "fuzz_seconds_per_plan": round(wall / budget, 4),
+            "fuzz_coverage_pairs": coverage.distinct(),
+            "fuzz_lease_coverage_pairs": coverage.lease_pairs(),
+            "fuzz_violations_found": violations_found,
+            "fuzz_corpus_entries": len(corpus),
+            "fuzz_wall_seconds": round(wall, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — evidence row, never the headline
+        log(f"fuzz row skipped: {type(e).__name__}: {str(e)[:200]}")
+        return {}
+
+
 def topology_row(backend, profile, pods: int, nodes: int, seed: int) -> dict:
     """Topology-aware gang placement at a real shape (ROADMAP "topology- and
     gang-aware placement"): a gang-heavy workload (~35% of pods in 4-8
@@ -1541,6 +1582,7 @@ def apply_secondary_regression_checks(out: dict, platform: str, repo_dir: str, t
         ("policy_delta_cycle_seconds_min", "policy_shape"),
         ("latency_p99_ttb_s_max", "latency_shape"),
         ("elasticity_joint_objective_max", "elasticity_shape"),
+        ("fuzz_seconds_per_plan", "fuzz_shape"),
     ):
         val = out.get(field)
         if val is None:
@@ -1598,6 +1640,7 @@ def main() -> int:
     ap.add_argument("--no-multi-replica-row", action="store_true")
     ap.add_argument("--no-elasticity-row", action="store_true")
     ap.add_argument("--no-multi-mesh-row", action="store_true")
+    ap.add_argument("--no-fuzz-row", action="store_true")
     ap.add_argument(
         "--sim-sweep-seeds",
         type=int,
@@ -1742,6 +1785,10 @@ def main() -> int:
         out.update(latency_row(args.seed))
     if not args.no_elasticity_row and _remaining() > 180:
         out.update(elasticity_row(args.seed))
+    # Coverage-guided chaos fuzzer (tpu_scheduler/sim/fuzz): seconds per
+    # judged plan + campaign coverage reach, gated cross-round below.
+    if not args.no_fuzz_row and _remaining() > 120:
+        out.update(fuzz_row(args.seed))
     # Active-active sharded control plane: K-replica settle throughput +
     # crash-kill takeover latency in virtual time, gated cross-round below.
     if not args.no_multi_replica_row and _remaining() > 90:

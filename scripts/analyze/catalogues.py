@@ -59,6 +59,13 @@ ELAS  — every autoscaler skip reason / config knob (``autoscale/policy.
         and elasticity-exercising sim scenario (a registry entry passing
         ``autoscale=``) must appear in the README "Autoscaling &
         elasticity" catalogue.
+FUZZ  — every fault-op kind / plan-JSON field / base workload
+        (``sim/fuzz/plan.FAULT_OPS``, ``PLAN_FIELDS``, ``OP_FIELDS``,
+        ``BASE_WORKLOADS`` keys), coverage facet
+        (``sim/fuzz/coverage.STATE_FACETS``), corpus-entry field
+        (``sim/fuzz/corpus.ENTRY_FIELDS``), and convergence-scorecard field
+        (``sim/scorecard.CONVERGENCE_FIELDS``) must appear in the README
+        "Chaos fuzzing" catalogue.
 """
 
 from __future__ import annotations
@@ -82,6 +89,7 @@ CODES = {
     "LERN": "a policy objective component/observation field/action knob/search knob/artifact field missing from the README \"Learned policy & tuning\" catalogue",
     "LATN": "a time-to-bind waterfall segment/latency scorecard field missing from the README \"Latency & time-to-bind\" catalogue",
     "ELAS": "an autoscaler skip reason/config knob/catalog SKU/scorecard field/scenario missing from the README \"Autoscaling & elasticity\" catalogue",
+    "FUZZ": "a fault-op kind/plan field/base workload/coverage facet/corpus field/convergence field missing from the README \"Chaos fuzzing\" catalogue",
 }
 
 # Code→README direction only: a partial (--changed-only) context can merely
@@ -594,6 +602,56 @@ def _run_elas(ctx: Context) -> list[Finding]:
     ]
 
 
+def _run_fuzz(ctx: Context) -> list[Finding]:
+    tokens: list[tuple[str, str]] = []
+    for f in ctx.parsed():
+        if f.rel == "tpu_scheduler/sim/fuzz/plan.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if t.id == "FAULT_OPS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("fault op",)))
+                        elif t.id == "PLAN_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("plan field",)))
+                        elif t.id == "OP_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("plan op field",)))
+                        elif t.id == "BASE_WORKLOADS" and isinstance(node.value, ast.Dict):
+                            for k in node.value.keys:
+                                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                                    tokens.append(("fuzz base workload", k.value))
+        elif f.rel == "tpu_scheduler/sim/fuzz/coverage.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "STATE_FACETS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("coverage facet",)))
+        elif f.rel == "tpu_scheduler/sim/fuzz/corpus.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "ENTRY_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("corpus entry field",)))
+        elif f.rel == "tpu_scheduler/sim/scorecard.py":
+            for node in f.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "CONVERGENCE_FIELDS":
+                            tokens.extend(_topo_tuple_entries(node.value, ("convergence scorecard field",)))
+    return [
+        Finding(
+            "FUZZ",
+            "README.md",
+            1,
+            f"{kind} '{name}' exists in the chaos fuzzer but is missing from the README "
+            f"\"Chaos fuzzing\" catalogue",
+        )
+        for kind, name in sorted(set(tokens))
+        if name not in ctx.readme
+    ]
+
+
 def run(ctx: Context) -> list[Finding]:
     return (
         _run_metr(ctx)
@@ -609,4 +667,5 @@ def run(ctx: Context) -> list[Finding]:
         + _run_lern(ctx)
         + _run_latn(ctx)
         + _run_elas(ctx)
+        + _run_fuzz(ctx)
     )

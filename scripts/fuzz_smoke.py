@@ -1,0 +1,94 @@
+"""fuzz-smoke — the chaos fuzzer's standalone gate (make check).
+
+Runs a small pinned campaign (``PLANS`` seeded plans from seed 0) through
+the invariant oracle plus a full corpus replay, and asserts:
+
+  1. CORPUS — every checked-in reproducer in ``tests/fuzz_corpus/``
+     replays bit-identically (fingerprint, verdict, violations, pins);
+  2. GREEN — the pinned campaign finds zero violations (a finding here is
+     a real regression: the exact plan JSON is printed for shrinking);
+  3. COVERAGE — the campaign reaches at least ``MIN_PAIRS`` distinct
+     (fault-op × state-facet) pairs including ``MIN_LEASE_PAIRS`` lease
+     pairs (a collapsed generator or dead facet sampler fails here, not
+     silently);
+  4. BUDGET — campaign + replay inside ``BUDGET_SECONDS`` of wall clock.
+
+Off the tier-1 clock (a few seconds of wall); wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+BUDGET_SECONDS = 30.0
+PLANS = 24
+MIN_PAIRS = 30
+MIN_LEASE_PAIRS = 4
+CORPUS_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", "fuzz_corpus")
+
+
+def main() -> int:
+    from tpu_scheduler.sim.fuzz import CoverageMap, PlanGenerator, run_plan
+    from tpu_scheduler.sim.fuzz.corpus import load_corpus, replay_entry
+    from tpu_scheduler.utils.tracing import configure_logging
+
+    configure_logging("ERROR")
+    t0 = time.perf_counter()
+    ok = True
+
+    entries = load_corpus(CORPUS_DIR)
+    if not entries:
+        print(f"FAIL: no corpus entries under {CORPUS_DIR}", file=sys.stderr)
+        ok = False
+    for entry in entries:
+        good, problems, _card = replay_entry(entry)
+        print(f"corpus {entry['name']}: {'ok' if good else 'DRIFTED'} ({len(entry['plan'].ops)} ops)")
+        if not good:
+            for p in problems:
+                print(f"FAIL: corpus {entry['name']}: {p}", file=sys.stderr)
+            ok = False
+
+    coverage = CoverageMap()
+    gen = PlanGenerator(seed=0, coverage=coverage)
+    violations_found = 0
+    for i in range(PLANS):
+        plan = gen.next_plan(i)
+        _card, violations = run_plan(plan, seed=0, coverage=coverage)
+        if violations:
+            violations_found += 1
+            from tpu_scheduler.sim.fuzz import plan_to_json
+
+            print(
+                f"FAIL: {plan.plan_id} violated {violations} — shrink with "
+                f"`python -m tpu_scheduler.sim.cli fuzz`; plan: {plan_to_json(plan)}",
+                file=sys.stderr,
+            )
+    if violations_found:
+        ok = False
+
+    pairs = coverage.distinct()
+    lease = coverage.lease_pairs()
+    if pairs < MIN_PAIRS:
+        print(f"FAIL: {pairs} coverage pairs < {MIN_PAIRS} floor", file=sys.stderr)
+        ok = False
+    if lease < MIN_LEASE_PAIRS:
+        print(f"FAIL: {lease} lease coverage pairs < {MIN_LEASE_PAIRS} floor", file=sys.stderr)
+        ok = False
+
+    elapsed = time.perf_counter() - t0
+    print(
+        f"fuzz-smoke: {len(entries)} corpus entries, {PLANS} plans, "
+        f"{pairs} coverage pairs ({lease} lease), {violations_found} violations, {elapsed:.2f}s"
+    )
+    if elapsed > BUDGET_SECONDS:
+        print(f"FAIL: {elapsed:.2f}s > {BUDGET_SECONDS:.1f}s budget", file=sys.stderr)
+        ok = False
+    if ok:
+        print("fuzz-smoke: OK")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,9 +1,9 @@
 """protocol-smoke — the control plane's protocol-verification gate (make check).
 
-Model-checks every committed ``# protocol:`` spec (the six protocol
+Model-checks every committed ``# protocol:`` spec (the seven protocol
 sites: circuit breaker, shard leases, gang reservations, drain executor,
-provider lifecycle, placement ledger) against its declared crash/retry
-environment and asserts:
+provider lifecycle, placement ledger, fuzz plan lifecycle) against its
+declared crash/retry environment and asserts:
 
   1. COVERAGE — at least ``MIN_MACHINES`` machines parse out of the tree
      (a deleted or broken contract fails here, not silently);
@@ -25,7 +25,7 @@ import sys
 import time
 
 BUDGET_SECONDS = 5.0
-MIN_MACHINES = 6
+MIN_MACHINES = 7
 MAX_MACHINE_STATES = 256
 
 

@@ -501,6 +501,60 @@ def test_elas_real_tree_is_catalogued():
     assert not hits, "; ".join(h.render() for h in hits)
 
 
+def test_fuzz_drift_and_guard():
+    plan_mod = (
+        "tpu_scheduler/sim/fuzz/plan.py",
+        'FAULT_OPS = ("ghost-meteor",)\n'
+        'PLAN_FIELDS = ("ghost_plan_field",)\n'
+        'OP_FIELDS = ("ghost_op_field",)\n'
+        'BASE_WORKLOADS = {"ghost-base": None}\n'
+        'OTHER = ("not-a-fault",)\n',
+    )
+    cov_mod = (
+        "tpu_scheduler/sim/fuzz/coverage.py",
+        'STATE_FACETS = ("ghost-facet",)\n',
+    )
+    corpus_mod = (
+        "tpu_scheduler/sim/fuzz/corpus.py",
+        'ENTRY_FIELDS = ("ghost_entry_field",)\n',
+    )
+    sc_mod = (
+        "tpu_scheduler/sim/scorecard.py",
+        'CONVERGENCE_FIELDS = ("ghost_convergence_field",)\nSCORECARD_FIELDS = ("simc_business",)\n',
+    )
+    hits = rule_hits(catalogues.run(make_ctx(plan_mod, cov_mod, corpus_mod, sc_mod, readme="")), "FUZZ")
+    # simc_business belongs to SIMC; OTHER is not fuzz catalogue surface.
+    assert {h.message.split("'")[1] for h in hits} == {
+        "ghost-meteor",
+        "ghost_plan_field",
+        "ghost_op_field",
+        "ghost-base",
+        "ghost-facet",
+        "ghost_entry_field",
+        "ghost_convergence_field",
+    }
+    ok = (
+        "ghost-meteor ghost_plan_field ghost_op_field ghost-base "
+        "ghost-facet ghost_entry_field ghost_convergence_field"
+    )
+    assert not rule_hits(catalogues.run(make_ctx(plan_mod, cov_mod, corpus_mod, sc_mod, readme=ok)), "FUZZ")
+
+
+def test_fuzz_real_tree_is_catalogued():
+    files = load_files(
+        [
+            "tpu_scheduler/sim/fuzz/plan.py",
+            "tpu_scheduler/sim/fuzz/coverage.py",
+            "tpu_scheduler/sim/fuzz/corpus.py",
+            "tpu_scheduler/sim/scorecard.py",
+        ]
+    )
+    readme = (ROOT / "README.md").read_text()
+    ctx = Context(files=files, root=ROOT, readme=readme)
+    hits = rule_hits(catalogues.run(ctx), "FUZZ")
+    assert not hits, "; ".join(h.render() for h in hits)
+
+
 def test_anlz_drift_and_guard():
     codes = sorted(all_codes())
     partial_readme = " ".join(c for c in codes if c != "DTRM")
@@ -1493,7 +1547,7 @@ def test_prot_real_tree_is_clean_with_all_six_sites():
         taxes.extend(tx)
     assert {m.name for m in machines} >= {
         "circuit-breaker", "shard-lease", "gang-reservation",
-        "drain-migration", "provider-node", "placement-ledger",
+        "drain-migration", "provider-node", "placement-ledger", "fuzz-plan",
     }
     assert len(taxes) >= 3
     hits = rule_hits(protocol.run(ctx), "PROT")

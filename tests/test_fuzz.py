@@ -1,0 +1,266 @@
+"""Coverage-guided chaos fuzzer (sim/fuzz/): corpus replay bit-identity,
+generator determinism, shrinker minimality, coverage-map accounting, the
+end-state convergence gate (TP + FP-guard), and the lease-fault chaos
+surface composed with failover — the acceptance criteria of the
+chaos-fuzzer issue."""
+
+import json
+import os
+from dataclasses import replace
+
+import pytest
+
+from tpu_scheduler.sim import run_scenario
+from tpu_scheduler.sim.fuzz import (
+    FAULT_OPS,
+    STATE_FACETS,
+    CoverageMap,
+    FaultOp,
+    FaultPlan,
+    PlanGenerator,
+    compile_plan,
+    plan_from_json,
+    plan_to_json,
+    run_plan,
+    shrink_plan,
+)
+from tpu_scheduler.sim.fuzz.corpus import ENTRY_FIELDS, load_corpus, replay_entry
+from tpu_scheduler.sim.fuzz.plan import BASE_WORKLOADS, MAX_OPS, OP_FIELDS, PLAN_FIELDS
+from tpu_scheduler.sim.scenarios import Scenario
+from tpu_scheduler.sim.workload import WorkloadSpec
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "fuzz_corpus")
+
+
+# -- corpus replay (the forever-regressions) --------------------------------
+
+
+def test_corpus_entries_replay_bit_identically():
+    entries = load_corpus(CORPUS_DIR)
+    assert entries, "the reproducer corpus must not be empty"
+    for entry in entries:
+        ok, problems, card = replay_entry(entry)
+        assert ok, f"corpus entry {entry['name']} drifted: {problems}"
+        # Every checked-in reproducer is shrunk: at most MAX_OPS fault ops.
+        assert 1 <= len(entry["plan"].ops) <= MAX_OPS
+        assert card["fingerprint"] == entry["expect"]["fingerprint"]
+
+
+def test_corpus_files_carry_the_closed_entry_schema():
+    for fname in sorted(os.listdir(CORPUS_DIR)):
+        if not fname.endswith(".json"):
+            continue
+        with open(os.path.join(CORPUS_DIR, fname), encoding="utf-8") as fh:
+            raw = json.load(fh)
+        assert set(ENTRY_FIELDS) <= set(raw), f"{fname} missing entry fields"
+        assert tuple(raw["plan"][k] is not None for k in PLAN_FIELDS), fname
+        for op in raw["plan"]["ops"]:
+            assert tuple(op) == tuple(sorted(OP_FIELDS)) or set(op) == set(OP_FIELDS), fname
+
+
+def test_lease_outage_credit_regression_pins_the_oracle_fix():
+    """The fuzzer-found bug: without the hard-lease-outage credit the
+    physically-optimal takeover (blocked by a total lease-500 window) was
+    judged an availability failure.  The corpus entry pins latency > bound
+    but ok=True via the credit."""
+    entries = {e["name"]: e for e in load_corpus(CORPUS_DIR)}
+    entry = entries["lease-outage-takeover-credit"]
+    _ok, _problems, card = replay_entry(entry)
+    a = card["availability"]
+    assert a["max_takeover_latency_s"] > a["takeover_bound_s"]
+    assert a["lease_outage_credit_s"] > 0
+    assert a["ok"] and card["pass"]
+
+
+# -- generator determinism ---------------------------------------------------
+
+
+def test_generator_same_seed_same_plans():
+    g1 = PlanGenerator(7, CoverageMap())
+    g2 = PlanGenerator(7, CoverageMap())
+    plans1 = [plan_to_json(g1.next_plan(i)) for i in range(8)]
+    plans2 = [plan_to_json(g2.next_plan(i)) for i in range(8)]
+    assert plans1 == plans2
+    g3 = PlanGenerator(8, CoverageMap())
+    assert [plan_to_json(g3.next_plan(i)) for i in range(8)] != plans1
+
+
+def test_generated_plans_are_well_formed():
+    gen = PlanGenerator(3, CoverageMap())
+    for i in range(12):
+        plan = gen.next_plan(i)
+        assert plan.base in BASE_WORKLOADS
+        assert 2 <= len(plan.ops) <= MAX_OPS
+        assert sum(1 for op in plan.ops if op.kind == "replica-kill") <= 1
+        for op in plan.ops:
+            assert op.kind in FAULT_OPS
+        # Serde round-trips exactly.
+        assert plan_from_json(plan_to_json(plan)) == plan
+        # Compiles to an ordinary (unregistered) Scenario.
+        sc = compile_plan(plan)
+        assert sc.convergence_required and sc.replicas == 2
+
+
+def test_plan_json_rejects_unknown_ops_and_oversized_plans():
+    plan = FaultPlan(plan_id="p", base="mixed", duration=20.0, ops=(FaultOp("bind-500", 2.0, 6.0, 0.5),))
+    raw = json.loads(plan_to_json(plan))
+    raw["ops"][0]["kind"] = "meteor-strike"
+    with pytest.raises(ValueError):
+        plan_from_json(json.dumps(raw))
+    raw["ops"] = [{"kind": "bind-500", "t0": 1.0, "t1": 2.0, "magnitude": 0.5}] * (MAX_OPS + 1)
+    with pytest.raises(ValueError):
+        plan_from_json(json.dumps(raw))
+
+
+# -- shrinker minimality -----------------------------------------------------
+
+
+def test_shrinker_reduces_to_minimal_reproducer():
+    """Synthetic judge: the 'violation' reproduces iff some lease-500 op has
+    magnitude >= 0.5.  A 5-op plan must shrink to exactly that one op at the
+    weakest reproducing magnitude — every probe deterministic, no sim runs."""
+    plan = FaultPlan(
+        plan_id="shrink-me",
+        base="mixed",
+        duration=24.0,
+        ops=(
+            FaultOp("brownout", 3.0, 9.0, 1.0),
+            FaultOp("lease-500", 5.0, 15.0, 1.0),
+            FaultOp("watch-drop", 6.0, 12.0, 0.75),
+            FaultOp("node-flap", 8.0, 8.0, 0.5),
+            FaultOp("replica-kill", 10.0, 10.0, 0.25),
+        ),
+    )
+    probes = []
+
+    def judge(p):
+        probes.append(p)
+        hit = any(op.kind == "lease-500" and op.magnitude >= 0.5 for op in p.ops)
+        return ["boom"] if hit else []
+
+    minimal = shrink_plan(plan, 0, run=judge)
+    assert len(minimal.ops) == 1
+    assert minimal.ops[0].kind == "lease-500"
+    assert minimal.ops[0].magnitude == 0.5  # halved from 1.0, floor of reproduction
+    assert minimal.ops[0].t1 - minimal.ops[0].t0 == 2.0  # window shrunk to the floor
+    assert judge(minimal) == ["boom"]
+    assert len(probes) > 5  # it actually searched
+
+
+def test_shrinker_returns_passing_plans_unchanged():
+    plan = FaultPlan(plan_id="fine", base="mixed", duration=20.0, ops=(FaultOp("bind-500", 2.0, 6.0, 0.5),))
+    assert shrink_plan(plan, 0, run=lambda p: []) == plan
+
+
+# -- coverage-map accounting -------------------------------------------------
+
+
+def test_coverage_map_accounting():
+    cov = CoverageMap()
+    assert cov.distinct() == 0 and cov.lease_pairs() == 0
+    assert cov.unseen("lease-500") == len(STATE_FACETS)
+    cov.record("lease-500", ("breaker-closed", "fleet-full"))
+    cov.record("lease-500", ("breaker-closed", "fleet-degraded"))
+    cov.record("bind-500", ("breaker-open",))
+    assert cov.distinct() == 4
+    assert cov.lease_pairs() == 3
+    assert cov.unseen("lease-500") == len(STATE_FACETS) - 3
+    assert cov.unseen("bind-500") == len(STATE_FACETS) - 1
+    # Repeat pairs count but stay one distinct pair.
+    cov.record("bind-500", ("breaker-open",))
+    assert cov.distinct() == 4
+    assert cov.to_json() == [
+        ["bind-500", "breaker-open", 2],
+        ["lease-500", "breaker-closed", 2],
+        ["lease-500", "fleet-degraded", 1],
+        ["lease-500", "fleet-full", 1],
+    ]
+
+
+def test_oracle_fills_coverage_and_is_deterministic():
+    plan = FaultPlan(
+        plan_id="cov",
+        base="mixed",
+        duration=18.0,
+        ops=(FaultOp("lease-refused", 4.0, 9.0, 0.75), FaultOp("watch-drop", 6.0, 11.0, 0.5)),
+    )
+    cov = CoverageMap()
+    card1, viol1 = run_plan(plan, 0, cov)
+    card2, viol2 = run_plan(plan, 0)
+    assert card1["fingerprint"] == card2["fingerprint"]  # bit-identical re-run
+    assert viol1 == viol2 == []
+    # Both ops activated under the sampled facets: one pair per facet axis.
+    assert cov.distinct() == 2 * 5
+    assert cov.lease_pairs() == 5
+
+
+# -- the end-state convergence gate ------------------------------------------
+
+
+def _mini_scenario(**kw) -> Scenario:
+    base = dict(
+        name="fuzz-mini",
+        description="convergence gate unit scenario",
+        duration=10.0,
+        workload=WorkloadSpec(initial_nodes=6, arrival_rate=2.0, lifetime_mean_s=6.0),
+        replicas=2,
+        shards=4,
+        drain_grace_cycles=15,
+        convergence_required=True,
+    )
+    base.update(kw)
+    return Scenario(**base)
+
+
+def test_convergence_true_positive_draining_run_quiesces():
+    card = run_scenario(_mini_scenario(), seed=0)
+    c = card["convergence"]
+    assert c["required"] and c["ok"], json.dumps(c)
+    assert c["pending_final"] == 0 and c["deferred_residue"] == 0 and c["stale_leases"] == 0
+    assert c["settle_overtime_s"] <= c["settle_bound_s"]
+    assert card["pass"]
+
+
+def test_convergence_false_positive_guard_wedged_backlog_fails_the_run():
+    """Forever-pods on an oversubscribed fleet can never drain: the
+    convergence gate must call that out (ok=False) and, because the
+    scenario requires convergence, fail the whole verdict."""
+    wedged = _mini_scenario(
+        workload=WorkloadSpec(
+            initial_nodes=2,
+            arrival_rate=4.0,
+            lifetime_mean_s=0.0,  # forever-pods: the backlog can only grow
+            pod_cpu_m=(4000,),
+            pod_mem_mi=(4096,),
+        ),
+    )
+    card = run_scenario(wedged, seed=0)
+    c = card["convergence"]
+    assert c["pending_final"] > 0
+    assert not c["ok"]
+    assert not card["pass"]
+    # Same wedge WITHOUT the requirement: reported, not gating.
+    relaxed = run_scenario(replace(wedged, convergence_required=False), seed=0)
+    assert not relaxed["convergence"]["ok"]
+    assert relaxed["convergence"]["required"] is False
+    assert relaxed["pass"]
+
+
+# -- the lease-fault chaos surface (satellite) -------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_lease_brownout_during_takeover_passes_and_replays(seed, tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    card = run_scenario("lease-brownout-during-takeover", seed=seed, record=path)
+    assert card["pass"], json.dumps({"availability": card["availability"], "convergence": card["convergence"]})
+    # The lease-fault surface actually fired into the takeover window.
+    injected = card["chaos_injected"]
+    assert any(k.startswith("lease-") for k in injected), injected
+    a = card["availability"]
+    assert a["ok"] and a["double_binds"] == 0 and a["orphaned_pods"] == 0
+    assert card["convergence"]["required"] and card["convergence"]["ok"]
+    # Record->replay is bit-identical with lease faults in the trace.
+    replayed = run_scenario(None, replay=path)
+    assert replayed["fingerprint"] == card["fingerprint"]
+    assert replayed["availability"] == a

@@ -166,6 +166,30 @@ class ShardSet:
             return self.keyer.shard_for_key(pod_full) in self.owned
         return shard_for_name(pod_full, self.num_shards) in self.owned
 
+    # -- hardened lease primitives ------------------------------------------
+    # Lease-endpoint brownouts (sim/chaos.py lease faults, a flaky remote
+    # apiserver) REFUSE, never raise into the cycle: a failed acquire is a
+    # lost CAS, a failed release leaves the lease to expire within one TTL,
+    # a failed read reads as unheld — the CAS still arbitrates takeover.
+
+    def _acquire(self, name: str) -> bool:
+        try:
+            return bool(self.api.acquire_lease(name, self.identity, self.lease_duration))
+        except Exception:
+            return False
+
+    def _release(self, name: str) -> None:
+        try:
+            self.api.release_lease(name, self.identity)
+        except Exception:
+            pass
+
+    def _get(self, name: str) -> dict | None:
+        try:
+            return self.api.get_lease(name)
+        except Exception:
+            return None
+
     # -- one ownership round ------------------------------------------------
 
     def _live_holders(self, now: float) -> dict[int, str]:
@@ -173,7 +197,7 @@ class ShardSet:
         (unexpired, non-empty holder); absent shards map to ""."""
         holders: dict[int, str] = {}
         for s in range(self.num_shards):
-            info = self.api.get_lease(shard_lease_name(s))
+            info = self._get(shard_lease_name(s))
             if info is not None and info.get("holder") and now < float(info.get("expires", 0.0)):
                 holders[s] = info["holder"]
             else:
@@ -221,7 +245,7 @@ class ShardSet:
             return False
         for s in sorted(self.owned):
             if s >= count:
-                self.api.release_lease(shard_lease_name(s), self.identity)
+                self._release(shard_lease_name(s))
         self.owned = frozenset(s for s in self.owned if s < count)
         self.num_shards = count
         return True
@@ -247,7 +271,7 @@ class ShardSet:
         now = self.clock()
         # Presence first: visible to every other replica's target math even
         # while we hold nothing.
-        self.api.acquire_lease(REPLICA_LEASE_PREFIX + self.identity, self.identity, self.lease_duration)
+        self._acquire(REPLICA_LEASE_PREFIX + self.identity)
         resized = self._adopt_shard_map()
         holders = self._live_holders(now)
         n_replicas = self._live_replicas(now, holders)
@@ -262,7 +286,7 @@ class ShardSet:
         # losing a renewal CAS means another replica took it, which pass 2's
         # bookkeeping reports as lost).
         for s in order:
-            if s in prev and self.api.acquire_lease(shard_lease_name(s), self.identity, self.lease_duration):
+            if s in prev and self._acquire(shard_lease_name(s)):
                 owned.add(s)
         # Pass 2: rebalance — release the excess above target (freshly
         # joined replicas pick them up next round) from the END of the
@@ -275,7 +299,7 @@ class ShardSet:
                 if s in owned:
                     owned.discard(s)
                     released.add(s)
-                    self.api.release_lease(shard_lease_name(s), self.identity)
+                    self._release(shard_lease_name(s))
         # Pass 3: absorb orphans (expired/released/never-created shards)
         # while under target.
         for s in order:
@@ -283,7 +307,7 @@ class ShardSet:
                 break
             if s in owned or holders[s] not in ("", self.identity):
                 continue
-            if self.api.acquire_lease(shard_lease_name(s), self.identity, self.lease_duration):
+            if self._acquire(shard_lease_name(s)):
                 owned.add(s)
                 if s not in prev:
                     gained.add(s)
@@ -302,8 +326,8 @@ class ShardSet:
         back so survivors absorb them immediately instead of waiting out the
         TTL."""
         for s in sorted(self.owned):
-            self.api.release_lease(shard_lease_name(s), self.identity)
-        self.api.release_lease(REPLICA_LEASE_PREFIX + self.identity, self.identity)
+            self._release(shard_lease_name(s))
+        self._release(REPLICA_LEASE_PREFIX + self.identity)
         self.owned = frozenset()
 
     def debug(self, now: float) -> dict:
@@ -312,7 +336,7 @@ class ShardSet:
         resilience_snapshot stance)."""
         leases = {}
         for s in range(self.num_shards):
-            info = self.api.get_lease(shard_lease_name(s))
+            info = self._get(shard_lease_name(s))
             leases[shard_lease_name(s)] = (
                 None
                 if info is None

@@ -12,6 +12,11 @@ declarative, SEEDED fault surface the simulator (and any test) can drive:
   • watch drops (``ConnectionError``) and 410 Gone storms (``ApiError(410)``)
     raised from ``poll()`` — events stay queued, exactly the FlakyWatch
     contract, so the reflector's backoff-and-retry path is what recovers
+  • lease-op faults on the coordination surface every control-plane
+    protocol rides (shard leases, replica presence, gang reservations, the
+    shard map): CAS 500s (``lease_error_rate``), refused acquires
+    (``lease_refused_rate`` — the CAS loses as if a conflicting writer
+    won), and virtual lease latency (``lease_latency_s``)
   • timed fault WINDOWS overriding any base rate over a virtual interval
     (an api-brownout is one window; a flap storm is several)
 
@@ -46,6 +51,9 @@ class ChaosWindow:
     api_error_rate: float | None = None
     watch_drop_rate: float | None = None
     watch_gone_rate: float | None = None
+    lease_error_rate: float | None = None
+    lease_refused_rate: float | None = None
+    lease_latency_s: float | None = None
 
 
 @dataclass(frozen=True)
@@ -57,6 +65,9 @@ class ChaosConfig:
     api_error_rate: float = 0.0  # ApiError(500) on delete_pod / list_pdbs
     watch_drop_rate: float = 0.0  # poll() raises ConnectionError
     watch_gone_rate: float = 0.0  # poll() raises ApiError(410) — Gone storm
+    lease_error_rate: float = 0.0  # ApiError(500) on acquire/release/get lease
+    lease_refused_rate: float = 0.0  # acquire_lease CAS refused (returns False)
+    lease_latency_s: float = 0.0  # virtual seconds added per lease mutation
     windows: tuple[ChaosWindow, ...] = ()
 
     def rate(self, name: str, t: float) -> float:
@@ -72,7 +83,16 @@ class ChaosConfig:
     def any_faults(self) -> bool:
         base = any(
             getattr(self, f) > 0
-            for f in ("binding_error_rate", "binding_latency_s", "api_error_rate", "watch_drop_rate", "watch_gone_rate")
+            for f in (
+                "binding_error_rate",
+                "binding_latency_s",
+                "api_error_rate",
+                "watch_drop_rate",
+                "watch_gone_rate",
+                "lease_error_rate",
+                "lease_refused_rate",
+                "lease_latency_s",
+            )
         )
         return base or bool(self.windows)
 
@@ -218,3 +238,33 @@ class ChaosApiServer:
         if self._decide("api_error_rate", "list-pdbs-500"):
             raise ApiError(500, "chaos: injected apiserver 500 listing PDBs")
         return self.inner.list_pdbs()
+
+    # -- lease endpoints (the coordination surface every control-plane
+    # -- protocol rides: shard/replica/gang-reservation/shard-map leases) ----
+
+    def _lease_latency(self) -> None:
+        lat = self.config.rate("lease_latency_s", self.clock())
+        if lat > 0 and hasattr(self.clock, "advance"):
+            # Virtual CAS latency: the cycle's own clock moves, so lease
+            # TTL deadlines feel the slow coordination plane.
+            self.clock.advance(lat)
+            self.injected["lease-latency"] = self.injected.get("lease-latency", 0) + 1
+
+    def acquire_lease(self, name: str, holder: str, duration_seconds: float) -> bool:
+        if self._decide("lease_error_rate", "lease-acquire-500"):
+            raise ApiError(500, f"chaos: injected apiserver 500 acquiring lease {name}")
+        if self._decide("lease_refused_rate", "lease-refused"):
+            return False  # CAS lost — indistinguishable from a conflicting writer winning
+        self._lease_latency()
+        return self.inner.acquire_lease(name, holder, duration_seconds)
+
+    def release_lease(self, name: str, holder: str) -> None:
+        if self._decide("lease_error_rate", "lease-release-500"):
+            raise ApiError(500, f"chaos: injected apiserver 500 releasing lease {name}")
+        self._lease_latency()
+        return self.inner.release_lease(name, holder)
+
+    def get_lease(self, name: str) -> dict | None:
+        if self._decide("lease_error_rate", "lease-get-500"):
+            raise ApiError(500, f"chaos: injected apiserver 500 reading lease {name}")
+        return self.inner.get_lease(name)

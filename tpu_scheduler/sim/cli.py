@@ -46,6 +46,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv and argv[0] == "fuzz":
+        # Coverage-guided chaos fuzzing (tpu_scheduler/sim/fuzz): seeded
+        # fault-plan search + corpus replay, byte-identical per seed:
+        #   python -m tpu_scheduler.cli sim fuzz --budget 200 --seed 0
+        from .fuzz.cli import main as fuzz_main
+
+        return fuzz_main(argv[1:])
     if argv and argv[0] == "train":
         # Policy training (tpu_scheduler/learn): seeded CEM over the
         # profile weight surface, distilled to a JSON artifact:

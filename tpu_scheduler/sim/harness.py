@@ -40,7 +40,15 @@ from .chaos import ChaosApiServer
 from .clock import VirtualClock
 from .multi import MultiReplicaHarness
 from .scenarios import SCENARIOS, Scenario
-from .scorecard import ELASTICITY_FIELDS, _percentile, build_latency_block, build_scorecard, check_invariants, fingerprint
+from .scorecard import (
+    CONVERGENCE_FIELDS,
+    ELASTICITY_FIELDS,
+    _percentile,
+    build_latency_block,
+    build_scorecard,
+    check_invariants,
+    fingerprint,
+)
 from .trace import TraceWriter, load_trace
 from .workload import generate_events, initial_nodes
 
@@ -509,6 +517,66 @@ def _elasticity_block(
     gate = out["objective_gate"]
     out["ok"] = bool((gate <= 0 or out["joint_objective"] <= gate) and out["reclaim_orphans"] == 0)
     assert tuple(out) == ELASTICITY_FIELDS, "elasticity block drifted from ELASTICITY_FIELDS"
+    return out
+
+
+# shape: (sc: obj, fleet: obj, inner: obj, pending_final: obj, end_t: float) -> obj
+def _convergence_block(sc: Scenario, fleet: MultiReplicaHarness, inner, pending_final, end_t: float) -> dict:
+    """The scorecard ``convergence`` verdict — the fuzzer's end-state
+    quiescence oracle (sim/fuzz).  After the last scheduled fault
+    (latest chaos-window end, replica kill, or rack failure) the run must
+    settle: backlog drained, every LIVE replica's deferred-bind buffer
+    flushed, and no unexpired shard/replica/gang-reservation lease held by
+    a dead replica (a crashed owner's leases stop renewing and expire
+    within one TTL, so a settled fleet counts zero).  The shard-map lease
+    is excluded — its holder is the map payload, not a replica identity.
+    ``settle_overtime_s`` is the virtual time spent past
+    max(duration, last fault); the loop's drain-grace exit bounds it, and
+    the bound here re-derives that cap so a wedged run is named, not
+    silently truncated.  Deterministic by construction: every quantity is
+    virtual time or control flow."""
+    from ..fleet.reservation import GANG_RESERVATION_PREFIX
+    from ..fleet.resize import SHARD_MAP_LEASE
+    from ..runtime.shards import REPLICA_LEASE_PREFIX, SHARD_LEASE_PREFIX
+
+    last_fault = 0.0
+    for w in sc.chaos.windows:
+        last_fault = max(last_fault, float(w.end))
+    for t, _idx in sc.replica_kills:
+        last_fault = max(last_fault, float(t))
+    for t in sc.workload.rack_fail_times:
+        last_fault = max(last_fault, float(t))
+    # The settle bound the loop itself enforces: one drain-grace stretch of
+    # no-progress cycles plus two lease TTLs for takeover/expiry tails.
+    settle_bound = 2.0 * float(sc.lease_duration) + float(sc.drain_grace_cycles) * float(sc.cycle_interval)
+    overtime = max(0.0, end_t - max(float(sc.duration), last_fault))
+    deferred = sum(len(r.deferred_binds) for i, r in enumerate(fleet.scheds) if fleet.alive[i])
+    live = {r.identity for i, r in enumerate(fleet.scheds) if fleet.alive[i] and getattr(r, "identity", None)}
+    stale = 0
+    lister = getattr(inner, "list_lease_summaries", None)
+    if lister is not None:
+        for info in lister():
+            name = info["name"]
+            if name == SHARD_MAP_LEASE:
+                continue
+            if not name.startswith((SHARD_LEASE_PREFIX, REPLICA_LEASE_PREFIX, GANG_RESERVATION_PREFIX)):
+                continue
+            if info.get("holder") and info["holder"] not in live and end_t < float(info.get("expires", 0.0)):
+                stale += 1
+    out = {
+        "enabled": True,
+        "required": bool(sc.convergence_required),
+        "last_fault_t": round(last_fault, 6),
+        "settle_overtime_s": round(overtime, 6),
+        "settle_bound_s": round(settle_bound, 6),
+        "pending_final": len(pending_final),
+        "deferred_residue": int(deferred),
+        "stale_leases": stale,
+        "ok": bool(
+            len(pending_final) == 0 and deferred == 0 and stale == 0 and overtime <= settle_bound + 1e-9
+        ),
+    }
+    assert tuple(out) == CONVERGENCE_FIELDS, "convergence block drifted from CONVERGENCE_FIELDS"
     return out
 
 
@@ -988,6 +1056,7 @@ def scenario_episode(
         chaos_injected=chaos.injected,
         resilience=resilience,
         availability=fleet.availability_block(pending_final, st.double_bound),
+        convergence=_convergence_block(sc, fleet, inner, pending_final, end_t),
         locality=_locality_block(sc, st),
         profile=_profile_block(sc, fleet),
         incremental=_incremental_block(sc, fleet),

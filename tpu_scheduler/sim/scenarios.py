@@ -104,6 +104,15 @@ class Scenario:
     # ``autoscale_reclaim_grace_s`` of notice; ``autoscale_burn_trigger``,
     # ``autoscale_max_per_tick``, ``autoscale_reserve``, and
     # ``autoscale_cooldown`` are the AutoscaleConfig knobs.
+    # End-state convergence (the fuzzer's quiescence oracle, sim/fuzz):
+    # ``convergence_required`` gates the scorecard pass on the
+    # ``convergence`` block's ok — after the last scheduled fault the
+    # backlog must drain, live replicas' deferred buffers must flush, and
+    # no unexpired shard/replica/reservation lease may be held by a dead
+    # replica, all within the settle bound.  Off by default: scenarios with
+    # a standing backlog by design (autoscaler-backlog-whatif) judge
+    # convergence informationally, never as a gate.
+    convergence_required: bool = False
     autoscale: bool = False
     autoscale_every: int = 2
     autoscale_required: bool = False
@@ -397,6 +406,32 @@ _register(
         lease_duration=5.0,
         replica_kills=((18.0, 0),),
         drain_grace_cycles=30,
+    )
+)
+
+_register(
+    Scenario(
+        name="lease-brownout-during-takeover",
+        description="The lease-fault surface composed with failover: the coordination plane browns out (lease CAS 500s, refused acquires, virtual lease latency) in a window spanning a replica crash-kill — the survivor's takeover CAS calls fail and retry through the hardened refuse-don't-raise path, and the run must still absorb the orphaned shards within 2x lease_duration with zero double-binds and a converged end state (pass-gated availability + convergence blocks)",
+        duration=60.0,
+        workload=WorkloadSpec(
+            initial_nodes=30,
+            arrival_rate=6.0,
+            lifetime_mean_s=25.0,
+            gang_fraction=0.1,
+            priority_tiers=(0, 0, 5),
+        ),
+        chaos=ChaosConfig(
+            windows=(
+                ChaosWindow(start=12.0, end=28.0, lease_error_rate=0.3, lease_refused_rate=0.15, lease_latency_s=0.005),
+            ),
+        ),
+        replicas=2,
+        shards=4,
+        lease_duration=5.0,
+        replica_kills=((15.0, 0),),
+        convergence_required=True,
+        drain_grace_cycles=25,
     )
 )
 

@@ -33,6 +33,7 @@ __all__ = [
     "REBALANCE_FIELDS",
     "ELASTICITY_FIELDS",
     "LATENCY_FIELDS",
+    "CONVERGENCE_FIELDS",
     "check_invariants",
     "build_scorecard",
     "build_latency_block",
@@ -53,6 +54,7 @@ SCORECARD_FIELDS = (
     "chaos_injected",
     "resilience",
     "availability",
+    "convergence",
     "locality",
     "profile",
     "incremental",
@@ -142,6 +144,29 @@ ELASTICITY_FIELDS = (
     "cost_node_hours",
     "joint_objective",
     "objective_gate",
+    "ok",
+)
+
+
+# The closed schema of the ``convergence`` block (drift-gated against the
+# README "Chaos fuzzing" catalogue by the FUZZ analyze rule).  The fuzzer's
+# end-state quiescence oracle: after the last scheduled fault
+# (``last_fault_t`` — the latest chaos-window end, replica kill, or rack
+# failure) the backlog must drain (``pending_final`` == 0), every LIVE
+# replica's deferred-bind buffer must flush (``deferred_residue`` == 0),
+# no unexpired shard/replica/gang-reservation lease may be held by a dead
+# replica (``stale_leases`` == 0), and the overtime the run spent settling
+# past max(duration, last fault) must stay within ``settle_bound_s``.
+# Strictly virtual-time quantities — byte-identity and record→replay hold.
+CONVERGENCE_FIELDS = (
+    "enabled",
+    "required",
+    "last_fault_t",
+    "settle_overtime_s",
+    "settle_bound_s",
+    "pending_final",
+    "deferred_residue",
+    "stale_leases",
     "ok",
 )
 
@@ -343,6 +368,7 @@ def build_scorecard(
     chaos_injected: dict,
     resilience: dict,
     availability: dict,
+    convergence: dict,
     locality: dict,
     profile: dict,
     incremental: dict,
@@ -432,6 +458,12 @@ def build_scorecard(
             # rounding on EVERY measured pod — an attribution leak is an
             # observability regression and fails the run.
             and not (latency.get("required") and not latency.get("ok"))
+            # Convergence-required scenarios (the fuzzer's generated plans
+            # and the lease-brownout scenario) additionally gate on the
+            # convergence block's ok: after the last fault the backlog
+            # drains, live deferred buffers flush, and no dead replica
+            # holds an unexpired lease — a wedged end state fails the run.
+            and not (convergence.get("required") and not convergence.get("ok"))
         ),
         "virtual_seconds": round(virtual_seconds, 6),
         "cycles": cycles,
@@ -441,6 +473,7 @@ def build_scorecard(
         "chaos_injected": dict(sorted(chaos_injected.items())),
         "resilience": resilience,
         "availability": availability,
+        "convergence": convergence,
         "locality": locality,
         "profile": profile,
         "incremental": incremental,
