@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke fuzz-smoke clean
+.PHONY: check lint analyze test native bench sim-smoke profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke fuzz-smoke jit-stability-smoke clean
 
-check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke fuzz-smoke
+check: lint test profile-smoke constrained-smoke delta-smoke defrag-smoke train-smoke latency-smoke elasticity-smoke protocol-smoke fuzz-smoke jit-stability-smoke
 
 lint: analyze
 	$(PY) -m compileall -q tpu_scheduler tests scripts bench.py __graft_entry__.py
@@ -96,6 +96,14 @@ protocol-smoke:
 # inside a pinned wall budget (scripts/fuzz_smoke.py).
 fuzz-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.fuzz_smoke
+
+# The compile-cache boundedness gate: the JITC/XFER analyzer rules must be
+# clean over the annotated tree, and the steady-state scenario driven by
+# the real TpuBackend (JAX on CPU) must show ZERO XLA compiles after the
+# warmup window — the scorecard compile block live and flat
+# (scripts/jit_stability_smoke.py).
+jit-stability-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.jit_stability_smoke
 
 # C++ shim (optional; ops/native_ext.py gates on its presence)
 native:

@@ -627,13 +627,17 @@ def incremental_row(backend, profile, pods: int, nodes: int, seed: int, cycles: 
         from tpu_scheduler.utils.gc_tuning import enable_daemon_gc_tuning
 
         enable_daemon_gc_tuning()
+        from tpu_scheduler.utils.profiler import compile_stats
+
         base = synth_cluster(n_nodes=nodes, n_pending=pods, n_bound=2 * nodes, seed=seed)
         api = FakeApiServer()
         api.load(base.nodes, base.pods)
         sched = Scheduler(api, backend, profile=profile, requeue_seconds=0.0)
         assert sched.delta is not None, "incremental row needs the delta engine"
+        compiles_base = compile_stats()["compiles"]
         t0 = time.perf_counter()
         m0 = sched.run_cycle()
+        compiles_cold = compile_stats()["compiles"]
         log(f"incremental cycle 0 (cold full wave + rebuild): {time.perf_counter()-t0:.2f}s, bound {m0.bound}")
         wave = synth_cluster(n_nodes=nodes, n_pending=pods, n_bound=0, seed=seed + 1).pending_pods()
         bound_pool = [p for p in base.pods if p.spec is not None and p.spec.node_name is None]
@@ -681,6 +685,7 @@ def incremental_row(backend, profile, pods: int, nodes: int, seed: int, cycles: 
         def pct(q: float) -> int:
             return sizes[min(len(sizes) - 1, int(q * (len(sizes) - 1)))] if sizes else 0
 
+        compiles_end = compile_stats()["compiles"]
         row = {
             "incremental_shape": f"{pods}x{nodes}",
             "delta_cycle_seconds": round(stats.median(steady), 4),
@@ -690,11 +695,21 @@ def incremental_row(backend, profile, pods: int, nodes: int, seed: int, cycles: 
             "delta_escalations": s["full_solve_reasons"],
             "delta_dirty_p50": pct(0.50),
             "delta_dirty_p95": pct(0.95),
+            # Compile-cache boundedness evidence (the JITC contract at run
+            # time): XLA compiles across the whole row and across the
+            # post-cold churn cycles alone.  The steady count must sit near
+            # zero — shape buckets make churn cycles cache hits; the total
+            # rides the cross-round gate so a leaked raw dim (every cycle a
+            # fresh jit signature) shows up as a compile-count regression
+            # even when the extra traces are individually cheap.
+            "delta_compiles_total": compiles_end - compiles_base,
+            "delta_compiles_steady": compiles_end - compiles_cold,
         }
         log(
             f"incremental steady-state: median {row['delta_cycle_seconds']:.3f}s min "
             f"{row['delta_cycle_seconds_min']:.3f}s burst median {row['delta_burst_cycle_seconds']:.3f}s "
-            f"full-solve fraction {row['delta_full_solve_fraction']}"
+            f"full-solve fraction {row['delta_full_solve_fraction']} "
+            f"compiles {row['delta_compiles_total']} (steady {row['delta_compiles_steady']})"
         )
         return row
     except Exception as e:  # noqa: BLE001 — evidence row, never the headline
@@ -936,6 +951,17 @@ def provenance(platform: str) -> dict:
             "machines": len(mc),
             "states": sum(m.get("states", 0) for m in mc.values()),
             "violations": sum(m.get("violations", 0) for m in mc.values()),
+        }
+        # Compile-cache contract coverage (the JITC/XFER pass): how many
+        # `# bucket:`/`# hotpath:` contracts the jit-boundedness verdict
+        # actually rests on — a clean row from an unannotated tree proves
+        # nothing, so the coverage rides next to the verdict.
+        jc = rep.get("jitc") or {}
+        out["analyze_jitc"] = {
+            "bucket_contracts": jc.get("bucket_contracts", 0),
+            "hotpath_contracts": jc.get("hotpath_contracts", 0),
+            "jit_roots": jc.get("jit_roots", 0),
+            "root_call_sites": jc.get("root_call_sites", 0),
         }
     except Exception:  # noqa: BLE001 — no artifact: provenance records that
         out["analyze_findings"] = None
@@ -1578,6 +1604,7 @@ def apply_secondary_regression_checks(out: dict, platform: str, repo_dir: str, t
         ("multi_mesh_wall_seconds_min", "multi_mesh_shape"),
         ("constrained_seconds_min", "constrained_shape"),
         ("delta_cycle_seconds_min", "incremental_shape"),
+        ("delta_compiles_total", "incremental_shape"),
         ("rebalance_solve_seconds_min", "rebalance_shape"),
         ("policy_delta_cycle_seconds_min", "policy_shape"),
         ("latency_p99_ttb_s_max", "latency_shape"),

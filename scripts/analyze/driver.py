@@ -37,14 +37,14 @@ import subprocess
 import sys
 import time
 
-from . import catalogues, determinism, excp, exports, hygiene, jitpure, locks, modelcheck, protocol, shapes
+from . import catalogues, determinism, excp, exports, hygiene, jitc, jitpure, locks, modelcheck, protocol, shapes
 from .baseline import BASELINE_PATH, compare, load_baseline, write_baseline
 from .core import DEFAULT_PATHS, ROOT, Context, Finding, load_files
 
 # Fixed pass order: cheap mechanical hygiene first, repo-invariant passes
 # last (their reports are the ones a human digs into).  protocol precedes
 # modelcheck so spec parse errors surface as PROT before MODL explores.
-PASSES = (hygiene, exports, catalogues, excp, locks, jitpure, determinism, shapes, protocol, modelcheck)
+PASSES = (hygiene, exports, catalogues, excp, locks, jitpure, jitc, determinism, shapes, protocol, modelcheck)
 
 
 def all_codes() -> dict[str, str]:
@@ -239,6 +239,9 @@ def main(argv: list[str]) -> int:
             # Per-machine model-check stats (empty when MODL did not run,
             # e.g. --changed-only or a --rule subset); bench.py provenance.
             "modelcheck": dict(modelcheck.LAST_STATS),
+            # Bucket/hotpath contract coverage (empty when JITC did not
+            # run); bench.py provenance.
+            "jitc": dict(jitc.LAST_STATS),
         }
     if json_out and report is not None:
         pathlib.Path(json_out).write_text(json.dumps(report, indent=2, sort_keys=True) + "\n")

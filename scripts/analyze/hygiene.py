@@ -35,103 +35,34 @@ FILE_SCOPED = True
 _AMBIGUOUS = ("l", "O", "I")
 
 
-class _FunctionScopeChecks:
-    """Per-function rules: F841 unused locals, B006 mutable defaults."""
+class _FnScope:
+    """One function's F841 state, filled during the single module walk.
 
-    def __init__(self, relpath: str, findings: list[Finding]):
+    STORES are collected from the function's OWN scope only (nested
+    function/lambda/class bodies get their own record — counting them here
+    would double-report their dead stores against the outer scope); READS
+    come from the full subtree so a closure's use of an outer local still
+    counts (conservative: an inner local shadowing an outer name can mask
+    an outer dead store — false negatives over false positives).
+    AugAssign targets count as READS — the ledger-accumulator pattern is a
+    use, not a dead store."""
+
+    __slots__ = ("relpath", "assigned", "reads", "exempt", "args")
+
+    def __init__(self, relpath: str, node) -> None:
         self.relpath = relpath
-        self.findings = findings
-        self._reads_cache: dict[int, set[str]] = {}
+        self.assigned: dict[str, int] = {}
+        self.reads: set[str] = set()
+        self.exempt: set[str] = set()
+        self.args = {a.arg for a in node.args.args + node.args.kwonlyargs + node.args.posonlyargs}
 
-    def _subtree_reads(self, root) -> set:
-        """Every name READ in the subtree (Name Loads plus AugAssign
-        targets, which mutate in place).  Memoized at nested-scope roots so
-        an enclosing function reuses its inner functions' sets instead of
-        re-walking them — the walk stays linear in the module, not
-        quadratic in nesting depth."""
-        cached = self._reads_cache.get(id(root))
-        if cached is not None:
-            return cached
-        reads: set[str] = set()
-        stack = [root]
-        while stack:
-            n = stack.pop()
-            if n is not root and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
-                reads |= self._subtree_reads(n)
-                continue
-            if isinstance(n, ast.Name):
-                if isinstance(n.ctx, ast.Load):
-                    reads.add(n.id)
-                continue  # Name nodes are leaves bar the ctx
-            if isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Name):
-                reads.add(n.target.id)
-            stack.extend(ast.iter_child_nodes(n))
-        self._reads_cache[id(root)] = reads
-        return reads
-
-    def _check_function(self, node):
-        # B006 — mutable literals/constructors as parameter defaults.
-        for default in list(node.args.defaults) + [d for d in node.args.kw_defaults if d is not None]:
-            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
-                isinstance(default, ast.Call)
-                and isinstance(default.func, ast.Name)
-                and default.func.id in ("list", "dict", "set")
-            ):
-                self.findings.append(Finding("B006", self.relpath, default.lineno, "mutable default argument"))
-        # F841 — plain-name single assignments never read in the function.
-        # STORES are collected from this function's OWN scope only (nested
-        # function bodies get their own visit — walking them here would
-        # double-report their dead stores against the outer scope); READS
-        # come from the full walk so a closure's use of an outer local still
-        # counts (conservative: an inner local shadowing an outer name can
-        # mask an outer dead store — false negatives over false positives).
-        def own_scope(n):
-            for child in ast.iter_child_nodes(n):
-                # Nested functions/lambdas AND class bodies are their own
-                # scopes — a class attribute is not a function local (it is
-                # read via ast.Attribute, which never registers as a Name
-                # Load, so walking it would hard-fail valid code).
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
-                    continue
-                yield child
-                yield from own_scope(child)
-
-        assigned: dict[str, int] = {}
-        # READS (including AugAssign in-place mutation — the
-        # ledger-accumulator pattern is a use, not a dead store) come from
-        # the full subtree so a closure's use of an outer local counts.
-        read: set[str] = self._subtree_reads(node)
-        exempt: set[str] = set()
-        for sub in own_scope(node):
-            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
-                assigned.setdefault(sub.id, sub.lineno)
-            # global/nonlocal writes are module/outer-scope effects, and
-            # loop induction variables are iteration plumbing (ruff would
-            # file them under B007) — neither is an unused LOCAL.
-            if isinstance(sub, (ast.Global, ast.Nonlocal)):
-                exempt.update(sub.names)
-            elif isinstance(sub, (ast.For, ast.AsyncFor)):
-                exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
-            elif isinstance(sub, ast.comprehension):
-                exempt.update(n.id for n in ast.walk(sub.target) if isinstance(n, ast.Name))
-            elif isinstance(sub, (ast.With, ast.AsyncWith)):
-                # `with ... as x:` targets are context handles pyflakes/ruff
-                # never file under F841 (e.g. pytest.raises(...) as exc).
-                for item in sub.items:
-                    if item.optional_vars is not None:
-                        exempt.update(n.id for n in ast.walk(item.optional_vars) if isinstance(n, ast.Name))
-            elif isinstance(sub, ast.Assign):
-                # Tuple-unpack targets document structure — exempt them.
-                for t in sub.targets:
-                    if isinstance(t, (ast.Tuple, ast.List)):
-                        exempt.update(n.id for n in ast.walk(t) if isinstance(n, ast.Name))
-        args = {a.arg for a in node.args.args + node.args.kwonlyargs + node.args.posonlyargs}
-        for name, lineno in sorted(assigned.items(), key=lambda kv: kv[1]):
-            if name in read or name in exempt or name in args or name.startswith("_"):
+    def finalize(self, findings: list[Finding]) -> None:
+        for name, lineno in sorted(self.assigned.items(), key=lambda kv: kv[1]):
+            if name in self.reads or name in self.exempt or name in self.args or name.startswith("_"):
                 continue
             if name in ("self", "cls"):
                 continue
-            self.findings.append(Finding("F841", self.relpath, lineno, f"local variable '{name}' assigned but never used"))
+            findings.append(Finding("F841", self.relpath, lineno, f"local variable '{name}' assigned but never used"))
 
 
 def _check_module(f: SourceFile, findings: list[Finding]) -> None:
@@ -140,21 +71,62 @@ def _check_module(f: SourceFile, findings: list[Finding]) -> None:
     rel = f.rel
     imports: dict[str, int] = {}  # bound name -> lineno
     used: set[str] = set()
-    scopes = _FunctionScopeChecks(rel, findings)
-    # ONE walk of the module drives every per-node rule — E722/E741
-    # (bare except, ambiguous bindings), E711/E712 (None/bool compares,
-    # both sides so Yoda comparisons are caught too), import collection
-    # for F401, and the per-function scope checks (B006/F841) — these
-    # used to be four separate full traversals of the same tree.
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
+    # ONE walk of the module drives every rule — E722/E741 (bare except,
+    # ambiguous bindings), E711/E712 (None/bool compares, both sides so
+    # Yoda comparisons are caught too), import collection for F401, AND
+    # the per-function scope state (B006/F841).  The F841 reads/stores
+    # used to be two more full traversals (subtree reads per function,
+    # own-scope stores per function); here each node is visited exactly
+    # once carrying its enclosing-function context: ``fscopes`` is the
+    # stack of _FnScope records whose subtree contains the node (a Name
+    # Load feeds every one of them — that is exactly the old full-subtree
+    # reads semantics), and ``own`` says whether plain stores at this node
+    # belong to ``fscopes[-1]``'s own scope (False under a lambda/class
+    # barrier — a class attribute is not a function local — and at module
+    # level).
+    records: list[_FnScope] = []
+    stack: list = [(node, (), False) for node in ast.iter_child_nodes(tree)]
+    while stack:
+        node, fscopes, own = stack.pop()
+        t = type(node)
+        if t is ast.Name:
             if isinstance(node.ctx, ast.Load):
                 used.add(node.id)
-            elif isinstance(node.ctx, ast.Store) and node.id in _AMBIGUOUS:
-                findings.append(Finding("E741", rel, node.lineno, f"ambiguous variable name '{node.id}'"))
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            scopes._check_function(node)
-        elif isinstance(node, ast.Compare):
+                for r in fscopes:
+                    r.reads.add(node.id)
+            elif isinstance(node.ctx, ast.Store):
+                if node.id in _AMBIGUOUS:
+                    findings.append(Finding("E741", rel, node.lineno, f"ambiguous variable name '{node.id}'"))
+                if own:
+                    r = fscopes[-1]
+                    # Earliest store wins (stack order is not document
+                    # order, so keep the min lineno explicitly).
+                    prev = r.assigned.get(node.id)
+                    if prev is None or node.lineno < prev:
+                        r.assigned[node.id] = node.lineno
+            continue  # Name nodes are leaves bar the ctx
+        if t is ast.Constant:
+            continue
+        if t in (ast.FunctionDef, ast.AsyncFunctionDef):
+            # B006 — mutable literals/constructors as parameter defaults.
+            for default in list(node.args.defaults) + [d for d in node.args.kw_defaults if d is not None]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in ("list", "dict", "set")
+                ):
+                    findings.append(Finding("B006", rel, default.lineno, "mutable default argument"))
+            r = _FnScope(rel, node)
+            records.append(r)
+            inner = fscopes + (r,)
+            stack.extend((child, inner, True) for child in ast.iter_child_nodes(node))
+            continue
+        if t in (ast.Lambda, ast.ClassDef):
+            # Scope barrier: reads still reach the enclosing functions (a
+            # closure use counts), but stores are no longer their locals.
+            stack.extend((child, fscopes, False) for child in ast.iter_child_nodes(node))
+            continue
+        if t is ast.Compare:
             # Operand i of op i is left for i == 0, else comparators[i-1].
             operands = [node.left] + list(node.comparators)
             for i, op in enumerate(node.ops):
@@ -169,21 +141,51 @@ def _check_module(f: SourceFile, findings: list[Finding]) -> None:
                         findings.append(
                             Finding("E712", rel, node.lineno, f"comparison to {side.value} (use the value or 'is')")
                         )
-        elif isinstance(node, ast.arg):
+        elif t is ast.arg:
             if node.arg in _AMBIGUOUS:
                 findings.append(Finding("E741", rel, node.lineno, f"ambiguous argument name '{node.arg}'"))
-        elif isinstance(node, ast.ExceptHandler):
+        elif t is ast.ExceptHandler:
             if node.type is None:
                 findings.append(Finding("E722", rel, node.lineno, "bare 'except:' — name the exception"))
-        elif isinstance(node, ast.Import):
+        elif t is ast.Import:
             for a in node.names:
                 imports[a.asname or a.name.split(".")[0]] = node.lineno
-        elif isinstance(node, ast.ImportFrom):
+        elif t is ast.ImportFrom:
             # future imports act by existing, never by reference
             if node.module != "__future__":
                 for a in node.names:
                     if a.name != "*":
                         imports[a.asname or a.name] = node.lineno
+        elif t is ast.AugAssign:
+            # In-place mutation is a USE of the target, not a dead store.
+            if isinstance(node.target, ast.Name):
+                for r in fscopes:
+                    r.reads.add(node.target.id)
+        elif own:
+            # global/nonlocal writes are module/outer-scope effects, and
+            # loop induction variables are iteration plumbing (ruff would
+            # file them under B007) — neither is an unused LOCAL.
+            r = fscopes[-1]
+            if t in (ast.Global, ast.Nonlocal):
+                r.exempt.update(node.names)
+            elif t in (ast.For, ast.AsyncFor):
+                r.exempt.update(n.id for n in ast.walk(node.target) if isinstance(n, ast.Name))
+            elif t is ast.comprehension:
+                r.exempt.update(n.id for n in ast.walk(node.target) if isinstance(n, ast.Name))
+            elif t in (ast.With, ast.AsyncWith):
+                # `with ... as x:` targets are context handles pyflakes/ruff
+                # never file under F841 (e.g. pytest.raises(...) as exc).
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        r.exempt.update(n.id for n in ast.walk(item.optional_vars) if isinstance(n, ast.Name))
+            elif t is ast.Assign:
+                # Tuple-unpack targets document structure — exempt them.
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Tuple, ast.List)):
+                        r.exempt.update(n.id for n in ast.walk(tgt) if isinstance(n, ast.Name))
+        stack.extend((child, fscopes, own) for child in ast.iter_child_nodes(node))
+    for r in records:
+        r.finalize(findings)
     exported = set(module_all(tree))
     # Names referenced in string annotations / docstring doctests are out
     # of scope; __init__ re-exports are legitimate when listed in __all__.

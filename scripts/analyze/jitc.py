@@ -1,0 +1,729 @@
+"""JITC / XFER — compile-cache boundedness and host-sync discipline.
+
+The TPU design rests on one claim (ops/pack.py): shapes are padded to
+static buckets, so XLA recompiles only when a bucket grows.  Nothing
+checked that claim statically — any raw per-cycle dim (a ``len(pending)``,
+an un-rounded pad) leaking into a ``jax.jit`` signature turns the
+sub-100 ms delta cycle into a retrace storm that no unit test notices
+(results stay correct; only the compile cache explodes).  This pass makes
+bucket discipline machine-checked, with a runtime twin in the scorecard
+``compile`` block (sim/harness.py): statically proven bounded, dynamically
+proven flat after warmup.
+
+**JITC (compile-cache boundedness).**  A padding site declares its bucketed
+dims in a ``# bucket:`` comment directly above the ``def`` (decorators may
+sit between — the ``# shape:`` placement rule)::
+
+    # bucket: n_pad p_pad
+    def pack_snapshot(...):
+        n_pad = round_up(n_real, node_block)
+
+Two contract forms:
+
+  ``# bucket: name1 name2 ...``  every binding of each named local must be
+    a ROUND-UP IDIOM: a call to a bucket primitive (below), ``max``/``min``
+    /arithmetic over already-bucketed values, an integer constant, a
+    carried attribute (``packed.padded_pods``), a ``.shape[...]`` read
+    (tensor dims are bucketed by induction), or a static jit parameter.
+    A binding from anything else — a raw ``len()``, an unrounded parameter
+    — is an unbounded-retrace finding.  A declared name that is never
+    bound is contract rot (same finding class as SHPE's).
+
+  ``# bucket: return``  the function IS a bucketing primitive — its body
+    must contain a round-up idiom (next-multiple arithmetic
+    ``((x + m - 1) // m) * m`` or a power-of-2 doubling loop
+    ``while size < n: size *= 2``); its name then resolves as an idiom at
+    every call site (same-module first, then from-imports, the JAXP
+    name-resolution pattern).
+
+On top of the contracts, each ``jax.jit`` ROOT (decorator forms plus the
+``jax.jit(f)`` call form, ``static_argnames`` parsed from the decorator)
+is checked for the three classic cache-key leaks JAXP cannot see:
+
+  • a non-static parameter driving Python control flow (``if``/``while``
+    on its value, ``range(param)``) inside the jit body — per-call values
+    retrace (or crash at trace when passed as an array);
+  • a Python int/float literal passed traced at one call site of a root
+    whose same parameter receives a non-literal elsewhere — the weak-typed
+    literal promotes differently and retraces on the dtype flip;
+  • ``jnp.array``/``jnp.asarray``/``device_put`` of a non-constant Python
+    list inside a function that calls a jit root — a per-cycle host list
+    is re-uploaded (and re-keyed) every call.
+
+**XFER (host-sync discipline).**  JAXP forbids syncs INSIDE jit-reached
+code; XFER governs the host side.  A per-cycle driver declares itself with
+``# hotpath: <label>`` above its def; within it, every device→host
+materialization — ``.item()``, ``float()``/``int()``/``bool()`` on a
+device value, ``np.asarray``/``np.array`` of a device value,
+``.block_until_ready()``, ``jax.device_get`` — must sit inside a declared
+host-sync span: a ``with span("host-sync")`` block (the profiler's
+attribution point) or a line carrying a trailing ``# host-sync: <reason>``
+comment.  Device taint is light and local: results of calls to known jit
+roots (or local aliases of them) and ``jnp.``/``lax.`` calls; ``int()``/
+``float()``/``device_get``/``np.asarray`` drop taint (their result lives
+on the host — they ARE the sync, flagged at the point).
+
+Authoring guide: README "Static analysis" → "Bucket & hotpath contracts".
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, SourceFile
+
+CODES = {
+    "JITC": "a raw per-cycle dim, non-static scalar branch, or per-call host list reaching a jax.jit signature — unbounded retrace",
+    "XFER": "a device->host sync inside a # hotpath: cycle driver outside a declared host-sync span — hidden per-cycle round-trip",
+}
+
+# Contracts are per-file; cross-module resolution (bucket primitives, jit
+# root names) trusts what it cannot load — a partial (--changed-only)
+# context yields fewer findings, never false ones.
+FILE_SCOPED = True
+
+# Per-run stats for the bench provenance row (the modelcheck.LAST_STATS
+# pattern): how much of the tree the contracts actually cover.
+LAST_STATS: dict[str, int] = {}
+
+_SYNC_SPAN_TOKEN = "host-sync"
+_SHAPE_ATTRS = ("shape",)
+
+
+def _contract_above(f: SourceFile, node: ast.FunctionDef, tag: str) -> tuple[int, str] | None:
+    """(lineno, payload) of the ``# <tag>: ...`` comment line directly above
+    the def/decorator block, or None (the # shape: placement rule)."""
+    start = min([node.lineno] + [d.lineno for d in node.decorator_list])
+    i = start - 2  # 0-indexed line above the def/decorator block
+    prefix = f"# {tag}:"
+    while i >= 0 and f.lines[i].strip().startswith("#"):
+        text = f.lines[i].strip()
+        if text.startswith(prefix):
+            return i + 1, text[len(prefix):].strip()
+        i -= 1
+    return None
+
+
+def _is_jax_jit_expr(node: ast.expr) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _static_argnames(call: ast.Call) -> set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames" and isinstance(kw.value, (ast.Tuple, ast.List)):
+            return {e.value for e in kw.value.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+        if kw.arg == "static_argnames" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+            return {kw.value.value}
+    return set()
+
+
+def _jit_root_info(fn: ast.FunctionDef) -> set[str] | None:
+    """static_argnames when ``fn`` is jit-decorated, else None."""
+    for dec in fn.decorator_list:
+        if _is_jax_jit_expr(dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit_expr(dec.func):
+                return _static_argnames(dec)
+            fname = dec.func.attr if isinstance(dec.func, ast.Attribute) else getattr(dec.func, "id", None)
+            if fname == "partial" and dec.args and _is_jax_jit_expr(dec.args[0]):
+                return _static_argnames(dec)
+    return None
+
+
+class _ModIndex:
+    """Per-module maps: function defs, imports, contracts."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.functions: dict[str, list[ast.FunctionDef]] = {}
+        self.from_imports: set[str] = set()
+        self.np_aliases: set[str] = set()
+        self.taint_bases: set[str] = set()  # jnp / lax style namespaces
+        self.jax_aliases: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        self.np_aliases.add(bound)
+                    elif a.name == "jax":
+                        self.jax_aliases.add(bound)
+                    elif a.name == "jax.numpy" and a.asname:
+                        self.taint_bases.add(a.asname)
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.from_imports.add(a.asname or a.name)
+                    if node.module == "jax" and a.name in ("numpy", "lax"):
+                        self.taint_bases.add(a.asname or a.name)
+
+    def nested_defs(self, fn: ast.AST):
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+# -- bucket idiom verification -----------------------------------------------
+
+
+def _is_shape_read(node: ast.expr) -> bool:
+    """``x.shape[0]`` / ``a["k"].shape[...]`` / ``mesh.shape["dp"]`` — an
+    existing tensor/mesh dim, bucketed by induction."""
+    if isinstance(node, ast.Subscript):
+        v = node.value
+        return isinstance(v, ast.Attribute) and v.attr in _SHAPE_ATTRS
+    return isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS
+
+
+def _has_roundup_body(fn: ast.FunctionDef) -> bool:
+    """A ``# bucket: return`` primitive must actually round: next-multiple
+    arithmetic anywhere, or a power-of-2 doubling loop."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            if any(isinstance(s, ast.BinOp) and isinstance(s.op, ast.FloorDiv) for s in (node.left, node.right)):
+                return True
+        if isinstance(node, ast.While):
+            for stmt in ast.walk(node):
+                if (
+                    isinstance(stmt, ast.AugAssign)
+                    and isinstance(stmt.op, ast.Mult)
+                    and isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value == 2
+                ):
+                    return True
+    return False
+
+
+class _BucketScope:
+    """Decides whether an expression yields a bucketed (bounded-vocabulary)
+    dim inside one contract-carrying function."""
+
+    def __init__(self, declared: set[str], static_params: set[str], idx: _ModIndex, primitives: set[str]):
+        self.declared = declared
+        self.static_params = static_params
+        self.idx = idx
+        self.primitives = primitives
+        self.derived: set[str] = set()
+
+    def name_ok(self, name: str) -> bool:
+        return name in self.declared or name in self.derived or name in self.static_params
+
+    def expr_ok(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, (int, bool)) or node.value is None
+        if isinstance(node, ast.Name):
+            return self.name_ok(node.id)
+        if _is_shape_read(node):
+            return True
+        if isinstance(node, ast.Attribute):
+            return True  # carried pad (packed.padded_pods) — padded upstream
+        if isinstance(node, ast.Call):
+            f = node.func
+            fname = f.id if isinstance(f, ast.Name) else (f.attr if isinstance(f, ast.Attribute) else None)
+            if fname in ("max", "min"):
+                return bool(node.args) and all(self.expr_ok(a) for a in node.args)
+            if isinstance(f, ast.Name):
+                if f.id in self.primitives:
+                    return True  # round-up primitive: raw in, bucketed out
+                if f.id in self.idx.from_imports and f.id not in self.idx.functions:
+                    return True  # unresolved import — trust, never false-flag
+                return False
+            if isinstance(f, ast.Attribute) and f.attr in self.primitives:
+                return True
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.expr_ok(node.left) and self.expr_ok(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_ok(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.expr_ok(node.body) and self.expr_ok(node.orelse)
+        if isinstance(node, ast.Tuple):
+            return all(self.expr_ok(e) for e in node.elts)
+        return False
+
+
+def _check_bucket_fn(
+    f: SourceFile,
+    fn: ast.FunctionDef,
+    names: list[str],
+    idx: _ModIndex,
+    primitives: set[str],
+    findings: list[Finding],
+) -> None:
+    static = _jit_root_info(fn) or set()
+    scope = _BucketScope(set(names), static, idx, primitives)
+    nested = set(idx.nested_defs(fn))
+    bound: set[str] = set()
+
+    def own_nodes(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if child in nested or isinstance(child, ast.Lambda):
+                continue
+            yield child
+            yield from own_nodes(child)
+
+    def check_binding(target: ast.expr, value: ast.expr) -> None:
+        if isinstance(target, ast.Tuple) and isinstance(value, ast.Tuple) and len(target.elts) == len(value.elts):
+            for t, v in zip(target.elts, value.elts):
+                check_binding(t, v)
+            return
+        if not isinstance(target, ast.Name):
+            return
+        name = target.id
+        ok = scope.expr_ok(value)
+        if name in scope.declared:
+            bound.add(name)
+            if not ok:
+                findings.append(
+                    Finding(
+                        "JITC",
+                        f.rel,
+                        value.lineno,
+                        f"bucketed dim '{name}' in '{fn.name}' bound from a raw per-cycle value — "
+                        "not a round-up idiom (# bucket: contract)",
+                    )
+                )
+        elif ok:
+            scope.derived.add(name)
+
+    for node in own_nodes(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                check_binding(t, node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            check_binding(node.target, node.value)
+        elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Name):
+            if node.target.id in scope.declared:
+                bound.add(node.target.id)
+                if not scope.expr_ok(node.value):
+                    findings.append(
+                        Finding(
+                            "JITC",
+                            f.rel,
+                            node.lineno,
+                            f"bucketed dim '{node.target.id}' in '{fn.name}' bound from a raw per-cycle value — "
+                            "not a round-up idiom (# bucket: contract)",
+                        )
+                    )
+
+    for name in sorted(scope.declared - bound):
+        params = {a.arg for a in list(fn.args.args) + list(fn.args.kwonlyargs)}
+        findings.append(
+            Finding(
+                "JITC",
+                f.rel,
+                fn.lineno,
+                f"# bucket: contract rot — '{name}' is never bound in '{fn.name}'"
+                + (" (it is a parameter; declare buckets where they are computed)" if name in params else ""),
+            )
+        )
+
+
+# -- jit-root static discipline ----------------------------------------------
+
+
+def _branch_value_names(test: ast.expr) -> set[str]:
+    """Bare names whose VALUE the test consumes: the whole test, operands of
+    not/and/or, and operands of non-``is`` comparisons.  Names inside
+    subscripts/attributes/calls are structural, not per-call scalars."""
+    out: set[str] = set()
+    if isinstance(test, ast.Name):
+        out.add(test.id)
+    elif isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        out |= _branch_value_names(test.operand)
+    elif isinstance(test, ast.BoolOp):
+        for v in test.values:
+            out |= _branch_value_names(v)
+    elif isinstance(test, ast.Compare):
+        if not all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            for operand in [test.left, *test.comparators]:
+                if isinstance(operand, ast.Name):
+                    out.add(operand.id)
+    return out
+
+
+def _check_jit_root(f: SourceFile, fn: ast.FunctionDef, static: set[str], findings: list[Finding]) -> None:
+    params = {a.arg for a in list(fn.args.args) + list(fn.args.posonlyargs) + list(fn.args.kwonlyargs)} - {"self"}
+    nonstatic = params - static
+    # None-defaulted params are pytree/sentinel operands: ``if x is not
+    # None`` is already excluded, and their truthiness never reaches a
+    # Python branch in working code — skip them to avoid sentinel noise.
+    defaults = list(fn.args.defaults)
+    pos = list(fn.args.args)
+    for arg, d in zip(pos[len(pos) - len(defaults):], defaults):
+        if isinstance(d, ast.Constant) and d.value is None:
+            nonstatic.discard(arg.arg)
+    for arg, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if isinstance(d, ast.Constant) and d.value is None:
+            nonstatic.discard(arg.arg)
+    if not nonstatic:
+        return
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            for name in sorted(_branch_value_names(node.test) & nonstatic):
+                findings.append(
+                    Finding(
+                        "JITC",
+                        f.rel,
+                        node.lineno,
+                        f"Python branch on per-call scalar '{name}' in jit root '{fn.name}' — "
+                        "add it to static_argnames (each value retraces; an array crashes at trace)",
+                    )
+                )
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "range":
+            for a in node.args:
+                if isinstance(a, ast.Name) and a.id in nonstatic:
+                    findings.append(
+                        Finding(
+                            "JITC",
+                            f.rel,
+                            node.lineno,
+                            f"range() over per-call scalar '{a.id}' in jit root '{fn.name}' — "
+                            "add it to static_argnames (the unrolled length keys the compile cache)",
+                        )
+                    )
+
+
+# -- jit-root call sites: literal promotion + per-cycle host lists ------------
+
+
+def _map_call_args(call: ast.Call, fn: ast.FunctionDef) -> dict[str, ast.expr]:
+    names = [a.arg for a in fn.args.args]
+    out: dict[str, ast.expr] = {}
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(names):
+            out[names[i]] = a
+    for kw in call.keywords:
+        if kw.arg:
+            out[kw.arg] = kw.value
+    return out
+
+
+def _nonconst_list(node: ast.expr) -> bool:
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        return True
+    if isinstance(node, ast.List):
+        return any(not isinstance(e, ast.Constant) for e in node.elts)
+    return False
+
+
+# -- XFER: hotpath host-sync discipline ---------------------------------------
+
+
+def _sync_span_ranges(fn: ast.FunctionDef) -> list[tuple[int, int]]:
+    """Line ranges of ``with span("...host-sync...")`` blocks — declared
+    host-sync spans (the profiler's attribution point)."""
+    out: list[tuple[int, int]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            e = item.context_expr
+            if not (isinstance(e, ast.Call) and e.args and isinstance(e.args[0], ast.Constant)):
+                continue
+            fname = e.func.id if isinstance(e.func, ast.Name) else (e.func.attr if isinstance(e.func, ast.Attribute) else None)
+            if fname == "span" and isinstance(e.args[0].value, str) and _SYNC_SPAN_TOKEN in e.args[0].value:
+                out.append((node.lineno, node.end_lineno or node.lineno))
+    return out
+
+
+def _check_hotpath(
+    f: SourceFile,
+    fn: ast.FunctionDef,
+    label: str,
+    idx: _ModIndex,
+    root_names: set[str],
+    stats: dict[str, int],
+    findings: list[Finding],
+) -> None:
+    spans = _sync_span_ranges(fn)
+    nested = set(idx.nested_defs(fn))
+    tainted: set[str] = set()
+    aliases = set(root_names)
+
+    def allowed(lineno: int) -> bool:
+        if any(lo <= lineno <= hi for lo, hi in spans):
+            stats["allowed_syncs"] += 1
+            return True
+        if 0 < lineno <= len(f.lines) and "# host-sync:" in f.lines[lineno - 1]:
+            stats["allowed_syncs"] += 1
+            return True
+        return False
+
+    def is_device(node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Call):
+            g = node.func
+            if isinstance(g, ast.Name):
+                if g.id in aliases:
+                    return True
+                if g.id in ("int", "float", "bool"):
+                    return False  # the sync itself — result is host
+                return any(is_device(a) for a in node.args)
+            if isinstance(g, ast.Attribute):
+                base = g.value
+                if isinstance(base, ast.Name) and base.id in idx.taint_bases:
+                    return True
+                if isinstance(base, ast.Name) and base.id in idx.np_aliases:
+                    return False  # numpy result lives on the host
+                if isinstance(base, ast.Name) and base.id in idx.jax_aliases and g.attr == "device_get":
+                    return False
+                return is_device(base)
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("shape", "dtype", "ndim", "size"):
+                return False
+            return is_device(node.value)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return is_device(node.value)
+        if isinstance(node, ast.BinOp):
+            return is_device(node.left) or is_device(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return is_device(node.operand)
+        if isinstance(node, ast.Compare):
+            return is_device(node.left) or any(is_device(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(is_device(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return is_device(node.body) or is_device(node.orelse)
+        return False
+
+    def flag(lineno: int, what: str) -> None:
+        if not allowed(lineno):
+            findings.append(
+                Finding(
+                    "XFER",
+                    f.rel,
+                    lineno,
+                    f"{what} in # hotpath: '{fn.name}' ({label}) outside a declared host-sync span — "
+                    "wrap in `with span(\"host-sync\")` or justify with a trailing `# host-sync: <reason>`",
+                )
+            )
+
+    def own_nodes(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if child in nested or isinstance(child, ast.Lambda):
+                continue
+            yield child
+            yield from own_nodes(child)
+
+    for node in [fn, *own_nodes(fn)]:
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Name) and node.value.id in aliases:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+            elif isinstance(node.value, ast.IfExp) and all(
+                isinstance(b, ast.Name) and b.id in aliases for b in (node.value.body, node.value.orelse)
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+            elif is_device(node.value):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Name):
+            if is_device(node.value) or node.target.id in tainted:
+                tainted.add(node.target.id)
+        elif isinstance(node, ast.For):
+            if is_device(node.iter):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        tainted.add(n.id)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                if is_device(gen.iter):
+                    for n in ast.walk(gen.target):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        if isinstance(node, ast.Call):
+            g = node.func
+            if isinstance(g, ast.Attribute):
+                if g.attr == "item" and not node.args and is_device(g.value):
+                    flag(node.lineno, ".item() device fetch")
+                elif g.attr == "block_until_ready":
+                    flag(node.lineno, ".block_until_ready() device barrier")
+                elif (
+                    isinstance(g.value, ast.Name)
+                    and g.value.id in idx.np_aliases
+                    and g.attr in ("asarray", "array")
+                    and node.args
+                    and is_device(node.args[0])
+                ):
+                    flag(node.lineno, f"np.{g.attr}() materialization of a device value")
+                elif g.attr == "device_get":
+                    flag(node.lineno, "jax.device_get() device fetch")
+            elif isinstance(g, ast.Name):
+                if g.id in ("float", "int", "bool") and node.args and is_device(node.args[0]):
+                    flag(node.lineno, f"{g.id}() on a device value (blocking fetch)")
+                elif g.id == "device_get":
+                    flag(node.lineno, "device_get() device fetch")
+
+
+# -- driver -------------------------------------------------------------------
+
+
+def run(ctx: Context) -> list[Finding]:
+    stats = {
+        "bucket_contracts": 0,
+        "bucket_dims": 0,
+        "bucket_primitives": 0,
+        "hotpath_contracts": 0,
+        "jit_roots": 0,
+        "root_call_sites": 0,
+        "allowed_syncs": 0,
+    }
+    LAST_STATS.clear()
+    findings: list[Finding] = []
+    files = [f for f in ctx.parsed() if f.in_package("tpu_scheduler")]
+    # Index construction walks the whole module AST, so it is LAZY: most
+    # files carry no contracts, no jit decorators, and no root call sites,
+    # and a cheap substring test proves it without a walk.
+    indices: dict[str, _ModIndex] = {}
+
+    def idx_of(f: SourceFile) -> _ModIndex:
+        got = indices.get(f.rel)
+        if got is None:
+            got = indices[f.rel] = _ModIndex(f)
+        return got
+
+    # Pass 1 — global sets: bucket primitives, jit roots (+ static names).
+    primitives: set[str] = set()
+    primitive_defs: list[tuple[SourceFile, ast.FunctionDef]] = []
+    roots: list[tuple[SourceFile, ast.FunctionDef, set[str]]] = []
+    bucket_fns: list[tuple[SourceFile, ast.FunctionDef, list[str]]] = []
+    hot_fns: list[tuple[SourceFile, ast.FunctionDef, str]] = []
+    for f in files:
+        if "# bucket:" not in f.text and "# hotpath:" not in f.text and "jit" not in f.text:
+            continue
+        idx = idx_of(f)
+        for defs in idx.functions.values():
+            for fn in defs:
+                static = _jit_root_info(fn)
+                if static is not None:
+                    roots.append((f, fn, static))
+                c = _contract_above(f, fn, "bucket")
+                if c is not None:
+                    _lineno, payload = c
+                    names = payload.split()
+                    if names == ["return"]:
+                        primitives.add(fn.name)
+                        primitive_defs.append((f, fn))
+                    elif names:
+                        bucket_fns.append((f, fn, names))
+                        stats["bucket_dims"] += len(names)
+                    stats["bucket_contracts"] += 1
+                h = _contract_above(f, fn, "hotpath")
+                if h is not None:
+                    hot_fns.append((f, fn, h[1] or fn.name))
+                    stats["hotpath_contracts"] += 1
+    stats["bucket_primitives"] = len(primitives)
+    stats["jit_roots"] = len(roots)
+    root_names = {fn.name for _f, fn, _s in roots}
+    root_def = {fn.name: (fn, static) for _f, fn, static in roots}
+
+    # Pass 2 — verify primitives actually round.
+    for f, fn in primitive_defs:
+        if not _has_roundup_body(fn):
+            findings.append(
+                Finding(
+                    "JITC",
+                    f.rel,
+                    fn.lineno,
+                    f"# bucket: return on '{fn.name}' but its body has no round-up idiom "
+                    "(next-multiple arithmetic or power-of-2 doubling loop)",
+                )
+            )
+
+    # Pass 3 — bucket contracts + jit-root static discipline.
+    for f, fn, names in bucket_fns:
+        _check_bucket_fn(f, fn, names, indices[f.rel], primitives, findings)
+    for f, fn, static in roots:
+        _check_jit_root(f, fn, static, findings)
+
+    # Pass 4 — root call sites: weak-typed literal promotion (a param that
+    # sees BOTH a bare literal and a non-literal across the tree promotes
+    # differently per site and retraces on the flip) + per-cycle host lists.
+    site_kinds: dict[tuple[str, str], set[str]] = {}
+    literal_sites: dict[tuple[str, str], list[tuple[SourceFile, int, str]]] = {}
+    for f in files:
+        if not any(rn in f.text for rn in root_names):
+            continue  # no textual mention of a root — no call sites to map
+        idx = idx_of(f)
+        for fname, defs in idx.functions.items():
+            for caller in defs:
+                calls_root = False
+                for node in ast.walk(caller):
+                    if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+                        continue
+                    cname = node.func.id
+                    if cname not in root_names:
+                        continue
+                    if cname not in idx.functions and cname not in idx.from_imports:
+                        continue  # unrelated same-name symbol
+                    calls_root = True
+                    stats["root_call_sites"] += 1
+                    fn, static = root_def[cname]
+                    for pname, arg in _map_call_args(node, fn).items():
+                        if pname in static:
+                            continue
+                        key = (cname, pname)
+                        if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)) and not isinstance(arg.value, bool):
+                            kind = "literal"
+                            literal_sites.setdefault(key, []).append((f, node.lineno, type(arg.value).__name__))
+                        else:
+                            kind = "value"
+                        site_kinds.setdefault(key, set()).add(kind)
+                if calls_root:
+                    for node in ast.walk(caller):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        g = node.func
+                        gname = g.attr if isinstance(g, ast.Attribute) else (g.id if isinstance(g, ast.Name) else None)
+                        base_ok = not isinstance(g, ast.Attribute) or (
+                            isinstance(g.value, ast.Name)
+                            and g.value.id in (idx.taint_bases | idx.jax_aliases)
+                        )
+                        if gname in ("array", "asarray", "device_put") and base_ok and node.args and _nonconst_list(node.args[0]):
+                            if isinstance(g, ast.Name) and gname in ("array", "asarray"):
+                                continue  # bare array()/asarray() is not jnp's
+                            findings.append(
+                                Finding(
+                                    "JITC",
+                                    f.rel,
+                                    node.lineno,
+                                    f"{gname}() of a per-cycle Python list in '{caller.name}' (a jit call path) — "
+                                    "build it once or pack it as a bucketed tensor",
+                                )
+                            )
+    for key, kinds in site_kinds.items():
+        if kinds == {"literal", "value"}:
+            cname, pname = key
+            for f, lineno, typename in literal_sites[key]:
+                findings.append(
+                    Finding(
+                        "JITC",
+                        f.rel,
+                        lineno,
+                        f"weak-typed {typename} literal passed traced for '{pname}' of jit root '{cname}' — "
+                        "other sites pass a value; the promotion flip retraces (wrap in jnp.asarray or make it static)",
+                    )
+                )
+
+    # Pass 5 — XFER hotpath discipline.
+    for f, fn, label in hot_fns:
+        _check_hotpath(f, fn, label, indices[f.rel], root_names, stats, findings)
+
+    LAST_STATS.update(stats)
+    return findings

@@ -1,0 +1,76 @@
+"""jit-stability-smoke — the compile-cache boundedness standing gate (make check).
+
+Two contracts, runnable standalone for a verdict (exit 0 = green), the
+`make delta-smoke` pattern:
+
+  1. STATIC — the JITC/XFER analyzer rules (scripts/analyze/jitc.py) must
+     come back clean over the annotated tree: every padding dimension that
+     reaches a ``jax.jit`` root provably round-up bucketed, every declared
+     hot path free of undeclared host syncs.
+  2. STEADY — the steady-state scenario driven by the REAL ``TpuBackend``
+     (JAX on CPU — the pure-numpy NativeBackend would leave the compile
+     listener uninstalled and the gate vacuous) must pass its scorecard
+     with the ``compile`` block live (``enabled``) and FLAT: zero XLA
+     compiles after the warmup window.  This is the runtime twin of
+     contract 1 — a raw per-cycle dim the static pass missed shows up here
+     as a post-warmup retrace.
+
+Off the tier-1 clock (seconds of wall); wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def main() -> int:
+    import logging
+
+    # 1. static: the JITC/XFER rule subset over the whole tree, findings
+    # fatal (baseline pins would surface as baselined counts; there are
+    # none and this gate keeps it that way for these two families).
+    proc = subprocess.run(
+        [sys.executable, "-m", "scripts.analyze", "--rule", "JITC,XFER"],
+        capture_output=True,
+        text=True,
+    )
+    print(proc.stdout.strip() or proc.stderr.strip())
+    if proc.returncode != 0:
+        print("FAIL: JITC/XFER static analysis found compile-stability hazards", file=sys.stderr)
+        return 1
+
+    from tpu_scheduler.backends.tpu import TpuBackend
+    from tpu_scheduler.sim.harness import run_scenario
+
+    logging.getLogger("tpu_scheduler").setLevel(logging.WARNING)
+
+    # 2. steady: the scenario's pass gate REQUIRES the compile block ok,
+    # but under NativeBackend that is vacuous — drive the TpuBackend so
+    # ``enabled`` is true and the flatness assertion counts real XLA
+    # compiles.
+    card = run_scenario("steady-state", seed=0, backend=TpuBackend())
+    comp = card["compile"]
+    print(
+        f"steady-state(TpuBackend): pass={card['pass']} enabled={comp['enabled']} "
+        f"warmup_cycles={comp['warmup_cycles']} post_warmup_compiles={comp['post_warmup_compiles']}"
+    )
+    if not comp["enabled"]:
+        print("FAIL: compile listener not installed — the flatness gate is vacuous", file=sys.stderr)
+        return 1
+    if not card["pass"] or not comp["ok"]:
+        print("FAIL: steady-state scorecard (compile block) is red", file=sys.stderr)
+        return 1
+    if comp["post_warmup_compiles"] != 0:
+        print(
+            f"FAIL: {comp['post_warmup_compiles']} XLA compiles after the "
+            f"{comp['warmup_cycles']}-cycle warmup window — a shape bucket is leaking",
+            file=sys.stderr,
+        )
+        return 1
+    print("jit-stability-smoke green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1104,11 +1104,12 @@ def test_driver_rule_filter_and_json_output():
     proc = run_cli("-m", "scripts.analyze", "--rule", "THRD", "--json")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     report = json.loads(proc.stdout)
-    assert {"files", "findings", "new", "stale", "elapsed_s", "budget_s", "changed_only", "modelcheck"} == set(report)
+    assert {"files", "findings", "new", "stale", "elapsed_s", "budget_s", "changed_only", "modelcheck", "jitc"} == set(report)
     assert report["new"] == [] and report["stale"] == []
     assert all(f["rule"] == "THRD" for f in report["findings"])
     assert all(f["baselined"] for f in report["findings"])
     assert report["modelcheck"] == {}  # MODL did not run under --rule THRD
+    assert report["jitc"] == {}  # JITC did not run under --rule THRD
 
 
 def test_driver_rejects_unknown_rule():
@@ -1710,3 +1711,121 @@ def test_prot_and_modl_are_registered_and_scoped():
     # PROT rides --changed-only; MODL is full-context like EXCP.
     scoped = file_scoped_codes()
     assert "PROT" in scoped and "MODL" not in scoped
+
+
+# -- JITC compile-cache boundedness + XFER host-sync discipline ---------------
+
+from scripts.analyze import jitc  # noqa: E402
+
+
+def test_jitc_pack_unbucket_mutation_caught_once():
+    """ISSUE 20 acceptance: deleting one power-of-2 round-up under a real
+    `# bucket:` contract in pack.py must produce EXACTLY one JITC finding
+    (a raw per-cycle dim reaching the jit roots), and the committed file
+    must be clean."""
+    path = ROOT / "tpu_scheduler" / "ops" / "pack.py"
+    text = path.read_text()
+    ctx = make_ctx(("tpu_scheduler/ops/pack.py", text))
+    assert not rule_hits(jitc.run(ctx), "JITC")
+    mutated = text.replace("n_pad = round_up(n_real, node_block)", "n_pad = n_real")
+    assert mutated != text, "the node-pad round-up went missing from pack_snapshot"
+    hits = rule_hits(jitc.run(make_ctx(("tpu_scheduler/ops/pack.py", mutated))), "JITC")
+    assert len(hits) == 1 and "n_pad" in hits[0].message and "raw per-cycle value" in hits[0].message
+
+
+JITC_ROOT_BRANCH = '''from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def solve(req, n_pad, limit):
+    if limit > 0:
+        return jnp.sum(req[:n_pad])
+    return jnp.sum(req)
+'''
+
+
+def test_jitc_traced_scalar_branch_caught_and_static_guard():
+    """A Python branch on a per-call scalar inside a jit root retraces per
+    value (or crashes on a traced array); promoting the name to
+    static_argnames is the sanctioned spelling and must silence it."""
+    ctx = make_ctx(("tpu_scheduler/ops/fixture.py", JITC_ROOT_BRANCH))
+    hits = rule_hits(jitc.run(ctx), "JITC")
+    assert len(hits) == 1 and "'limit'" in hits[0].message and "static_argnames" in hits[0].message
+    fixed = JITC_ROOT_BRANCH.replace('static_argnames=("n_pad",)', 'static_argnames=("n_pad", "limit")')
+    assert not rule_hits(jitc.run(make_ctx(("tpu_scheduler/ops/fixture.py", fixed))), "JITC")
+
+
+XFER_HOTPATH = '''from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def solve(req, n_pad):
+    return jnp.sum(req)
+
+
+# hotpath: cycle-driver
+def run_cycle(req):
+    out = solve(req, n_pad=8)
+    return out.item()
+'''
+
+
+def test_xfer_hotpath_item_sync_caught_and_declared_span_guard():
+    """`.item()` on a jit-root result inside a `# hotpath:` function is a
+    hidden per-cycle device round-trip; both sanctioned spellings — a
+    `with span("...host-sync...")` block and a trailing `# host-sync:`
+    justification — must silence it."""
+    ctx = make_ctx(("tpu_scheduler/ops/fixture.py", XFER_HOTPATH))
+    hits = rule_hits(jitc.run(ctx), "XFER")
+    assert len(hits) == 1 and ".item()" in hits[0].message and "host-sync" in hits[0].message
+    justified = XFER_HOTPATH.replace("return out.item()", "return out.item()  # host-sync: verdict fetch")
+    assert not rule_hits(jitc.run(make_ctx(("tpu_scheduler/ops/fixture.py", justified))), "XFER")
+    spanned = XFER_HOTPATH.replace(
+        "    return out.item()",
+        '    with span("solve/host-sync"):\n        return out.item()',
+    )
+    assert not rule_hits(jitc.run(make_ctx(("tpu_scheduler/ops/fixture.py", spanned))), "XFER")
+
+
+def test_jitc_real_tree_is_clean_and_exports_stats():
+    """FP guard over the real annotated tree: every committed `# bucket:`
+    and `# hotpath:` contract must interpret clean, and LAST_STATS carries
+    the coverage evidence the driver folds into --json-out for bench.py
+    provenance."""
+    files = load_files(DEFAULT_PATHS)
+    ctx = Context(files=files, root=ROOT, readme="")
+    n_bucket = sum(f.text.count("# bucket:") for f in files)
+    n_hot = sum(f.text.count("# hotpath:") for f in files)
+    assert n_bucket >= 9 and n_hot >= 5, "bucket/hotpath annotations went missing"
+    hits = [f for f in jitc.run(ctx) if f.rule in ("JITC", "XFER")]
+    assert not hits, "; ".join(h.render() for h in hits)
+    stats = jitc.LAST_STATS
+    assert stats["bucket_contracts"] >= 9
+    assert stats["hotpath_contracts"] >= 5
+    assert stats["jit_roots"] >= 5
+    assert stats["root_call_sites"] >= 5
+    assert stats["allowed_syncs"] >= 1
+
+
+def test_driver_json_out_carries_jitc_stats(tmp_path):
+    out = tmp_path / "report.json"
+    proc = run_cli("-m", "scripts.analyze", "--rule", "JITC,XFER", "--json-out", str(out), "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(out.read_text())
+    assert report["jitc"]["bucket_contracts"] >= 9
+    assert report["jitc"]["jit_roots"] >= 5
+
+
+def test_jitc_and_xfer_are_registered_and_scoped():
+    codes = all_codes()
+    assert "JITC" in codes and "XFER" in codes
+    # Both interpret per-module with unresolved imports trusted, so they
+    # soundly ride the --changed-only fast path.
+    scoped = file_scoped_codes()
+    assert "JITC" in scoped and "XFER" in scoped

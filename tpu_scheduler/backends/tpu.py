@@ -225,6 +225,7 @@ class TpuBackend(SchedulingBackend):
         return buf
 
     # shape: (packed: obj, profile: obj, use_pallas: bool) -> ([P] i32, scalar i32, dict)
+    # hotpath: tpu-solve
     def _assign_once(self, packed: PackedCluster, profile: SchedulingProfile, use_pallas: bool):
         jax = self._jax
         a = packed.device_arrays()
@@ -277,7 +278,7 @@ class TpuBackend(SchedulingBackend):
         # costs ~80 ms of tunnel latency regardless of size (measured on the
         # real chip), so assigned/acc_round/rank_of/rounds ride home stacked
         # in a single [4, P] transfer instead of four round-trips.
-        combined = np.asarray(jax.device_get(_stack_results(assigned, acc_round, rank_of, rounds)))
+        combined = np.asarray(jax.device_get(_stack_results(assigned, acc_round, rank_of, rounds)))  # host-sync: the designed single [4, P] result fetch
         extras = {"acc_round": combined[1], "rank": combined[2]}
         return combined[0], int(combined[3, 0]), extras
 

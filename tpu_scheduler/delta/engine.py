@@ -147,6 +147,7 @@ class DeltaEngine:
 
     # shape: (self: obj, snapshot: obj, pending: obj, pending_all: obj,
     #   packed: obj, node_sig: obj, preempting: bool) -> obj
+    # hotpath: delta-plan
     def plan(self, snapshot, pending: list, pending_all: list, packed, node_sig, preempting: bool = False):
         """Classify this cycle: a DeltaPlan (solve the dirty set against
         carried residuals) or None (escalate to the full-wave path; the
@@ -203,6 +204,7 @@ class DeltaEngine:
 
     # -- commit -------------------------------------------------------------
 
+    # hotpath: delta-commit
     def commit(self, plan, snapshot, packed, node_sig, placed: list, unschedulable: list, pending_all: list, res_memo=None) -> None:
         """Fold the cycle's outcome back into the SolveState.  ``plan`` is
         the object this cycle ran under (None = the full-wave path ran, so

@@ -37,6 +37,7 @@ __all__ = ["compact_candidate_nodes"]
 
 
 # shape: (n: int, node_block: int) -> int
+# bucket: return
 def _bucket(n: int, node_block: int) -> int:
     """Quantized padding for the compacted axis: next power of two at or
     above ``n``, floored at one node block — few distinct jit shapes."""
@@ -55,6 +56,7 @@ def _candidate_mask(avail: np.ndarray, min_req: np.ndarray, valid: np.ndarray) -
 
 
 # shape: (packed: obj, node_block: int) -> obj
+# bucket: n_pad
 def compact_candidate_nodes(packed, node_block: int = 128):
     """Gather the candidate-node rows of every node-side tensor into a
     compact workspace (or return ``packed`` unchanged when compaction does

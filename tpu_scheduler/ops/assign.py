@@ -636,6 +636,7 @@ def _assign_epoch(
 #   cstate: dict, soft_spread: bool, soft_pa: bool, hard_pa: bool,
 #   tmeta: dict, tstate: dict)
 #   -> ([P] i32, scalar i32, [N, R] i32, [P] i32, [P] i32)
+# hotpath: epochs-driver
 def assign_cycle_epochs(
     nodes: dict,
     pods: dict,
@@ -670,7 +671,13 @@ def assign_cycle_epochs(
     p_out = pods["pod_req"].shape[0]
     perm, avail, ps, n_active_dev = _epoch_prelude(nodes, pods, block)
     p_pad = ps["pod_req"].shape[0]
-    n_active = int(n_active_dev)
+    # Enter the loop on the static upper bound instead of blocking on the
+    # prelude's device count (an XFER finding: a whole extra device
+    # round-trip per cycle before any epoch had even dispatched).  The true
+    # active count rides home in epoch 0's single per-epoch fetch below; if
+    # it is 0 the epoch's while_loop exits without running a round and the
+    # results are identical.
+    n_active = p_pad
     rounds = jnp.int32(0)
     if cmeta is not None:
         from .constraints import augment_round_state

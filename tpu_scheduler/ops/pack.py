@@ -93,6 +93,7 @@ STALL_ROUNDS = 3
 
 
 # shape: (x: int, multiple: int) -> int
+# bucket: return
 def round_up(x: int, multiple: int) -> int:
     if multiple <= 1:
         return max(x, 1)
@@ -541,6 +542,7 @@ def _avail_i32(alloc64: np.ndarray, used64: np.ndarray, res_scales: tuple[int, .
 # shape: (snapshot: obj, pod_block: int, node_block: int, label_block: int,
 #   vocab: dict, taint_vocab: dict, aff_vocab: dict, soft_taint_vocab: dict,
 #   pref_vocab: dict, res_memo: dict) -> obj
+# bucket: n_pad p_pad l_pad t_pad a_pad ts_pad a2_pad
 def pack_snapshot(
     snapshot: ClusterSnapshot,
     pod_block: int = 128,
@@ -760,13 +762,15 @@ def repack_avail(packed: PackedCluster, snapshot: ClusterSnapshot) -> PackedClus
 
 
 # shape: (arr: [N, L] f32, total: int, label_block: int) -> [N, ?] f32
+# bucket: w_pad
 def _grow_columns(arr: np.ndarray, total: int, label_block: int) -> np.ndarray:
     """Copy ``arr`` with its column count grown to cover ``total`` entries
     (padded to the block multiple).  Always copies — cached tensors may be
     aliased by checkpoints or in-flight device transfers."""
     width = arr.shape[1]
     if total > width:
-        return np.pad(arr, ((0, 0), (0, round_up(total, label_block) - width)))
+        w_pad = round_up(total, label_block)
+        return np.pad(arr, ((0, 0), (0, w_pad - width)))
     return arr.copy()
 
 
@@ -879,6 +883,7 @@ def extend_node_vocabs(packed: PackedCluster, snapshot: ClusterSnapshot, label_b
 
 # shape: (packed: obj, snapshot: obj, pod_block: int, res_memo: dict,
 #   alloc_used64: obj) -> obj
+# bucket: p_pad l_w t_w a_w ts_w a2_w
 def repack_incremental(
     packed: PackedCluster,
     snapshot: ClusterSnapshot,

@@ -351,6 +351,7 @@ def _make_choose_kernel(constrained: bool):
     return kernel
 
 
+# bucket: bp pb nbt b_pad n_pad
 @functools.partial(jax.jit, static_argnames=("pod_tile", "node_tile", "interpret", "return_best"))
 def choose_block_pallas(
     req,  # [B, 2] i32

@@ -67,6 +67,7 @@ def make_global_array(mesh, spec, arr: np.ndarray):
     return jax.make_array_from_callback(arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx])
 
 
+# bucket: n_pad extra
 def sharded_assign_multihost(
     mesh, arrays: dict, weights, max_rounds: int = 32, constraints: dict | None = None,
     soft_spread: bool = False, soft_pa: bool = False, hard_pa: bool = True,

@@ -531,6 +531,7 @@ class ShardedBackend(SchedulingBackend):
         return np.asarray(jax.device_get(assigned)), int(rounds)
 
     # shape: (packed: obj, profile: obj) -> ([P] i32, scalar i32)
+    # bucket: n_pad
     def assign(self, packed: PackedCluster, profile: SchedulingProfile) -> tuple[np.ndarray, int]:
         from ..errors import BackendUnavailable
 

@@ -2248,6 +2248,7 @@ class Scheduler:
 
     # -- the loop ----------------------------------------------------------
 
+    # hotpath: cycle-driver
     def run_cycle(self) -> CycleMetrics:
         t0 = time.perf_counter()
         self._cycle_unschedulable = []

@@ -34,13 +34,14 @@ from ..testing import make_node, make_pod
 from ..topology.locality import gang_placement_stats
 from ..topology.model import DEFAULT_LEVEL_KEYS
 from ..utils.events import waterfall
-from ..utils.profiler import tier_of
+from ..utils.profiler import compile_listener_active, compile_stats, tier_of
 from ..utils.tracing import base_name
 from .chaos import ChaosApiServer
 from .clock import VirtualClock
 from .multi import MultiReplicaHarness
 from .scenarios import SCENARIOS, Scenario
 from .scorecard import (
+    COMPILE_FIELDS,
     CONVERGENCE_FIELDS,
     ELASTICITY_FIELDS,
     _percentile,
@@ -181,6 +182,35 @@ def _profile_block(sc: Scenario, fleet: MultiReplicaHarness) -> dict:
         "cycles": cycles,
         "span_census": dict(sorted(census.items())),
     }
+
+
+def _compile_block(sc: Scenario, post_warmup_compiles: int) -> dict:
+    """The scorecard ``compile`` verdict — the runtime twin of the JITC
+    static pass (scripts/analyze/jitc.py): after ``compile_warmup_cycles``
+    cycles every shape bucket must already be traced, so a later XLA
+    compile means a raw per-cycle dimension leaked into a jit signature.
+
+    Deterministic by construction: the block carries only the warmup-window
+    LENGTH and the POST-warmup compile count — never the warmup compile
+    count itself, which differs between a cold record (every bucket traces)
+    and a warm replay (the in-process cache is already primed).  A PASSING
+    run has ``post_warmup_compiles == 0`` in both, so the gate preserves
+    record→replay bit-identity.  Under the pure-numpy NativeBackend the
+    listener never installs and the count is vacuously zero; ``enabled``
+    says so and ``ok`` stays green — the jit-stability smoke drives the
+    TpuBackend to make this gate bite."""
+    enabled = compile_listener_active()
+    flat = int(post_warmup_compiles) == 0
+    out = {
+        "enabled": bool(enabled),
+        "required": bool(sc.compile_required),
+        "warmup_cycles": int(sc.compile_warmup_cycles),
+        "post_warmup_compiles": int(post_warmup_compiles),
+        "steady_flat": flat,
+        "ok": flat or not enabled,
+    }
+    assert tuple(out) == COMPILE_FIELDS, "compile block drifted from COMPILE_FIELDS"
+    return out
 
 
 # shape: (sc: obj, fleet: obj, st: obj) -> obj
@@ -921,6 +951,10 @@ def scenario_episode(
     cycles = 0
     no_progress = 0
     max_pending = 0
+    # Compile-flatness bookkeeping: the process-global compile count at the
+    # warmup-cycle boundary.  None until the run crosses it (a run shorter
+    # than the warmup window is all-warmup: post-warmup count 0).
+    warmup_compile_mark: int | None = None
     hard_cap = int(3 * sc.duration / sc.cycle_interval) + 400
     while True:
         now = clock.now
@@ -963,6 +997,8 @@ def scenario_episode(
 
         fleet.step()
         cycles += 1
+        if warmup_compile_mark is None and cycles >= sc.compile_warmup_cycles:
+            warmup_compile_mark = int(compile_stats()["compiles"])
         new_binds = fold_outcomes()
         pending = len(inner.list_pods("status.phase=Pending"))
         max_pending = max(max_pending, pending)
@@ -1059,6 +1095,10 @@ def scenario_episode(
         convergence=_convergence_block(sc, fleet, inner, pending_final, end_t),
         locality=_locality_block(sc, st),
         profile=_profile_block(sc, fleet),
+        compile=_compile_block(
+            sc,
+            0 if warmup_compile_mark is None else int(compile_stats()["compiles"]) - warmup_compile_mark,
+        ),
         incremental=_incremental_block(sc, fleet),
         rebalance=_rebalance_block(
             sc,

@@ -53,6 +53,17 @@ class Scenario:
     # regression (a new unattributed cycle region) fails the run like an
     # SLO regression does.
     profile_required: bool = False
+    # Compile-cache flatness (the scorecard ``compile`` block — the runtime
+    # twin of the JITC static pass): ``compile_required`` gates the
+    # scorecard pass on ZERO XLA compiles after the first
+    # ``compile_warmup_cycles`` cycles.  Shape buckets are all traced
+    # during warmup; a later compile means a raw per-cycle dim leaked into
+    # a jit signature (a retrace leak the static pass missed).  Vacuously
+    # green under the pure-numpy NativeBackend (the block's ``enabled`` bit
+    # says so) — the jit-stability smoke drives the TpuBackend on CPU to
+    # make the gate bite.
+    compile_required: bool = False
+    compile_warmup_cycles: int = 24
     # Incremental delta engine (tpu_scheduler/delta): ``delta_shadow_every``
     # > 0 runs the full-wave shadow solve beside every Nth delta cycle and
     # records placed-set parity; ``incremental_required`` gates the
@@ -151,6 +162,7 @@ _register(
         ),
         profile_required=True,
         latency_required=True,
+        compile_required=True,
     )
 )
 
@@ -450,6 +462,7 @@ _register(
         ),
         delta_shadow_every=8,
         incremental_required=True,
+        compile_required=True,
     )
 )
 

@@ -34,6 +34,7 @@ __all__ = [
     "ELASTICITY_FIELDS",
     "LATENCY_FIELDS",
     "CONVERGENCE_FIELDS",
+    "COMPILE_FIELDS",
     "check_invariants",
     "build_scorecard",
     "build_latency_block",
@@ -57,6 +58,7 @@ SCORECARD_FIELDS = (
     "convergence",
     "locality",
     "profile",
+    "compile",
     "incremental",
     "rebalance",
     "elasticity",
@@ -115,6 +117,30 @@ REBALANCE_FIELDS = (
     "migration_budget",
     "preemption_churn",
     "whatif",
+    "ok",
+)
+
+
+# The closed schema of the ``compile`` block (drift-gated against the
+# README "Simulation & chaos" catalogue like every scorecard field).  The
+# runtime twin of the JITC static pass (scripts/analyze/jitc.py): bucket
+# discipline statically proven bounded must also be DYNAMICALLY flat — the
+# XLA compile count (the PR-8 jax.monitoring listener,
+# utils/profiler.compile_stats) may grow only during the warmup window
+# while shape buckets are first traced; a single post-warmup compile is a
+# retrace leak and fails compile-required scenarios.  Deliberately
+# environment-robust: the block carries the warmup-window LENGTH (scenario
+# config) and the POST-warmup count (0 in any healthy run, warm or cold
+# cache) but never the warmup compile count itself — that number differs
+# between a cold record and a warm same-process replay, and the scorecard
+# must stay bit-identical across record→replay (the same reasoning that
+# keeps ``compile`` spans out of the profile block's census).
+COMPILE_FIELDS = (
+    "enabled",
+    "required",
+    "warmup_cycles",
+    "post_warmup_compiles",
+    "steady_flat",
     "ok",
 )
 
@@ -371,6 +397,7 @@ def build_scorecard(
     convergence: dict,
     locality: dict,
     profile: dict,
+    compile: dict,
     incremental: dict,
     rebalance: dict,
     elasticity: dict,
@@ -433,6 +460,12 @@ def build_scorecard(
             and not (locality.get("required") and locality.get("cross_rack_gangs", 0) != 0)
             and not (availability.get("enabled") and not availability.get("ok"))
             and not (profile.get("required") and not profile.get("coverage_ok"))
+            # Compile-required scenarios additionally gate on the compile
+            # block's ok: the XLA compile count must go FLAT after the
+            # warmup window — one post-warmup compile is a shape-bucket
+            # retrace leak (the runtime twin of the JITC static pass) and
+            # fails the run like an SLO regression does.
+            and not (compile.get("required") and not compile.get("ok"))
             and not (incremental.get("required") and not incremental.get("ok"))
             # Rebalance-required scenarios additionally gate on the
             # rebalance block's ok: final packing efficiency past the
@@ -476,6 +509,7 @@ def build_scorecard(
         "convergence": convergence,
         "locality": locality,
         "profile": profile,
+        "compile": compile,
         "incremental": incremental,
         "rebalance": rebalance,
         "elasticity": elasticity,

@@ -465,6 +465,13 @@ def compile_stats() -> dict:
         return dict(_compile_stats)
 
 
+def compile_listener_active() -> bool:
+    """Whether the jax.monitoring compile listener is counting — the
+    scorecard ``compile`` block's ``enabled`` bit (False means the counts
+    are vacuously zero, e.g. a pure-numpy NativeBackend run)."""
+    return bool(_hooks_installed[0])
+
+
 def _on_event_duration(event: str, duration: float, **_kw) -> None:
     """jax.monitoring duration listener: XLA backend compiles become
     ``compile`` spans of the active trace (attributed wherever the trace was
